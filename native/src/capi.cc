@@ -1,0 +1,158 @@
+// C ABI exposing the coordination core to Python via ctypes.
+//
+// The role of the reference's PyO3 binding layer (src/lib.rs:710-726), minus
+// codegen: requests/responses cross the boundary as serialized protobuf bytes
+// which the Python side builds/parses with the generated tpuft_pb2 module.
+// ctypes releases the GIL for the duration of every call, matching the
+// reference's `py.allow_threads` usage (src/lib.rs:186-200).
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "lighthouse.h"
+#include "manager.h"
+#include "store.h"
+#include "wire.h"
+
+using namespace tpuft;
+
+namespace {
+
+char* CopyString(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.data(), s.size());
+  out[s.size()] = '\0';
+  return out;
+}
+
+void SetErr(char** err, const std::string& msg) {
+  if (err) *err = CopyString(msg);
+}
+
+}  // namespace
+
+extern "C" {
+
+void tf_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------------------
+// Lighthouse server
+// ---------------------------------------------------------------------------
+
+void* tf_lighthouse_new(const char* bind, const char* http_bind, uint64_t min_replicas,
+                        uint64_t join_timeout_ms, uint64_t quorum_tick_ms,
+                        uint64_t heartbeat_timeout_ms, char** err) {
+  LighthouseOpt opt;
+  opt.bind = bind;
+  opt.http_bind = http_bind ? http_bind : "";
+  opt.min_replicas = min_replicas;
+  opt.join_timeout_ms = join_timeout_ms;
+  opt.quorum_tick_ms = quorum_tick_ms;
+  opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+  auto* lh = new Lighthouse(opt);
+  std::string e;
+  if (!lh->Start(&e)) {
+    SetErr(err, e);
+    delete lh;
+    return nullptr;
+  }
+  return lh;
+}
+
+char* tf_lighthouse_address(void* p) { return CopyString(static_cast<Lighthouse*>(p)->address()); }
+
+char* tf_lighthouse_http_address(void* p) {
+  return CopyString(static_cast<Lighthouse*>(p)->http_address());
+}
+
+void tf_lighthouse_shutdown(void* p) { static_cast<Lighthouse*>(p)->Shutdown(); }
+
+void tf_lighthouse_free(void* p) { delete static_cast<Lighthouse*>(p); }
+
+// ---------------------------------------------------------------------------
+// Manager server
+// ---------------------------------------------------------------------------
+
+void* tf_manager_new(const char* replica_id, const char* lighthouse_addr, const char* bind,
+                     const char* store_addr, uint64_t world_size, uint64_t heartbeat_interval_ms,
+                     uint64_t connect_timeout_ms, char** err) {
+  ManagerOpt opt;
+  opt.replica_id = replica_id;
+  opt.lighthouse_addr = lighthouse_addr;
+  opt.bind = bind;
+  opt.store_addr = store_addr ? store_addr : "";
+  opt.world_size = world_size;
+  opt.heartbeat_interval_ms = heartbeat_interval_ms;
+  opt.connect_timeout_ms = connect_timeout_ms;
+  auto* m = new ManagerServer(opt);
+  std::string e;
+  if (!m->Start(&e)) {
+    SetErr(err, e);
+    delete m;
+    return nullptr;
+  }
+  return m;
+}
+
+char* tf_manager_address(void* p) { return CopyString(static_cast<ManagerServer*>(p)->address()); }
+
+void tf_manager_shutdown(void* p) { static_cast<ManagerServer*>(p)->Shutdown(); }
+
+void tf_manager_free(void* p) { delete static_cast<ManagerServer*>(p); }
+
+// ---------------------------------------------------------------------------
+// Store server
+// ---------------------------------------------------------------------------
+
+void* tf_store_new(const char* bind, char** err) {
+  auto* s = new StoreServer(bind);
+  std::string e;
+  if (!s->Start(&e)) {
+    SetErr(err, e);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+char* tf_store_address(void* p) { return CopyString(static_cast<StoreServer*>(p)->address()); }
+
+void tf_store_shutdown(void* p) { static_cast<StoreServer*>(p)->Shutdown(); }
+
+void tf_store_free(void* p) { delete static_cast<StoreServer*>(p); }
+
+// ---------------------------------------------------------------------------
+// Generic RPC client (lighthouse / manager / store methods alike)
+// ---------------------------------------------------------------------------
+
+void* tf_client_new(const char* addr, uint64_t connect_timeout_ms, char** err) {
+  auto* c = new RpcClient(addr);
+  std::string e;
+  if (c->Connect(connect_timeout_ms, &e) != Status::kOk) {
+    SetErr(err, e);
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+// Returns the wire status code; on kOk fills resp/resp_len (malloc'd), else err.
+int tf_client_call(void* p, uint16_t method, const uint8_t* req, size_t req_len,
+                   uint64_t timeout_ms, uint8_t** resp, size_t* resp_len, char** err) {
+  auto* c = static_cast<RpcClient*>(p);
+  std::string request(reinterpret_cast<const char*>(req), req_len);
+  std::string response, e;
+  Status st = c->Call(method, request, timeout_ms, &response, &e);
+  if (st == Status::kOk) {
+    *resp = static_cast<uint8_t*>(malloc(response.size() ? response.size() : 1));
+    memcpy(*resp, response.data(), response.size());
+    *resp_len = response.size();
+  } else {
+    SetErr(err, e.empty() ? StatusName(st) : e);
+  }
+  return static_cast<int>(st);
+}
+
+void tf_client_free(void* p) { delete static_cast<RpcClient*>(p); }
+
+}  // extern "C"

@@ -1,0 +1,188 @@
+#include "http.h"
+
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "wire.h"
+
+namespace tpuft {
+
+namespace {
+
+// Reads until "\r\n\r\n" plus Content-Length body. Very small requests only.
+bool ReadRequest(int fd, std::string* method, std::string* path, std::string* body) {
+  std::string buf;
+  char tmp[4096];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    if (poll(&pfd, 1, 10000) <= 0) return false;
+    ssize_t r = recv(fd, tmp, sizeof(tmp), 0);
+    if (r <= 0) return false;
+    buf.append(tmp, static_cast<size_t>(r));
+    if (buf.size() > (1u << 20)) return false;
+    header_end = buf.find("\r\n\r\n");
+  }
+  auto line_end = buf.find("\r\n");
+  std::string request_line = buf.substr(0, line_end);
+  auto sp1 = request_line.find(' ');
+  auto sp2 = request_line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == std::string::npos || sp2 <= sp1) return false;
+  *method = request_line.substr(0, sp1);
+  *path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+
+  size_t content_length = 0;
+  std::string headers = buf.substr(0, header_end);
+  for (char& c : headers) c = static_cast<char>(tolower(c));
+  auto cl = headers.find("content-length:");
+  if (cl != std::string::npos) {
+    content_length = static_cast<size_t>(atoll(headers.c_str() + cl + 15));
+    if (content_length > (1u << 20)) return false;
+  }
+  std::string have = buf.substr(header_end + 4);
+  while (have.size() < content_length) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    if (poll(&pfd, 1, 10000) <= 0) return false;
+    ssize_t r = recv(fd, tmp, sizeof(tmp), 0);
+    if (r <= 0) return false;
+    have.append(tmp, static_cast<size_t>(r));
+  }
+  *body = have.substr(0, content_length);
+  return true;
+}
+
+void WriteResponse(int fd, const HttpResponse& resp) {
+  const char* reason = resp.code == 200 ? "OK" : (resp.code == 404 ? "Not Found" : "Error");
+  std::string out = "HTTP/1.1 " + std::to_string(resp.code) + " " + reason +
+                    "\r\nContent-Type: " + resp.content_type +
+                    "\r\nContent-Length: " + std::to_string(resp.body.size()) +
+                    "\r\nConnection: close\r\n\r\n" + resp.body;
+  size_t sent = 0;
+  while (sent < out.size()) {
+    ssize_t r = send(fd, out.data() + sent, out.size() - sent, MSG_NOSIGNAL);
+    if (r <= 0) return;
+    sent += static_cast<size_t>(r);
+  }
+}
+
+}  // namespace
+
+HttpServer::HttpServer(std::string bind, HttpHandler handler)
+    : bind_(std::move(bind)), handler_(std::move(handler)) {}
+
+HttpServer::~HttpServer() { Shutdown(); }
+
+bool HttpServer::Start(std::string* err) {
+  SockAddr sa;
+  if (!ParseAddress(bind_, &sa, err)) return false;
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  hints.ai_flags = AI_PASSIVE;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(sa.port);
+  const char* node = sa.host.empty() || sa.host == "::" || sa.host == "0.0.0.0"
+                         ? nullptr
+                         : sa.host.c_str();
+  int rc = getaddrinfo(node, port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    if (err) *err = std::string("getaddrinfo: ") + gai_strerror(rc);
+    return false;
+  }
+  int fd = -1;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (ai->ai_family == AF_INET6) {
+      int zero = 0;
+      setsockopt(fd, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
+    }
+    if (bind(fd, ai->ai_addr, ai->ai_addrlen) == 0 && listen(fd, 1024) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    if (err) *err = "failed to bind http " + bind_ + ": " + strerror(errno);
+    return false;
+  }
+  listen_fd_ = fd;
+  struct sockaddr_storage bound = {};
+  socklen_t blen = sizeof(bound);
+  getsockname(fd, reinterpret_cast<struct sockaddr*>(&bound), &blen);
+  uint16_t port = bound.ss_family == AF_INET6
+                      ? ntohs(reinterpret_cast<struct sockaddr_in6*>(&bound)->sin6_port)
+                      : ntohs(reinterpret_cast<struct sockaddr_in*>(&bound)->sin_port);
+  std::string host = sa.host;
+  if (host.empty() || host == "::" || host == "0.0.0.0") {
+    char name[256];
+    host = gethostname(name, sizeof(name)) == 0 ? name : "localhost";
+  }
+  address_ = "http://" + (host.find(':') != std::string::npos ? "[" + host + "]" : host) + ":" +
+             std::to_string(port);
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  return true;
+}
+
+void HttpServer::AcceptLoop() {
+  while (!shutdown_.load()) {
+    struct pollfd pfd = {listen_fd_, POLLIN, 0};
+    if (poll(&pfd, 1, 100) <= 0) continue;
+    int cfd = accept(listen_fd_, nullptr, nullptr);
+    if (cfd < 0) continue;
+    std::lock_guard<std::mutex> lk(conns_mu_);
+    if (shutdown_.load()) {
+      close(cfd);
+      break;
+    }
+    conns_[cfd] = std::make_shared<std::thread>([this, cfd] { Serve(cfd); });
+  }
+}
+
+void HttpServer::Serve(int fd) {
+  std::string method, path, body;
+  if (ReadRequest(fd, &method, &path, &body)) {
+    HttpResponse resp;
+    try {
+      resp = handler_(method, path, body);
+    } catch (const std::exception& e) {
+      resp.code = 500;
+      resp.body = e.what();
+      resp.content_type = "text/plain";
+    }
+    WriteResponse(fd, resp);
+  }
+  close(fd);
+  std::lock_guard<std::mutex> lk(conns_mu_);
+  auto it = conns_.find(fd);
+  if (it != conns_.end()) {
+    it->second->detach();
+    conns_.erase(it);
+  }
+}
+
+void HttpServer::Shutdown() {
+  if (shutdown_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  std::map<int, std::shared_ptr<std::thread>> conns;
+  {
+    std::lock_guard<std::mutex> lk(conns_mu_);
+    conns.swap(conns_);
+  }
+  for (auto& [fd, th] : conns) ::shutdown(fd, SHUT_RDWR);
+  for (auto& [fd, th] : conns)
+    if (th->joinable()) th->join();
+}
+
+}  // namespace tpuft

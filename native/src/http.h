@@ -1,0 +1,47 @@
+// Minimal HTTP/1.1 server for the Lighthouse dashboard and ops endpoints.
+// Reference parity: the axum routes in src/lighthouse.rs:349-367.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace tpuft {
+
+struct HttpResponse {
+  int code = 200;
+  std::string content_type = "text/html; charset=utf-8";
+  std::string body;
+};
+
+// (method, path, body) -> response.
+using HttpHandler = std::function<HttpResponse(const std::string& method, const std::string& path,
+                                               const std::string& body)>;
+
+class HttpServer {
+ public:
+  HttpServer(std::string bind, HttpHandler handler);
+  ~HttpServer();
+  bool Start(std::string* err);
+  void Shutdown();
+  std::string address() const { return address_; }
+
+ private:
+  void AcceptLoop();
+  void Serve(int fd);
+
+  std::string bind_;
+  HttpHandler handler_;
+  int listen_fd_ = -1;
+  std::string address_;
+  std::atomic<bool> shutdown_{false};
+  std::thread accept_thread_;
+  std::mutex conns_mu_;
+  std::map<int, std::shared_ptr<std::thread>> conns_;
+};
+
+}  // namespace tpuft

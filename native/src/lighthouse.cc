@@ -1,0 +1,448 @@
+#include "lighthouse.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <set>
+#include <sstream>
+
+#include "http.h"
+#include "log.h"
+
+namespace tpuft {
+
+int64_t NowEpochMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// Pure quorum math.  Reference parity: quorum_compute, src/lighthouse.rs:133-261.
+// Semantics (in evaluation order):
+//   1. only replicas with a fresh heartbeat are candidates;
+//   2. if any candidate requests shrink_only, membership may not grow beyond
+//      the previous quorum;
+//   3. "fast quorum": if every member of the previous quorum has re-joined
+//      and is healthy, form the quorum immediately (steady-state path);
+//   4. otherwise require >= min_replicas, and a strict majority of all
+//      currently-heartbeating replicas (split-brain guard);
+//   5. wait join_timeout (measured from the round's first joiner) for healthy
+//      stragglers that have not re-joined yet, unless all have joined.
+// ---------------------------------------------------------------------------
+std::optional<std::vector<QuorumMember>> QuorumCompute(TimePoint now, const QuorumState& state,
+                                                       const LighthouseOpt& opt,
+                                                       std::string* reason) {
+  auto hb_timeout = std::chrono::milliseconds(opt.heartbeat_timeout_ms);
+
+  std::set<std::string> healthy;
+  for (const auto& [id, last] : state.heartbeats) {
+    if (now - last < hb_timeout) healthy.insert(id);
+  }
+
+  std::vector<QuorumMember> candidates;
+  bool shrink_only = false;
+  for (const auto& [id, j] : state.participants) {
+    if (!healthy.count(id)) continue;
+    candidates.push_back(j.member);
+    if (j.member.shrink_only()) shrink_only = true;
+  }
+
+  std::set<std::string> prev_ids;
+  if (state.prev_quorum) {
+    for (const auto& m : state.prev_quorum->participants()) prev_ids.insert(m.replica_id());
+  }
+
+  if (shrink_only && state.prev_quorum) {
+    std::vector<QuorumMember> shrunk;
+    for (auto& m : candidates) {
+      if (prev_ids.count(m.replica_id())) shrunk.push_back(m);
+    }
+    candidates = std::move(shrunk);
+  }
+
+  std::sort(candidates.begin(), candidates.end(),
+            [](const QuorumMember& a, const QuorumMember& b) {
+              return a.replica_id() < b.replica_id();
+            });
+
+  std::set<std::string> candidate_ids;
+  for (const auto& m : candidates) candidate_ids.insert(m.replica_id());
+
+  if (candidates.size() < opt.min_replicas) {
+    if (reason) {
+      *reason = "need at least " + std::to_string(opt.min_replicas) + " replicas, have " +
+                std::to_string(candidates.size());
+    }
+    return std::nullopt;
+  }
+
+  // Fast quorum: every previous member is healthy and has re-joined.
+  bool fast = state.prev_quorum && !prev_ids.empty() &&
+              std::all_of(prev_ids.begin(), prev_ids.end(), [&](const std::string& id) {
+                return candidate_ids.count(id) > 0;
+              });
+  if (fast) {
+    if (reason) *reason = "fast quorum (all previous members present)";
+    return candidates;
+  }
+
+  // Split-brain guard: require a strict majority of everything heartbeating.
+  if (candidates.size() * 2 <= healthy.size()) {
+    if (reason) {
+      *reason = "potential split brain: only " + std::to_string(candidates.size()) + " of " +
+                std::to_string(healthy.size()) + " healthy replicas joined";
+    }
+    return std::nullopt;
+  }
+
+  // All healthy replicas joined -> no reason to wait.
+  bool all_joined = std::all_of(healthy.begin(), healthy.end(), [&](const std::string& id) {
+    return state.participants.count(id) > 0 ||
+           (shrink_only && !prev_ids.count(id));
+  });
+  if (all_joined) {
+    if (reason) *reason = "quorum (all healthy replicas joined)";
+    return candidates;
+  }
+
+  // Wait for stragglers up to join_timeout from the round's first joiner.
+  TimePoint first_join = TimePoint::max();
+  for (const auto& [id, j] : state.participants) {
+    first_join = std::min(first_join, j.joined_at);
+  }
+  if (first_join != TimePoint::max() &&
+      now - first_join >= std::chrono::milliseconds(opt.join_timeout_ms)) {
+    if (reason) *reason = "quorum (join timeout elapsed, proceeding without stragglers)";
+    return candidates;
+  }
+  if (reason) {
+    *reason = "waiting for stragglers to join (" + std::to_string(candidates.size()) + "/" +
+              std::to_string(healthy.size()) + " healthy joined)";
+  }
+  return std::nullopt;
+}
+
+// ---------------------------------------------------------------------------
+// Lighthouse server
+// ---------------------------------------------------------------------------
+
+Lighthouse::Lighthouse(LighthouseOpt opt) : opt_(std::move(opt)) {}
+
+Lighthouse::~Lighthouse() { Shutdown(); }
+
+bool Lighthouse::Start(std::string* err) {
+  server_ = std::make_unique<RpcServer>(
+      opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl, std::string* resp) {
+        return Dispatch(method, req, dl, resp);
+      });
+  if (!server_->Start(err)) return false;
+  if (!opt_.http_bind.empty()) {
+    http_ = std::make_unique<HttpServer>(
+        opt_.http_bind,
+        [this](const std::string& method, const std::string& path, const std::string&) {
+          HttpResponse r;
+          if (method == "GET" && (path == "/" || path == "/status")) {
+            r.body = StatusHtml();
+          } else if (method == "GET" && path == "/status.json") {
+            r.content_type = "application/json";
+            r.body = StatusJson();
+          } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
+                     path.size() > 14 && path.substr(path.size() - 5) == "/kill") {
+            std::string replica_id = path.substr(9, path.size() - 9 - 5);
+            std::string kerr;
+            if (KillReplica(replica_id, &kerr)) {
+              r.body = "killed " + replica_id;
+              r.content_type = "text/plain";
+            } else {
+              r.code = 500;
+              r.body = kerr;
+              r.content_type = "text/plain";
+            }
+          } else {
+            r.code = 404;
+            r.body = "not found";
+            r.content_type = "text/plain";
+          }
+          return r;
+        });
+    if (!http_->Start(err)) return false;
+  }
+  tick_thread_ = std::thread([this] { TickLoop(); });
+  LOGI("lighthouse listening on %s (dashboard %s)", server_->address().c_str(),
+       http_ ? http_->address().c_str() : "disabled");
+  return true;
+}
+
+void Lighthouse::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_) return;
+    shutdown_ = true;
+    quorum_cv_.notify_all();
+  }
+  if (tick_thread_.joinable()) tick_thread_.join();
+  if (server_) server_->Shutdown();
+  if (http_) http_->Shutdown();
+}
+
+std::string Lighthouse::address() const { return server_ ? server_->address() : ""; }
+std::string Lighthouse::http_address() const { return http_ ? http_->address() : ""; }
+
+Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl,
+                            std::string* resp) {
+  switch (method) {
+    case kLighthouseQuorum: {
+      LighthouseQuorumRequest q;
+      if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      LighthouseQuorumResponse r;
+      std::string err;
+      Status st = HandleQuorum(q, dl, &r, &err);
+      if (st != Status::kOk) {
+        *resp = err;
+        return st;
+      }
+      r.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kLighthouseHeartbeat: {
+      LighthouseHeartbeatRequest h;
+      if (!h.ParseFromString(req)) return Status::kInvalidArgument;
+      Status st = HandleHeartbeat(h);
+      LighthouseHeartbeatResponse r;
+      r.SerializeToString(resp);
+      return st;
+    }
+    case kLighthouseStatus: {
+      LighthouseStatusResponse r;
+      FillStatus(&r);
+      r.SerializeToString(resp);
+      return Status::kOk;
+    }
+    default:
+      *resp = "unknown lighthouse method " + std::to_string(method);
+      return Status::kUnknown;
+  }
+}
+
+Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
+  std::lock_guard<std::mutex> lk(mu_);
+  state_.heartbeats[req.replica_id()] = Clock::now();
+  return Status::kOk;
+}
+
+Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline deadline,
+                                LighthouseQuorumResponse* resp, std::string* err) {
+  const std::string& id = req.requester().replica_id();
+  if (id.empty()) {
+    *err = "replica_id must be set";
+    return Status::kInvalidArgument;
+  }
+  std::unique_lock<std::mutex> lk(mu_);
+  // Joining is an implicit heartbeat (reference: src/lighthouse.rs:480-491).
+  state_.heartbeats[id] = Clock::now();
+  state_.participants[id] = QuorumState::Joined{req.requester(), Clock::now()};
+  // Only quorums broadcast after this join count — a stale quorum from a
+  // previous round must not satisfy this request.
+  int64_t start_gen = quorum_gen_;
+  TickLocked();
+
+  // Wait for a quorum broadcast that includes the requester; a member may be
+  // excluded from the quorum its own join triggered (e.g. shrink_only), in
+  // which case it keeps waiting for a later round (src/lighthouse.rs:494-530).
+  while (true) {
+    if (latest_quorum_ && quorum_gen_ > start_gen) {
+      for (const auto& m : latest_quorum_->participants()) {
+        if (m.replica_id() == id) {
+          *resp->mutable_quorum() = *latest_quorum_;
+          return Status::kOk;
+        }
+      }
+    }
+    int64_t gen = quorum_gen_;
+    bool woke = quorum_cv_.wait_until(lk, deadline.at, [&] {
+      return quorum_gen_ != gen || shutdown_;
+    });
+    if (shutdown_) {
+      *err = "lighthouse shutting down";
+      return Status::kUnavailable;
+    }
+    if (!woke && deadline.expired()) {
+      *err = "timed out waiting for quorum";
+      return Status::kDeadlineExceeded;
+    }
+  }
+}
+
+void Lighthouse::TickLoop() {
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (shutdown_) return;
+      TickLocked();
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(opt_.quorum_tick_ms));
+  }
+}
+
+void Lighthouse::TickLocked() {
+  std::string reason;
+  auto members = QuorumCompute(Clock::now(), state_, opt_, &reason);
+  if (reason != last_reason_) {
+    LOGI("lighthouse: %s", reason.c_str());
+    last_reason_ = reason;
+  }
+  if (!members) return;
+
+  // Bump the quorum id only when membership changed
+  // (reference: src/lighthouse.rs:288-304).
+  bool changed = true;
+  if (state_.prev_quorum) {
+    const auto& prev = state_.prev_quorum->participants();
+    if (static_cast<size_t>(prev.size()) == members->size()) {
+      changed = false;
+      for (int i = 0; i < prev.size(); ++i) {
+        if (prev[i].replica_id() != (*members)[i].replica_id()) {
+          changed = true;
+          break;
+        }
+      }
+    }
+  }
+  if (changed) state_.quorum_id += 1;
+
+  Quorum q;
+  q.set_quorum_id(state_.quorum_id);
+  q.set_created_ms(NowEpochMs());
+  for (const auto& m : *members) *q.add_participants() = m;
+
+  state_.prev_quorum = q;
+  // Every replica must re-join for the next round (src/lighthouse.rs:314-319).
+  state_.participants.clear();
+  latest_quorum_ = q;
+  quorum_gen_ += 1;
+  quorum_cv_.notify_all();
+  LOGI("lighthouse: formed quorum %lld with %d participants",
+       static_cast<long long>(state_.quorum_id), q.participants_size());
+}
+
+void Lighthouse::FillStatus(LighthouseStatusResponse* resp) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (state_.prev_quorum) *resp->mutable_prev_quorum() = *state_.prev_quorum;
+  for (const auto& [id, j] : state_.participants) *resp->add_pending_participants() = j.member;
+  auto now = Clock::now();
+  for (const auto& [id, last] : state_.heartbeats) {
+    (*resp->mutable_heartbeat_age_ms())[id] =
+        std::chrono::duration_cast<std::chrono::milliseconds>(now - last).count();
+  }
+  resp->set_quorum_id(state_.quorum_id);
+}
+
+bool Lighthouse::KillReplica(const std::string& replica_id, std::string* err) {
+  std::string address;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (state_.prev_quorum) {
+      for (const auto& m : state_.prev_quorum->participants()) {
+        if (m.replica_id() == replica_id) address = m.address();
+      }
+    }
+    for (const auto& [id, j] : state_.participants) {
+      if (id == replica_id) address = j.member.address();
+    }
+  }
+  if (address.empty()) {
+    if (err) *err = "unknown replica " + replica_id;
+    return false;
+  }
+  RpcClient client(address);
+  KillRequest kreq;
+  kreq.set_msg("killed from lighthouse dashboard");
+  std::string payload, resp;
+  kreq.SerializeToString(&payload);
+  // The manager exits inside the handler, so the connection usually drops
+  // before a response arrives; any outcome but a clean error is success.
+  client.Call(kManagerKill, payload, 5000, &resp, err);
+  return true;
+}
+
+namespace {
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+}  // namespace
+
+std::string Lighthouse::StatusJson() {
+  LighthouseStatusResponse s;
+  FillStatus(&s);
+  std::ostringstream o;
+  o << "{\"quorum_id\":" << s.quorum_id() << ",\"participants\":[";
+  bool first = true;
+  for (const auto& m : s.prev_quorum().participants()) {
+    if (!first) o << ",";
+    first = false;
+    o << "{\"replica_id\":\"" << JsonEscape(m.replica_id()) << "\",\"address\":\""
+      << JsonEscape(m.address()) << "\",\"step\":" << m.step()
+      << ",\"world_size\":" << m.world_size() << "}";
+  }
+  o << "],\"pending\":[";
+  first = true;
+  for (const auto& m : s.pending_participants()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(m.replica_id()) << "\"";
+  }
+  o << "],\"heartbeat_age_ms\":{";
+  first = true;
+  for (const auto& [id, age] : s.heartbeat_age_ms()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\":" << age;
+  }
+  o << "}}";
+  return o.str();
+}
+
+std::string Lighthouse::StatusHtml() {
+  LighthouseStatusResponse s;
+  FillStatus(&s);
+  int64_t max_step = 0;
+  for (const auto& m : s.prev_quorum().participants()) max_step = std::max(max_step, m.step());
+  std::ostringstream o;
+  o << "<!DOCTYPE html><html><head><title>tpu-ft lighthouse</title>"
+       "<meta http-equiv=\"refresh\" content=\"1\">"
+       "<style>body{font-family:monospace;background:#111;color:#eee;margin:2em}"
+       ".card{border:1px solid #444;border-radius:6px;padding:1em;margin:.5em;display:inline-block;"
+       "min-width:18em;vertical-align:top}"
+       ".recovering{border-color:orange}.stale{color:#f66}"
+       "button{background:#a33;color:#fff;border:0;padding:.3em .8em;border-radius:4px;"
+       "cursor:pointer}</style></head><body>"
+       "<h1>tpu-ft lighthouse</h1>";
+  o << "<p>quorum_id: " << s.quorum_id() << " &mdash; " << s.prev_quorum().participants_size()
+    << " participants, " << s.pending_participants_size() << " pending</p>";
+  for (const auto& m : s.prev_quorum().participants()) {
+    bool recovering = m.step() != max_step;
+    int64_t age = -1;
+    auto it = s.heartbeat_age_ms().find(m.replica_id());
+    if (it != s.heartbeat_age_ms().end()) age = it->second;
+    o << "<div class=\"card" << (recovering ? " recovering" : "") << "\"><b>" << m.replica_id()
+      << "</b><br>step: " << m.step() << (recovering ? " (recovering)" : "")
+      << "<br>world_size: " << m.world_size() << "<br>manager: " << m.address()
+      << "<br><span class=\"" << (age > 2500 ? "stale" : "") << "\">heartbeat: " << age
+      << " ms ago</span><br><form method=\"post\" action=\"/replica/" << m.replica_id()
+      << "/kill\"><button>Kill</button></form></div>";
+  }
+  o << "</body></html>";
+  return o.str();
+}
+
+}  // namespace tpuft

@@ -1,0 +1,111 @@
+// Lighthouse: global membership + quorum service.
+//
+// Reference parity: src/lighthouse.rs.  Tracks per-replica heartbeats, admits
+// participants per quorum round, computes a quorum on a periodic tick (and on
+// every join), bumps the quorum id only when membership changes, broadcasts
+// the new quorum to every blocked Quorum RPC caller, serves an HTML/JSON
+// dashboard, and can kill replicas through their Manager.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpuft.pb.h"
+#include "wire.h"
+
+namespace tpuft {
+
+class HttpServer;
+
+struct LighthouseOpt {
+  // RPC bind address, e.g. "[::]:0".
+  std::string bind = "[::]:0";
+  // Dashboard HTTP bind address; empty disables the dashboard.
+  std::string http_bind = "[::]:0";
+  uint64_t min_replicas = 1;
+  // How long to wait for stragglers after the first joiner of a round.
+  // Reference default: 60 s (src/lighthouse.rs:97-102).
+  uint64_t join_timeout_ms = 60000;
+  // Reference default: 100 ms (src/lighthouse.rs:110-115).
+  uint64_t quorum_tick_ms = 100;
+  // Reference default: 5 s (src/lighthouse.rs:117-122).
+  uint64_t heartbeat_timeout_ms = 5000;
+};
+
+// Pure quorum math, unit-testable without sockets.
+// Reference parity: quorum_compute, src/lighthouse.rs:133-261.
+struct QuorumState {
+  struct Joined {
+    QuorumMember member;
+    TimePoint joined_at;
+  };
+  // Replicas that called Quorum this round, keyed by replica id.
+  std::map<std::string, Joined> participants;
+  // Last heartbeat seen per replica id (includes non-participants).
+  std::map<std::string, TimePoint> heartbeats;
+  std::optional<Quorum> prev_quorum;
+  int64_t quorum_id = 0;
+};
+
+// Returns the members of a valid quorum (sorted by replica id), or nullopt
+// with `reason` describing what is still missing.
+std::optional<std::vector<QuorumMember>> QuorumCompute(TimePoint now, const QuorumState& state,
+                                                       const LighthouseOpt& opt,
+                                                       std::string* reason);
+
+class Lighthouse {
+ public:
+  explicit Lighthouse(LighthouseOpt opt);
+  ~Lighthouse();
+
+  bool Start(std::string* err);
+  void Shutdown();
+  std::string address() const;
+  std::string http_address() const;
+
+  // RPC handlers (public for in-process tests).
+  Status HandleQuorum(const LighthouseQuorumRequest& req, Deadline deadline,
+                      LighthouseQuorumResponse* resp, std::string* err);
+  Status HandleHeartbeat(const LighthouseHeartbeatRequest& req);
+  void FillStatus(LighthouseStatusResponse* resp);
+
+  // Asks the replica's manager to exit. Used by the dashboard kill button.
+  // Reference parity: src/lighthouse.rs:433-458.
+  bool KillReplica(const std::string& replica_id, std::string* err);
+
+ private:
+  Status Dispatch(uint16_t method, const std::string& req, Deadline deadline, std::string* resp);
+  void TickLoop();
+  // Runs one quorum attempt; on success installs + broadcasts it.
+  // Caller must hold mu_.
+  void TickLocked();
+  std::string StatusJson();
+  std::string StatusHtml();
+
+  LighthouseOpt opt_;
+  std::unique_ptr<RpcServer> server_;
+  std::unique_ptr<HttpServer> http_;
+
+  std::mutex mu_;
+  std::condition_variable quorum_cv_;
+  QuorumState state_;
+  // Broadcast slot: generation bumps on every new quorum.
+  int64_t quorum_gen_ = 0;
+  std::optional<Quorum> latest_quorum_;
+  // Dedup logging of quorum status changes
+  // (reference ChangeLogger, src/lighthouse.rs:68-84).
+  std::string last_reason_;
+
+  std::thread tick_thread_;
+  bool shutdown_ = false;
+};
+
+int64_t NowEpochMs();
+
+}  // namespace tpuft

@@ -1,0 +1,63 @@
+// Colored stderr logging with TPUFT_LOG level filtering.
+// Reference parity: fern logging configured at import, src/lib.rs:670-713.
+#pragma once
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+namespace tpuft {
+namespace logging {
+
+enum Level { kDebug = 0, kInfo = 1, kWarn = 2, kError = 3 };
+
+inline Level MinLevel() {
+  static Level lvl = [] {
+    const char* e = getenv("TPUFT_LOG");
+    if (!e) return kInfo;
+    if (!strcasecmp(e, "debug")) return kDebug;
+    if (!strcasecmp(e, "warn")) return kWarn;
+    if (!strcasecmp(e, "error")) return kError;
+    return kInfo;
+  }();
+  return lvl;
+}
+
+inline void Log(Level lvl, const char* fmt, ...) {
+  if (lvl < MinLevel()) return;
+  static const char* kNames[] = {"DEBUG", "INFO", "WARN", "ERROR"};
+  static const char* kColors[] = {"\x1b[90m", "\x1b[32m", "\x1b[33m", "\x1b[31m"};
+  auto now = std::chrono::system_clock::now();
+  std::time_t t = std::chrono::system_clock::to_time_t(now);
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(now.time_since_epoch()).count() %
+            1000;
+  struct tm tmv;
+  localtime_r(&t, &tmv);
+  char ts[32];
+  strftime(ts, sizeof(ts), "%H:%M:%S", &tmv);
+  bool color = isatty(fileno(stderr));
+  char body[2048];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(body, sizeof(body), fmt, ap);
+  va_end(ap);
+  if (color) {
+    fprintf(stderr, "%s[%s.%03d %s tpuft]\x1b[0m %s\n", kColors[lvl], ts, (int)ms, kNames[lvl],
+            body);
+  } else {
+    fprintf(stderr, "[%s.%03d %s tpuft] %s\n", ts, (int)ms, kNames[lvl], body);
+  }
+}
+
+}  // namespace logging
+}  // namespace tpuft
+
+#define LOGD(...) ::tpuft::logging::Log(::tpuft::logging::kDebug, __VA_ARGS__)
+#define LOGI(...) ::tpuft::logging::Log(::tpuft::logging::kInfo, __VA_ARGS__)
+#define LOGW(...) ::tpuft::logging::Log(::tpuft::logging::kWarn, __VA_ARGS__)
+#define LOGE(...) ::tpuft::logging::Log(::tpuft::logging::kError, __VA_ARGS__)

@@ -1,0 +1,357 @@
+#include "manager.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <vector>
+
+#include "log.h"
+
+namespace tpuft {
+
+bool ComputeQuorumResults(const std::string& replica_id, int64_t group_rank, const Quorum& quorum,
+                          bool init_sync, bool force_recover, ManagerQuorumResponse* resp,
+                          std::string* err) {
+  // Participants are kept sorted by replica_id by the Lighthouse; sort again
+  // defensively since replica rank assignment depends on it.
+  std::vector<QuorumMember> members(quorum.participants().begin(), quorum.participants().end());
+  std::sort(members.begin(), members.end(), [](const QuorumMember& a, const QuorumMember& b) {
+    return a.replica_id() < b.replica_id();
+  });
+  if (members.empty()) {
+    if (err) *err = "empty quorum";
+    return false;
+  }
+
+  int64_t replica_rank = -1;
+  for (size_t i = 0; i < members.size(); ++i) {
+    if (members[i].replica_id() == replica_id) replica_rank = static_cast<int64_t>(i);
+  }
+  if (replica_rank < 0) {
+    if (err) *err = "replica " + replica_id + " not in quorum";
+    return false;
+  }
+
+  int64_t max_step = 0;
+  for (const auto& m : members) max_step = std::max(max_step, m.step());
+
+  std::vector<int64_t> up_to_date;
+  for (size_t i = 0; i < members.size(); ++i) {
+    if (members[i].step() == max_step) up_to_date.push_back(static_cast<int64_t>(i));
+  }
+  // Initial weight sync: at step 0 everyone is nominally "up to date" but has
+  // different random init; collapse the source set to participant 0 so all
+  // other groups pull its weights (skipped when init_sync=false;
+  // reference: src/manager.rs init_sync tests + torchft/manager.py:118-131).
+  if (init_sync && max_step == 0 && members.size() > 1) {
+    up_to_date = {0};
+  }
+
+  std::vector<int64_t> recovering;
+  for (size_t i = 0; i < members.size(); ++i) {
+    int64_t idx = static_cast<int64_t>(i);
+    if (std::find(up_to_date.begin(), up_to_date.end(), idx) == up_to_date.end()) {
+      recovering.push_back(idx);
+    }
+  }
+
+  resp->set_quorum_id(quorum.quorum_id());
+  resp->set_max_step(max_step);
+  resp->set_max_world_size(static_cast<int64_t>(up_to_date.size()));
+  resp->set_replica_rank(replica_rank);
+  resp->set_replica_world_size(static_cast<int64_t>(members.size()));
+
+  int64_t max_replica_rank = -1;
+  for (size_t i = 0; i < up_to_date.size(); ++i) {
+    if (up_to_date[i] == replica_rank) max_replica_rank = static_cast<int64_t>(i);
+  }
+  resp->set_max_replica_rank(max_replica_rank);
+
+  // Stripe store load: local rank r uses participant (r % n)'s store.
+  const auto& store_member = members[group_rank % static_cast<int64_t>(members.size())];
+  resp->set_store_address(store_member.store_address());
+
+  bool heal = std::find(recovering.begin(), recovering.end(), replica_rank) != recovering.end();
+  if (force_recover && !heal && up_to_date.size() > 1) {
+    // A replica that repeatedly failed commits re-fetches weights even though
+    // its step looks current.
+    heal = true;
+  }
+  resp->set_heal(heal);
+
+  // Round-robin recovery assignment, striped by local rank so different local
+  // ranks of the same recovering group pull from different sources.
+  if (!up_to_date.empty()) {
+    for (size_t j = 0; j < recovering.size(); ++j) {
+      int64_t src =
+          up_to_date[(static_cast<int64_t>(j) + group_rank) % static_cast<int64_t>(up_to_date.size())];
+      if (recovering[j] == replica_rank) {
+        resp->set_recover_src_replica_rank(src);
+        resp->set_recover_src_manager_address(members[src].address());
+      }
+      if (src == replica_rank) {
+        resp->add_recover_dst_replica_ranks(recovering[j]);
+      }
+    }
+    if (heal && std::find(recovering.begin(), recovering.end(), replica_rank) == recovering.end()) {
+      // force_recover path: pick a striped source among the other up-to-date.
+      std::vector<int64_t> others;
+      for (int64_t idx : up_to_date) {
+        if (idx != replica_rank) others.push_back(idx);
+      }
+      int64_t src = others[group_rank % static_cast<int64_t>(others.size())];
+      resp->set_recover_src_replica_rank(src);
+      resp->set_recover_src_manager_address(members[src].address());
+    }
+  }
+  return true;
+}
+
+ManagerServer::ManagerServer(ManagerOpt opt) : opt_(std::move(opt)) {}
+
+ManagerServer::~ManagerServer() { Shutdown(); }
+
+bool ManagerServer::Start(std::string* err) {
+  server_ = std::make_unique<RpcServer>(
+      opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl, std::string* resp) {
+        return Dispatch(method, req, dl, resp);
+      });
+  if (!server_->Start(err)) return false;
+  heartbeat_client_ = std::make_unique<RpcClient>(opt_.lighthouse_addr);
+  quorum_client_ = std::make_unique<RpcClient>(opt_.lighthouse_addr);
+  hb_thread_ = std::thread([this] { HeartbeatLoop(); });
+  LOGI("manager %s listening on %s (lighthouse %s)", opt_.replica_id.c_str(),
+       server_->address().c_str(), opt_.lighthouse_addr.c_str());
+  return true;
+}
+
+void ManagerServer::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_) return;
+    shutdown_ = true;
+    cv_.notify_all();
+  }
+  if (heartbeat_client_) heartbeat_client_->Close();
+  if (quorum_client_) quorum_client_->Close();
+  if (hb_thread_.joinable()) hb_thread_.join();
+  if (server_) server_->Shutdown();
+}
+
+std::string ManagerServer::address() const { return server_ ? server_->address() : ""; }
+
+void ManagerServer::HeartbeatLoop() {
+  LighthouseHeartbeatRequest req;
+  req.set_replica_id(opt_.replica_id);
+  std::string payload, resp, err;
+  req.SerializeToString(&payload);
+  bool warned = false;
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (cv_.wait_for(lk, std::chrono::milliseconds(opt_.heartbeat_interval_ms),
+                       [&] { return shutdown_; })) {
+        return;
+      }
+    }
+    Status st = heartbeat_client_->Call(kLighthouseHeartbeat, payload, 5000, &resp, &err);
+    if (st != Status::kOk && !warned) {
+      LOGW("manager %s: heartbeat to %s failed: %s", opt_.replica_id.c_str(),
+           opt_.lighthouse_addr.c_str(), err.c_str());
+      warned = true;
+    } else if (st == Status::kOk) {
+      warned = false;
+    }
+  }
+}
+
+Status ManagerServer::Dispatch(uint16_t method, const std::string& req, Deadline dl,
+                               std::string* resp) {
+  switch (method) {
+    case kManagerQuorum: {
+      ManagerQuorumRequest r;
+      if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      ManagerQuorumResponse out;
+      std::string err;
+      Status st = HandleQuorum(r, dl, &out, &err);
+      if (st != Status::kOk) {
+        *resp = err;
+        return st;
+      }
+      out.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kManagerCheckpointMetadata: {
+      CheckpointMetadataRequest r;
+      if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      CheckpointMetadataResponse out;
+      std::string err;
+      Status st = HandleCheckpointMetadata(r, &out, &err);
+      if (st != Status::kOk) {
+        *resp = err;
+        return st;
+      }
+      out.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kManagerShouldCommit: {
+      ShouldCommitRequest r;
+      if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      ShouldCommitResponse out;
+      std::string err;
+      Status st = HandleShouldCommit(r, dl, &out, &err);
+      if (st != Status::kOk) {
+        *resp = err;
+        return st;
+      }
+      out.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kManagerKill: {
+      KillRequest r;
+      r.ParseFromString(req);
+      LOGE("manager %s: kill requested: %s", opt_.replica_id.c_str(), r.msg().c_str());
+      std::exit(1);
+    }
+    default:
+      *resp = "unknown manager method " + std::to_string(method);
+      return Status::kUnknown;
+  }
+}
+
+Status ManagerServer::HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
+                                   ManagerQuorumResponse* resp, std::string* err) {
+  std::unique_lock<std::mutex> lk(mu_);
+  if (req.group_rank() < 0 || req.group_rank() >= static_cast<int64_t>(opt_.world_size)) {
+    *err = "group_rank " + std::to_string(req.group_rank()) + " out of range for world_size " +
+           std::to_string(opt_.world_size);
+    return Status::kInvalidArgument;
+  }
+  checkpoint_metadata_[req.group_rank()] = req.checkpoint_metadata();
+  round_reqs_[req.group_rank()] = req;
+  int64_t my_round = round_;
+
+  if (round_reqs_.size() == opt_.world_size) {
+    // This rank completed the set: perform the Lighthouse RPC for the group.
+    int64_t step = 0;
+    bool shrink_only = false;
+    for (const auto& [rank, r] : round_reqs_) {
+      step = std::max(step, r.step());
+      shrink_only = shrink_only || r.shrink_only();
+    }
+    LighthouseQuorumRequest lreq;
+    auto* member = lreq.mutable_requester();
+    member->set_replica_id(opt_.replica_id);
+    member->set_address(server_->address());
+    member->set_store_address(opt_.store_addr);
+    member->set_step(step);
+    member->set_world_size(opt_.world_size);
+    member->set_shrink_only(shrink_only);
+
+    lk.unlock();
+    std::string payload, lresp_bytes, lerr;
+    lreq.SerializeToString(&payload);
+    uint64_t timeout = static_cast<uint64_t>(
+        std::min<int64_t>(deadline.remaining_ms(), 24LL * 3600 * 1000));
+    Status st = quorum_client_->Call(kLighthouseQuorum, payload, timeout, &lresp_bytes, &lerr);
+    lk.lock();
+
+    if (round_ == my_round) {
+      result_round_ = my_round;
+      result_status_ = st;
+      result_error_ = lerr;
+      if (st == Status::kOk) {
+        LighthouseQuorumResponse lresp;
+        if (!lresp.ParseFromString(lresp_bytes)) {
+          result_status_ = Status::kInternal;
+          result_error_ = "bad lighthouse response";
+        } else {
+          result_quorum_ = lresp.quorum();
+        }
+      }
+      round_ += 1;
+      round_reqs_.clear();
+      cv_.notify_all();
+    }
+  } else {
+    bool ok = cv_.wait_until(lk, deadline.at, [&] {
+      return result_round_ >= my_round || shutdown_;
+    });
+    if (shutdown_) {
+      *err = "manager shutting down";
+      return Status::kUnavailable;
+    }
+    if (!ok) {
+      // Leave our request in place; peers may still arrive and complete the
+      // round, but this caller gives up now.
+      *err = "timed out waiting for all " + std::to_string(opt_.world_size) +
+             " local ranks to call quorum";
+      return Status::kDeadlineExceeded;
+    }
+  }
+
+  if (result_round_ != my_round) {
+    *err = "quorum round moved on; retry";
+    return Status::kAborted;
+  }
+  if (result_status_ != Status::kOk) {
+    *err = "lighthouse quorum failed: " + result_error_;
+    return result_status_;
+  }
+  if (!ComputeQuorumResults(opt_.replica_id, req.group_rank(), result_quorum_, req.init_sync(),
+                            req.commit_failures() > 0, resp, err)) {
+    return Status::kInternal;
+  }
+  return Status::kOk;
+}
+
+Status ManagerServer::HandleCheckpointMetadata(const CheckpointMetadataRequest& req,
+                                               CheckpointMetadataResponse* resp,
+                                               std::string* err) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = checkpoint_metadata_.find(req.group_rank());
+  if (it == checkpoint_metadata_.end()) {
+    *err = "no checkpoint metadata for rank " + std::to_string(req.group_rank());
+    return Status::kNotFound;
+  }
+  resp->set_checkpoint_metadata(it->second);
+  return Status::kOk;
+}
+
+Status ManagerServer::HandleShouldCommit(const ShouldCommitRequest& req, Deadline deadline,
+                                         ShouldCommitResponse* resp, std::string* err) {
+  std::unique_lock<std::mutex> lk(mu_);
+  CommitRound& cr = commits_[req.step()];
+  cr.votes[req.group_rank()] = req.should_commit();
+  if (!req.should_commit()) {
+    LOGW("manager %s: rank %lld voted to abort step %lld", opt_.replica_id.c_str(),
+         static_cast<long long>(req.group_rank()), static_cast<long long>(req.step()));
+  }
+  if (cr.votes.size() == opt_.world_size) {
+    cr.decided = true;
+    cr.decision = true;
+    for (const auto& [rank, vote] : cr.votes) cr.decision = cr.decision && vote;
+    cv_.notify_all();
+  } else {
+    bool ok = cv_.wait_until(lk, deadline.at, [&] {
+      return commits_[req.step()].decided || shutdown_;
+    });
+    if (shutdown_) {
+      *err = "manager shutting down";
+      return Status::kUnavailable;
+    }
+    if (!ok) {
+      *err = "timed out waiting for all ranks to vote on step " + std::to_string(req.step());
+      return Status::kDeadlineExceeded;
+    }
+  }
+  CommitRound& done = commits_[req.step()];
+  resp->set_should_commit(done.decision);
+  done.handed_out += 1;
+  // Reset once every rank has its answer so a failed step can be re-voted.
+  if (done.handed_out == static_cast<int64_t>(opt_.world_size)) {
+    commits_.erase(req.step());
+  }
+  return Status::kOk;
+}
+
+}  // namespace tpuft

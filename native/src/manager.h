@@ -1,0 +1,105 @@
+// Manager: per-replica-group coordination server.
+//
+// Reference parity: src/manager.rs.  Runs inside the group's rank-0 process.
+// Aggregates the group's local ranks: waits until all `world_size` ranks call
+// Quorum, performs a single Lighthouse quorum RPC on their behalf, computes
+// the per-rank recovery plan, stores per-rank checkpoint metadata, implements
+// the all-ranks should_commit vote, heartbeats to the Lighthouse, and exits
+// the process on Kill.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+
+#include "tpuft.pb.h"
+#include "wire.h"
+
+namespace tpuft {
+
+struct ManagerOpt {
+  std::string replica_id;
+  std::string lighthouse_addr;
+  std::string bind = "[::]:0";
+  // The group's rendezvous store address, advertised in the quorum member.
+  std::string store_addr;
+  uint64_t world_size = 1;
+  // Reference default: 100 ms (torchft/manager.py:107).
+  uint64_t heartbeat_interval_ms = 100;
+  uint64_t connect_timeout_ms = 10000;
+};
+
+// Pure per-rank recovery-plan math over a formed quorum.
+// Reference parity: compute_quorum_results, src/manager.rs:381-509.
+//   - replica_rank: index of our replica id in the (sorted) participant list;
+//   - up-to-date set: participants at max_step; at step 0 with init_sync the
+//     set collapses to participant 0 so random init weights are synced;
+//   - recovery assignment: recovering replica j heals from
+//     up_to_date[(j + group_rank) % |up_to_date|] — the group_rank offset
+//     stripes transfer load across sources per local rank;
+//   - store striping: local rank r rendezvouses on the store of participant
+//     (r % |participants|) to spread store load.
+bool ComputeQuorumResults(const std::string& replica_id, int64_t group_rank, const Quorum& quorum,
+                          bool init_sync, bool force_recover, ManagerQuorumResponse* resp,
+                          std::string* err);
+
+class ManagerServer {
+ public:
+  explicit ManagerServer(ManagerOpt opt);
+  ~ManagerServer();
+
+  bool Start(std::string* err);
+  void Shutdown();
+  std::string address() const;
+
+  // RPC handlers (public for in-process tests).
+  Status HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
+                      ManagerQuorumResponse* resp, std::string* err);
+  Status HandleCheckpointMetadata(const CheckpointMetadataRequest& req,
+                                  CheckpointMetadataResponse* resp, std::string* err);
+  Status HandleShouldCommit(const ShouldCommitRequest& req, Deadline deadline,
+                            ShouldCommitResponse* resp, std::string* err);
+
+ private:
+  Status Dispatch(uint16_t method, const std::string& req, Deadline deadline, std::string* resp);
+  void HeartbeatLoop();
+
+  ManagerOpt opt_;
+  std::unique_ptr<RpcServer> server_;
+  std::unique_ptr<RpcClient> heartbeat_client_;
+  std::unique_ptr<RpcClient> quorum_client_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool shutdown_ = false;
+
+  // Quorum aggregation round state.  All world_size local ranks must call
+  // Quorum; the rank completing the set performs the Lighthouse RPC
+  // (reference: src/manager.rs:185-292).
+  int64_t round_ = 0;
+  std::map<int64_t, ManagerQuorumRequest> round_reqs_;
+  int64_t result_round_ = -1;
+  Status result_status_ = Status::kOk;
+  std::string result_error_;
+  Quorum result_quorum_;
+
+  // Latest checkpoint metadata per local rank (served to healing peers).
+  std::map<int64_t, std::string> checkpoint_metadata_;
+
+  // should_commit barrier per (step) round (reference: src/manager.rs:313-371).
+  struct CommitRound {
+    std::map<int64_t, bool> votes;
+    bool decided = false;
+    bool decision = false;
+    int64_t handed_out = 0;
+  };
+  std::map<int64_t, CommitRound> commits_;
+
+  std::thread hb_thread_;
+};
+
+}  // namespace tpuft

@@ -1,0 +1,43 @@
+// Exponential backoff with jitter, bounded by an overall deadline.
+// Reference parity: retry_backoff / ExponentialBackoff, src/retry.rs:6-41.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <random>
+#include <thread>
+
+namespace tpuft {
+
+struct Deadline;  // wire.h
+
+class ExponentialBackoff {
+ public:
+  ExponentialBackoff(uint64_t initial_ms = 100, double multiplier = 1.5,
+                     uint64_t max_ms = 10000, uint64_t jitter_ms = 100)
+      : next_ms_(initial_ms), multiplier_(multiplier), max_ms_(max_ms), jitter_ms_(jitter_ms) {}
+
+  // Sleeps for the next backoff interval unless the deadline would be crossed.
+  // Returns false when the deadline has fewer ms left than the sleep needs.
+  template <typename DeadlineT>
+  bool Sleep(const DeadlineT& deadline) {
+    uint64_t jitter = jitter_ms_ ? (rng_() % jitter_ms_) : 0;
+    uint64_t sleep_ms = next_ms_ + jitter;
+    if (static_cast<int64_t>(sleep_ms) >= deadline.remaining_ms()) return false;
+    std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
+    next_ms_ = static_cast<uint64_t>(next_ms_ * multiplier_);
+    if (next_ms_ > max_ms_) next_ms_ = max_ms_;
+    return true;
+  }
+
+  uint64_t next_ms() const { return next_ms_; }
+
+ private:
+  uint64_t next_ms_;
+  double multiplier_;
+  uint64_t max_ms_;
+  uint64_t jitter_ms_;
+  std::minstd_rand rng_{std::random_device{}()};
+};
+
+}  // namespace tpuft

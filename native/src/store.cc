@@ -1,0 +1,106 @@
+#include "store.h"
+
+#include "log.h"
+#include "tpuft.pb.h"
+
+namespace tpuft {
+
+StoreServer::~StoreServer() { Shutdown(); }
+
+bool StoreServer::Start(std::string* err) {
+  server_ = std::make_unique<RpcServer>(
+      bind_, [this](uint16_t method, const std::string& req, Deadline dl, std::string* resp) {
+        return Dispatch(method, req, dl, resp);
+      });
+  if (!server_->Start(err)) return false;
+  LOGD("store listening on %s", server_->address().c_str());
+  return true;
+}
+
+void StoreServer::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_) return;
+    shutdown_ = true;
+    cv_.notify_all();
+  }
+  if (server_) server_->Shutdown();
+}
+
+std::string StoreServer::address() const { return server_ ? server_->address() : ""; }
+
+Status StoreServer::Dispatch(uint16_t method, const std::string& req, Deadline deadline,
+                             std::string* resp) {
+  switch (method) {
+    case kStoreSet: {
+      StoreSetRequest r;
+      if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        kv_[r.key()] = r.value();
+        cv_.notify_all();
+      }
+      StoreSetResponse out;
+      out.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kStoreGet: {
+      StoreGetRequest r;
+      if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      StoreGetResponse out;
+      std::unique_lock<std::mutex> lk(mu_);
+      if (r.wait()) {
+        bool ok = cv_.wait_until(lk, deadline.at, [&] {
+          return kv_.count(r.key()) > 0 || shutdown_;
+        });
+        if (shutdown_) {
+          *resp = "store shutting down";
+          return Status::kUnavailable;
+        }
+        if (!ok) {
+          *resp = "timed out waiting for key " + r.key();
+          return Status::kDeadlineExceeded;
+        }
+      }
+      auto it = kv_.find(r.key());
+      out.set_found(it != kv_.end());
+      if (it != kv_.end()) out.set_value(it->second);
+      lk.unlock();
+      out.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kStoreAdd: {
+      StoreAddRequest r;
+      if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      StoreAddResponse out;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        int64_t cur = 0;
+        auto it = kv_.find(r.key());
+        if (it != kv_.end()) cur = atoll(it->second.c_str());
+        cur += r.delta();
+        kv_[r.key()] = std::to_string(cur);
+        out.set_value(cur);
+        cv_.notify_all();
+      }
+      out.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kStoreDelete: {
+      StoreDeleteRequest r;
+      if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        kv_.erase(r.key());
+      }
+      StoreDeleteResponse out;
+      out.SerializeToString(resp);
+      return Status::kOk;
+    }
+    default:
+      *resp = "unknown store method " + std::to_string(method);
+      return Status::kUnknown;
+  }
+}
+
+}  // namespace tpuft

@@ -1,0 +1,36 @@
+// Key-value rendezvous store with blocking waits.
+// The native analogue of the c10d TCPStore the reference relies on for
+// process-group rendezvous (torchft/process_group.py:85-104, src/manager.rs:501).
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "wire.h"
+
+namespace tpuft {
+
+class StoreServer {
+ public:
+  explicit StoreServer(std::string bind) : bind_(std::move(bind)) {}
+  ~StoreServer();
+
+  bool Start(std::string* err);
+  void Shutdown();
+  std::string address() const;
+
+ private:
+  Status Dispatch(uint16_t method, const std::string& req, Deadline deadline, std::string* resp);
+
+  std::string bind_;
+  std::unique_ptr<RpcServer> server_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+  bool shutdown_ = false;
+};
+
+}  // namespace tpuft

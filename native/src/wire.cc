@@ -1,0 +1,425 @@
+#include "wire.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <random>
+
+#include "retry.h"
+
+namespace tpuft {
+
+namespace {
+
+constexpr uint32_t kMagic = 0x7f7a55aa;
+
+#pragma pack(push, 1)
+struct FrameHeader {
+  uint32_t magic;
+  uint16_t method;
+  uint16_t status;
+  uint64_t req_id;
+  // Relative deadline budget in ms chosen by the client; 0 = none.
+  uint64_t deadline_ms;
+  uint32_t len;
+  uint32_t reserved;
+};
+#pragma pack(pop)
+static_assert(sizeof(FrameHeader) == 32, "frame header must be 32 bytes");
+
+// Read exactly n bytes; honors an absolute poll deadline. Returns false on
+// EOF/error/timeout (timed_out set on timeout).
+bool ReadFull(int fd, char* buf, size_t n, TimePoint deadline, bool* timed_out) {
+  size_t got = 0;
+  while (got < n) {
+    int timeout = -1;
+    if (deadline != TimePoint::max()) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(deadline - Clock::now())
+                      .count();
+      if (left <= 0) {
+        if (timed_out) *timed_out = true;
+        return false;
+      }
+      timeout = static_cast<int>(std::min<int64_t>(left, INT32_MAX));
+    }
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, timeout);
+    if (pr == 0) continue;  // re-check deadline
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    ssize_t r = recv(fd, buf + got, n - got, 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+      return false;
+    }
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+      return false;
+    }
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFrame(int fd, uint16_t method, Status status, uint64_t req_id,
+                uint64_t deadline_ms, const std::string& payload) {
+  FrameHeader h;
+  h.magic = kMagic;
+  h.method = method;
+  h.status = static_cast<uint16_t>(status);
+  h.req_id = req_id;
+  h.deadline_ms = deadline_ms;
+  h.len = static_cast<uint32_t>(payload.size());
+  h.reserved = 0;
+  std::string buf;
+  buf.reserve(sizeof(h) + payload.size());
+  buf.append(reinterpret_cast<const char*>(&h), sizeof(h));
+  buf.append(payload);
+  return WriteFull(fd, buf.data(), buf.size());
+}
+
+bool ReadFrame(int fd, FrameHeader* h, std::string* payload, TimePoint deadline,
+               bool* timed_out) {
+  if (!ReadFull(fd, reinterpret_cast<char*>(h), sizeof(*h), deadline, timed_out)) return false;
+  if (h->magic != kMagic) return false;
+  if (h->len > (1u << 30)) return false;  // 1 GiB sanity cap
+  payload->resize(h->len);
+  if (h->len > 0 &&
+      !ReadFull(fd, payload->empty() ? nullptr : &(*payload)[0], h->len, deadline, timed_out))
+    return false;
+  return true;
+}
+
+void SetKeepAlive(int fd) {
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  int idle = 60, intvl = 20, cnt = 3;
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+bool ParseAddress(const std::string& addr, SockAddr* out, std::string* err) {
+  if (addr.empty()) {
+    if (err) *err = "empty address";
+    return false;
+  }
+  if (addr[0] == '[') {
+    auto close = addr.find(']');
+    if (close == std::string::npos || close + 1 >= addr.size() || addr[close + 1] != ':') {
+      if (err) *err = "bad [v6]:port address: " + addr;
+      return false;
+    }
+    out->host = addr.substr(1, close - 1);
+    out->port = static_cast<uint16_t>(atoi(addr.c_str() + close + 2));
+    return true;
+  }
+  auto colon = addr.rfind(':');
+  if (colon == std::string::npos) {
+    if (err) *err = "missing port in address: " + addr;
+    return false;
+  }
+  out->host = addr.substr(0, colon);
+  out->port = static_cast<uint16_t>(atoi(addr.c_str() + colon + 1));
+  return true;
+}
+
+std::string StatusName(Status s) {
+  switch (s) {
+    case Status::kOk: return "OK";
+    case Status::kCancelled: return "CANCELLED";
+    case Status::kUnknown: return "UNKNOWN";
+    case Status::kInvalidArgument: return "INVALID_ARGUMENT";
+    case Status::kDeadlineExceeded: return "DEADLINE_EXCEEDED";
+    case Status::kNotFound: return "NOT_FOUND";
+    case Status::kFailedPrecondition: return "FAILED_PRECONDITION";
+    case Status::kAborted: return "ABORTED";
+    case Status::kInternal: return "INTERNAL";
+    case Status::kUnavailable: return "UNAVAILABLE";
+  }
+  return "UNKNOWN";
+}
+
+// ---------------------------------------------------------------------------
+// Server
+// ---------------------------------------------------------------------------
+
+RpcServer::RpcServer(std::string bind, RpcHandler handler)
+    : bind_(std::move(bind)), handler_(std::move(handler)) {}
+
+RpcServer::~RpcServer() { Shutdown(); }
+
+bool RpcServer::Start(std::string* err) {
+  SockAddr sa;
+  if (!ParseAddress(bind_, &sa, err)) return false;
+
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  hints.ai_flags = AI_PASSIVE;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(sa.port);
+  const char* node = sa.host.empty() || sa.host == "::" || sa.host == "0.0.0.0"
+                         ? nullptr
+                         : sa.host.c_str();
+  int rc = getaddrinfo(node, port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    if (err) *err = std::string("getaddrinfo: ") + gai_strerror(rc);
+    return false;
+  }
+  int fd = -1;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (ai->ai_family == AF_INET6) {
+      int zero = 0;  // dual-stack
+      setsockopt(fd, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
+    }
+    if (bind(fd, ai->ai_addr, ai->ai_addrlen) == 0 && listen(fd, 1024) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    if (err) *err = "failed to bind " + bind_ + ": " + strerror(errno);
+    return false;
+  }
+  listen_fd_ = fd;
+
+  struct sockaddr_storage bound = {};
+  socklen_t blen = sizeof(bound);
+  getsockname(fd, reinterpret_cast<struct sockaddr*>(&bound), &blen);
+  if (bound.ss_family == AF_INET6) {
+    port_ = ntohs(reinterpret_cast<struct sockaddr_in6*>(&bound)->sin6_port);
+  } else {
+    port_ = ntohs(reinterpret_cast<struct sockaddr_in*>(&bound)->sin_port);
+  }
+  // Advertise a connectable host: keep the requested host unless it was a
+  // wildcard, in which case use localhost (single-host tests) or the FQDN.
+  std::string host = sa.host;
+  if (host.empty() || host == "::" || host == "0.0.0.0") {
+    char name[256];
+    if (gethostname(name, sizeof(name)) == 0) {
+      host = name;
+    } else {
+      host = "localhost";
+    }
+  }
+  address_ = (host.find(':') != std::string::npos ? "[" + host + "]" : host) + ":" +
+             std::to_string(port_);
+
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  return true;
+}
+
+void RpcServer::AcceptLoop() {
+  while (!shutdown_.load()) {
+    struct pollfd pfd = {listen_fd_, POLLIN, 0};
+    int pr = poll(&pfd, 1, 100);
+    if (pr <= 0) continue;
+    int cfd = accept(listen_fd_, nullptr, nullptr);
+    if (cfd < 0) continue;
+    SetKeepAlive(cfd);
+    std::lock_guard<std::mutex> lk(conns_mu_);
+    if (shutdown_.load()) {
+      close(cfd);
+      break;
+    }
+    auto th = std::make_shared<std::thread>([this, cfd] { Serve(cfd); });
+    conns_[cfd] = th;
+  }
+}
+
+void RpcServer::Serve(int fd) {
+  while (!shutdown_.load()) {
+    FrameHeader h;
+    std::string payload;
+    bool timed_out = false;
+    if (!ReadFrame(fd, &h, &payload, TimePoint::max(), &timed_out)) break;
+    Deadline dl = Deadline::FromMillis(h.deadline_ms);
+    std::string resp;
+    Status st;
+    try {
+      st = handler_(h.method, payload, dl, &resp);
+    } catch (const std::exception& e) {
+      st = Status::kInternal;
+      resp = e.what();
+    }
+    if (!WriteFrame(fd, h.method, st, h.req_id, 0, resp)) break;
+  }
+  close(fd);
+  std::lock_guard<std::mutex> lk(conns_mu_);
+  auto it = conns_.find(fd);
+  if (it != conns_.end()) {
+    it->second->detach();
+    conns_.erase(it);
+  }
+}
+
+void RpcServer::Shutdown() {
+  if (shutdown_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  std::map<int, std::shared_ptr<std::thread>> conns;
+  {
+    std::lock_guard<std::mutex> lk(conns_mu_);
+    conns.swap(conns_);
+  }
+  for (auto& [fd, th] : conns) {
+    ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& [fd, th] : conns) {
+    if (th->joinable()) th->join();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+int DialTcp(const std::string& addr, uint64_t timeout_ms, std::string* err) {
+  SockAddr sa;
+  if (!ParseAddress(addr, &sa, err)) return -1;
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(sa.port);
+  int rc = getaddrinfo(sa.host.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    if (err) *err = std::string("getaddrinfo(") + sa.host + "): " + gai_strerror(rc);
+    return -1;
+  }
+  int fd = -1;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    // Non-blocking connect with poll so we can honor timeout_ms.
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    int cr = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (cr != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      int timeout = timeout_ms == 0 ? -1 : static_cast<int>(timeout_ms);
+      if (poll(&pfd, 1, timeout) == 1) {
+        int serr = 0;
+        socklen_t slen = sizeof(serr);
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &serr, &slen);
+        if (serr == 0) cr = 0;
+      }
+    }
+    if (cr == 0) {
+      fcntl(fd, F_SETFL, flags);
+      SetKeepAlive(fd);
+      break;
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0 && err) *err = "failed to connect to " + addr;
+  return fd;
+}
+
+RpcClient::~RpcClient() { Close(); }
+
+void RpcClient::Close() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (fd_ >= 0) {
+    ::shutdown(fd_, SHUT_RDWR);
+    close(fd_);
+    fd_ = -1;
+  }
+}
+
+Status RpcClient::Connect(uint64_t connect_timeout_ms, std::string* err) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (fd_ >= 0) return Status::kOk;
+  Deadline dl = Deadline::FromMillis(connect_timeout_ms);
+  ExponentialBackoff backoff;
+  std::string last_err;
+  do {
+    int64_t left = dl.remaining_ms();
+    int fd = DialTcp(addr_, static_cast<uint64_t>(std::min<int64_t>(left, 10000)), &last_err);
+    if (fd >= 0) {
+      fd_ = fd;
+      return Status::kOk;
+    }
+  } while (backoff.Sleep(dl));
+  if (err) *err = "connect to " + addr_ + " timed out: " + last_err;
+  return Status::kDeadlineExceeded;
+}
+
+Status RpcClient::Call(uint16_t method, const std::string& req, uint64_t timeout_ms,
+                       std::string* resp, std::string* err) {
+  std::lock_guard<std::mutex> lk(mu_);
+  return CallLocked(method, req, timeout_ms, resp, err);
+}
+
+Status RpcClient::CallLocked(uint16_t method, const std::string& req, uint64_t timeout_ms,
+                             std::string* resp, std::string* err) {
+  if (fd_ < 0) {
+    // Lazy reconnect (e.g. after a Close or a broken pipe).
+    std::string cerr;
+    int fd = DialTcp(addr_, timeout_ms == 0 ? 10000 : timeout_ms, &cerr);
+    if (fd < 0) {
+      if (err) *err = cerr;
+      return Status::kUnavailable;
+    }
+    fd_ = fd;
+  }
+  uint64_t req_id = next_req_id_++;
+  if (!WriteFrame(fd_, method, Status::kOk, req_id, timeout_ms, req)) {
+    close(fd_);
+    fd_ = -1;
+    if (err) *err = "send failed to " + addr_ + ": " + strerror(errno);
+    return Status::kUnavailable;
+  }
+  TimePoint dl = timeout_ms == 0 ? TimePoint::max()
+                                 : Clock::now() + std::chrono::milliseconds(timeout_ms);
+  FrameHeader h;
+  bool timed_out = false;
+  if (!ReadFrame(fd_, &h, resp, dl, &timed_out)) {
+    close(fd_);
+    fd_ = -1;
+    if (timed_out) {
+      if (err) *err = "rpc to " + addr_ + " timed out after " + std::to_string(timeout_ms) + "ms";
+      return Status::kDeadlineExceeded;
+    }
+    if (err) *err = "connection to " + addr_ + " lost";
+    return Status::kUnavailable;
+  }
+  Status st = static_cast<Status>(h.status);
+  if (st != Status::kOk && err) *err = *resp;
+  return st;
+}
+
+}  // namespace tpuft

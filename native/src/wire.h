@@ -1,0 +1,156 @@
+// Framed-TCP RPC transport for the tpu-ft coordination plane.
+//
+// Plays the role of tonic/gRPC in the reference (src/net.rs:8-34): a client
+// connects with retry + keep-alive, sends one protobuf-serialized request per
+// frame, and blocks for the response.  The frame header carries a
+// client-chosen deadline which the server honors on blocking calls — the
+// analogue of the reference's `grpc-timeout` header parsing (src/timeout.rs:18-61).
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace tpuft {
+
+using Clock = std::chrono::steady_clock;
+using TimePoint = Clock::time_point;
+
+// gRPC-compatible status codes so the Python layer can map
+// CANCELLED/DEADLINE_EXCEEDED -> TimeoutError like the reference
+// (src/lib.rs:644-668).
+enum class Status : uint16_t {
+  kOk = 0,
+  kCancelled = 1,
+  kUnknown = 2,
+  kInvalidArgument = 3,
+  kDeadlineExceeded = 4,
+  kNotFound = 5,
+  kFailedPrecondition = 9,
+  kAborted = 10,
+  kInternal = 13,
+  kUnavailable = 14,
+};
+
+// Method ids (stable wire contract; see proto/tpuft.proto section comments).
+enum Method : uint16_t {
+  kLighthouseQuorum = 1,
+  kLighthouseHeartbeat = 2,
+  kLighthouseStatus = 3,
+  kManagerQuorum = 10,
+  kManagerCheckpointMetadata = 11,
+  kManagerShouldCommit = 12,
+  kManagerKill = 13,
+  kStoreSet = 20,
+  kStoreGet = 21,
+  kStoreAdd = 22,
+  kStoreDelete = 23,
+};
+
+struct Deadline {
+  // Absolute steady-clock deadline; TimePoint::max() means "none".
+  TimePoint at = TimePoint::max();
+
+  static Deadline FromMillis(uint64_t ms) {
+    Deadline d;
+    if (ms > 0) d.at = Clock::now() + std::chrono::milliseconds(ms);
+    return d;
+  }
+  bool expired() const { return Clock::now() >= at; }
+  // Remaining time in ms, clamped to >= 0; large value when unset.
+  int64_t remaining_ms() const {
+    if (at == TimePoint::max()) return INT64_MAX;
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(at - Clock::now()).count();
+    return left < 0 ? 0 : left;
+  }
+};
+
+// A parsed "host:port" / "[v6]:port" address.
+struct SockAddr {
+  std::string host;
+  uint16_t port = 0;
+};
+bool ParseAddress(const std::string& addr, SockAddr* out, std::string* err);
+
+// ---------------------------------------------------------------------------
+// Server
+// ---------------------------------------------------------------------------
+
+// Handler: (method, request payload, deadline) -> status + response payload.
+using RpcHandler =
+    std::function<Status(uint16_t method, const std::string& req, Deadline deadline,
+                         std::string* resp)>;
+
+class RpcServer {
+ public:
+  // bind: "host:port", port 0 for ephemeral.  The handler runs on a
+  // per-connection thread and may block (subject to the frame deadline).
+  RpcServer(std::string bind, RpcHandler handler);
+  ~RpcServer();
+
+  // Starts the accept loop.  Returns false and fills err on bind failure.
+  bool Start(std::string* err);
+  // Address actually bound, "host:port" with the resolved port.
+  std::string address() const { return address_; }
+  uint16_t port() const { return port_; }
+  void Shutdown();
+
+ private:
+  void AcceptLoop();
+  void Serve(int fd);
+
+  std::string bind_;
+  RpcHandler handler_;
+  int listen_fd_ = -1;
+  std::string address_;
+  uint16_t port_ = 0;
+  std::atomic<bool> shutdown_{false};
+  std::thread accept_thread_;
+  std::mutex conns_mu_;
+  std::map<int, std::shared_ptr<std::thread>> conns_;
+};
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+class RpcClient {
+ public:
+  explicit RpcClient(std::string addr) : addr_(std::move(addr)) {}
+  ~RpcClient();
+
+  // Establish the connection, retrying with exponential backoff until
+  // connect_timeout_ms elapses (reference: src/net.rs:22-34 + retry.rs).
+  Status Connect(uint64_t connect_timeout_ms, std::string* err);
+
+  // One blocking RPC.  timeout_ms==0 means no deadline.  Thread-safe; calls
+  // are serialized on the single connection.
+  Status Call(uint16_t method, const std::string& req, uint64_t timeout_ms,
+              std::string* resp, std::string* err);
+
+  const std::string& addr() const { return addr_; }
+  void Close();
+
+ private:
+  Status CallLocked(uint16_t method, const std::string& req, uint64_t timeout_ms,
+                    std::string* resp, std::string* err);
+
+  std::string addr_;
+  std::mutex mu_;
+  int fd_ = -1;
+  uint64_t next_req_id_ = 1;
+};
+
+// Dials a TCP connection; returns fd or -1 (err filled).
+int DialTcp(const std::string& addr, uint64_t timeout_ms, std::string* err);
+
+std::string StatusName(Status s);
+
+}  // namespace tpuft

@@ -1,0 +1,494 @@
+// Native-core unit tests.
+//
+// These port the semantics of the reference's Rust in-file tests — they are
+// the spec for quorum math (src/lighthouse.rs:606-1038), recovery assignment
+// (src/manager.rs:752-934), and the in-process Lighthouse+Manager end-to-end
+// paths (src/lighthouse.rs:946-988, src/manager.rs:534-578).
+#include <cassert>
+#include <chrono>
+#include <cstdio>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "lighthouse.h"
+#include "manager.h"
+#include "store.h"
+#include "wire.h"
+
+using namespace tpuft;
+
+#define CHECK(cond)                                                      \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__, __LINE__, \
+              #cond);                                                    \
+      exit(1);                                                           \
+    }                                                                    \
+  } while (0)
+
+namespace {
+
+QuorumMember MakeMember(const std::string& id, int64_t step, uint64_t world_size = 1,
+                        bool shrink_only = false) {
+  QuorumMember m;
+  m.set_replica_id(id);
+  m.set_address("addr-" + id + ":1");
+  m.set_store_address("store-" + id + ":2");
+  m.set_step(step);
+  m.set_world_size(world_size);
+  m.set_shrink_only(shrink_only);
+  return m;
+}
+
+void Join(QuorumState* s, const QuorumMember& m, TimePoint now) {
+  s->participants[m.replica_id()] = QuorumState::Joined{m, now};
+  s->heartbeats[m.replica_id()] = now;
+}
+
+// --- QuorumCompute -----------------------------------------------------------
+
+void TestQuorumMinReplicas() {
+  LighthouseOpt opt;
+  opt.min_replicas = 2;
+  opt.join_timeout_ms = 0;  // no straggler wait
+  QuorumState s;
+  auto now = Clock::now();
+  Join(&s, MakeMember("a", 0), now);
+  std::string reason;
+  CHECK(!QuorumCompute(now, s, opt, &reason).has_value());
+  Join(&s, MakeMember("b", 0), now);
+  auto q = QuorumCompute(now, s, opt, &reason);
+  CHECK(q.has_value());
+  CHECK(q->size() == 2);
+  CHECK((*q)[0].replica_id() == "a");  // sorted
+}
+
+void TestQuorumHeartbeatExpiry() {
+  LighthouseOpt opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 0;
+  opt.heartbeat_timeout_ms = 1000;
+  QuorumState s;
+  auto now = Clock::now();
+  Join(&s, MakeMember("a", 0), now);
+  Join(&s, MakeMember("b", 0), now);
+  // b's heartbeat goes stale: it drops out of the quorum.
+  s.heartbeats["b"] = now - std::chrono::milliseconds(5000);
+  std::string reason;
+  auto q = QuorumCompute(now, s, opt, &reason);
+  CHECK(q.has_value());
+  CHECK(q->size() == 1);
+  CHECK((*q)[0].replica_id() == "a");
+}
+
+void TestQuorumJoinTimeoutStragglers() {
+  // A healthy replica that has not re-joined blocks quorum until
+  // join_timeout elapses.
+  LighthouseOpt opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 60000;
+  QuorumState s;
+  auto now = Clock::now();
+  Join(&s, MakeMember("a", 0), now);
+  Join(&s, MakeMember("b", 0), now);
+  s.heartbeats["c"] = now;  // healthy but not joined
+  std::string reason;
+  CHECK(!QuorumCompute(now, s, opt, &reason).has_value());
+  CHECK(reason.find("straggler") != std::string::npos);
+  // After join_timeout, proceed without the straggler.
+  auto later = now + std::chrono::milliseconds(61000);
+  s.heartbeats["a"] = later;
+  s.heartbeats["b"] = later;
+  s.heartbeats["c"] = later;
+  auto q = QuorumCompute(later, s, opt, &reason);
+  CHECK(q.has_value());
+  CHECK(q->size() == 2);
+}
+
+void TestQuorumFast() {
+  // All members of the previous quorum re-joined: quorum forms immediately
+  // even though join_timeout has not elapsed and a new healthy replica exists.
+  LighthouseOpt opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 60000;
+  QuorumState s;
+  auto now = Clock::now();
+  Quorum prev;
+  prev.set_quorum_id(1);
+  *prev.add_participants() = MakeMember("a", 5);
+  *prev.add_participants() = MakeMember("b", 5);
+  s.prev_quorum = prev;
+  Join(&s, MakeMember("a", 5), now);
+  Join(&s, MakeMember("b", 5), now);
+  Join(&s, MakeMember("c", 0), now);  // new joiner rides along
+  std::string reason;
+  auto q = QuorumCompute(now, s, opt, &reason);
+  CHECK(q.has_value());
+  CHECK(q->size() == 3);
+  CHECK(reason.find("fast") != std::string::npos);
+}
+
+void TestQuorumShrinkOnly() {
+  // shrink_only restricts membership to previous members even when a new
+  // replica joins.
+  LighthouseOpt opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 0;
+  QuorumState s;
+  auto now = Clock::now();
+  Quorum prev;
+  prev.set_quorum_id(3);
+  *prev.add_participants() = MakeMember("a", 5);
+  *prev.add_participants() = MakeMember("b", 5);
+  s.prev_quorum = prev;
+  Join(&s, MakeMember("a", 5, 1, /*shrink_only=*/true), now);
+  Join(&s, MakeMember("b", 5), now);
+  Join(&s, MakeMember("c", 0), now);
+  std::string reason;
+  auto q = QuorumCompute(now, s, opt, &reason);
+  CHECK(q.has_value());
+  CHECK(q->size() == 2);
+  CHECK((*q)[0].replica_id() == "a");
+  CHECK((*q)[1].replica_id() == "b");
+}
+
+void TestQuorumSplitBrain() {
+  // Only 1 of 3 heartbeating replicas joined: no majority, no quorum, even
+  // after the join timeout.
+  LighthouseOpt opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 0;
+  QuorumState s;
+  auto now = Clock::now();
+  Join(&s, MakeMember("a", 0), now);
+  s.heartbeats["b"] = now;
+  s.heartbeats["c"] = now;
+  std::string reason;
+  CHECK(!QuorumCompute(now, s, opt, &reason).has_value());
+  CHECK(reason.find("split brain") != std::string::npos);
+  // 2 of 3 is a strict majority; with join_timeout=0 it proceeds.
+  Join(&s, MakeMember("b", 0), now);
+  auto q = QuorumCompute(now, s, opt, &reason);
+  CHECK(q.has_value());
+  CHECK(q->size() == 2);
+}
+
+// --- ComputeQuorumResults ----------------------------------------------------
+
+Quorum MakeQuorum(const std::vector<QuorumMember>& members, int64_t id = 7) {
+  Quorum q;
+  q.set_quorum_id(id);
+  for (const auto& m : members) *q.add_participants() = m;
+  return q;
+}
+
+void TestResultsHealthySteadyState() {
+  auto q = MakeQuorum({MakeMember("a", 10), MakeMember("b", 10)});
+  ManagerQuorumResponse r;
+  std::string err;
+  CHECK(ComputeQuorumResults("a", 0, q, true, false, &r, &err));
+  CHECK(r.quorum_id() == 7);
+  CHECK(r.replica_rank() == 0);
+  CHECK(r.replica_world_size() == 2);
+  CHECK(r.max_step() == 10);
+  CHECK(r.max_world_size() == 2);
+  CHECK(r.max_replica_rank() == 0);
+  CHECK(!r.heal());
+  CHECK(r.recover_dst_replica_ranks_size() == 0);
+}
+
+void TestResultsRecovery() {
+  // b is behind: it heals from an up-to-date member; a learns it is a source.
+  auto q = MakeQuorum({MakeMember("a", 10), MakeMember("b", 4), MakeMember("c", 10)});
+  ManagerQuorumResponse ra, rb;
+  std::string err;
+  CHECK(ComputeQuorumResults("b", 0, q, true, false, &rb, &err));
+  CHECK(rb.heal());
+  CHECK(rb.max_step() == 10);
+  CHECK(rb.max_replica_rank() == -1);  // not in the up-to-date set
+  // recovering j=0 (which is b, index 1), group_rank 0 -> src = up_to_date[0] = a(0)
+  CHECK(rb.recover_src_replica_rank() == 0);
+  CHECK(rb.recover_src_manager_address() == "addr-a:1");
+
+  CHECK(ComputeQuorumResults("a", 0, q, true, false, &ra, &err));
+  CHECK(!ra.heal());
+  CHECK(ra.recover_dst_replica_ranks_size() == 1);
+  CHECK(ra.recover_dst_replica_ranks(0) == 1);
+  // a is up-to-date rank 0 of 2.
+  CHECK(ra.max_world_size() == 2);
+  CHECK(ra.max_replica_rank() == 0);
+}
+
+void TestResultsRankStriping() {
+  // Different local ranks stripe to different recovery sources and stores.
+  auto q = MakeQuorum({MakeMember("a", 10), MakeMember("b", 4), MakeMember("c", 10)});
+  ManagerQuorumResponse r0, r1;
+  std::string err;
+  CHECK(ComputeQuorumResults("b", 0, q, true, false, &r0, &err));
+  CHECK(ComputeQuorumResults("b", 1, q, true, false, &r1, &err));
+  CHECK(r0.recover_src_replica_rank() == 0);  // a
+  CHECK(r1.recover_src_replica_rank() == 2);  // c
+  CHECK(r0.store_address() == "store-a:2");
+  CHECK(r1.store_address() == "store-b:2");
+}
+
+void TestResultsInitSync() {
+  // Step 0 with init_sync: everyone but participant 0 heals from it.
+  auto q = MakeQuorum({MakeMember("a", 0), MakeMember("b", 0)});
+  ManagerQuorumResponse ra, rb;
+  std::string err;
+  CHECK(ComputeQuorumResults("a", 0, q, true, false, &ra, &err));
+  CHECK(ComputeQuorumResults("b", 0, q, true, false, &rb, &err));
+  CHECK(!ra.heal());
+  CHECK(ra.recover_dst_replica_ranks_size() == 1);
+  CHECK(rb.heal());
+  CHECK(rb.recover_src_replica_rank() == 0);
+  // init_sync=false skips the step-0 sync (reference: src/manager.rs init_sync tests).
+  ManagerQuorumResponse rb2;
+  CHECK(ComputeQuorumResults("b", 0, q, false, false, &rb2, &err));
+  CHECK(!rb2.heal());
+}
+
+void TestResultsForceRecover() {
+  // force_recover makes an up-to-date replica heal anyway.
+  auto q = MakeQuorum({MakeMember("a", 10), MakeMember("b", 10)});
+  ManagerQuorumResponse r;
+  std::string err;
+  CHECK(ComputeQuorumResults("b", 0, q, true, true, &r, &err));
+  CHECK(r.heal());
+  CHECK(r.recover_src_replica_rank() == 0);
+}
+
+// --- End-to-end over real sockets -------------------------------------------
+
+void TestLighthouseE2E() {
+  LighthouseOpt opt;
+  opt.bind = "127.0.0.1:0";
+  opt.http_bind = "";
+  opt.min_replicas = 2;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 10;
+  Lighthouse lh(opt);
+  std::string err;
+  CHECK(lh.Start(&err));
+
+  auto join = [&](const std::string& id, LighthouseQuorumResponse* out) {
+    RpcClient c(lh.address());
+    CHECK(c.Connect(2000, &err) == Status::kOk);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = MakeMember(id, 0);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    std::string cerr;
+    Status st = c.Call(kLighthouseQuorum, payload, 5000, &resp, &cerr);
+    CHECK(st == Status::kOk);
+    CHECK(out->ParseFromString(resp));
+  };
+
+  LighthouseQuorumResponse qa, qb;
+  std::thread ta([&] { join("a", &qa); });
+  std::thread tb([&] { join("b", &qb); });
+  ta.join();
+  tb.join();
+  CHECK(qa.quorum().participants_size() == 2);
+  CHECK(qa.quorum().quorum_id() == qb.quorum().quorum_id());
+
+  // Timeout path: a single joiner can't reach min_replicas.
+  RpcClient c(lh.address());
+  CHECK(c.Connect(2000, &err) == Status::kOk);
+  LighthouseQuorumRequest req;
+  *req.mutable_requester() = MakeMember("a", 1);
+  std::string payload, resp, cerr;
+  req.SerializeToString(&payload);
+  auto t0 = Clock::now();
+  Status st = c.Call(kLighthouseQuorum, payload, 300, &resp, &cerr);
+  auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(Clock::now() - t0);
+  CHECK(st == Status::kDeadlineExceeded);
+  CHECK(elapsed.count() < 2000);
+  lh.Shutdown();
+}
+
+void TestManagerE2E() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.http_bind = "";
+  lopt.min_replicas = 1;
+  lopt.join_timeout_ms = 50;
+  lopt.quorum_tick_ms = 10;
+  Lighthouse lh(lopt);
+  std::string err;
+  CHECK(lh.Start(&err));
+
+  ManagerOpt mopt;
+  mopt.replica_id = "group0";
+  mopt.lighthouse_addr = lh.address();
+  mopt.bind = "127.0.0.1:0";
+  mopt.store_addr = "store0:1";
+  mopt.world_size = 2;
+  ManagerServer mgr(mopt);
+  CHECK(mgr.Start(&err));
+
+  // Both local ranks call quorum; the manager aggregates them into one
+  // lighthouse join.
+  auto call_quorum = [&](int64_t rank, ManagerQuorumResponse* out) {
+    RpcClient c(mgr.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    ManagerQuorumRequest req;
+    req.set_group_rank(rank);
+    req.set_step(0);
+    req.set_checkpoint_metadata("meta-rank" + std::to_string(rank));
+    req.set_init_sync(true);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    Status st = c.Call(kManagerQuorum, payload, 5000, &resp, &cerr);
+    if (st != Status::kOk) fprintf(stderr, "quorum rpc failed: %s\n", cerr.c_str());
+    CHECK(st == Status::kOk);
+    CHECK(out->ParseFromString(resp));
+  };
+  ManagerQuorumResponse q0, q1;
+  std::thread t0([&] { call_quorum(0, &q0); });
+  std::thread t1([&] { call_quorum(1, &q1); });
+  t0.join();
+  t1.join();
+  CHECK(q0.replica_rank() == 0);
+  CHECK(q0.replica_world_size() == 1);
+  CHECK(!q0.heal());
+  CHECK(q0.store_address() == "store0:1");
+  CHECK(q1.store_address() == "store0:1");
+
+  // Checkpoint metadata is stored per rank and served to peers.
+  {
+    RpcClient c(mgr.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    CheckpointMetadataRequest req;
+    req.set_group_rank(1);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    CHECK(c.Call(kManagerCheckpointMetadata, payload, 2000, &resp, &cerr) == Status::kOk);
+    CheckpointMetadataResponse out;
+    CHECK(out.ParseFromString(resp));
+    CHECK(out.checkpoint_metadata() == "meta-rank1");
+  }
+
+  // should_commit: all-yes commits, any-no aborts.
+  auto vote = [&](int64_t rank, int64_t step, bool v, bool* decision) {
+    RpcClient c(mgr.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    ShouldCommitRequest req;
+    req.set_group_rank(rank);
+    req.set_step(step);
+    req.set_should_commit(v);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    CHECK(c.Call(kManagerShouldCommit, payload, 5000, &resp, &cerr) == Status::kOk);
+    ShouldCommitResponse out;
+    CHECK(out.ParseFromString(resp));
+    *decision = out.should_commit();
+  };
+  bool d0 = false, d1 = false;
+  std::thread v0([&] { vote(0, 1, true, &d0); });
+  std::thread v1([&] { vote(1, 1, true, &d1); });
+  v0.join();
+  v1.join();
+  CHECK(d0 && d1);
+  std::thread v2([&] { vote(0, 2, true, &d0); });
+  std::thread v3([&] { vote(1, 2, false, &d1); });
+  v2.join();
+  v3.join();
+  CHECK(!d0 && !d1);
+  // The same step can be re-voted after a failed round.
+  std::thread v4([&] { vote(0, 2, true, &d0); });
+  std::thread v5([&] { vote(1, 2, true, &d1); });
+  v4.join();
+  v5.join();
+  CHECK(d0 && d1);
+
+  mgr.Shutdown();
+  lh.Shutdown();
+}
+
+void TestStoreE2E() {
+  StoreServer store("127.0.0.1:0");
+  std::string err;
+  CHECK(store.Start(&err));
+  RpcClient c(store.address());
+  CHECK(c.Connect(2000, &err) == Status::kOk);
+
+  StoreSetRequest set;
+  set.set_key("k");
+  set.set_value("v");
+  std::string payload, resp, cerr;
+  set.SerializeToString(&payload);
+  CHECK(c.Call(kStoreSet, payload, 2000, &resp, &cerr) == Status::kOk);
+
+  StoreGetRequest get;
+  get.set_key("k");
+  get.SerializeToString(&payload);
+  CHECK(c.Call(kStoreGet, payload, 2000, &resp, &cerr) == Status::kOk);
+  StoreGetResponse gout;
+  CHECK(gout.ParseFromString(resp));
+  CHECK(gout.found() && gout.value() == "v");
+
+  // Blocking wait satisfied by a concurrent set.
+  std::thread setter([&] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    RpcClient c2(store.address());
+    std::string e2;
+    CHECK(c2.Connect(2000, &e2) == Status::kOk);
+    StoreSetRequest s2;
+    s2.set_key("later");
+    s2.set_value("x");
+    std::string p2, r2;
+    s2.SerializeToString(&p2);
+    CHECK(c2.Call(kStoreSet, p2, 2000, &r2, &e2) == Status::kOk);
+  });
+  StoreGetRequest wait_get;
+  wait_get.set_key("later");
+  wait_get.set_wait(true);
+  wait_get.SerializeToString(&payload);
+  CHECK(c.Call(kStoreGet, payload, 5000, &resp, &cerr) == Status::kOk);
+  setter.join();
+
+  // Wait timeout.
+  StoreGetRequest missing;
+  missing.set_key("never");
+  missing.set_wait(true);
+  missing.SerializeToString(&payload);
+  CHECK(c.Call(kStoreGet, payload, 200, &resp, &cerr) == Status::kDeadlineExceeded);
+
+  // Atomic add.
+  StoreAddRequest add;
+  add.set_key("ctr");
+  add.set_delta(5);
+  add.SerializeToString(&payload);
+  CHECK(c.Call(kStoreAdd, payload, 2000, &resp, &cerr) == Status::kOk);
+  StoreAddResponse aout;
+  CHECK(aout.ParseFromString(resp));
+  CHECK(aout.value() == 5);
+  store.Shutdown();
+}
+
+}  // namespace
+
+int main() {
+  TestQuorumMinReplicas();
+  TestQuorumHeartbeatExpiry();
+  TestQuorumJoinTimeoutStragglers();
+  TestQuorumFast();
+  TestQuorumShrinkOnly();
+  TestQuorumSplitBrain();
+  TestResultsHealthySteadyState();
+  TestResultsRecovery();
+  TestResultsRankStriping();
+  TestResultsInitSync();
+  TestResultsForceRecover();
+  TestLighthouseE2E();
+  TestManagerE2E();
+  TestStoreE2E();
+  printf("all native tests passed\n");
+  return 0;
+}
