@@ -1,0 +1,178 @@
+"""Bindings-level tests for the native coordination core.
+
+Reference parity: torchft/lighthouse_test.py:12-123 (join timeout behavior,
+LighthouseClient user-data round trip) and torchft/coordination_test.py:15
+(API surface has docstrings).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu import coordination
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    StoreClient,
+    StoreServer,
+)
+
+
+def test_coordination_docstrings() -> None:
+    for name in coordination.__all__:
+        if name in ("Quorum", "QuorumMember"):
+            continue  # generated protobuf messages carry no docstrings
+        obj = getattr(coordination, name)
+        assert obj.__doc__, f"{name} missing docstring"
+
+
+def test_lighthouse_join_two_replicas() -> None:
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=100)
+    try:
+        results = {}
+
+        def join(replica_id: str) -> None:
+            client = LighthouseClient(lh.address())
+            results[replica_id] = client.quorum(replica_id, timeout_ms=5000, step=0)
+            client.close()
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=join, args=(f"replica{i}",)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        # Reference guard: quorum join < 0.4s with 100ms join timeout
+        # (torchft/lighthouse_test.py:45-48).
+        assert elapsed < 0.4, f"quorum took {elapsed:.3f}s"
+        assert len(results["replica0"].participants) == 2
+        assert results["replica0"].quorum_id == results["replica1"].quorum_id
+    finally:
+        lh.shutdown()
+
+
+def test_lighthouse_timeout_returns_fast() -> None:
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=100)
+    try:
+        client = LighthouseClient(lh.address())
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            client.quorum("lonely", timeout_ms=300)
+        # Reference guard: timed-out quorum returns < 1.0s
+        # (torchft/manager_integ_test.py:450-462).
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        lh.shutdown()
+
+
+def test_lighthouse_user_data_roundtrip() -> None:
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100)
+    try:
+        client = LighthouseClient(lh.address())
+        quorum = client.quorum(
+            "replica0", timeout_ms=5000, data={"role": "trainer", "shards": [1, 2]}
+        )
+        member = quorum.participants[0]
+        assert json.loads(member.data) == {"role": "trainer", "shards": [1, 2]}
+    finally:
+        lh.shutdown()
+
+
+def test_lighthouse_heartbeat_and_status() -> None:
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100)
+    try:
+        client = LighthouseClient(lh.address())
+        client.heartbeat("replica0")
+        status = client.status()
+        assert "replica0" in status.heartbeat_age_ms
+    finally:
+        lh.shutdown()
+
+
+def test_lighthouse_dashboard_http() -> None:
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100,
+                          http_bind="127.0.0.1:0")
+    try:
+        client = LighthouseClient(lh.address())
+        client.quorum("replica0", timeout_ms=5000, step=3)
+        url = lh.http_address()
+        html = urllib.request.urlopen(url + "/", timeout=5).read().decode()
+        assert "replica0" in html and "lighthouse" in html
+        blob = json.loads(
+            urllib.request.urlopen(url + "/status.json", timeout=5).read().decode()
+        )
+        assert blob["participants"][0]["replica_id"] == "replica0"
+        assert blob["participants"][0]["step"] == 3
+    finally:
+        lh.shutdown()
+
+
+def test_manager_quorum_and_commit() -> None:
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=50)
+    mgr = ManagerServer(
+        replica_id="group0",
+        lighthouse_addr=lh.address(),
+        bind="127.0.0.1:0",
+        store_addr="store0:0",
+        world_size=2,
+    )
+    try:
+        results = {}
+
+        def rank_flow(rank: int) -> None:
+            client = ManagerClient(mgr.address())
+            q = client._quorum(
+                group_rank=rank,
+                step=0,
+                checkpoint_metadata=f"ckpt{rank}",
+                shrink_only=False,
+                timeout_ms=5000,
+            )
+            commit = client.should_commit(rank, 0, True, timeout_ms=5000)
+            results[rank] = (q, commit)
+            client.close()
+
+        threads = [threading.Thread(target=rank_flow, args=(r,)) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        q0, commit0 = results[0]
+        assert q0.replica_world_size == 1
+        assert q0.replica_rank == 0
+        assert not q0.heal
+        assert commit0 is True
+
+        # Peer metadata fetch (the healing path's first RPC,
+        # torchft/manager.py:536-540).
+        client = ManagerClient(mgr.address())
+        assert client._checkpoint_metadata(1, timeout_ms=5000) == "ckpt1"
+    finally:
+        mgr.shutdown()
+        lh.shutdown()
+
+
+def test_store_roundtrip_and_prefix() -> None:
+    store = StoreServer(bind="127.0.0.1:0")
+    try:
+        client = StoreClient(store.address(), prefix="q0")
+        client.set("rank0", b"addr0")
+        assert client.get("rank0") == b"addr0"
+        other = StoreClient(store.address(), prefix="q1")
+        assert other.get("rank0", wait=False) is None
+        with pytest.raises(TimeoutError):
+            other.get("rank0", wait=True, timeout_ms=200)
+        assert client.add("counter", 3) == 3
+        assert client.add("counter", 2) == 5
+        sub = client.sub_store("inner")
+        sub.set("k", b"v")
+        assert sub.get("k") == b"v"
+        assert client.get("inner/k") == b"v"
+    finally:
+        store.shutdown()

@@ -1,0 +1,503 @@
+"""ctypes bindings to the native coordination core (libtpuft.so).
+
+The role of the reference's PyO3 binding layer (reference: src/lib.rs:710-726):
+exposes ``LighthouseServer``, ``LighthouseClient``, ``ManagerServer``,
+``ManagerClient``, ``QuorumResult`` plus tpu-ft's native ``StoreServer`` /
+``StoreClient`` to Python.  Requests and responses cross the C ABI as
+serialized protobuf bytes built/parsed with the generated ``tpuft_pb2``
+module; ctypes drops the GIL for the duration of every native call, matching
+the reference's ``py.allow_threads`` usage (src/lib.rs:186-200).
+
+gRPC-style status codes CANCELLED/DEADLINE_EXCEEDED map to ``TimeoutError``
+and everything else to ``RuntimeError`` (reference: StatusError mapping,
+src/lib.rs:644-668).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib", "libtpuft.so")
+_BUILD_LOCK = threading.Lock()
+
+# Wire status codes (native/src/wire.h).
+_OK = 0
+_CANCELLED = 1
+_DEADLINE_EXCEEDED = 4
+
+# Method ids (native/src/wire.h).
+LIGHTHOUSE_QUORUM = 1
+LIGHTHOUSE_HEARTBEAT = 2
+LIGHTHOUSE_STATUS = 3
+MANAGER_QUORUM = 10
+MANAGER_CHECKPOINT_METADATA = 11
+MANAGER_SHOULD_COMMIT = 12
+MANAGER_KILL = 13
+STORE_SET = 20
+STORE_GET = 21
+STORE_ADD = 22
+STORE_DELETE = 23
+
+
+def _build_native() -> None:
+    """Builds libtpuft.so and the generated protobuf modules via cmake/ninja."""
+    native_dir = os.path.join(_REPO_ROOT, "native")
+    build_dir = os.path.join(native_dir, "build")
+    subprocess.run(
+        ["cmake", "-B", build_dir, "-G", "Ninja", native_dir],
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", build_dir, "tpuft", "py_proto"], check=True, capture_output=True
+    )
+
+
+def _ensure_built() -> None:
+    pb2 = os.path.join(os.path.dirname(os.path.abspath(__file__)), "proto", "tpuft_pb2.py")
+    if os.path.exists(_LIB_PATH) and os.path.exists(pb2):
+        return
+    with _BUILD_LOCK:
+        if os.path.exists(_LIB_PATH) and os.path.exists(pb2):
+            return
+        _build_native()
+
+
+_ensure_built()
+
+from torchft_tpu.proto import tpuft_pb2 as pb  # noqa: E402
+
+
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.tf_free.argtypes = [ctypes.c_void_p]
+    lib.tf_lighthouse_new.restype = ctypes.c_void_p
+    lib.tf_lighthouse_new.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    lib.tf_lighthouse_address.restype = ctypes.c_void_p
+    lib.tf_lighthouse_address.argtypes = [ctypes.c_void_p]
+    lib.tf_lighthouse_http_address.restype = ctypes.c_void_p
+    lib.tf_lighthouse_http_address.argtypes = [ctypes.c_void_p]
+    lib.tf_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tf_lighthouse_free.argtypes = [ctypes.c_void_p]
+    lib.tf_manager_new.restype = ctypes.c_void_p
+    lib.tf_manager_new.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    lib.tf_manager_address.restype = ctypes.c_void_p
+    lib.tf_manager_address.argtypes = [ctypes.c_void_p]
+    lib.tf_manager_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tf_manager_free.argtypes = [ctypes.c_void_p]
+    lib.tf_store_new.restype = ctypes.c_void_p
+    lib.tf_store_new.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
+    lib.tf_store_address.restype = ctypes.c_void_p
+    lib.tf_store_address.argtypes = [ctypes.c_void_p]
+    lib.tf_store_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tf_store_free.argtypes = [ctypes.c_void_p]
+    lib.tf_client_new.restype = ctypes.c_void_p
+    lib.tf_client_new.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    lib.tf_client_call.restype = ctypes.c_int
+    lib.tf_client_call.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint16,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    lib.tf_client_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib = _load_lib()
+
+
+def _take_string(ptr: int) -> str:
+    if not ptr:
+        return ""
+    value = ctypes.string_at(ptr).decode()
+    _lib.tf_free(ptr)
+    return value
+
+
+def _take_error(err: "ctypes.c_char_p") -> str:
+    if not err.value:
+        return "unknown native error"
+    msg = err.value.decode()
+    _lib.tf_free(ctypes.cast(err, ctypes.c_void_p))
+    return msg
+
+
+def _raise_for_status(status: int, msg: str) -> None:
+    if status in (_CANCELLED, _DEADLINE_EXCEEDED):
+        raise TimeoutError(msg)
+    raise RuntimeError(msg)
+
+
+class _Client:
+    """Generic RPC client over the native connection (connect w/ retry+backoff,
+    reference: src/net.rs:22-34)."""
+
+    def __init__(self, addr: str, connect_timeout_ms: int = 10000) -> None:
+        err = ctypes.c_char_p()
+        self._ptr = _lib.tf_client_new(addr.encode(), connect_timeout_ms, ctypes.byref(err))
+        if not self._ptr:
+            raise TimeoutError(_take_error(err))
+        self._addr = addr
+
+    def call(self, method: int, request: bytes, timeout_ms: int) -> bytes:
+        resp = ctypes.POINTER(ctypes.c_uint8)()
+        resp_len = ctypes.c_size_t()
+        err = ctypes.c_char_p()
+        status = _lib.tf_client_call(
+            self._ptr,
+            method,
+            request,
+            len(request),
+            max(0, int(timeout_ms)),
+            ctypes.byref(resp),
+            ctypes.byref(resp_len),
+            ctypes.byref(err),
+        )
+        if status != _OK:
+            _raise_for_status(status, _take_error(err))
+        data = ctypes.string_at(resp, resp_len.value)
+        _lib.tf_free(ctypes.cast(resp, ctypes.c_void_p))
+        return data
+
+    def close(self) -> None:
+        if self._ptr:
+            _lib.tf_client_free(self._ptr)
+            self._ptr = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+@dataclass
+class QuorumResult:
+    """Per-rank recovery plan returned by ``ManagerClient._quorum``.
+    Reference parity: QuorumResult pyclass, src/lib.rs:275-308."""
+
+    quorum_id: int = 0
+    replica_rank: int = 0
+    replica_world_size: int = 1
+    recover_src_manager_address: str = ""
+    recover_src_replica_rank: Optional[int] = None
+    recover_dst_replica_ranks: List[int] = field(default_factory=list)
+    store_address: str = ""
+    max_step: int = 0
+    max_replica_rank: Optional[int] = None
+    max_world_size: int = 1
+    heal: bool = False
+
+
+class LighthouseServer:
+    """In-process native Lighthouse (reference: LighthouseServer, src/lib.rs:580-642)."""
+
+    def __init__(
+        self,
+        bind: str = "[::]:0",
+        min_replicas: int = 1,
+        join_timeout_ms: int = 100,
+        quorum_tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+        http_bind: str = "[::]:0",
+    ) -> None:
+        err = ctypes.c_char_p()
+        self._ptr = _lib.tf_lighthouse_new(
+            bind.encode(),
+            http_bind.encode(),
+            min_replicas,
+            join_timeout_ms,
+            quorum_tick_ms,
+            heartbeat_timeout_ms,
+            ctypes.byref(err),
+        )
+        if not self._ptr:
+            raise RuntimeError(_take_error(err))
+
+    def address(self) -> str:
+        return _take_string(_lib.tf_lighthouse_address(self._ptr))
+
+    def http_address(self) -> str:
+        return _take_string(_lib.tf_lighthouse_http_address(self._ptr))
+
+    def shutdown(self) -> None:
+        if self._ptr:
+            _lib.tf_lighthouse_shutdown(self._ptr)
+
+    def __del__(self) -> None:
+        try:
+            if self._ptr:
+                _lib.tf_lighthouse_shutdown(self._ptr)
+                _lib.tf_lighthouse_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+
+class LighthouseClient:
+    """Direct lighthouse access for tooling and LocalSGD-style algorithms
+    (reference: LighthouseClient, src/lib.rs:475-565)."""
+
+    def __init__(self, addr: str, connect_timeout_ms: int = 10000) -> None:
+        self._client = _Client(addr, connect_timeout_ms)
+
+    def quorum(
+        self,
+        replica_id: str,
+        timeout_ms: int = 5000,
+        address: str = "",
+        store_address: str = "",
+        step: int = 0,
+        world_size: int = 1,
+        shrink_only: bool = False,
+        data: Optional[dict] = None,
+    ) -> "pb.Quorum":
+        import json
+
+        req = pb.LighthouseQuorumRequest()
+        m = req.requester
+        m.replica_id = replica_id
+        m.address = address
+        m.store_address = store_address
+        m.step = step
+        m.world_size = world_size
+        m.shrink_only = shrink_only
+        if data is not None:
+            m.data = json.dumps(data)
+        resp = pb.LighthouseQuorumResponse()
+        resp.ParseFromString(
+            self._client.call(LIGHTHOUSE_QUORUM, req.SerializeToString(), timeout_ms)
+        )
+        return resp.quorum
+
+    def heartbeat(self, replica_id: str, timeout_ms: int = 5000) -> None:
+        req = pb.LighthouseHeartbeatRequest(replica_id=replica_id)
+        self._client.call(LIGHTHOUSE_HEARTBEAT, req.SerializeToString(), timeout_ms)
+
+    def status(self, timeout_ms: int = 5000) -> "pb.LighthouseStatusResponse":
+        resp = pb.LighthouseStatusResponse()
+        resp.ParseFromString(
+            self._client.call(LIGHTHOUSE_STATUS, b"", timeout_ms)
+        )
+        return resp
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class ManagerServer:
+    """In-process native Manager server, run by the group's rank 0
+    (reference: ManagerServer, src/lib.rs:73-135)."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        bind: str = "[::]:0",
+        store_addr: str = "",
+        world_size: int = 1,
+        heartbeat_interval_ms: int = 100,
+        connect_timeout_ms: int = 10000,
+    ) -> None:
+        err = ctypes.c_char_p()
+        self._ptr = _lib.tf_manager_new(
+            replica_id.encode(),
+            lighthouse_addr.encode(),
+            bind.encode(),
+            store_addr.encode(),
+            world_size,
+            heartbeat_interval_ms,
+            connect_timeout_ms,
+            ctypes.byref(err),
+        )
+        if not self._ptr:
+            raise RuntimeError(_take_error(err))
+
+    def address(self) -> str:
+        return _take_string(_lib.tf_manager_address(self._ptr))
+
+    def shutdown(self) -> None:
+        if self._ptr:
+            _lib.tf_manager_shutdown(self._ptr)
+
+    def __del__(self) -> None:
+        try:
+            if self._ptr:
+                _lib.tf_manager_shutdown(self._ptr)
+                _lib.tf_manager_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+
+class ManagerClient:
+    """Client used by every local rank to talk to its group's Manager
+    (reference: ManagerClient, src/lib.rs:144-273)."""
+
+    def __init__(self, addr: str, connect_timeout_ms: int = 10000) -> None:
+        self._client = _Client(addr, connect_timeout_ms)
+
+    def _quorum(
+        self,
+        group_rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout_ms: int,
+        init_sync: bool = True,
+        commit_failures: int = 0,
+    ) -> QuorumResult:
+        req = pb.ManagerQuorumRequest(
+            group_rank=group_rank,
+            step=step,
+            checkpoint_metadata=checkpoint_metadata,
+            shrink_only=shrink_only,
+            init_sync=init_sync,
+            commit_failures=commit_failures,
+        )
+        resp = pb.ManagerQuorumResponse()
+        resp.ParseFromString(
+            self._client.call(MANAGER_QUORUM, req.SerializeToString(), timeout_ms)
+        )
+        return QuorumResult(
+            quorum_id=resp.quorum_id,
+            replica_rank=resp.replica_rank,
+            replica_world_size=resp.replica_world_size,
+            recover_src_manager_address=resp.recover_src_manager_address,
+            recover_src_replica_rank=resp.recover_src_replica_rank if resp.heal else None,
+            recover_dst_replica_ranks=list(resp.recover_dst_replica_ranks),
+            store_address=resp.store_address,
+            max_step=resp.max_step,
+            max_replica_rank=resp.max_replica_rank if resp.max_replica_rank >= 0 else None,
+            max_world_size=resp.max_world_size,
+            heal=resp.heal,
+        )
+
+    def _checkpoint_metadata(self, rank: int, timeout_ms: int) -> str:
+        req = pb.CheckpointMetadataRequest(group_rank=rank)
+        resp = pb.CheckpointMetadataResponse()
+        resp.ParseFromString(
+            self._client.call(MANAGER_CHECKPOINT_METADATA, req.SerializeToString(), timeout_ms)
+        )
+        return resp.checkpoint_metadata
+
+    def should_commit(
+        self, group_rank: int, step: int, should_commit: bool, timeout_ms: int
+    ) -> bool:
+        req = pb.ShouldCommitRequest(
+            group_rank=group_rank, step=step, should_commit=should_commit
+        )
+        resp = pb.ShouldCommitResponse()
+        resp.ParseFromString(
+            self._client.call(MANAGER_SHOULD_COMMIT, req.SerializeToString(), timeout_ms)
+        )
+        return resp.should_commit
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class StoreServer:
+    """Native key-value rendezvous store server."""
+
+    def __init__(self, bind: str = "[::]:0") -> None:
+        err = ctypes.c_char_p()
+        self._ptr = _lib.tf_store_new(bind.encode(), ctypes.byref(err))
+        if not self._ptr:
+            raise RuntimeError(_take_error(err))
+
+    def address(self) -> str:
+        return _take_string(_lib.tf_store_address(self._ptr))
+
+    def shutdown(self) -> None:
+        if self._ptr:
+            _lib.tf_store_shutdown(self._ptr)
+
+    def __del__(self) -> None:
+        try:
+            if self._ptr:
+                _lib.tf_store_shutdown(self._ptr)
+                _lib.tf_store_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+
+class StoreClient:
+    """Client for the rendezvous store, with optional key prefixing
+    (the PrefixStore analogue, torchft/process_group.py:96-104)."""
+
+    def __init__(self, addr: str, prefix: str = "", connect_timeout_ms: int = 10000) -> None:
+        # "host:port/prefix" is accepted like the reference's
+        # create_store_client (torchft/process_group.py:85-104).
+        if "/" in addr:
+            addr, extra = addr.split("/", 1)
+            prefix = extra + "/" + prefix if prefix else extra
+        self._client = _Client(addr, connect_timeout_ms)
+        self._prefix = prefix
+        self._addr = addr
+
+    def sub_store(self, prefix: str) -> "StoreClient":
+        child = StoreClient.__new__(StoreClient)
+        child._client = self._client
+        child._addr = self._addr
+        child._prefix = f"{self._prefix}/{prefix}" if self._prefix else prefix
+        return child
+
+    def _key(self, key: str) -> str:
+        return f"{self._prefix}/{key}" if self._prefix else key
+
+    def set(self, key: str, value: bytes, timeout_ms: int = 10000) -> None:
+        req = pb.StoreSetRequest(key=self._key(key), value=value)
+        self._client.call(STORE_SET, req.SerializeToString(), timeout_ms)
+
+    def get(self, key: str, wait: bool = True, timeout_ms: int = 10000) -> Optional[bytes]:
+        req = pb.StoreGetRequest(key=self._key(key), wait=wait)
+        resp = pb.StoreGetResponse()
+        resp.ParseFromString(self._client.call(STORE_GET, req.SerializeToString(), timeout_ms))
+        return resp.value if resp.found else None
+
+    def add(self, key: str, delta: int, timeout_ms: int = 10000) -> int:
+        req = pb.StoreAddRequest(key=self._key(key), delta=delta)
+        resp = pb.StoreAddResponse()
+        resp.ParseFromString(self._client.call(STORE_ADD, req.SerializeToString(), timeout_ms))
+        return resp.value
+
+    def delete(self, key: str, timeout_ms: int = 10000) -> None:
+        req = pb.StoreDeleteRequest(key=self._key(key))
+        self._client.call(STORE_DELETE, req.SerializeToString(), timeout_ms)
+
+    def close(self) -> None:
+        self._client.close()

@@ -1,0 +1,32 @@
+"""Low-level coordination API re-exports.
+
+Reference parity: torchft/coordination.py:17-33 — the public surface of the
+native bindings for users who want to build custom fault-tolerance logic on
+the raw quorum/heartbeat primitives.
+"""
+
+from torchft_tpu._native import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    QuorumResult,
+    StoreClient,
+    StoreServer,
+)
+from torchft_tpu.proto import tpuft_pb2 as proto
+
+Quorum = proto.Quorum
+QuorumMember = proto.QuorumMember
+
+__all__ = [
+    "LighthouseClient",
+    "LighthouseServer",
+    "ManagerClient",
+    "ManagerServer",
+    "Quorum",
+    "QuorumMember",
+    "QuorumResult",
+    "StoreClient",
+    "StoreServer",
+]
