@@ -1,0 +1,69 @@
+"""Timeout plumbing tests (reference parity: torchft/futures_test.py)."""
+
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from torchft_tpu.futures import (
+    completed_future,
+    context_timeout,
+    failed_future,
+    future_timeout,
+    future_wait,
+    then,
+)
+
+
+def test_future_timeout_fires() -> None:
+    never: Future = Future()
+    out = future_timeout(never, 0.1)
+    with pytest.raises(TimeoutError):
+        out.result(timeout=5)
+
+
+def test_future_timeout_passthrough() -> None:
+    fut: Future = Future()
+    out = future_timeout(fut, 10.0)
+    fut.set_result(42)
+    assert out.result(timeout=1) == 42
+
+
+def test_future_timeout_propagates_error() -> None:
+    out = future_timeout(failed_future(ValueError("boom")), 10.0)
+    with pytest.raises(ValueError):
+        out.result(timeout=1)
+
+
+def test_future_wait() -> None:
+    assert future_wait(completed_future(7), timeout=1) == 7
+    with pytest.raises(TimeoutError):
+        future_wait(Future(), timeout=0.05)
+
+
+def test_context_timeout_fires_callback() -> None:
+    fired = []
+    with context_timeout(lambda: fired.append(True), 0.05):
+        time.sleep(0.3)
+    assert fired
+
+
+def test_context_timeout_cancelled_on_fast_exit() -> None:
+    fired = []
+    with context_timeout(lambda: fired.append(True), 5.0):
+        pass
+    time.sleep(0.1)
+    assert not fired
+
+
+def test_then_chain() -> None:
+    fut: Future = Future()
+    out = then(fut, lambda v: v * 2)
+    fut.set_result(21)
+    assert out.result(timeout=1) == 42
+
+
+def test_then_propagates_error() -> None:
+    out = then(failed_future(RuntimeError("x")), lambda v: v)
+    with pytest.raises(RuntimeError):
+        out.result(timeout=1)

@@ -1,0 +1,484 @@
+"""Manager unit tests against a mocked ManagerClient.
+
+Reference parity: torchft/manager_test.py — QuorumResult objects are
+fabricated by hand to script quorum outcomes, the client is patched, and a
+fake collective records configure/allreduce traffic.  Covers: happy path,
+async/sync heal, not-enough-participants, allreduce error latching,
+collective errored() propagation, FIXED_WITH_SPARES spare zeroing,
+allow_heal=False, wrap_future timeouts, dynamic world size numerics,
+state_dict round trip, and max_retries.
+"""
+
+from datetime import timedelta
+from typing import List, Optional
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu._native import QuorumResult, StoreServer
+from torchft_tpu.collectives import Collective, Work
+from torchft_tpu.futures import completed_future, failed_future
+from torchft_tpu.manager import ExceededMaxRetriesError, Manager, WorldSizeMode
+
+
+class FakeCollective(Collective):
+    """Records traffic; allreduce multiplies by a fake world contribution."""
+
+    def __init__(self) -> None:
+        self.configured: List[tuple] = []
+        self.allreduced: List[np.ndarray] = []
+        self.fail_next = False
+        self._errored: Optional[Exception] = None
+        self._world_size = 1
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self.configured.append((store_addr, rank, world_size))
+        self._world_size = world_size
+        self._errored = None
+
+    def allreduce(self, arrays, op="sum") -> Work:
+        if self.fail_next:
+            self.fail_next = False
+            exc = RuntimeError("injected allreduce failure")
+            self._errored = exc
+            return Work(failed_future(exc))
+        self.allreduced.append(np.array(arrays[0], copy=True))
+        # Pretend every participant contributed the same values.
+        return Work(completed_future([a * self._world_size for a in arrays]))
+
+    def allgather(self, array):
+        return Work(completed_future([array]))
+
+    def broadcast(self, array, root=0):
+        return Work(completed_future(array))
+
+    def reduce_scatter(self, arrays, op="sum"):
+        return Work(completed_future(arrays[0]))
+
+    def alltoall(self, arrays):
+        return Work(completed_future(list(arrays)))
+
+    def send(self, array, dst, tag=0):
+        return Work(completed_future(None))
+
+    def recv(self, shape, dtype, src, tag=0):
+        return Work(completed_future(np.zeros(shape, dtype)))
+
+    def barrier(self):
+        return Work(completed_future(None))
+
+    def size(self):
+        return self._world_size
+
+    def rank(self):
+        return 0
+
+    def errored(self):
+        return self._errored
+
+    def abort(self):
+        pass
+
+
+def make_quorum(
+    quorum_id: int = 1,
+    replica_rank: int = 0,
+    replica_world_size: int = 2,
+    max_step: int = 0,
+    max_replica_rank: Optional[int] = 0,
+    max_world_size: int = 2,
+    heal: bool = False,
+    recover_src: Optional[int] = None,
+    recover_dst: Optional[List[int]] = None,
+) -> QuorumResult:
+    return QuorumResult(
+        quorum_id=quorum_id,
+        replica_rank=replica_rank,
+        replica_world_size=replica_world_size,
+        recover_src_manager_address="src-mgr:0",
+        recover_src_replica_rank=recover_src,
+        recover_dst_replica_ranks=recover_dst or [],
+        store_address="fake-store:0",
+        max_step=max_step,
+        max_replica_rank=max_replica_rank,
+        max_world_size=max_world_size,
+        heal=heal,
+    )
+
+
+@pytest.fixture(scope="module")
+def store():
+    server = StoreServer(bind="127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+def make_manager(store, collective=None, client_mock=None, **kwargs):
+    collective = collective or FakeCollective()
+    kwargs.setdefault("min_replica_size", 2)
+    kwargs.setdefault("use_async_quorum", True)
+    kwargs.setdefault("timeout", timedelta(seconds=10))
+    with patch("torchft_tpu.manager.ManagerClient") as client_cls, patch(
+        "torchft_tpu.manager.ManagerServer"
+    ) as server_cls:
+        server_cls.return_value.address.return_value = "fake-manager:0"
+        client_cls.return_value = client_mock or MagicMock()
+        manager = Manager(
+            collective=collective,
+            load_state_dict=kwargs.pop("load_state_dict", None),
+            state_dict=kwargs.pop("state_dict", None),
+            rank=0,
+            world_size=1,
+            external_store_addr=store.address(),
+            lighthouse_addr="unused:0",
+            replica_id=kwargs.pop("replica_id", "testrep"),
+            **kwargs,
+        )
+    return manager, collective, manager._client
+
+
+def test_happy_path_commit(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(max_world_size=2)
+    client.should_commit.return_value = True
+    manager, collective, _ = make_manager(store, client_mock=client)
+    try:
+        manager.start_quorum()
+        grad = np.full(4, 8.0, dtype=np.float32)
+        fut = manager.allreduce(grad)
+        # Fake collective multiplies by world size 2, manager divides by
+        # num_participants=2: value preserved.
+        np.testing.assert_allclose(fut.result(), grad)
+        assert manager.should_commit()
+        assert manager.current_step() == 1
+        assert manager.batches_committed() == 2
+        assert manager.is_participating()
+        assert manager.num_participants() == 2
+        assert (store.address() not in "") and collective.configured
+        store_addr, rank, world = collective.configured[0]
+        assert "tpuft/1/0" in store_addr
+        assert (rank, world) == (0, 2)
+    finally:
+        manager.shutdown()
+
+
+def test_quorum_reconfigure_only_on_change(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(quorum_id=7)
+    client.should_commit.return_value = True
+    manager, collective, _ = make_manager(store, client_mock=client)
+    try:
+        manager.start_quorum()
+        manager.should_commit()
+        manager.start_quorum()
+        manager.should_commit()
+        assert len(collective.configured) == 1  # same quorum id
+        client._quorum.return_value = make_quorum(quorum_id=8)
+        manager.start_quorum()
+        manager.should_commit()
+        assert len(collective.configured) == 2
+    finally:
+        manager.shutdown()
+
+
+def test_async_heal(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(
+        max_step=5, heal=True, recover_src=1, max_replica_rank=None
+    )
+    client._checkpoint_metadata.return_value = "peer-meta"
+    client.should_commit.return_value = True
+
+    transport = MagicMock()
+    transport.metadata.return_value = "my-meta"
+    transport.recv_checkpoint.return_value = {
+        "user": {"default": {"w": np.ones(2)}},
+        "tpuft": {"step": 5, "batches_committed": 10},
+    }
+    loaded = {}
+
+    manager, collective, _ = make_manager(
+        store,
+        client_mock=client,
+        checkpoint_transport=transport,
+        load_state_dict=lambda sd: loaded.update(sd),
+        state_dict=lambda: {"w": np.zeros(2)},
+    )
+    try:
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert manager._healing
+        assert not manager.is_participating()
+        # Healing replica contributes zeros.
+        fut = manager.allreduce(np.full(3, 9.0, dtype=np.float32))
+        np.testing.assert_allclose(collective.allreduced[0], np.zeros(3))
+        fut.result()
+        assert manager.should_commit()
+        # State was applied at commit time (async quorum).
+        assert "w" in loaded
+        # Healed to max_step=5; the commit bumps to 6 like every participant
+        # (the healed replica applies the same averaged grads).
+        assert manager.current_step() == 6
+        assert manager.batches_committed() == 10 + manager.num_participants()
+        transport.recv_checkpoint.assert_called_once()
+        assert transport.recv_checkpoint.call_args.kwargs["metadata"] == "peer-meta"
+    finally:
+        manager.shutdown()
+
+
+def test_sync_heal_applies_eagerly(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(
+        max_step=3, heal=True, recover_src=1, max_replica_rank=None
+    )
+    client._checkpoint_metadata.return_value = "m"
+    client.should_commit.return_value = True
+    transport = MagicMock()
+    transport.metadata.return_value = "m"
+    transport.recv_checkpoint.return_value = {
+        "user": {"default": {"w": 1}},
+        "tpuft": {"step": 3, "batches_committed": 6},
+    }
+    loaded = {}
+    manager, _, _ = make_manager(
+        store,
+        client_mock=client,
+        checkpoint_transport=transport,
+        use_async_quorum=False,
+        load_state_dict=lambda sd: loaded.update(sd),
+        state_dict=lambda: {},
+    )
+    try:
+        manager.start_quorum()
+        # Sync mode: state applied before returning from start_quorum.
+        assert loaded == {"w": 1}
+        assert manager.current_step() == 3
+    finally:
+        manager.shutdown()
+
+
+def test_send_checkpoint_as_recovery_source(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(max_step=2, recover_dst=[1, 3])
+    client.should_commit.return_value = True
+    transport = MagicMock()
+    transport.metadata.return_value = "m"
+    manager, _, _ = make_manager(
+        store,
+        client_mock=client,
+        checkpoint_transport=transport,
+        state_dict=lambda: {"w": 42},
+    )
+    try:
+        manager.start_quorum()
+        manager.wait_quorum()
+        transport.send_checkpoint.assert_called_once()
+        kwargs = transport.send_checkpoint.call_args.kwargs
+        assert kwargs["dst_ranks"] == [1, 3]
+        assert kwargs["step"] == 2
+        assert kwargs["state_dict"]["user"]["default"] == {"w": 42}
+    finally:
+        manager.shutdown()
+
+
+def test_allow_heal_false_skips_transfer(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(
+        max_step=5, heal=True, recover_src=1, recover_dst=[2], max_replica_rank=None
+    )
+    client.should_commit.return_value = True
+    transport = MagicMock()
+    transport.metadata.return_value = "m"
+    manager, _, _ = make_manager(store, client_mock=client, checkpoint_transport=transport)
+    try:
+        manager.start_quorum(allow_heal=False)
+        manager.wait_quorum()
+        transport.send_checkpoint.assert_not_called()
+        transport.recv_checkpoint.assert_not_called()
+        # Still marked not participating (behind the quorum).
+        assert not manager.is_participating()
+    finally:
+        manager.shutdown()
+
+
+def test_not_enough_participants_votes_no(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(max_world_size=1)
+    client.should_commit.return_value = False
+    manager, _, _ = make_manager(store, client_mock=client, min_replica_size=2)
+    try:
+        manager.start_quorum()
+        assert not manager.should_commit()
+        # Local vote was False.
+        assert client.should_commit.call_args.args[2] is False
+        assert manager.current_step() == 0
+    finally:
+        manager.shutdown()
+
+
+def test_allreduce_error_latches_and_recovers(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum()
+    client.should_commit.side_effect = [False, True]
+    collective = FakeCollective()
+    manager, _, _ = make_manager(store, collective=collective, client_mock=client)
+    try:
+        manager.start_quorum()
+        collective.fail_next = True
+        grad = np.full(2, 3.0, dtype=np.float32)
+        fut = manager.allreduce(grad)
+        # Error is swallowed: default (unmodified input) comes back.
+        np.testing.assert_allclose(fut.result(), grad)
+        assert manager.errored() is not None
+        # Subsequent allreduces are no-ops.
+        fut2 = manager.allreduce(grad)
+        np.testing.assert_allclose(fut2.result(), grad)
+        assert not manager.should_commit()
+        assert client.should_commit.call_args.args[2] is False
+
+        # Next round clears the error.
+        manager.start_quorum()
+        assert manager.errored() is None
+        manager.allreduce(grad).result()
+        assert manager.should_commit()
+        assert manager.current_step() == 1
+    finally:
+        manager.shutdown()
+
+
+def test_collective_errored_propagates(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum()
+    client.should_commit.return_value = False
+    collective = FakeCollective()
+    manager, _, _ = make_manager(store, collective=collective, client_mock=client)
+    try:
+        manager.start_quorum()
+        manager.wait_quorum()
+        collective._errored = RuntimeError("background failure")
+        assert not manager.should_commit()
+        assert client.should_commit.call_args.args[2] is False
+    finally:
+        manager.shutdown()
+
+
+def test_fixed_with_spares_zeroes_spare(store) -> None:
+    client = MagicMock()
+    # Three groups alive, fixed world size 2 -> replica_rank 2 is a spare.
+    client._quorum.return_value = make_quorum(
+        replica_rank=2, replica_world_size=3, max_replica_rank=2, max_world_size=3
+    )
+    client.should_commit.return_value = True
+    collective = FakeCollective()
+    manager, _, _ = make_manager(
+        store,
+        collective=collective,
+        client_mock=client,
+        world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+        fixed_world_size=2,
+    )
+    try:
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert not manager.is_participating()
+        assert manager.num_participants() == 2
+        manager.allreduce(np.ones(2, dtype=np.float32)).result()
+        np.testing.assert_allclose(collective.allreduced[0], np.zeros(2))
+    finally:
+        manager.shutdown()
+
+
+def test_dynamic_world_size_numerics(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(max_world_size=3, replica_world_size=3)
+    client.should_commit.return_value = True
+    collective = FakeCollective()
+    manager, _, _ = make_manager(store, collective=collective, client_mock=client)
+    try:
+        manager.start_quorum()
+        grad = np.full(2, 6.0, dtype=np.float32)
+        out = manager.allreduce(grad).result()
+        # collective returned grad*3 (world 3); divided by num_participants=3.
+        np.testing.assert_allclose(out, grad)
+        assert manager.num_participants() == 3
+    finally:
+        manager.shutdown()
+
+
+def test_wrap_future_timeout(store) -> None:
+    from concurrent.futures import Future
+
+    client = MagicMock()
+    client._quorum.return_value = make_quorum()
+    client.should_commit.return_value = False
+    manager, _, _ = make_manager(store, client_mock=client)
+    try:
+        manager.start_quorum()
+        manager.wait_quorum()
+        never: Future = Future()
+        out = manager.wrap_future(never, default="fallback", timeout=timedelta(milliseconds=100))
+        assert out.result(timeout=5) == "fallback"
+        assert isinstance(manager.errored(), TimeoutError)
+    finally:
+        manager.shutdown()
+
+
+def test_state_dict_roundtrip(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum()
+    client.should_commit.return_value = True
+    manager, _, _ = make_manager(store, client_mock=client)
+    try:
+        manager.start_quorum()
+        manager.should_commit()
+        sd = manager.state_dict()
+        assert sd == {"step": 1, "batches_committed": 2}
+        manager.load_state_dict({"step": 7, "batches_committed": 70})
+        assert manager.current_step() == 7
+        assert manager.batches_committed() == 70
+    finally:
+        manager.shutdown()
+
+
+def test_max_retries(store) -> None:
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(max_world_size=1)
+    client.should_commit.return_value = False
+    manager, _, _ = make_manager(store, client_mock=client, min_replica_size=2, max_retries=2)
+    try:
+        manager.start_quorum()
+        assert not manager.should_commit()
+        manager.start_quorum()
+        assert not manager.should_commit()
+        manager.start_quorum()
+        with pytest.raises(ExceededMaxRetriesError):
+            manager.should_commit()
+    finally:
+        manager.shutdown()
+
+
+def test_quorum_happens_in_background(store) -> None:
+    import threading
+    import time
+
+    client = MagicMock()
+    gate = threading.Event()
+
+    def slow_quorum(**kwargs):
+        gate.wait(timeout=10)
+        return make_quorum()
+
+    client._quorum.side_effect = slow_quorum
+    client.should_commit.return_value = True
+    manager, _, _ = make_manager(store, client_mock=client)
+    try:
+        t0 = time.monotonic()
+        manager.start_quorum()
+        # Returns immediately despite the slow quorum RPC.
+        assert time.monotonic() - t0 < 1.0
+        gate.set()
+        manager.wait_quorum()
+        assert manager.is_participating()
+    finally:
+        manager.shutdown()

@@ -1,0 +1,3 @@
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+__all__ = ["CheckpointTransport"]

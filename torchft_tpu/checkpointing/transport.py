@@ -1,0 +1,42 @@
+"""Checkpoint transport abstraction for live peer-to-peer weight recovery.
+
+Reference parity: CheckpointTransport ABC, torchft/checkpointing/transport.py:14-69.
+A transport moves a full state dict (a pytree of jax/numpy arrays plus
+metadata) from a healthy replica group to a recovering one *while training
+continues* on the healthy groups.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Generic, List, TypeVar
+
+T = TypeVar("T")
+
+
+class CheckpointTransport(ABC, Generic[T]):
+    @abstractmethod
+    def metadata(self) -> str:
+        """Returns transport metadata (e.g. "http://host:port") relayed to
+        recovering peers through the manager quorum."""
+
+    @abstractmethod
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T, timeout: float
+    ) -> None:
+        """Makes `state_dict` for `step` available to the destination replica
+        ranks (push- or pull-based depending on the transport)."""
+
+    def disallow_checkpoint(self) -> None:
+        """Called when the weights are about to be mutated (optimizer step);
+        pull-based transports must stop serving the stale checkpoint."""
+
+    @abstractmethod
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> T:
+        """Fetches the state dict for `step` from the source replica rank
+        using its advertised `metadata`."""
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Releases transport resources."""

@@ -1,0 +1,710 @@
+"""Reconfigurable collectives for the fault-tolerant replica dimension.
+
+Reference parity: torchft/process_group.py.  The reference reconfigures torch
+c10d ProcessGroups (Gloo/NCCL) on every quorum change; XLA has no notion of a
+dynamically sized mesh — a compiled program's collectives are fixed at trace
+time — so the cross-replica-group dimension lives at the host layer: a
+``Collective`` moves host buffers between replica groups over TCP (the DCN
+path), while all intra-group parallelism stays inside the pjit-compiled
+program over ICI (see torchft_tpu/parallel/).
+
+Semantics carried over from the reference:
+  - ``configure(store_addr, rank, world_size)`` tears down the old
+    communicator and rendezvouses a new one; safe to call at every quorum
+    change (torchft/process_group.py:253-268).
+  - operations return ``Work`` futures; errors are latched and surfaced via
+    ``errored()`` rather than raised into the train loop
+    (torchft/process_group.py:333-349).
+  - ``abort()`` cancels in-flight operations without killing the process —
+    the analogue of NCCL abort (torchft/process_group.py:650-727).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from abc import ABC, abstractmethod
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu._native import StoreClient
+from torchft_tpu.futures import completed_future, failed_future
+
+__all__ = [
+    "Work",
+    "Collective",
+    "DummyCollective",
+    "TCPCollective",
+    "ErrorSwallowingCollective",
+    "ManagedCollective",
+]
+
+
+class Work:
+    """Handle for an async collective operation (the c10d Work analogue)."""
+
+    def __init__(self, future: Future) -> None:
+        self._future = future
+
+    def wait(self, timeout: Optional[float] = None):
+        return self._future.result(timeout=timeout)
+
+    def result(self, timeout: Optional[float] = None):
+        return self._future.result(timeout=timeout)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def exception(self, timeout: Optional[float] = None):
+        return self._future.exception(timeout=timeout)
+
+    def future(self) -> Future:
+        return self._future
+
+    def add_done_callback(self, fn: Callable[[Future], None]) -> None:
+        self._future.add_done_callback(fn)
+
+
+class Collective(ABC):
+    """Abstract reconfigurable collective over the replica-group dimension.
+
+    The full collective surface of the reference's ProcessGroup
+    (torchft/process_group.py:115-251) mapped to host arrays: allreduce,
+    allgather, broadcast, reduce_scatter, alltoall, barrier, send/recv.
+    """
+
+    @abstractmethod
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        """(Re)builds the communicator; aborts any previous one.  store_addr
+        is "host:port/prefix" — a unique prefix per quorum round prevents
+        rendezvous collisions with stale rounds (torchft/manager.py:503)."""
+
+    @abstractmethod
+    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        """Elementwise reduction across ranks; results replace `arrays`
+        contents in the returned Work's result list."""
+
+    @abstractmethod
+    def allgather(self, array: np.ndarray) -> Work:
+        """Gathers each rank's array; result is a list of world_size arrays."""
+
+    @abstractmethod
+    def broadcast(self, array: np.ndarray, root: int = 0) -> Work:
+        """Broadcasts root's array to all ranks; result is the array."""
+
+    @abstractmethod
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        """Reduces world_size equal chunks and scatters: rank i receives the
+        reduction of every rank's arrays[i]."""
+
+    @abstractmethod
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        """Rank i sends arrays[j] to rank j; result is the received list."""
+
+    @abstractmethod
+    def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
+        ...
+
+    @abstractmethod
+    def recv(self, shape: tuple, dtype, src: int, tag: int = 0) -> Work:
+        ...
+
+    @abstractmethod
+    def barrier(self) -> Work:
+        ...
+
+    @abstractmethod
+    def size(self) -> int:
+        ...
+
+    @abstractmethod
+    def rank(self) -> int:
+        ...
+
+    def abort(self) -> None:
+        """Cancels in-flight work and poisons the communicator until the next
+        configure()."""
+
+    def errored(self) -> Optional[Exception]:
+        """Returns the latched error, if any."""
+        return None
+
+    def shutdown(self) -> None:
+        self.abort()
+
+
+class DummyCollective(Collective):
+    """World-size-1 no-op collective: copies inputs to outputs and completes
+    immediately.  Used to soak init-time collectives and as post-error
+    placeholder (reference: ProcessGroupDummy, torchft/process_group.py:730-864)."""
+
+    def __init__(self, rank: int = 0, world_size: int = 1) -> None:
+        self._rank = rank
+        self._world_size = world_size
+        self.configure_count = 0
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._rank = rank
+        self._world_size = world_size
+        self.configure_count += 1
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        out = [np.array(a, copy=True) for a in arrays]
+        if op == "avg":
+            out = [a / 1.0 for a in out]
+        return Work(completed_future(out))
+
+    def allgather(self, array: np.ndarray) -> Work:
+        return Work(completed_future([np.array(array, copy=True)]))
+
+    def broadcast(self, array: np.ndarray, root: int = 0) -> Work:
+        return Work(completed_future(np.array(array, copy=True)))
+
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        return Work(completed_future(np.array(arrays[0], copy=True)))
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        return Work(completed_future([np.array(a, copy=True) for a in arrays]))
+
+    def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
+        return Work(completed_future(None))
+
+    def recv(self, shape: tuple, dtype, src: int, tag: int = 0) -> Work:
+        return Work(completed_future(np.zeros(shape, dtype)))
+
+    def barrier(self) -> Work:
+        return Work(completed_future(None))
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+
+# ---------------------------------------------------------------------------
+# TCP ring collective — the cross-group (DCN) data plane.
+# ---------------------------------------------------------------------------
+
+_HDR = struct.Struct("<IQ")  # tag, nbytes
+
+
+class _Peer:
+    """A framed duplex TCP link to one peer rank."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.recv_lock = threading.Lock()
+
+    def send_msg(self, tag: int, payload: memoryview) -> None:
+        with self.send_lock:
+            self.sock.sendall(_HDR.pack(tag, len(payload)))
+            self.sock.sendall(payload)
+
+    def recv_msg(self, expect_tag: int) -> bytes:
+        with self.recv_lock:
+            hdr = self._recv_exact(_HDR.size)
+            tag, nbytes = _HDR.unpack(hdr)
+            if tag != expect_tag:
+                raise RuntimeError(f"collective protocol error: tag {tag} != {expect_tag}")
+            return self._recv_exact(nbytes)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = self.sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise ConnectionError("peer connection closed")
+            got += r
+        return bytes(buf)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class TCPCollective(Collective):
+    """Ring collective over TCP sockets between replica groups.
+
+    This is the tpu-ft data plane for the *replica* (DCN) dimension: gradients
+    have already been reduced over ICI inside the pjit step; what crosses
+    groups is one host buffer per ring chunk.  Ring allreduce moves
+    2*(n-1)/n of the data per rank — bandwidth optimal, and each group talks
+    only to its ring neighbors, matching how DCN links are provisioned.
+
+    Reconfiguration: rendezvous through the group store under a caller-chosen
+    prefix; every rank publishes "host:port", rank i dials rank (i+1)%n.
+    abort() closes the sockets, causing in-flight ops to fail fast and latch
+    an error until the next configure() (the NCCL-abort analogue,
+    torchft/process_group.py:584-647).
+    """
+
+    RENDEZVOUS_TIMEOUT_MS = 60000
+
+    def __init__(self, timeout: float = 60.0, chunk_bytes: int = 4 << 20) -> None:
+        self._timeout = timeout
+        self._chunk_bytes = chunk_bytes
+        self._lock = threading.Lock()
+        self._executor: Optional[object] = None
+        self._rank = 0
+        self._world_size = 1
+        self._next: Optional[_Peer] = None  # link to (rank+1) % n
+        self._prev: Optional[_Peer] = None  # link to (rank-1) % n
+        self._peers: dict[int, _Peer] = {}
+        self._listener: Optional[socket.socket] = None
+        self._error: Optional[Exception] = None
+        self._op_error: Optional[Exception] = None
+        self._generation = 0
+        self._store: Optional[StoreClient] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self.abort()
+        with self._lock:
+            self._error = None
+            self._op_error = None
+            self._rank = rank
+            self._world_size = world_size
+            self._generation += 1
+            if world_size == 1:
+                return
+            self._store = StoreClient(store_addr)
+            self._rendezvous()
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="tpuft_collective"
+            )
+
+    def _rendezvous(self) -> None:
+        listener = socket.create_server(("", 0), family=socket.AF_INET6, dualstack_ipv6=True)
+        listener.listen(16)
+        self._listener = listener
+        port = listener.getsockname()[1]
+        host = socket.gethostname()
+        store = self._store
+        store.set(f"rank_{self._rank}", f"{host}:{port}".encode())
+
+        n = self._world_size
+        rank = self._rank
+        # Full mesh is unnecessary: ring ops need next/prev; point-to-point
+        # (send/recv, used by checkpoint transports) dials lazily.
+        next_rank = (rank + 1) % n
+        prev_rank = (rank - 1) % n
+
+        accepted: dict[int, _Peer] = {}
+        accept_err: List[Exception] = []
+
+        def accept_loop() -> None:
+            # Every rank accepts a connection from its prev (for the "next"
+            # direction) — plus lazy point-to-point dials later.
+            try:
+                listener.settimeout(self.RENDEZVOUS_TIMEOUT_MS / 1000)
+                conn, _ = listener.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                peer = _Peer(conn)
+                their_rank = struct.unpack("<I", peer._recv_exact(4))[0]
+                accepted[their_rank] = peer
+            except Exception as e:  # noqa: BLE001
+                accept_err.append(e)
+
+        acceptor = threading.Thread(target=accept_loop, daemon=True)
+        acceptor.start()
+
+        # Dial our next neighbor.
+        addr = store.get(f"rank_{next_rank}", wait=True, timeout_ms=self.RENDEZVOUS_TIMEOUT_MS)
+        if addr is None:
+            raise TimeoutError(f"rendezvous: rank {next_rank} never published its address")
+        nhost, nport = addr.decode().rsplit(":", 1)
+        sock = socket.create_connection((nhost, int(nport)), timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._next = _Peer(sock)
+        self._next.sock.sendall(struct.pack("<I", rank))
+
+        acceptor.join(timeout=self.RENDEZVOUS_TIMEOUT_MS / 1000)
+        if accept_err:
+            raise accept_err[0]
+        if prev_rank not in accepted:
+            raise TimeoutError(f"rendezvous: rank {prev_rank} never connected")
+        self._prev = accepted[prev_rank]
+        if n == 2:
+            # With two ranks next and prev are the same peer but distinct
+            # sockets, which keeps the ring protocol direction-safe.
+            pass
+        self._peers = {next_rank: self._next}
+
+    def _dial(self, peer_rank: int) -> _Peer:
+        """Lazy point-to-point link for send/recv outside the ring."""
+        with self._lock:
+            peer = self._peers.get(peer_rank)
+            if peer is not None:
+                return peer
+        raise RuntimeError(
+            f"no link to rank {peer_rank}; TCPCollective point-to-point requires "
+            "ring neighbors (use the HTTP checkpoint transport for arbitrary pairs)"
+        )
+
+    def abort(self) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = RuntimeError("collective aborted")
+            for peer in (self._next, self._prev):
+                if peer is not None:
+                    peer.close()
+            if self._listener is not None:
+                self._listener.close()
+                self._listener = None
+            self._next = None
+            self._prev = None
+            self._peers = {}
+            if self._executor is not None:
+                self._executor.shutdown(wait=False, cancel_futures=True)
+                self._executor = None
+            if self._store is not None:
+                self._store.close()
+                self._store = None
+
+    def errored(self) -> Optional[Exception]:
+        """Reports latched operation failures; cleared by configure()."""
+        with self._lock:
+            return self._op_error
+
+    def _latch(self, exc: Exception) -> None:
+        with self._lock:
+            if self._op_error is None:
+                self._op_error = exc
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+    # -- ops ----------------------------------------------------------------
+
+    def _submit(self, fn: Callable[[], object]) -> Work:
+        if self._world_size == 1:
+            try:
+                return Work(completed_future(fn()))
+            except Exception as e:  # noqa: BLE001
+                self._latch(e)
+                return Work(failed_future(e))
+        with self._lock:
+            executor = self._executor
+            gen = self._generation
+        if executor is None:
+            err = self._op_error or RuntimeError("collective not configured")
+            return Work(failed_future(err))
+
+        def run() -> object:
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001
+                self._latch(e)
+                raise
+
+        return Work(executor.submit(run))
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        if self._world_size == 1:
+            return Work(completed_future(list(arrays)))
+        return self._submit(lambda: self._ring_allreduce(arrays, op))
+
+    def _ring_allreduce(self, arrays: List[np.ndarray], op: str) -> List[np.ndarray]:
+        n = self._world_size
+        rank = self._rank
+        # Flatten all arrays into one contiguous f64-safe working buffer of
+        # the common dtype to cut per-message overhead.
+        flat = np.concatenate([a.reshape(-1) for a in arrays]) if len(arrays) > 1 \
+            else arrays[0].reshape(-1).copy()
+        chunks = np.array_split(flat, n)
+        offsets = np.cumsum([0] + [c.size for c in chunks])
+
+        # Reduce-scatter phase: after n-1 steps, chunk (rank+1)%n holds the
+        # full reduction on this rank.
+        for step in range(n - 1):
+            send_idx = (rank - step) % n
+            recv_idx = (rank - step - 1) % n
+            self._next.send_msg(1, memoryview(np.ascontiguousarray(chunks[send_idx])).cast("B"))
+            incoming = np.frombuffer(self._prev.recv_msg(1), dtype=flat.dtype)
+            chunks[recv_idx] = chunks[recv_idx] + incoming
+
+        # Allgather phase: circulate the reduced chunks.
+        for step in range(n - 1):
+            send_idx = (rank - step + 1) % n
+            recv_idx = (rank - step) % n
+            self._next.send_msg(2, memoryview(np.ascontiguousarray(chunks[send_idx])).cast("B"))
+            chunks[recv_idx] = np.frombuffer(self._prev.recv_msg(2), dtype=flat.dtype).copy()
+
+        out_flat = np.concatenate(chunks)
+        if op == "avg":
+            out_flat = out_flat / n
+        elif op == "max":
+            raise NotImplementedError("ring max: use allgather")
+        out: List[np.ndarray] = []
+        pos = 0
+        for a in arrays:
+            out.append(out_flat[pos : pos + a.size].reshape(a.shape).astype(a.dtype, copy=False))
+            pos += a.size
+        return out
+
+    def allgather(self, array: np.ndarray) -> Work:
+        array = np.ascontiguousarray(array)
+        if self._world_size == 1:
+            return Work(completed_future([array.copy()]))
+        return self._submit(lambda: self._ring_allgather(array))
+
+    def _ring_allgather(self, array: np.ndarray) -> List[np.ndarray]:
+        import pickle
+
+        n = self._world_size
+        rank = self._rank
+        slots: List[Optional[bytes]] = [None] * n
+        slots[rank] = pickle.dumps(array)
+        for step in range(n - 1):
+            send_idx = (rank - step) % n
+            recv_idx = (rank - step - 1) % n
+            self._next.send_msg(3, memoryview(slots[send_idx]))
+            slots[recv_idx] = self._prev.recv_msg(3)
+        return [pickle.loads(s) for s in slots]
+
+    def broadcast(self, array: np.ndarray, root: int = 0) -> Work:
+        array = np.ascontiguousarray(array)
+        if self._world_size == 1:
+            return Work(completed_future(array.copy()))
+
+        def run() -> np.ndarray:
+            out = self._ring_allgather(array)[root]
+            return out
+
+        return self._submit(run)
+
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        if self._world_size == 1:
+            return Work(completed_future(arrays[0].copy()))
+        if len(arrays) != self._world_size:
+            return Work(
+                failed_future(
+                    ValueError(
+                        f"reduce_scatter needs world_size={self._world_size} inputs, "
+                        f"got {len(arrays)}"
+                    )
+                )
+            )
+
+        def run() -> np.ndarray:
+            # Implemented over ring allreduce of the stacked buffer; rank i
+            # keeps slice i.  Adequate for the replica dim's small world sizes.
+            stacked = np.stack(arrays)
+            reduced = self._ring_allreduce([stacked], op)[0]
+            return reduced[self._rank]
+
+        return self._submit(run)
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        if self._world_size == 1:
+            return Work(completed_future([arrays[0].copy()]))
+
+        def run() -> List[np.ndarray]:
+            import pickle
+
+            n = self._world_size
+            rank = self._rank
+            # Route through the ring: circulate everyone's full payload list.
+            slots: List[Optional[bytes]] = [None] * n
+            slots[rank] = pickle.dumps(list(arrays))
+            for step in range(n - 1):
+                send_idx = (rank - step) % n
+                recv_idx = (rank - step - 1) % n
+                self._next.send_msg(4, memoryview(slots[send_idx]))
+                slots[recv_idx] = self._prev.recv_msg(4)
+            lists = [pickle.loads(s) for s in slots]
+            return [lists[src][rank] for src in range(n)]
+
+        return self._submit(run)
+
+    def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
+        array = np.ascontiguousarray(array)
+
+        def run() -> None:
+            import pickle
+
+            peer = self._dial(dst)
+            peer.send_msg(100 + tag, memoryview(pickle.dumps(array)))
+
+        return self._submit(run)
+
+    def recv(self, shape: tuple, dtype, src: int, tag: int = 0) -> Work:
+        def run() -> np.ndarray:
+            import pickle
+
+            if src == (self._rank - 1) % self._world_size:
+                peer = self._prev
+            else:
+                peer = self._dial(src)
+            return pickle.loads(peer.recv_msg(100 + tag))
+
+        return self._submit(run)
+
+    def barrier(self) -> Work:
+        if self._world_size == 1:
+            return Work(completed_future(None))
+        token = np.zeros(1, dtype=np.int32)
+        return self._submit(lambda: (self._ring_allreduce([token], "sum"), None)[1])
+
+
+class ErrorSwallowingCollective(Collective):
+    """Latches the first error and turns subsequent operations into immediate
+    no-ops until the next configure() (reference:
+    ErrorSwallowingProcessGroupWrapper, torchft/process_group.py:906-960)."""
+
+    def __init__(self, inner: Collective) -> None:
+        self._inner = inner
+        self._error: Optional[Exception] = None
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._error = None
+        self._inner.configure(store_addr, rank, world_size)
+
+    def errored(self) -> Optional[Exception]:
+        return self._error or self._inner.errored()
+
+    def report_error(self, exc: Exception) -> None:
+        if self._error is None:
+            self._error = exc
+
+    def _guard(self, fn: Callable[[], Work], fallback) -> Work:
+        if self.errored() is not None:
+            return Work(completed_future(fallback))
+        work = fn()
+
+        def on_done(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                self.report_error(exc)
+
+        work.add_done_callback(on_done)
+        # Swallow: map failure to the fallback value.
+        out: Future = Future()
+
+        def settle(f: Future) -> None:
+            if f.exception() is not None:
+                out.set_result(fallback)
+            else:
+                out.set_result(f.result())
+
+        work.future().add_done_callback(settle)
+        return Work(out)
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        return self._guard(lambda: self._inner.allreduce(arrays, op), list(arrays))
+
+    def allgather(self, array: np.ndarray) -> Work:
+        return self._guard(lambda: self._inner.allgather(array), [array])
+
+    def broadcast(self, array: np.ndarray, root: int = 0) -> Work:
+        return self._guard(lambda: self._inner.broadcast(array, root), array)
+
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        return self._guard(lambda: self._inner.reduce_scatter(arrays, op), arrays[0])
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._guard(lambda: self._inner.alltoall(arrays), list(arrays))
+
+    def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
+        return self._guard(lambda: self._inner.send(array, dst, tag), None)
+
+    def recv(self, shape: tuple, dtype, src: int, tag: int = 0) -> Work:
+        return self._guard(
+            lambda: self._inner.recv(shape, dtype, src, tag), np.zeros(shape, dtype)
+        )
+
+    def barrier(self) -> Work:
+        return self._guard(lambda: self._inner.barrier(), None)
+
+    def size(self) -> int:
+        return self._inner.size()
+
+    def rank(self) -> int:
+        return self._inner.rank()
+
+    def abort(self) -> None:
+        self._inner.abort()
+
+
+class ManagedCollective(Collective):
+    """Collective facade bound to a Manager: operations wait for quorum, report
+    errors to the manager, and size() reflects the dynamic participant count.
+    This is what makes mesh/array code see the fault-tolerant replica
+    dimension (reference: ManagedProcessGroup, torchft/process_group.py:963-1028)."""
+
+    def __init__(self, manager) -> None:  # Manager; untyped to avoid cycle
+        self._manager = manager
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._manager._collective.configure(store_addr, rank, world_size)
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        futs = [self._manager.allreduce(a) for a in arrays]
+        out: Future = Future()
+
+        def gather(_f: Future) -> None:
+            if all(f.done() for f in futs) and not out.done():
+                out.set_result([f.result() for f in futs])
+
+        for f in futs:
+            f.add_done_callback(gather)
+        return Work(out)
+
+    def allgather(self, array: np.ndarray) -> Work:
+        self._manager.wait_quorum()
+        return self._manager._collective.allgather(array)
+
+    def broadcast(self, array: np.ndarray, root: int = 0) -> Work:
+        self._manager.wait_quorum()
+        return self._manager._collective.broadcast(array, root)
+
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        self._manager.wait_quorum()
+        return self._manager._collective.reduce_scatter(arrays, op)
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        self._manager.wait_quorum()
+        return self._manager._collective.alltoall(arrays)
+
+    def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
+        self._manager.wait_quorum()
+        return self._manager._collective.send(array, dst, tag)
+
+    def recv(self, shape: tuple, dtype, src: int, tag: int = 0) -> Work:
+        self._manager.wait_quorum()
+        return self._manager._collective.recv(shape, dtype, src, tag)
+
+    def barrier(self) -> Work:
+        self._manager.wait_quorum()
+        return self._manager._collective.barrier()
+
+    def size(self) -> int:
+        return self._manager.num_participants()
+
+    def rank(self) -> int:
+        return self._manager.participating_rank() or 0
+
+    def errored(self) -> Optional[Exception]:
+        return self._manager.errored()
+
+    def abort(self) -> None:
+        self._manager._collective.abort()

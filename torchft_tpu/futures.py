@@ -1,0 +1,166 @@
+"""Future timeout plumbing.
+
+Reference parity: torchft/futures.py — a singleton timeout manager running a
+single background thread arms deadlines for futures and context blocks so
+that a stuck collective or RPC surfaces as a ``TimeoutError`` on the wrapped
+future instead of hanging the train loop.  The reference drives torch Futures
+and CUDA events from an asyncio loop thread (torchft/futures.py:88-210); here
+the unit of work is a ``concurrent.futures.Future`` and device-side waits are
+handled by JAX's async dispatch, so a heap-of-deadlines timer thread suffices.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from concurrent.futures import Future
+from contextlib import contextmanager
+from typing import Any, Callable, Generator, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class _TimeoutManager:
+    """Singleton deadline scheduler (reference: _TimeoutManager,
+    torchft/futures.py:88-207)."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._heap: list[tuple[float, int, Callable[[], None]]] = []
+        self._counter = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._cancelled: set[int] = set()
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="tpuft_timeout_manager", daemon=True
+            )
+            self._thread.start()
+
+    def register(self, delay: float, callback: Callable[[], None]) -> int:
+        """Schedules callback to fire after `delay` seconds; returns a handle
+        usable with cancel()."""
+        import time
+
+        with self._cond:
+            handle = next(self._counter)
+            heapq.heappush(self._heap, (time.monotonic() + delay, handle, callback))
+            self._ensure_thread()
+            self._cond.notify()
+        return handle
+
+    def cancel(self, handle: int) -> None:
+        with self._cond:
+            self._cancelled.add(handle)
+            self._cond.notify()
+
+    def _run(self) -> None:
+        import time
+
+        while True:
+            with self._cond:
+                while not self._heap:
+                    self._cond.wait()
+                deadline, handle, callback = self._heap[0]
+                now = time.monotonic()
+                if handle in self._cancelled:
+                    heapq.heappop(self._heap)
+                    self._cancelled.discard(handle)
+                    continue
+                if deadline > now:
+                    self._cond.wait(timeout=deadline - now)
+                    continue
+                heapq.heappop(self._heap)
+            try:
+                callback()
+            except Exception:
+                pass
+
+
+_TIMEOUT_MANAGER = _TimeoutManager()
+
+
+def future_timeout(fut: Future, timeout: float) -> Future:
+    """Returns a future that mirrors `fut` but fails with TimeoutError if it
+    does not complete within `timeout` seconds (reference:
+    future_timeout, torchft/futures.py:210-222)."""
+    out: Future = Future()
+
+    def on_timeout() -> None:
+        if not out.done():
+            out.set_exception(
+                TimeoutError(f"future did not complete within {timeout}s")
+            )
+
+    handle = _TIMEOUT_MANAGER.register(timeout, on_timeout)
+
+    def on_done(f: Future) -> None:
+        _TIMEOUT_MANAGER.cancel(handle)
+        if out.done():
+            return
+        exc = f.exception()
+        if exc is not None:
+            out.set_exception(exc)
+        else:
+            out.set_result(f.result())
+
+    fut.add_done_callback(on_done)
+    return out
+
+
+def future_wait(fut: Future, timeout: float) -> Any:
+    """Blocking wait with a deadline (reference: future_wait,
+    torchft/futures.py:225-252)."""
+    try:
+        return fut.result(timeout=timeout)
+    except TimeoutError:
+        raise
+    except Exception:
+        raise
+
+
+@contextmanager
+def context_timeout(callback: Callable[[], None], timeout: float) -> Generator[None, None, None]:
+    """Runs `callback` (typically an abort) if the with-block does not finish
+    within `timeout` seconds (reference: context_timeout,
+    torchft/futures.py:270-282)."""
+    handle = _TIMEOUT_MANAGER.register(timeout, callback)
+    try:
+        yield
+    finally:
+        _TIMEOUT_MANAGER.cancel(handle)
+
+
+def completed_future(value: T = None) -> Future:
+    """A future already resolved with `value`."""
+    fut: Future = Future()
+    fut.set_result(value)
+    return fut
+
+
+def failed_future(exc: Exception) -> Future:
+    fut: Future = Future()
+    fut.set_exception(exc)
+    return fut
+
+
+def then(fut: Future, fn: Callable[[Any], T]) -> Future:
+    """Chains a continuation onto `fut`, producing a new future with fn's
+    result (the torch Future.then analogue used for grad normalization,
+    torchft/manager.py:297-311)."""
+    out: Future = Future()
+
+    def on_done(f: Future) -> None:
+        exc = f.exception()
+        if exc is not None:
+            out.set_exception(exc)
+            return
+        try:
+            out.set_result(fn(f.result()))
+        except Exception as e:  # noqa: BLE001
+            out.set_exception(e)
+
+    fut.add_done_callback(on_done)
+    return out

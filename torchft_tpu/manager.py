@@ -1,0 +1,629 @@
+"""Fault-tolerance Manager: the per-replica-group training-loop state machine.
+
+Reference parity: torchft/manager.py.  The Manager owns everything the train
+loop needs for per-step fault tolerance:
+
+  - async quorum: each step starts a quorum computation on a background
+    thread that overlaps with the forward/backward pass
+    (torchft/manager.py:385-438);
+  - reconfiguration: when the quorum id changes, the cross-group collective
+    is rebuilt against a fresh store prefix (torchft/manager.py:502-509);
+  - healing: behind replicas stream weights from a healthy peer through a
+    CheckpointTransport while the healthy groups keep training
+    (torchft/manager.py:511-568);
+  - error latching: collective failures never raise into the train loop;
+    they mark the step failed and are resolved at commit time
+    (torchft/manager.py:262-383);
+  - commit protocol: an optimizer step lands only when every local rank of
+    the group voted success (torchft/manager.py:587-663).
+
+TPU adaptations: the unit of data is a pytree leaf (jax.Array / numpy array)
+rather than a torch tensor; cross-group traffic runs on a host-level
+Collective over the DCN path (see torchft_tpu/collectives.py) because XLA
+programs cannot change their collective world at runtime; the reference's
+dedicated CUDA recovery stream maps to performing transfers on the quorum
+thread while JAX async dispatch keeps device compute running.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from datetime import timedelta
+from enum import Enum
+from typing import Callable, Dict, List, Optional, TypeVar, cast
+
+import numpy as np
+
+from torchft_tpu._native import ManagerClient, ManagerServer, StoreClient, StoreServer
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.collectives import Collective
+from torchft_tpu.futures import completed_future, future_timeout
+
+T = TypeVar("T")
+
+MANAGER_ADDR_KEY: str = "manager_addr"
+REPLICA_ID_KEY: str = "replica_id"
+
+# Environment knobs (reference: torchft/manager.py:50,166-205).
+TPUFT_LIGHTHOUSE_ENV: str = "TPUFT_LIGHTHOUSE"
+TPUFT_MANAGER_PORT_ENV: str = "TPUFT_MANAGER_PORT"
+
+
+class WorldSizeMode(Enum):
+    """How the effective batch/world size behaves as replica groups come and
+    go (reference: WorldSizeMode, torchft/manager.py:56-71)."""
+
+    DYNAMIC = 0
+    FIXED_WITH_SPARES = 1
+
+
+class ExceededMaxRetriesError(RuntimeError):
+    """Raised by should_commit after max_retries consecutive failed commits
+    (reference: torchft/manager.py:652-661)."""
+
+
+class Manager:
+    """Fault tolerance manager for one local rank of one replica group."""
+
+    def __init__(
+        self,
+        collective: Collective,
+        load_state_dict: Optional[Callable[[T], None]],
+        state_dict: Optional[Callable[[], T]],
+        min_replica_size: int,
+        use_async_quorum: bool = True,
+        timeout: timedelta = timedelta(seconds=60),
+        quorum_timeout: timedelta = timedelta(seconds=60),
+        connect_timeout: timedelta = timedelta(seconds=10),
+        rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        fixed_world_size: Optional[int] = None,
+        store_addr: Optional[str] = None,
+        store_port: Optional[int] = None,
+        external_store_addr: Optional[str] = None,
+        lighthouse_addr: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        manager_bind: Optional[str] = None,
+        heartbeat_interval: timedelta = timedelta(milliseconds=100),
+        checkpoint_transport: Optional[CheckpointTransport] = None,
+        init_sync: bool = True,
+        max_retries: Optional[int] = None,
+    ) -> None:
+        """
+        Args:
+            collective: reconfigurable cross-group collective (data plane).
+            load_state_dict: applies a user state dict fetched from a peer.
+            state_dict: captures the user state dict to serve to peers.
+            min_replica_size: minimum replica groups for a committable step.
+            use_async_quorum: overlap quorum with forward/backward.
+            rank/world_size: local rank / ranks per group (env: RANK,
+                WORLD_SIZE).
+            store_addr/store_port: host + port for the group's rendezvous
+                store, created by local rank 0 (env: MASTER_ADDR/MASTER_PORT).
+            external_store_addr: use an existing store (tests / shared infra).
+            lighthouse_addr: lighthouse RPC address (env: TPUFT_LIGHTHOUSE).
+            replica_id: stable replica-group id; a ":uuid" suffix is added so
+                fast restarts look like new members (torchft/manager.py:230-238).
+            init_sync: sync weights from replica 0 at step 0.
+            max_retries: consecutive failed commits before giving up.
+        """
+        self._load_state_dict_fns: Dict[str, Callable] = {}
+        self._user_state_dicts: Dict[str, Callable] = {}
+        if load_state_dict is not None:
+            self._load_state_dict_fns["default"] = load_state_dict
+        if state_dict is not None:
+            self._user_state_dicts["default"] = state_dict
+
+        self._collective = collective
+        self._min_replica_size = min_replica_size
+        self._use_async_quorum = use_async_quorum
+        self._timeout = timeout
+        self._quorum_timeout = quorum_timeout
+        self._connect_timeout = connect_timeout
+        self._world_size_mode = world_size_mode
+        self._init_sync = init_sync
+        self._max_retries = max_retries
+        self._commit_failures = 0
+
+        self._rank: int = rank if rank is not None else int(os.environ.get("RANK", 0))
+        group_world_size = world_size if world_size is not None else int(
+            os.environ.get("WORLD_SIZE", 1)
+        )
+        self._group_world_size: int = group_world_size
+        self._fixed_world_size = fixed_world_size
+
+        lighthouse_addr = lighthouse_addr or os.environ.get(TPUFT_LIGHTHOUSE_ENV, "")
+
+        self._store_server: Optional[StoreServer] = None
+        self._manager_server: Optional[ManagerServer] = None
+
+        if external_store_addr is not None:
+            store_address = external_store_addr
+            self._store = StoreClient(store_address)
+        else:
+            store_host = store_addr or os.environ.get("MASTER_ADDR", "localhost")
+            port = store_port if store_port is not None else int(
+                os.environ.get("MASTER_PORT", 0)
+            )
+            if self._rank == 0:
+                self._store_server = StoreServer(bind=f"[::]:{port}")
+                actual_port = self._store_server.address().rsplit(":", 1)[1]
+                store_address = f"{store_host}:{actual_port}"
+            else:
+                if port == 0:
+                    raise ValueError(
+                        "non-zero store_port (or MASTER_PORT) required for rank > 0"
+                    )
+                store_address = f"{store_host}:{port}"
+            self._store = StoreClient(
+                store_address, connect_timeout_ms=int(connect_timeout.total_seconds() * 1000)
+            )
+        self._store_address = store_address
+
+        if self._rank == 0:
+            if replica_id is None:
+                replica_id = os.environ.get("REPLICA_GROUP_ID", socket.gethostname())
+            # Suffix survives fast restarts: a restarted group must look like
+            # a brand-new member to the lighthouse (torchft/manager.py:230-238).
+            new_uuid = str(uuid.uuid4())
+            replica_id = f"{replica_id}:{new_uuid}" if replica_id else new_uuid
+            bind = manager_bind or "[::]:" + os.environ.get(TPUFT_MANAGER_PORT_ENV, "0")
+            if not lighthouse_addr:
+                raise ValueError(
+                    f"lighthouse_addr or ${TPUFT_LIGHTHOUSE_ENV} must be set"
+                )
+            self._manager_server = ManagerServer(
+                replica_id=replica_id,
+                lighthouse_addr=lighthouse_addr,
+                bind=bind,
+                store_addr=store_address,
+                world_size=group_world_size,
+                heartbeat_interval_ms=int(heartbeat_interval.total_seconds() * 1000),
+                connect_timeout_ms=int(connect_timeout.total_seconds() * 1000),
+            )
+            self._store.set(MANAGER_ADDR_KEY, self._manager_server.address().encode())
+            self._store.set(REPLICA_ID_KEY, replica_id.encode())
+
+        addr = self._store.get(
+            MANAGER_ADDR_KEY, wait=True,
+            timeout_ms=int(connect_timeout.total_seconds() * 1000),
+        )
+        assert addr is not None
+        # Captured so the healing path dials peer managers through the same
+        # (mockable) factory.
+        self._manager_client_factory = ManagerClient
+        self._client = self._manager_client_factory(
+            addr.decode(), connect_timeout_ms=int(connect_timeout.total_seconds() * 1000)
+        )
+        rid = self._store.get(REPLICA_ID_KEY, wait=True)
+        assert rid is not None
+        self._replica_id = rid.decode()
+
+        self._checkpoint_transport = checkpoint_transport
+
+        self._step = 0
+        self._quorum_id = -1
+        self._batches_committed = 0
+        self._healing = False
+        self._errored: Optional[Exception] = None
+        self._pending_work: List[Future] = []
+        self._pending_state_dict: Optional[Dict[str, object]] = None
+        self._quorum_future: Optional[Future] = None
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpuft_quorum"
+        )
+
+        self._participating_replica_rank: Optional[int] = None
+        self._participating_replica_world_size: int = 0
+
+        self._logger = _ManagerLogger(self, self._replica_id, self._rank)
+
+    # -- registration -------------------------------------------------------
+
+    def register_state_dict_fn(
+        self, key: str, load: Callable[[object], None], save: Callable[[], object]
+    ) -> None:
+        """Registers an additional named state-dict provider (wrappers like
+        LocalSGD/DiLoCo register theirs here)."""
+        self._load_state_dict_fns[key] = load
+        self._user_state_dicts[key] = save
+
+    def set_checkpoint_transport(self, transport: CheckpointTransport) -> None:
+        self._checkpoint_transport = transport
+
+    # -- quorum -------------------------------------------------------------
+
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: Optional[timedelta] = None,
+    ) -> None:
+        """Starts the next-step quorum computation, possibly async.
+
+        Must be called at the top of every step (the optimizer wrapper does
+        it from zero_grad).  Reference: torchft/manager.py:385-438.
+        """
+        # Wait for the previous quorum to finish so state isn't mutated
+        # concurrently (torchft/manager.py:411-412).
+        if self._quorum_future is not None:
+            self._quorum_future.result()
+
+        self._errored = None
+        self._healing = False
+        self._pending_work = []
+
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+            quorum_timeout=timeout or self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                # Sync mode applies the fetched state dict eagerly
+                # (torchft/manager.py:429-438).
+                self._apply_pending_state_dict()
+
+    def wait_quorum(self) -> None:
+        """Blocks until the current quorum completes (torchft/manager.py:440-449)."""
+        assert self._quorum_future is not None, "call start_quorum before wait_quorum"
+        self._quorum_future.result()
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: timedelta
+    ) -> None:
+        try:
+            self._quorum_inner(allow_heal, shrink_only, quorum_timeout)
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"quorum failed: {e}")
+            self.report_error(e)
+            # Not participating this step.
+            self._participating_replica_rank = None
+            self._participating_replica_world_size = 0
+
+    def _quorum_inner(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: timedelta
+    ) -> None:
+        metadata = (
+            self._checkpoint_transport.metadata() if self._checkpoint_transport else ""
+        )
+        quorum = self._client._quorum(
+            group_rank=self._rank,
+            step=self._step,
+            checkpoint_metadata=metadata,
+            shrink_only=shrink_only,
+            timeout_ms=int(quorum_timeout.total_seconds() * 1000),
+            init_sync=self._init_sync,
+            commit_failures=self._commit_failures,
+        )
+
+        quorum_id = quorum.quorum_id
+        replica_rank = quorum.replica_rank
+        replica_world_size = quorum.replica_world_size
+        recover_src_replica_rank = quorum.recover_src_replica_rank
+        store_address = quorum.store_address
+        max_step = quorum.max_step
+        heal = quorum.heal
+
+        # Participation bookkeeping (torchft/manager.py:480-500): with async
+        # quorum (or healing disabled) only the up-to-date groups participate
+        # this step — a healing group's max_replica_rank is None; with sync
+        # quorum every group is healthy by the time the step runs.
+        if self._use_async_quorum or not allow_heal:
+            self._participating_replica_rank = quorum.max_replica_rank
+            self._participating_replica_world_size = quorum.max_world_size
+        else:
+            self._participating_replica_rank = replica_rank
+            self._participating_replica_world_size = replica_world_size
+
+        # FIXED_WITH_SPARES pins the divisor; extra live groups are spares
+        # contributing zeros.
+        if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            fixed = self._fixed_world_size or self._min_replica_size
+            self._participating_replica_world_size = min(
+                self._participating_replica_world_size, fixed
+            )
+            if (
+                self._participating_replica_rank is not None
+                and self._participating_replica_rank >= fixed
+            ):
+                self._participating_replica_rank = None
+
+        if quorum_id != self._quorum_id:
+            # Unique store prefix per (quorum, local rank): local rank r of
+            # every group forms one ring (torchft/manager.py:502-509).
+            prefix = f"tpuft/{quorum_id}/{self._rank}"
+            self._logger.info(
+                f"reconfiguring collective for quorum {quorum_id} "
+                f"(rank {replica_rank}/{replica_world_size})"
+            )
+            self._collective.configure(
+                f"{store_address}/{prefix}", replica_rank, replica_world_size
+            )
+            self._quorum_id = quorum_id
+
+        if allow_heal and self._checkpoint_transport is not None:
+            # Recovery source: serve our weights to the assigned destinations
+            # (torchft/manager.py:511-528).
+            if quorum.recover_dst_replica_ranks:
+                self._logger.info(
+                    f"serving checkpoint at step {max_step} to replicas "
+                    f"{quorum.recover_dst_replica_ranks}"
+                )
+                self._checkpoint_transport.send_checkpoint(
+                    dst_ranks=list(quorum.recover_dst_replica_ranks),
+                    step=max_step,
+                    state_dict=self._manager_state_dict(),
+                    timeout=self._timeout.total_seconds(),
+                )
+            # Recovery destination: fetch weights from our assigned source
+            # (torchft/manager.py:530-568).
+            if heal:
+                self._healing = True
+                src_rank = cast(int, recover_src_replica_rank)
+                self._logger.info(
+                    f"healing from replica {src_rank} "
+                    f"({quorum.recover_src_manager_address}) at step {max_step}"
+                )
+                src_client = self._manager_client_factory(
+                    quorum.recover_src_manager_address,
+                    connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
+                )
+                src_metadata = src_client._checkpoint_metadata(
+                    self._rank, timeout_ms=int(self._timeout.total_seconds() * 1000)
+                )
+                src_client.close()
+                state = self._checkpoint_transport.recv_checkpoint(
+                    src_rank=src_rank,
+                    metadata=src_metadata,
+                    step=max_step,
+                    timeout=self._timeout.total_seconds(),
+                )
+                self._pending_state_dict = cast(Dict[str, object], state)
+                # Fast-forward to the healed step (torchft/manager.py:562-568).
+                self._step = max_step
+        elif heal:
+            self._healing = True
+
+    def _manager_state_dict(self) -> Dict[str, object]:
+        """Full transferable state: user trees + manager bookkeeping
+        (torchft/manager.py:677-694)."""
+        return {
+            "user": {k: fn() for k, fn in self._user_state_dicts.items()},
+            "tpuft": self.state_dict(),
+        }
+
+    def _apply_pending_state_dict(self) -> None:
+        """Applies a healed state dict to the user model (torchft/manager.py:570-585)."""
+        assert self._healing, "apply_pending_state_dict called without healing"
+        if self._pending_state_dict is None:
+            # Quorum thread may still be fetching.
+            self.wait_quorum()
+        assert self._pending_state_dict is not None, "checkpoint was not fetched"
+        self._logger.info("applying healed state dict")
+        user = cast(Dict[str, object], self._pending_state_dict["user"])
+        for key, value in user.items():
+            if key in self._load_state_dict_fns:
+                self._load_state_dict_fns[key](value)
+        self.load_state_dict(cast(Dict[str, int], self._pending_state_dict["tpuft"]))
+        self._pending_state_dict = None
+
+    # -- allreduce ----------------------------------------------------------
+
+    def allreduce(self, tensor, should_average: bool = True) -> Future:
+        """Fault-tolerant gradient allreduce across replica groups.
+
+        Accepts a jax.Array or numpy array; returns a Future resolving to the
+        averaged array of the same type/sharding.  Never raises — failures
+        resolve to the unmodified input and latch the step error
+        (reference: torchft/manager.py:262-323).
+        """
+        if self.errored() is not None:
+            return completed_future(tensor)
+
+        self.wait_quorum()
+
+        is_jax = _is_jax_array(tensor)
+        host = np.asarray(tensor)
+        if not self.is_participating():
+            # Healing replicas / spares contribute zeros (torchft/manager.py:287-288).
+            host = np.zeros_like(host)
+
+        try:
+            work = self._collective.allreduce([host], op="sum")
+
+            def normalize(results: List[np.ndarray]):
+                out = results[0]
+                if should_average:
+                    num = max(1, self.num_participants())
+                    out = (out / num).astype(host.dtype, copy=False)
+                if is_jax:
+                    import jax
+
+                    return jax.device_put(out, tensor.sharding)
+                return out
+
+            from torchft_tpu.futures import then
+
+            fut = then(work.future(), normalize)
+            return self.wrap_future(fut, default=tensor)
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"allreduce failed: {e}")
+            self.report_error(e)
+            return completed_future(tensor)
+
+    def wrap_future(self, fut: Future, default, timeout: Optional[timedelta] = None) -> Future:
+        """Arms a deadline and converts failure into (default, latched error)
+        (reference: torchft/manager.py:346-383)."""
+        timed = future_timeout(fut, (timeout or self._timeout).total_seconds())
+        out: Future = Future()
+
+        def settle(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                self._logger.exception(f"async work failed: {exc}")
+                self.report_error(exc)
+                out.set_result(default)
+            else:
+                out.set_result(f.result())
+
+        timed.add_done_callback(settle)
+        self._pending_work.append(out)
+        return out
+
+    # -- error handling -----------------------------------------------------
+
+    def report_error(self, e: Exception) -> None:
+        """Latches an error for this step; cleared at the next start_quorum
+        (reference: torchft/manager.py:325-337)."""
+        self._errored = e
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    # -- commit protocol ----------------------------------------------------
+
+    def should_commit(self, timeout: Optional[timedelta] = None) -> bool:
+        """Two-phase commit vote across all local ranks of the group
+        (reference: torchft/manager.py:587-663)."""
+        # Drain pending allreduces; their errors are already latched.
+        for work in self._pending_work:
+            try:
+                work.result()
+            except Exception:  # noqa: BLE001
+                pass
+        self._pending_work = []
+
+        if self._collective.errored() is not None:
+            self.report_error(cast(Exception, self._collective.errored()))
+
+        if self._healing:
+            self._apply_pending_state_dict()
+
+        enough_replicas = self.num_participants() >= self._min_replica_size
+        local_should_commit = enough_replicas and self._errored is None
+        should_commit = self._client.should_commit(
+            self._rank,
+            self._step,
+            local_should_commit,
+            timeout_ms=int((timeout or self._timeout).total_seconds() * 1000),
+        )
+        self._logger.info(
+            f"should_commit={should_commit} (local={local_should_commit}, "
+            f"enough_replicas={enough_replicas}, error={self._errored})"
+        )
+
+        if self._checkpoint_transport is not None:
+            # Weights are about to be mutated: stop serving the stale
+            # checkpoint (torchft/manager.py:645).
+            self._checkpoint_transport.disallow_checkpoint()
+
+        if should_commit:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+            self._commit_failures = 0
+        else:
+            self._commit_failures += 1
+            if self._max_retries is not None and self._commit_failures > self._max_retries:
+                raise ExceededMaxRetriesError(
+                    f"exceeded max_retries={self._max_retries} consecutive failed commits"
+                )
+        return should_commit
+
+    # -- state --------------------------------------------------------------
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        """Restores manager bookkeeping from a durable checkpoint
+        (reference: torchft/manager.py:665-677)."""
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    def state_dict(self) -> Dict[str, int]:
+        """Manager bookkeeping to persist with the model
+        (reference: torchft/manager.py:679-694)."""
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    def current_step(self) -> int:
+        """Current step, incremented on every committed step
+        (reference: torchft/manager.py:742-750)."""
+        return self._step
+
+    def batches_committed(self) -> int:
+        """Total batches committed across all groups and steps
+        (reference: torchft/manager.py:752-762)."""
+        return self._batches_committed
+
+    def num_participants(self) -> int:
+        """Replica groups participating in the current step
+        (reference: torchft/manager.py:728-736)."""
+        return self._participating_replica_world_size
+
+    def participating_rank(self) -> Optional[int]:
+        """This group's rank among participating groups, or None while
+        healing / sparing (reference: torchft/manager.py:712-726)."""
+        assert self._quorum_future is not None, "quorum not started"
+        self.wait_quorum()
+        return self._participating_replica_rank
+
+    def is_participating(self) -> bool:
+        """False while healing or sparing (reference: torchft/manager.py:696-710)."""
+        return self._participating_replica_rank is not None
+
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    def store_address(self) -> str:
+        return self._store_address
+
+    def collective(self) -> Collective:
+        return self._collective
+
+    def shutdown(self) -> None:
+        self._executor.shutdown(wait=True)
+        if self._checkpoint_transport is not None:
+            self._checkpoint_transport.shutdown(wait=False)
+        self._client.close()
+        self._collective.shutdown()
+        if self._manager_server is not None:
+            self._manager_server.shutdown()
+        if self._store_server is not None:
+            self._store_server.shutdown()
+
+
+def _is_jax_array(x) -> bool:
+    try:
+        import jax
+
+        return isinstance(x, jax.Array)
+    except ImportError:
+        return False
+
+
+class _ManagerLogger:
+    """Log prefix "[replica/rank - step N]" (reference: torchft/manager.py:773-792)."""
+
+    def __init__(self, manager: Manager, replica_id: str, rank: int) -> None:
+        self._logger = logging.getLogger("torchft_tpu.manager")
+        self._replica_id = replica_id
+        self._rank = rank
+        self._manager = manager
+
+    def prefix(self) -> str:
+        return f"[{self._replica_id}/{self._rank} - step {self._manager.current_step()}]"
+
+    def info(self, msg: str) -> None:
+        self._logger.info(f"{self.prefix()} {msg}")
+
+    def warn(self, msg: str) -> None:
+        self._logger.warning(f"{self.prefix()} {msg}")
+
+    def exception(self, msg: str) -> None:
+        self._logger.exception(f"{self.prefix()} {msg}")
