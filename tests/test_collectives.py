@@ -1,0 +1,276 @@
+"""Collective conformance suite.
+
+Reference parity: torchft/process_group_test.py — a registry of per-op
+correctness checks (_COLLECTIVE_TO_FUNC, :482-495) run against every backend,
+with replica ranks as threads sharing one rendezvous store
+(MultiPgBaseTest, :847-912), plus the resiliency variant where a rank aborts
+mid-collective and survivors reconfigure onto a fresh store prefix (:942-998).
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List
+
+import numpy as np
+import pytest
+
+from torchft_tpu._native import StoreServer
+from torchft_tpu.collectives import (
+    Collective,
+    DummyCollective,
+    ErrorSwallowingCollective,
+    TCPCollective,
+)
+
+
+@pytest.fixture(scope="module")
+def store():
+    server = StoreServer(bind="127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+_PREFIX_COUNTER = [0]
+_PREFIX_LOCK = threading.Lock()
+
+
+def fresh_prefix() -> str:
+    with _PREFIX_LOCK:
+        _PREFIX_COUNTER[0] += 1
+        return f"test/{_PREFIX_COUNTER[0]}"
+
+
+def run_ranks(store, world_size: int, fn: Callable[[Collective, int], object]) -> List[object]:
+    """Runs fn on `world_size` TCPCollectives rendezvoused as threads."""
+    prefix = fresh_prefix()
+    collectives = [TCPCollective(timeout=10.0) for _ in range(world_size)]
+
+    def worker(rank: int) -> object:
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, world_size)
+        try:
+            return fn(c, rank)
+        finally:
+            c.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        futures = [pool.submit(worker, r) for r in range(world_size)]
+        return [f.result(timeout=30) for f in futures]
+
+
+# -- correctness functions (one per collective) ------------------------------
+
+
+def check_allreduce(c: Collective, rank: int):
+    n = c.size()
+    x = np.full(1000, float(rank + 1), dtype=np.float32)
+    out = c.allreduce([x], op="sum").wait(timeout=20)[0]
+    expected = sum(range(1, n + 1))
+    np.testing.assert_allclose(out, np.full(1000, expected, dtype=np.float32))
+    return True
+
+
+def check_allreduce_avg(c: Collective, rank: int):
+    n = c.size()
+    x = np.full(16, float(rank + 1), dtype=np.float32)
+    out = c.allreduce([x], op="avg").wait(timeout=20)[0]
+    np.testing.assert_allclose(out, np.full(16, sum(range(1, n + 1)) / n), rtol=1e-6)
+    return True
+
+
+def check_allreduce_multi_array(c: Collective, rank: int):
+    n = c.size()
+    xs = [
+        np.full(7, float(rank), dtype=np.float32),
+        np.full((3, 5), float(rank * 2), dtype=np.float32),
+    ]
+    out = c.allreduce(xs, op="sum").wait(timeout=20)
+    total = sum(range(n))
+    np.testing.assert_allclose(out[0], np.full(7, total, dtype=np.float32))
+    np.testing.assert_allclose(out[1], np.full((3, 5), 2 * total, dtype=np.float32))
+    return True
+
+
+def check_allgather(c: Collective, rank: int):
+    n = c.size()
+    x = np.array([rank, rank * 10], dtype=np.int64)
+    out = c.allgather(x).wait(timeout=20)
+    assert len(out) == n
+    for r in range(n):
+        np.testing.assert_array_equal(out[r], np.array([r, r * 10]))
+    return True
+
+
+def check_broadcast(c: Collective, rank: int):
+    x = np.full(8, float(rank + 5), dtype=np.float32)
+    out = c.broadcast(x, root=0).wait(timeout=20)
+    np.testing.assert_allclose(out, np.full(8, 5.0))
+    return True
+
+
+def check_reduce_scatter(c: Collective, rank: int):
+    n = c.size()
+    xs = [np.full(4, float(rank + i), dtype=np.float32) for i in range(n)]
+    out = c.reduce_scatter(xs, op="sum").wait(timeout=20)
+    expected = sum(r + rank for r in range(n))
+    np.testing.assert_allclose(out, np.full(4, expected, dtype=np.float32))
+    return True
+
+
+def check_alltoall(c: Collective, rank: int):
+    n = c.size()
+    xs = [np.array([rank * 100 + dst], dtype=np.int64) for dst in range(n)]
+    out = c.alltoall(xs).wait(timeout=20)
+    for src in range(n):
+        np.testing.assert_array_equal(out[src], np.array([src * 100 + rank]))
+    return True
+
+
+def check_barrier(c: Collective, rank: int):
+    c.barrier().wait(timeout=20)
+    return True
+
+
+def check_send_recv_ring(c: Collective, rank: int):
+    n = c.size()
+    if n == 1:
+        return True
+    nxt = (rank + 1) % n
+    prv = (rank - 1) % n
+    payload = np.array([rank, 42], dtype=np.int32)
+    send_work = c.send(payload, nxt, tag=1)
+    recv_work = c.recv((2,), np.int32, prv, tag=1)
+    send_work.wait(timeout=20)
+    got = recv_work.wait(timeout=20)
+    np.testing.assert_array_equal(got, np.array([prv, 42], dtype=np.int32))
+    return True
+
+
+_COLLECTIVE_TO_FUNC: Dict[str, Callable[[Collective, int], object]] = {
+    "allreduce": check_allreduce,
+    "allreduce_avg": check_allreduce_avg,
+    "allreduce_multi": check_allreduce_multi_array,
+    "allgather": check_allgather,
+    "broadcast": check_broadcast,
+    "reduce_scatter": check_reduce_scatter,
+    "alltoall": check_alltoall,
+    "barrier": check_barrier,
+    "send_recv": check_send_recv_ring,
+}
+
+
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+@pytest.mark.parametrize("op", sorted(_COLLECTIVE_TO_FUNC))
+def test_tcp_collective_conformance(store, world_size: int, op: str) -> None:
+    results = run_ranks(store, world_size, _COLLECTIVE_TO_FUNC[op])
+    assert all(results)
+
+
+@pytest.mark.parametrize("op", sorted(_COLLECTIVE_TO_FUNC))
+def test_dummy_collective_conformance(op: str) -> None:
+    c = DummyCollective()
+    c.configure("unused", 0, 1)
+    assert _COLLECTIVE_TO_FUNC[op](c, 0)
+
+
+def test_tcp_collective_reconfigure(store) -> None:
+    """A collective must be reusable across configure() calls with fresh
+    prefixes (the per-quorum reconfiguration path, torchft/manager.py:502-509)."""
+
+    def body(c: Collective, rank: int):
+        x = np.full(4, float(rank + 1), dtype=np.float32)
+        return c.allreduce([x]).wait(timeout=20)[0]
+
+    prefix1, prefix2 = fresh_prefix(), fresh_prefix()
+    collectives = [TCPCollective(timeout=10.0) for _ in range(2)]
+
+    def worker(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix1}", rank, 2)
+        first = body(c, rank)
+        c.configure(f"{store.address()}/{prefix2}", rank, 2)
+        second = body(c, rank)
+        c.shutdown()
+        return first, second
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futures = [pool.submit(worker, r) for r in range(2)]
+        for f in futures:
+            first, second = f.result(timeout=30)
+            np.testing.assert_allclose(first, np.full(4, 3.0))
+            np.testing.assert_allclose(second, np.full(4, 3.0))
+
+
+def test_tcp_collective_abort_resiliency(store) -> None:
+    """Last rank dies mid-run; survivors latch an error instead of crashing,
+    then reconfigure onto a fresh prefix without the dead rank and succeed
+    (reference: torchft/process_group_test.py:942-998)."""
+    world_size = 3
+    prefix = fresh_prefix()
+    prefix2 = fresh_prefix()
+    collectives = [TCPCollective(timeout=5.0) for _ in range(world_size)]
+    barrier = threading.Barrier(world_size)
+
+    def worker(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, world_size)
+        # One clean round first.
+        x = np.ones(8, dtype=np.float32)
+        c.allreduce([x]).wait(timeout=20)
+        barrier.wait(timeout=10)
+        if rank == world_size - 1:
+            c.abort()
+            return "dead"
+        # Survivors: the next collective fails fast (peer sockets closed).
+        work = c.allreduce([x])
+        exc = work.exception(timeout=20)
+        assert exc is not None, "expected failure after peer abort"
+        assert c.errored() is not None
+        return "latched"
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        futures = [pool.submit(worker, r) for r in range(world_size)]
+        results = [f.result(timeout=60) for f in futures]
+    assert results.count("latched") == 2
+
+    # Reconfigure survivors as a fresh world of 2: errors clear, ops work.
+    def recover(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix2}", rank, 2)
+        assert c.errored() is None
+        out = c.allreduce([np.full(4, float(rank + 1), dtype=np.float32)]).wait(timeout=20)
+        c.shutdown()
+        return out[0]
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futures = [pool.submit(recover, r) for r in range(2)]
+        for f in futures:
+            np.testing.assert_allclose(f.result(timeout=60), np.full(4, 3.0))
+
+
+def test_error_swallowing_wrapper() -> None:
+    inner = DummyCollective()
+    wrapper = ErrorSwallowingCollective(inner)
+    wrapper.configure("unused", 0, 1)
+    assert wrapper.errored() is None
+    wrapper.report_error(RuntimeError("boom"))
+    assert wrapper.errored() is not None
+    # Ops become immediate no-ops returning the fallback.
+    x = np.full(3, 7.0, dtype=np.float32)
+    out = wrapper.allreduce([x]).wait(timeout=5)
+    np.testing.assert_allclose(out[0], x)
+    # configure clears the latch.
+    wrapper.configure("unused", 0, 1)
+    assert wrapper.errored() is None
+
+
+def test_large_buffer_allreduce(store) -> None:
+    """16 MB per rank exercises chunked framing and full-duplex ring flow."""
+
+    def body(c: Collective, rank: int):
+        x = np.full(4 << 20, float(rank + 1), dtype=np.float32)
+        out = c.allreduce([x]).wait(timeout=60)[0]
+        assert out[0] == 3.0 and out[-1] == 3.0
+        return True
+
+    assert all(run_ranks(store, 2, body))

@@ -421,6 +421,28 @@ class TCPCollective(Collective):
             return Work(completed_future(list(arrays)))
         return self._submit(lambda: self._ring_allreduce(arrays, op))
 
+    def _exchange(self, tag: int, payload) -> bytes:
+        """Sends to the next neighbor while receiving from the previous one.
+        Full-duplex is required: with payloads larger than the kernel socket
+        buffers, blocking send-then-recv deadlocks the ring."""
+        send_exc: List[Exception] = []
+
+        def do_send() -> None:
+            try:
+                self._next.send_msg(tag, memoryview(payload) if isinstance(payload, (bytes, bytearray)) else payload)
+            except Exception as e:  # noqa: BLE001
+                send_exc.append(e)
+
+        sender = threading.Thread(target=do_send, daemon=True)
+        sender.start()
+        try:
+            received = self._prev.recv_msg(tag)
+        finally:
+            sender.join(timeout=self._timeout)
+        if send_exc:
+            raise send_exc[0]
+        return received
+
     def _ring_allreduce(self, arrays: List[np.ndarray], op: str) -> List[np.ndarray]:
         n = self._world_size
         rank = self._rank
@@ -436,16 +458,16 @@ class TCPCollective(Collective):
         for step in range(n - 1):
             send_idx = (rank - step) % n
             recv_idx = (rank - step - 1) % n
-            self._next.send_msg(1, memoryview(np.ascontiguousarray(chunks[send_idx])).cast("B"))
-            incoming = np.frombuffer(self._prev.recv_msg(1), dtype=flat.dtype)
+            payload = memoryview(np.ascontiguousarray(chunks[send_idx])).cast("B")
+            incoming = np.frombuffer(self._exchange(1, payload), dtype=flat.dtype)
             chunks[recv_idx] = chunks[recv_idx] + incoming
 
         # Allgather phase: circulate the reduced chunks.
         for step in range(n - 1):
             send_idx = (rank - step + 1) % n
             recv_idx = (rank - step) % n
-            self._next.send_msg(2, memoryview(np.ascontiguousarray(chunks[send_idx])).cast("B"))
-            chunks[recv_idx] = np.frombuffer(self._prev.recv_msg(2), dtype=flat.dtype).copy()
+            payload = memoryview(np.ascontiguousarray(chunks[send_idx])).cast("B")
+            chunks[recv_idx] = np.frombuffer(self._exchange(2, payload), dtype=flat.dtype).copy()
 
         out_flat = np.concatenate(chunks)
         if op == "avg":
@@ -475,8 +497,7 @@ class TCPCollective(Collective):
         for step in range(n - 1):
             send_idx = (rank - step) % n
             recv_idx = (rank - step - 1) % n
-            self._next.send_msg(3, memoryview(slots[send_idx]))
-            slots[recv_idx] = self._prev.recv_msg(3)
+            slots[recv_idx] = self._exchange(3, slots[send_idx])
         return [pickle.loads(s) for s in slots]
 
     def broadcast(self, array: np.ndarray, root: int = 0) -> Work:
@@ -529,8 +550,7 @@ class TCPCollective(Collective):
             for step in range(n - 1):
                 send_idx = (rank - step) % n
                 recv_idx = (rank - step - 1) % n
-                self._next.send_msg(4, memoryview(slots[send_idx]))
-                slots[recv_idx] = self._prev.recv_msg(4)
+                slots[recv_idx] = self._exchange(4, slots[send_idx])
             lists = [pickle.loads(s) for s in slots]
             return [lists[src][rank] for src in range(n)]
 
