@@ -5,7 +5,9 @@ dry-runs the multi-chip path via __graft_entry__.dryrun_multichip)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of ambient platform (the axon TPU tunnel may be set in
+# the environment); bench.py and __graft_entry__ use the real device instead.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,186 @@
+"""Checkpoint transport contract tests.
+
+Reference parity: torchft/checkpointing/transport_test.py:45-155 — one shared
+multi-node recovery scenario applied to every transport (3 nodes, all/some
+recover, timeout behavior), plus HTTP chunking parametrization
+(http_transport_test.py:32-113) and RWLock tests (rwlock_test.py).
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu._native import StoreServer
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing.collective_transport import CollectiveTransport
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.collectives import TCPCollective
+
+
+@pytest.fixture(scope="module")
+def store():
+    server = StoreServer(bind="127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+def make_state_dict(seed: int):
+    rng = np.random.RandomState(seed)
+    return {
+        "model": {
+            "w": jnp.asarray(rng.randn(8, 16).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(16), dtype=jnp.bfloat16),
+        },
+        "optim": [np.arange(10, dtype=np.int64) * seed, {"lr": 0.125}],
+        "tpuft": {"step": 7, "batches_committed": 21},
+    }
+
+
+def assert_state_dicts_equal(a, b) -> None:
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        if hasattr(x, "shape"):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            assert x == y
+
+
+_COUNTER = [0]
+
+
+def run_multi_recovery_test(
+    make_transport: Callable[[int, List[TCPCollective]], CheckpointTransport],
+    store,
+) -> None:
+    """3 nodes; node 0 serves, nodes 1 and 2 recover; results must match
+    node 0's state bitwise (the shared scenario of transport_test.py:45-155)."""
+    world = 3
+    _COUNTER[0] += 1
+    prefix = f"transport/{_COUNTER[0]}"
+    collectives = [TCPCollective(timeout=10.0) for _ in range(world)]
+    state = make_state_dict(seed=1)
+    results = {}
+    barrier = threading.Barrier(world)
+    # Transports must exist before recv (to read metadata): build eagerly.
+    metadatas = {}
+    transports = {}
+
+    def boot(rank: int):
+        collectives[rank].configure(f"{store.address()}/{prefix}", rank, world)
+        transport = make_transport(rank, collectives)
+        transports[rank] = transport
+        metadatas[rank] = transport.metadata()
+
+    with ThreadPoolExecutor(max_workers=world) as pool:
+        list(pool.map(boot, range(world)))
+
+    def node(rank: int):
+        transport = transports[rank]
+        try:
+            if rank == 0:
+                transport.send_checkpoint(
+                    dst_ranks=[1, 2], step=7, state_dict=state, timeout=20.0
+                )
+                barrier.wait(timeout=20)
+            else:
+                got = transport.recv_checkpoint(
+                    src_rank=0, metadata=metadatas[0], step=7, timeout=20.0
+                )
+                results[rank] = got
+                barrier.wait(timeout=20)
+        finally:
+            transport.shutdown()
+            collectives[rank].shutdown()
+
+    with ThreadPoolExecutor(max_workers=world) as pool:
+        futs = [pool.submit(node, r) for r in range(world)]
+        for f in futs:
+            f.result(timeout=60)
+
+    assert set(results) == {1, 2}
+    for rank in (1, 2):
+        assert_state_dicts_equal(results[rank], state)
+
+
+def test_http_transport_multi_recovery(store) -> None:
+    run_multi_recovery_test(lambda rank, colls: HTTPTransport(timeout=10.0), store)
+
+
+def test_http_transport_chunked_multi_recovery(store) -> None:
+    run_multi_recovery_test(
+        lambda rank, colls: HTTPTransport(timeout=10.0, num_chunks=3), store
+    )
+
+
+def test_collective_transport_multi_recovery(store) -> None:
+    run_multi_recovery_test(
+        lambda rank, colls: CollectiveTransport(colls[rank], timeout=10.0), store
+    )
+
+
+def test_http_transport_wrong_step_404(store) -> None:
+    t = HTTPTransport(timeout=5.0)
+    try:
+        t.send_checkpoint([1], step=3, state_dict={"x": np.ones(2)}, timeout=5.0)
+        with pytest.raises(Exception):
+            t.recv_checkpoint(src_rank=0, metadata=t.metadata(), step=9, timeout=5.0)
+        # Correct step succeeds.
+        got = t.recv_checkpoint(src_rank=0, metadata=t.metadata(), step=3, timeout=5.0)
+        np.testing.assert_array_equal(got["x"], np.ones(2))
+    finally:
+        t.shutdown()
+
+
+def test_http_transport_disallow_blocks_serving(store) -> None:
+    t = HTTPTransport(timeout=0.5)
+    try:
+        t.send_checkpoint([1], step=1, state_dict={"x": np.ones(2)}, timeout=5.0)
+        t.disallow_checkpoint()
+        # Serving now times out (write lock held): 503 -> HTTPError.
+        with pytest.raises(Exception):
+            t.recv_checkpoint(src_rank=0, metadata=t.metadata(), step=1, timeout=3.0)
+    finally:
+        t.shutdown()
+
+
+def test_rwlock_basics() -> None:
+    lock = RWLock()
+    assert lock.r_acquire(timeout=1)
+    assert lock.r_acquire(timeout=1)  # shared
+    assert not lock.w_acquire(timeout=0.05)  # blocked by readers
+    lock.r_release()
+    lock.r_release()
+    assert lock.w_acquire(timeout=1)
+    assert not lock.r_acquire(timeout=0.05)  # blocked by writer
+    lock.w_release()
+    assert lock.r_acquire(timeout=1)
+    lock.r_release()
+
+
+def test_rwlock_writer_preference() -> None:
+    lock = RWLock()
+    assert lock.r_acquire(timeout=1)
+    acquired = []
+
+    def writer():
+        acquired.append(lock.w_acquire(timeout=5))
+
+    t = threading.Thread(target=writer)
+    t.start()
+    time.sleep(0.1)
+    # A new reader must queue behind the waiting writer.
+    assert not lock.r_acquire(timeout=0.05)
+    lock.r_release()
+    t.join(timeout=5)
+    assert acquired == [True]
+    lock.w_release()

@@ -1,0 +1,228 @@
+"""HTTP checkpoint transport: pull-based live weight recovery.
+
+Reference parity: torchft/checkpointing/http_transport.py.  A threading HTTP
+server on every replica streams the current-step state dict to recovering
+peers; an RWLock gates serving so the train loop can mutate weights safely
+(write-held while training, released while a checkpoint is being served);
+the URL scheme is /checkpoint/<step>/{full|metadata|<chunk_i>} with optional
+round-robin chunking fetched in parallel by the receiver.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+import socket
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing.serialization import (
+    StateDictMeta,
+    as_u8,
+    flatten_state_dict,
+    read_state_dict,
+    unflatten_state_dict,
+    write_state_dict,
+)
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.http import ThreadingHTTPServerV6
+
+logger = logging.getLogger("torchft_tpu.checkpointing.http")
+
+
+class HTTPTransport(CheckpointTransport):
+    """Serves pickled+raw state-dict streams over HTTP.
+
+    Args:
+        timeout: per-request deadline.
+        num_chunks: if > 0, the buffers are split round-robin into this many
+            chunks which the receiver fetches in parallel
+            (reference: torchft/checkpointing/http_transport.py:287-298).
+        restore_sharding: optional spec -> jax.Sharding resolver used when
+            rebuilding fetched arrays on device.
+    """
+
+    def __init__(
+        self,
+        timeout: float = 60.0,
+        num_chunks: int = 0,
+        restore_sharding: Optional[Callable[[Any], Any]] = None,
+    ) -> None:
+        self._timeout = timeout
+        self._num_chunks = num_chunks
+        self._restore_sharding = restore_sharding
+        # Held while training mutates weights; released (allow_checkpoint)
+        # while a consistent snapshot is being served.
+        self._checkpoint_lock = RWLock(timeout=timeout)
+        self._checkpoint_lock.w_acquire()
+        self._state: Optional[Tuple[StateDictMeta, List[np.ndarray]]] = None
+        self._step = -1
+
+        transport = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: object) -> None:
+                logger.debug(fmt % args)
+
+            def do_GET(self) -> None:
+                parts = self.path.strip("/").split("/")
+                # /checkpoint/<step>/<what>
+                if len(parts) != 3 or parts[0] != "checkpoint":
+                    self.send_error(404, "unknown path")
+                    return
+                try:
+                    step = int(parts[1])
+                except ValueError:
+                    self.send_error(400, "bad step")
+                    return
+                what = parts[2]
+                try:
+                    with transport._checkpoint_lock.r_lock(transport._timeout):
+                        if transport._state is None or transport._step != step:
+                            self.send_error(
+                                404,
+                                f"checkpoint for step {step} not available "
+                                f"(serving {transport._step})",
+                            )
+                            return
+                        meta, buffers = transport._state
+                        payload = transport._render(meta, buffers, what)
+                        if payload is None:
+                            self.send_error(404, f"unknown object {what}")
+                            return
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/octet-stream")
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                except TimeoutError:
+                    self.send_error(503, "checkpoint lock busy")
+
+        self._server = ThreadingHTTPServerV6(("", 0), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="tpuft_http_transport", daemon=True
+        )
+        self._thread.start()
+
+    # -- serving ------------------------------------------------------------
+
+    def _render(self, meta: StateDictMeta, buffers: List[np.ndarray], what: str) -> Optional[bytes]:
+        out = io.BytesIO()
+        if what == "full":
+            write_state_dict(meta, buffers, out)
+        elif what == "metadata":
+            out.write(pickle.dumps(self._chunk_count(buffers)))
+        elif what.startswith("chunk_"):
+            idx = int(what[len("chunk_"):])
+            n = self._chunk_count(buffers)
+            if idx >= n:
+                return None
+            # Round-robin assignment keeps chunk sizes balanced without
+            # reordering metadata (torchft/checkpointing/http_transport.py:287-298).
+            sel = [i for i in range(len(buffers)) if i % n == idx]
+            sub_meta = pickle.dumps((idx, sel))
+            out.write(len(sub_meta).to_bytes(8, "little"))
+            out.write(sub_meta)
+            for i in sel:
+                out.write(memoryview(as_u8(buffers[i])))
+        else:
+            return None
+        return out.getvalue()
+
+    def _chunk_count(self, buffers: List[np.ndarray]) -> int:
+        if self._num_chunks <= 0:
+            return 1
+        return max(1, min(self._num_chunks, len(buffers)))
+
+    def metadata(self) -> str:
+        return f"http://{socket.gethostname()}:{self._port}"
+
+    # -- CheckpointTransport ------------------------------------------------
+
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
+    ) -> None:
+        """Pull-based: snapshot to host and open the serving window."""
+        meta, buffers = flatten_state_dict(state_dict, step=step)
+        self._state = (meta, buffers)
+        self._step = step
+        self.allow_checkpoint(step)
+
+    def allow_checkpoint(self, step: int) -> None:
+        if self._checkpoint_lock.w_locked():
+            self._checkpoint_lock.w_release()
+
+    def disallow_checkpoint(self) -> None:
+        if not self._checkpoint_lock.w_locked():
+            if not self._checkpoint_lock.w_acquire(self._timeout):
+                raise TimeoutError("timed out re-acquiring checkpoint write lock")
+
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> Any:
+        base = f"{metadata}/checkpoint/{step}"
+        n_chunks = pickle.loads(_fetch(f"{base}/metadata", timeout))
+        if n_chunks <= 1:
+            stream = io.BytesIO(_fetch(f"{base}/full", timeout))
+            meta, buffers = read_state_dict(stream)
+        else:
+            with ThreadPoolExecutor(max_workers=n_chunks) as pool:
+                parts = list(
+                    pool.map(
+                        lambda i: _fetch(f"{base}/chunk_{i}", timeout), range(n_chunks)
+                    )
+                )
+            meta, buffers = self._assemble_chunks(base, parts, timeout)
+        return unflatten_state_dict(meta, buffers, self._restore_sharding)
+
+    def _assemble_chunks(
+        self, base: str, parts: List[bytes], timeout: float
+    ) -> Tuple[StateDictMeta, List[np.ndarray]]:
+        # Header travels with the "full" metadata of chunked mode: fetch the
+        # meta-only stream (no buffers needed; nbytes live in tensor_metas).
+        meta_stream = io.BytesIO(_fetch(f"{base}/full", timeout, head_only=True))
+        header_len = int.from_bytes(meta_stream.read(8), "little")
+        meta: StateDictMeta = pickle.loads(meta_stream.read(header_len))
+        buffers: List[Optional[np.ndarray]] = [None] * len(meta.tensor_metas)
+        for part in parts:
+            sub_len = int.from_bytes(part[:8], "little")
+            idx, sel = pickle.loads(part[8 : 8 + sub_len])
+            offset = 8 + sub_len
+            for i in sel:
+                tm = meta.tensor_metas[i]
+                raw = part[offset : offset + tm.nbytes]
+                offset += tm.nbytes
+                buffers[i] = (
+                    np.frombuffer(raw, dtype=np.uint8).view(tm.dtype).reshape(tm.shape)
+                )
+        assert all(b is not None for b in buffers), "missing chunks"
+        return meta, buffers  # type: ignore[return-value]
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if wait:
+            self._thread.join(timeout=5)
+
+
+def _fetch(url: str, timeout: float, head_only: bool = False, fallback: object = ...) -> bytes:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if head_only:
+                # Read just the header prefix: 8-byte length + pickled meta.
+                head = resp.read(8)
+                header_len = int.from_bytes(head, "little")
+                return head + resp.read(header_len)
+            return resp.read()
+    except Exception:
+        if fallback is not ...:
+            return fallback  # type: ignore[return-value]
+        raise

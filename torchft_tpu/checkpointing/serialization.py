@@ -1,0 +1,174 @@
+"""Pytree state-dict (de)serialization shared by the checkpoint transports.
+
+Reference parity: the pytree flatten + _TensorMeta scheme of
+torchft/checkpointing/pg_transport.py:27-141 and the streaming serialization
+of torchft/checkpointing/_serialization.py, re-designed for JAX: leaves are
+jax.Arrays or numpy arrays; jax leaves record their sharding spec by name so
+the receiver can restore device placement (the DTensor analogue); all array
+payloads travel as raw contiguous bytes after a small pickled header.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "TensorMeta",
+    "StateDictMeta",
+    "as_u8",
+    "flatten_state_dict",
+    "unflatten_state_dict",
+    "write_state_dict",
+    "read_state_dict",
+]
+
+
+def as_u8(arr: np.ndarray) -> np.ndarray:
+    """Reinterprets any contiguous array (including ml_dtypes such as
+    bfloat16, which memoryview cannot cast) as a flat uint8 view."""
+    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
+
+@dataclass
+class TensorMeta:
+    """Array leaf metadata (reference: _TensorMeta,
+    torchft/checkpointing/pg_transport.py:39-55)."""
+
+    shape: Tuple[int, ...]
+    # The actual np.dtype object: custom dtypes like bfloat16 do not survive
+    # a round trip through their .str representation.
+    dtype: Any
+    nbytes: int
+    # "jax" leaves are restored onto device, "np" stay host-side.
+    kind: str = "np"
+    # Opaque sharding description: (mesh axis names tuple, partition spec)
+    # captured from a jax.NamedSharding; None for unsharded/host arrays.
+    sharding_spec: Optional[Any] = None
+
+
+@dataclass
+class StateDictMeta:
+    """Header for one serialized state dict (reference: _StateDictMeta,
+    torchft/checkpointing/pg_transport.py:58-77)."""
+
+    step: int
+    treespec_bytes: bytes
+    # For each flattened leaf: either ("tensor", index-into-buffers) or
+    # ("obj", the pickled-inline python value).
+    leaves: List[Tuple[str, Any]] = field(default_factory=list)
+    tensor_metas: List[TensorMeta] = field(default_factory=list)
+
+
+def _spec_of(arr: Any) -> Optional[Any]:
+    try:
+        import jax
+
+        sharding = arr.sharding
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            return (tuple(sharding.mesh.axis_names), tuple(sharding.spec))
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
+def flatten_state_dict(state_dict: Any, step: int = 0) -> Tuple[StateDictMeta, List[np.ndarray]]:
+    """Flattens a pytree into (header, host buffers).
+
+    jax.Arrays are fetched to host (this blocks on async dispatch, which is
+    the TPU analogue of the reference's CPU-copy-on-a-side-stream,
+    torchft/checkpointing/http_transport.py:219-241)."""
+    import jax
+
+    leaves, treespec = jax.tree_util.tree_flatten(state_dict)
+    meta = StateDictMeta(step=step, treespec_bytes=pickle.dumps(treespec))
+    buffers: List[np.ndarray] = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            host = np.ascontiguousarray(np.asarray(leaf))
+            meta.leaves.append(("tensor", len(buffers)))
+            meta.tensor_metas.append(
+                TensorMeta(
+                    shape=tuple(host.shape),
+                    dtype=host.dtype,
+                    nbytes=host.nbytes,
+                    kind="jax",
+                    sharding_spec=_spec_of(leaf),
+                )
+            )
+            buffers.append(host)
+        elif isinstance(leaf, np.ndarray):
+            host = np.ascontiguousarray(leaf)
+            meta.leaves.append(("tensor", len(buffers)))
+            meta.tensor_metas.append(
+                TensorMeta(
+                    shape=tuple(host.shape), dtype=host.dtype, nbytes=host.nbytes
+                )
+            )
+            buffers.append(host)
+        else:
+            meta.leaves.append(("obj", leaf))
+    return meta, buffers
+
+
+def unflatten_state_dict(
+    meta: StateDictMeta,
+    buffers: List[np.ndarray],
+    restore_sharding: Optional[Any] = None,
+) -> Any:
+    """Rebuilds the pytree.  `restore_sharding(spec)` may map a recorded
+    sharding spec to a live jax Sharding for in-place device placement."""
+    import jax
+
+    treespec = pickle.loads(meta.treespec_bytes)
+    leaves: List[Any] = []
+    for kind, value in meta.leaves:
+        if kind == "obj":
+            leaves.append(value)
+            continue
+        tm = meta.tensor_metas[value]
+        arr = as_u8(buffers[value]).view(tm.dtype).reshape(tm.shape)
+        if tm.kind == "jax":
+            sharding = None
+            if restore_sharding is not None and tm.sharding_spec is not None:
+                sharding = restore_sharding(tm.sharding_spec)
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            else:
+                arr = jax.numpy.asarray(arr)
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treespec, leaves)
+
+
+def write_state_dict(meta: StateDictMeta, buffers: List[np.ndarray], stream: io.RawIOBase) -> None:
+    """Streams header + raw buffers (reference: streaming ser/de,
+    torchft/checkpointing/_serialization.py:28-33)."""
+    header = pickle.dumps(meta)
+    stream.write(len(header).to_bytes(8, "little"))
+    stream.write(header)
+    for buf in buffers:
+        stream.write(memoryview(as_u8(buf)))
+
+
+def read_state_dict(stream: io.RawIOBase) -> Tuple[StateDictMeta, List[np.ndarray]]:
+    header_len = int.from_bytes(_read_exact(stream, 8), "little")
+    meta: StateDictMeta = pickle.loads(_read_exact(stream, header_len))
+    buffers: List[np.ndarray] = []
+    for tm in meta.tensor_metas:
+        raw = _read_exact(stream, tm.nbytes)
+        buffers.append(np.frombuffer(raw, dtype=np.uint8).view(tm.dtype).reshape(tm.shape))
+    return meta, buffers
+
+
+def _read_exact(stream: io.RawIOBase, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = stream.read(n - len(out))
+        if not chunk:
+            raise EOFError(f"stream ended after {len(out)}/{n} bytes")
+        out.extend(chunk)
+    return bytes(out)

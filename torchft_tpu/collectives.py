@@ -260,6 +260,10 @@ class TCPCollective(Collective):
         self._next: Optional[_Peer] = None  # link to (rank+1) % n
         self._prev: Optional[_Peer] = None  # link to (rank-1) % n
         self._peers: dict[int, _Peer] = {}
+        self._accept_cond = threading.Condition()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._accepted_ring: dict[int, _Peer] = {}
+        self._dialing: set[int] = set()
         self._listener: Optional[socket.socket] = None
         self._error: Optional[Exception] = None
         self._op_error: Optional[Exception] = None
@@ -283,8 +287,12 @@ class TCPCollective(Collective):
             from concurrent.futures import ThreadPoolExecutor
 
             self._executor = ThreadPoolExecutor(
-                max_workers=2, thread_name_prefix="tpuft_collective"
+                max_workers=4, thread_name_prefix="tpuft_collective"
             )
+
+    # Channel ids in the 8-byte connection preamble (rank, channel).
+    _CH_RING = 0
+    _CH_P2P = 1
 
     def _rendezvous(self) -> None:
         listener = socket.create_server(("", 0), family=socket.AF_INET6, dualstack_ipv6=True)
@@ -292,73 +300,117 @@ class TCPCollective(Collective):
         self._listener = listener
         port = listener.getsockname()[1]
         host = socket.gethostname()
-        store = self._store
-        store.set(f"rank_{self._rank}", f"{host}:{port}".encode())
+        self._store.set(f"rank_{self._rank}", f"{host}:{port}".encode())
 
         n = self._world_size
         rank = self._rank
-        # Full mesh is unnecessary: ring ops need next/prev; point-to-point
-        # (send/recv, used by checkpoint transports) dials lazily.
         next_rank = (rank + 1) % n
         prev_rank = (rank - 1) % n
+        gen = self._generation
 
-        accepted: dict[int, _Peer] = {}
-        accept_err: List[Exception] = []
-
+        # Persistent accept loop: registers the ring link from prev and any
+        # lazily-dialed point-to-point links (used by checkpoint transports
+        # to move weights between arbitrary replica pairs, the reference's
+        # pg.send/recv path, torchft/checkpointing/pg_transport.py:197-301).
         def accept_loop() -> None:
-            # Every rank accepts a connection from its prev (for the "next"
-            # direction) — plus lazy point-to-point dials later.
-            try:
-                listener.settimeout(self.RENDEZVOUS_TIMEOUT_MS / 1000)
-                conn, _ = listener.accept()
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                peer = _Peer(conn)
-                their_rank = struct.unpack("<I", peer._recv_exact(4))[0]
-                accepted[their_rank] = peer
-            except Exception as e:  # noqa: BLE001
-                accept_err.append(e)
+            while True:
+                try:
+                    conn, _ = listener.accept()
+                except OSError:
+                    return  # listener closed by abort()
+                try:
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    peer = _Peer(conn)
+                    their_rank, channel = struct.unpack("<II", peer._recv_exact(8))
+                    with self._accept_cond:
+                        if self._generation != gen:
+                            conn.close()
+                            return
+                        if channel == self._CH_RING:
+                            self._accepted_ring[their_rank] = peer
+                        else:
+                            self._peers[their_rank] = peer
+                        self._accept_cond.notify_all()
+                except Exception:  # noqa: BLE001
+                    conn.close()
 
-        acceptor = threading.Thread(target=accept_loop, daemon=True)
-        acceptor.start()
+        self._accepted_ring: dict[int, _Peer] = {}
+        self._accept_thread = threading.Thread(target=accept_loop, daemon=True)
+        self._accept_thread.start()
 
-        # Dial our next neighbor.
-        addr = store.get(f"rank_{next_rank}", wait=True, timeout_ms=self.RENDEZVOUS_TIMEOUT_MS)
+        # Dial our next ring neighbor.
+        self._next = self._dial_rank(next_rank, self._CH_RING)
+
+        # Wait for prev's ring connection.
+        deadline = self.RENDEZVOUS_TIMEOUT_MS / 1000
+        with self._accept_cond:
+            ok = self._accept_cond.wait_for(
+                lambda: prev_rank in self._accepted_ring, timeout=deadline
+            )
+            if not ok:
+                raise TimeoutError(f"rendezvous: rank {prev_rank} never connected")
+            self._prev = self._accepted_ring.pop(prev_rank)
+
+    def _dial_rank(self, peer_rank: int, channel: int) -> _Peer:
+        addr = self._store.get(
+            f"rank_{peer_rank}", wait=True, timeout_ms=self.RENDEZVOUS_TIMEOUT_MS
+        )
         if addr is None:
-            raise TimeoutError(f"rendezvous: rank {next_rank} never published its address")
-        nhost, nport = addr.decode().rsplit(":", 1)
-        sock = socket.create_connection((nhost, int(nport)), timeout=self._timeout)
+            raise TimeoutError(f"rendezvous: rank {peer_rank} never published its address")
+        phost, pport = addr.decode().rsplit(":", 1)
+        sock = socket.create_connection((phost, int(pport)), timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._next = _Peer(sock)
-        self._next.sock.sendall(struct.pack("<I", rank))
-
-        acceptor.join(timeout=self.RENDEZVOUS_TIMEOUT_MS / 1000)
-        if accept_err:
-            raise accept_err[0]
-        if prev_rank not in accepted:
-            raise TimeoutError(f"rendezvous: rank {prev_rank} never connected")
-        self._prev = accepted[prev_rank]
-        if n == 2:
-            # With two ranks next and prev are the same peer but distinct
-            # sockets, which keeps the ring protocol direction-safe.
-            pass
-        self._peers = {next_rank: self._next}
+        peer = _Peer(sock)
+        peer.sock.sendall(struct.pack("<II", self._rank, channel))
+        return peer
 
     def _dial(self, peer_rank: int) -> _Peer:
-        """Lazy point-to-point link for send/recv outside the ring."""
-        with self._lock:
-            peer = self._peers.get(peer_rank)
-            if peer is not None:
-                return peer
-        raise RuntimeError(
-            f"no link to rank {peer_rank}; TCPCollective point-to-point requires "
-            "ring neighbors (use the HTTP checkpoint transport for arbitrary pairs)"
-        )
+        """Point-to-point link for send/recv to an arbitrary rank.  Exactly
+        one side dials (the lower rank) and concurrent callers on the dialing
+        side coalesce onto one socket per pair."""
+        i_dial = False
+        with self._accept_cond:
+            while True:
+                peer = self._peers.get(peer_rank)
+                if peer is not None:
+                    return peer
+                if self._rank < peer_rank and peer_rank not in self._dialing:
+                    self._dialing.add(peer_rank)
+                    i_dial = True
+                    break
+                ok = self._accept_cond.wait_for(
+                    lambda: peer_rank in self._peers, timeout=self._timeout
+                )
+                if peer_rank in self._peers:
+                    return self._peers[peer_rank]
+                if not ok:
+                    raise TimeoutError(
+                        f"no point-to-point link to rank {peer_rank} within timeout"
+                    )
+        assert i_dial
+        try:
+            peer = self._dial_rank(peer_rank, self._CH_P2P)
+        except Exception:
+            with self._accept_cond:
+                self._dialing.discard(peer_rank)
+                self._accept_cond.notify_all()
+            raise
+        with self._accept_cond:
+            self._peers[peer_rank] = peer
+            self._dialing.discard(peer_rank)
+            self._accept_cond.notify_all()
+        return peer
 
     def abort(self) -> None:
         with self._lock:
             if self._error is None:
                 self._error = RuntimeError("collective aborted")
-            for peer in (self._next, self._prev):
+            with self._accept_cond:
+                peers = list(self._peers.values()) + list(self._accepted_ring.values())
+                self._peers = {}
+                self._accepted_ring = {}
+                self._accept_cond.notify_all()
+            for peer in [self._next, self._prev] + peers:
                 if peer is not None:
                     peer.close()
             if self._listener is not None:
@@ -366,7 +418,6 @@ class TCPCollective(Collective):
                 self._listener = None
             self._next = None
             self._prev = None
-            self._peers = {}
             if self._executor is not None:
                 self._executor.shutdown(wait=False, cancel_futures=True)
                 self._executor = None
@@ -571,10 +622,7 @@ class TCPCollective(Collective):
         def run() -> np.ndarray:
             import pickle
 
-            if src == (self._rank - 1) % self._world_size:
-                peer = self._prev
-            else:
-                peer = self._dial(src)
+            peer = self._dial(src)
             return pickle.loads(peer.recv_msg(100 + tag))
 
         return self._submit(run)

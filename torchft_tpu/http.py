@@ -1,0 +1,13 @@
+"""IPv6 threading HTTP server with a deep accept queue.
+
+Reference parity: torchft/http.py:5-7.
+"""
+
+import socket
+from http.server import ThreadingHTTPServer
+
+
+class ThreadingHTTPServerV6(ThreadingHTTPServer):
+    address_family = socket.AF_INET6
+    request_queue_size = 1024
+    daemon_threads = True
