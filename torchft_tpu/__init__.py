@@ -1,1 +1,41 @@
+"""torchft_tpu — a TPU-native per-step fault tolerance framework.
 
+Capabilities mirror the torchft reference (per-step quorum, reconfigurable
+cross-replica-group collectives, live peer-to-peer healing, commit-gated
+optimization, LocalSGD/DiLoCo, HSDP-style mesh composition), re-designed for
+JAX/XLA: intra-group parallelism is a pjit-compiled program over the ICI
+mesh, and the fault-tolerant replica dimension lives at the host layer.
+
+Public API parity: torchft/__init__.py:7-25.
+"""
+
+from torchft_tpu.collectives import (
+    Collective,
+    DummyCollective,
+    ErrorSwallowingCollective,
+    ManagedCollective,
+    TCPCollective,
+)
+from torchft_tpu.data import DistributedSampler
+from torchft_tpu.ddp import GradientAverager, PerLeafGradientAverager
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.optim import Optimizer
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Collective",
+    "DummyCollective",
+    "ErrorSwallowingCollective",
+    "ManagedCollective",
+    "TCPCollective",
+    "DistributedSampler",
+    "GradientAverager",
+    "PerLeafGradientAverager",
+    "DiLoCo",
+    "LocalSGD",
+    "Manager",
+    "WorldSizeMode",
+    "Optimizer",
+]

@@ -58,6 +58,9 @@ class RWLock:
                 return True
             finally:
                 self._writers_waiting -= 1
+                # Readers block on writers_waiting == 0; a timed-out writer
+                # must wake them or they stall until their own timeout.
+                self._cond.notify_all()
 
     def w_release(self) -> None:
         with self._cond:

@@ -118,6 +118,13 @@ class HTTPTransport(CheckpointTransport):
         out = io.BytesIO()
         if what == "full":
             write_state_dict(meta, buffers, out)
+        elif what == "header":
+            # Just the length-prefixed pickled StateDictMeta — what a chunked
+            # receiver needs to size its buffers, without making the server
+            # materialize the full multi-GB stream.
+            header = pickle.dumps(meta)
+            out.write(len(header).to_bytes(8, "little"))
+            out.write(header)
         elif what == "metadata":
             out.write(pickle.dumps(self._chunk_count(buffers)))
         elif what.startswith("chunk_"):
@@ -186,9 +193,7 @@ class HTTPTransport(CheckpointTransport):
     def _assemble_chunks(
         self, base: str, parts: List[bytes], timeout: float
     ) -> Tuple[StateDictMeta, List[np.ndarray]]:
-        # Header travels with the "full" metadata of chunked mode: fetch the
-        # meta-only stream (no buffers needed; nbytes live in tensor_metas).
-        meta_stream = io.BytesIO(_fetch(f"{base}/full", timeout, head_only=True))
+        meta_stream = io.BytesIO(_fetch(f"{base}/header", timeout))
         header_len = int.from_bytes(meta_stream.read(8), "little")
         meta: StateDictMeta = pickle.loads(meta_stream.read(header_len))
         buffers: List[Optional[np.ndarray]] = [None] * len(meta.tensor_metas)
@@ -213,16 +218,6 @@ class HTTPTransport(CheckpointTransport):
             self._thread.join(timeout=5)
 
 
-def _fetch(url: str, timeout: float, head_only: bool = False, fallback: object = ...) -> bytes:
-    try:
-        with urllib.request.urlopen(url, timeout=timeout) as resp:
-            if head_only:
-                # Read just the header prefix: 8-byte length + pickled meta.
-                head = resp.read(8)
-                header_len = int.from_bytes(head, "little")
-                return head + resp.read(header_len)
-            return resp.read()
-    except Exception:
-        if fallback is not ...:
-            return fallback  # type: ignore[return-value]
-        raise
+def _fetch(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()

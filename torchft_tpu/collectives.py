@@ -21,9 +21,11 @@ Semantics carried over from the reference:
 
 from __future__ import annotations
 
+import collections
 import socket
 import struct
 import threading
+import time
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
@@ -193,12 +195,17 @@ _HDR = struct.Struct("<IQ")  # tag, nbytes
 
 
 class _Peer:
-    """A framed duplex TCP link to one peer rank."""
+    """A framed duplex TCP link to one peer rank.
+
+    Frames arriving out of order (concurrent senders on a thread pool) are
+    demultiplexed by tag: a frame for a tag nobody asked for yet is stashed
+    until the matching recv_msg arrives."""
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
         self.send_lock = threading.Lock()
         self.recv_lock = threading.Lock()
+        self._stash: dict[int, "collections.deque[bytes]"] = {}
 
     def send_msg(self, tag: int, payload: memoryview) -> None:
         with self.send_lock:
@@ -207,11 +214,19 @@ class _Peer:
 
     def recv_msg(self, expect_tag: int) -> bytes:
         with self.recv_lock:
-            hdr = self._recv_exact(_HDR.size)
-            tag, nbytes = _HDR.unpack(hdr)
-            if tag != expect_tag:
-                raise RuntimeError(f"collective protocol error: tag {tag} != {expect_tag}")
-            return self._recv_exact(nbytes)
+            q = self._stash.get(expect_tag)
+            if q:
+                payload = q.popleft()
+                if not q:
+                    del self._stash[expect_tag]
+                return payload
+            while True:
+                hdr = self._recv_exact(_HDR.size)
+                tag, nbytes = _HDR.unpack(hdr)
+                payload = self._recv_exact(nbytes)
+                if tag == expect_tag:
+                    return payload
+                self._stash.setdefault(tag, collections.deque()).append(payload)
 
     def _recv_exact(self, n: int) -> bytes:
         buf = bytearray(n)
@@ -230,6 +245,31 @@ class _Peer:
         except OSError:
             pass
         self.sock.close()
+
+
+class _FifoQueue:
+    """Submission-order turnstile for one (direction, peer, tag) stream."""
+
+    def __init__(self) -> None:
+        self.cond = threading.Condition()
+        self.next_submit = 0
+        self.next_serve = 0
+
+    def take_ticket(self) -> int:
+        with self.cond:
+            seq = self.next_submit
+            self.next_submit += 1
+            return seq
+
+    def wait_turn(self, seq: int, timeout: float) -> None:
+        with self.cond:
+            if not self.cond.wait_for(lambda: self.next_serve >= seq, timeout=timeout):
+                raise TimeoutError("timed out waiting for earlier op on this channel")
+
+    def done(self) -> None:
+        with self.cond:
+            self.next_serve += 1
+            self.cond.notify_all()
 
 
 class TCPCollective(Collective):
@@ -255,6 +295,7 @@ class TCPCollective(Collective):
         self._chunk_bytes = chunk_bytes
         self._lock = threading.Lock()
         self._executor: Optional[object] = None
+        self._ring_executor: Optional[object] = None
         self._rank = 0
         self._world_size = 1
         self._next: Optional[_Peer] = None  # link to (rank+1) % n
@@ -269,6 +310,11 @@ class TCPCollective(Collective):
         self._op_error: Optional[Exception] = None
         self._generation = 0
         self._store: Optional[StoreClient] = None
+        # FIFO tickets so same-(peer, tag) send/recv pairs execute in
+        # submission order despite the multi-worker p2p executor; without
+        # this, two same-tag ops could be silently swapped by the tag demux.
+        self._fifo_lock = threading.Lock()
+        self._fifo: dict[tuple, "_FifoQueue"] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -280,14 +326,26 @@ class TCPCollective(Collective):
             self._rank = rank
             self._world_size = world_size
             self._generation += 1
+            # Abort may have cancelled queued p2p ops that will never call
+            # done(); fresh turnstiles avoid cross-generation waits.
+            with self._fifo_lock:
+                self._fifo = {}
             if world_size == 1:
                 return
             self._store = StoreClient(store_addr)
             self._rendezvous()
             from concurrent.futures import ThreadPoolExecutor
 
+            # Ring ops share the _next/_prev sockets and fixed frame tags, so
+            # they must execute one at a time in submission order — program
+            # order is identical on every rank, which keeps the rings aligned.
+            # P2P send/recv use per-pair sockets with tag demux and may
+            # overlap freely.
+            self._ring_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpuft_ring"
+            )
             self._executor = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="tpuft_collective"
+                max_workers=4, thread_name_prefix="tpuft_p2p"
             )
 
     # Channel ids in the 8-byte connection preamble (rank, channel).
@@ -320,6 +378,10 @@ class TCPCollective(Collective):
                     return  # listener closed by abort()
                 try:
                     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    # Accepted sockets must carry the op timeout too: a recv
+                    # from a stalled-but-open peer has to surface as an error,
+                    # not block an executor thread forever.
+                    conn.settimeout(self._timeout)
                     peer = _Peer(conn)
                     their_rank, channel = struct.unpack("<II", peer._recv_exact(8))
                     with self._accept_cond:
@@ -351,14 +413,22 @@ class TCPCollective(Collective):
                 raise TimeoutError(f"rendezvous: rank {prev_rank} never connected")
             self._prev = self._accepted_ring.pop(prev_rank)
 
-    def _dial_rank(self, peer_rank: int, channel: int) -> _Peer:
+    def _dial_rank(
+        self, peer_rank: int, channel: int, timeout: Optional[float] = None
+    ) -> _Peer:
+        timeout = timeout if timeout is not None else self.RENDEZVOUS_TIMEOUT_MS / 1000
         addr = self._store.get(
-            f"rank_{peer_rank}", wait=True, timeout_ms=self.RENDEZVOUS_TIMEOUT_MS
+            f"rank_{peer_rank}", wait=True, timeout_ms=int(timeout * 1000)
         )
         if addr is None:
             raise TimeoutError(f"rendezvous: rank {peer_rank} never published its address")
         phost, pport = addr.decode().rsplit(":", 1)
-        sock = socket.create_connection((phost, int(pport)), timeout=self._timeout)
+        sock = socket.create_connection(
+            (phost, int(pport)), timeout=min(self._timeout, timeout)
+        )
+        # create_connection's timeout would otherwise persist as the socket's
+        # recv/send deadline; ops get the full op timeout.
+        sock.settimeout(self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         peer = _Peer(sock)
         peer.sock.sendall(struct.pack("<II", self._rank, channel))
@@ -366,36 +436,59 @@ class TCPCollective(Collective):
 
     def _dial(self, peer_rank: int) -> _Peer:
         """Point-to-point link for send/recv to an arbitrary rank.  Exactly
-        one side dials (the lower rank) and concurrent callers on the dialing
-        side coalesce onto one socket per pair."""
-        i_dial = False
-        with self._accept_cond:
-            while True:
+        one side dials (the lower rank); concurrent callers on the dialing
+        side coalesce onto one socket per pair.  If the elected dialer fails,
+        a waiter takes over; a reconfigure mid-dial invalidates the attempt
+        (generation guard) so stale sockets never cross quorum boundaries."""
+        deadline = time.monotonic() + self._timeout
+        while True:
+            with self._accept_cond:
+                gen = self._generation
                 peer = self._peers.get(peer_rank)
                 if peer is not None:
                     return peer
                 if self._rank < peer_rank and peer_rank not in self._dialing:
                     self._dialing.add(peer_rank)
-                    i_dial = True
-                    break
-                ok = self._accept_cond.wait_for(
-                    lambda: peer_rank in self._peers, timeout=self._timeout
-                )
-                if peer_rank in self._peers:
-                    return self._peers[peer_rank]
-                if not ok:
+                    break  # we are the dialer
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     raise TimeoutError(
                         f"no point-to-point link to rank {peer_rank} within timeout"
                     )
-        assert i_dial
+                if self._rank < peer_rank:
+                    # Wake when the link lands, the dialer gives up, or a
+                    # reconfigure invalidates this generation.
+                    pred = lambda: (
+                        peer_rank in self._peers
+                        or peer_rank not in self._dialing
+                        or self._generation != gen
+                    )
+                else:
+                    pred = lambda: (
+                        peer_rank in self._peers or self._generation != gen
+                    )
+                self._accept_cond.wait_for(pred, timeout=remaining)
+                if self._generation != gen:
+                    raise RuntimeError("collective reconfigured during dial")
         try:
-            peer = self._dial_rank(peer_rank, self._CH_P2P)
+            # Honor the remaining op budget, not the full rendezvous window:
+            # a caller's timeout covers election + dial together.
+            peer = self._dial_rank(
+                peer_rank,
+                self._CH_P2P,
+                timeout=max(0.1, deadline - time.monotonic()),
+            )
         except Exception:
             with self._accept_cond:
                 self._dialing.discard(peer_rank)
                 self._accept_cond.notify_all()
             raise
         with self._accept_cond:
+            if self._generation != gen:
+                self._dialing.discard(peer_rank)
+                self._accept_cond.notify_all()
+                peer.close()
+                raise RuntimeError("collective reconfigured during dial")
             self._peers[peer_rank] = peer
             self._dialing.discard(peer_rank)
             self._accept_cond.notify_all()
@@ -409,6 +502,11 @@ class TCPCollective(Collective):
                 peers = list(self._peers.values()) + list(self._accepted_ring.values())
                 self._peers = {}
                 self._accepted_ring = {}
+                # Invalidate in-flight dials: a dial completing after this
+                # point must not register its socket into the next
+                # generation's peer table.
+                self._generation += 1
+                self._dialing = set()
                 self._accept_cond.notify_all()
             for peer in [self._next, self._prev] + peers:
                 if peer is not None:
@@ -421,6 +519,9 @@ class TCPCollective(Collective):
             if self._executor is not None:
                 self._executor.shutdown(wait=False, cancel_futures=True)
                 self._executor = None
+            if self._ring_executor is not None:
+                self._ring_executor.shutdown(wait=False, cancel_futures=True)
+                self._ring_executor = None
             if self._store is not None:
                 self._store.close()
                 self._store = None
@@ -443,7 +544,7 @@ class TCPCollective(Collective):
 
     # -- ops ----------------------------------------------------------------
 
-    def _submit(self, fn: Callable[[], object]) -> Work:
+    def _submit(self, fn: Callable[[], object], ring: bool = True) -> Work:
         if self._world_size == 1:
             try:
                 return Work(completed_future(fn()))
@@ -451,8 +552,7 @@ class TCPCollective(Collective):
                 self._latch(e)
                 return Work(failed_future(e))
         with self._lock:
-            executor = self._executor
-            gen = self._generation
+            executor = self._ring_executor if ring else self._executor
         if executor is None:
             err = self._op_error or RuntimeError("collective not configured")
             return Work(failed_future(err))
@@ -607,25 +707,49 @@ class TCPCollective(Collective):
 
         return self._submit(run)
 
+    def _fifo_queue(self, key: tuple) -> _FifoQueue:
+        with self._fifo_lock:
+            q = self._fifo.get(key)
+            if q is None:
+                q = self._fifo[key] = _FifoQueue()
+            return q
+
     def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
         array = np.ascontiguousarray(array)
+        q = self._fifo_queue(("send", dst, tag))
+        seq = q.take_ticket()
 
         def run() -> None:
             import pickle
 
-            peer = self._dial(dst)
-            peer.send_msg(100 + tag, memoryview(pickle.dumps(array)))
+            # done() must run even when wait_turn itself times out: a skipped
+            # slot keeps the channel moving (the error is latched and the
+            # next quorum reconfigures); a missing done() would poison every
+            # later op on this (peer, tag) stream.
+            try:
+                q.wait_turn(seq, self._timeout)
+                peer = self._dial(dst)
+                peer.send_msg(100 + tag, memoryview(pickle.dumps(array)))
+            finally:
+                q.done()
 
-        return self._submit(run)
+        return self._submit(run, ring=False)
 
     def recv(self, shape: tuple, dtype, src: int, tag: int = 0) -> Work:
+        q = self._fifo_queue(("recv", src, tag))
+        seq = q.take_ticket()
+
         def run() -> np.ndarray:
             import pickle
 
-            peer = self._dial(src)
-            return pickle.loads(peer.recv_msg(100 + tag))
+            try:
+                q.wait_turn(seq, self._timeout)
+                peer = self._dial(src)
+                return pickle.loads(peer.recv_msg(100 + tag))
+            finally:
+                q.done()
 
-        return self._submit(run)
+        return self._submit(run, ring=False)
 
     def barrier(self) -> Work:
         if self._world_size == 1:

@@ -1,0 +1,91 @@
+"""Data sharding across replica groups × local ranks.
+
+Reference parity: torchft/data.py (DistributedSampler, torchft/data.py:24-77).
+The reference composes the two parallel dimensions into one flat shard index:
+``global_rank = rank + num_replicas * replica_group`` over
+``num_replicas * num_replica_groups`` total shards.  The same arithmetic here
+yields index streams for any indexable dataset; like the reference, sharding
+is static per run and documented as lossy under membership churn (a group
+that leaves takes its shard's remaining samples with it).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DistributedSampler", "shard_batch"]
+
+
+class DistributedSampler:
+    """Yields dataset indices for one (replica_group, local rank) shard.
+
+    Args:
+        dataset_len: number of samples in the dataset.
+        replica_group: which replica group this worker belongs to.
+        num_replica_groups: total replica groups in the job.
+        rank: local rank within the group (default 0).
+        num_replicas: local ranks per group (default 1).
+        shuffle: reshuffle each epoch with a deterministic seed.
+        drop_last: drop the ragged tail so all shards are equal length.
+    """
+
+    def __init__(
+        self,
+        dataset_len: int,
+        replica_group: int,
+        num_replica_groups: int,
+        rank: int = 0,
+        num_replicas: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ) -> None:
+        # Flat composition of the two dimensions (torchft/data.py:62-67).
+        self.global_rank = rank + num_replicas * replica_group
+        self.global_world_size = num_replicas * num_replica_groups
+        self.dataset_len = dataset_len
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.num_samples = dataset_len // self.global_world_size
+        else:
+            self.num_samples = -(-dataset_len // self.global_world_size)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(self.dataset_len)
+        else:
+            order = np.arange(self.dataset_len)
+        if self.drop_last:
+            # Truncate the ragged tail so every shard matches __len__ —
+            # unequal shards would desync lockstep replicas.
+            order = order[: self.num_samples * self.global_world_size]
+        elif self.dataset_len % self.global_world_size:
+            pad = self.global_world_size - self.dataset_len % self.global_world_size
+            order = np.concatenate([order, order[:pad]])
+        yield from order[self.global_rank :: self.global_world_size].tolist()
+
+
+def shard_batch(
+    batch_indices: Sequence[int],
+    replica_group: int,
+    num_replica_groups: int,
+    rank: int = 0,
+    num_replicas: int = 1,
+) -> np.ndarray:
+    """Shards a single global batch's indices the same way the sampler shards
+    the dataset — convenience for synthetic/streaming pipelines."""
+    global_rank = rank + num_replicas * replica_group
+    global_ws = num_replicas * num_replica_groups
+    return np.asarray(batch_indices)[global_rank::global_ws]

@@ -1,0 +1,52 @@
+"""Standalone Lighthouse server CLI.
+
+Reference parity: the ``torchft_lighthouse`` binary (src/bin/lighthouse.rs:11-23,
+pyproject.toml:39-40).  Usage::
+
+    python -m torchft_tpu.lighthouse_cli --bind [::]:29510 --min_replicas 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="torchft_tpu lighthouse server")
+    parser.add_argument("--bind", default="[::]:29510", help="RPC bind address")
+    parser.add_argument("--http_bind", default="[::]:29511", help="dashboard bind address")
+    parser.add_argument("--min_replicas", type=int, default=1)
+    parser.add_argument("--join_timeout_ms", type=int, default=60000,
+                        help="straggler wait before forming a smaller quorum")
+    parser.add_argument("--quorum_tick_ms", type=int, default=100)
+    parser.add_argument("--heartbeat_timeout_ms", type=int, default=5000)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s"
+    )
+    from torchft_tpu._native import LighthouseServer
+
+    server = LighthouseServer(
+        bind=args.bind,
+        min_replicas=args.min_replicas,
+        join_timeout_ms=args.join_timeout_ms,
+        quorum_tick_ms=args.quorum_tick_ms,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+        http_bind=args.http_bind,
+    )
+    logging.info("lighthouse listening on %s (dashboard at %s)",
+                 server.address(), server.http_address())
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

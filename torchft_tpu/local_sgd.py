@@ -1,0 +1,185 @@
+"""Communication-efficient replica synchronization: LocalSGD and DiLoCo.
+
+Reference parity: torchft/local_sgd.py (LocalSGD torchft/local_sgd.py:41-167,
+DiLoCo torchft/local_sgd.py:170-320).  Both run many inner optimizer steps
+locally and synchronize across replica groups only every ``sync_every``
+steps, with commit gating so a failed sync never corrupts the model.
+
+JAX adaptation: instead of hooking a torch optimizer and mutating
+``param.data`` in place, these classes own a reference to the training
+state through ``get_params``/``set_params`` callables (pytrees are
+immutable), and ``step()`` is called explicitly after each inner update.
+DiLoCo's device backup of the last-synced params is a host (numpy) pytree —
+the analogue of the reference's pinned-CPU backup tensors
+(torchft/local_sgd.py:205-222).
+
+Note on the pseudogradient sign: the DiLoCo paper (arXiv:2311.08105) defines
+the outer gradient as ``backup - local`` so that an SGD *descent* step moves
+the global params toward the averaged local progress; the reference computes
+``local - backup`` (torchft/local_sgd.py:290) and relies on the outer
+optimizer's configuration to compensate.  We implement the paper sign.
+"""
+
+from __future__ import annotations
+
+from types import TracebackType
+from typing import Any, Callable, List, Optional, Type
+
+import numpy as np
+
+from torchft_tpu.manager import Manager
+
+__all__ = ["LocalSGD", "DiLoCo"]
+
+
+def _tree_to_host(tree: Any) -> Any:
+    import jax
+
+    return jax.tree.map(np.asarray, tree)
+
+
+class LocalSGD:
+    """Averages raw model weights across replica groups every ``sync_every``
+    inner steps (reference: torchft/local_sgd.py:41-167).
+
+    Usage::
+
+        with LocalSGD(manager, get_params, set_params, sync_every=100) as lsgd:
+            for batch in data:
+                params = inner_update(params, batch)   # plain local optax step
+                lsgd.step()                            # counts + maybe syncs
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        get_params: Callable[[], Any],
+        set_params: Callable[[Any], None],
+        sync_every: int,
+    ) -> None:
+        assert sync_every >= 1, "sync_every must be >= 1"
+        self._manager = manager
+        self._get_params = get_params
+        self._set_params = set_params
+        self._sync_every = sync_every
+        self._local_step = 0
+
+    def __enter__(self) -> "LocalSGD":
+        return self
+
+    def __exit__(
+        self,
+        exc_type: Optional[Type[BaseException]],
+        exc_value: Optional[BaseException],
+        traceback: Optional[TracebackType],
+    ) -> bool:
+        return False
+
+    def step(self) -> None:
+        """Call after each inner optimizer step (the reference's registered
+        post-step hook, torchft/local_sgd.py:95-104)."""
+        self._local_step += 1
+        if self._local_step >= self._sync_every:
+            self.sync()
+
+    def sync(self) -> None:
+        """Quorum + weight averaging + commit-gated copy-back
+        (reference: torchft/local_sgd.py:106-135)."""
+        self._manager.start_quorum()
+        averaged = self._average(self._get_params())
+        if self._manager.should_commit():
+            self._set_params(averaged)
+        self._local_step = 0
+
+    def _average(self, params: Any) -> Any:
+        from torchft_tpu.ddp import PerLeafGradientAverager
+
+        return PerLeafGradientAverager(self._manager).allreduce(params)
+
+
+class DiLoCo:
+    """Inner/outer optimizer synchronization (reference:
+    torchft/local_sgd.py:170-320; DiLoCo, arXiv:2311.08105).
+
+    Keeps a host backup of the last globally-committed params.  Every
+    ``sync_every`` inner steps: compute pseudogradients ``backup - local``,
+    allreduce-average them across groups, restore the backup params, and only
+    if the commit vote passes apply the outer optimizer (typically SGD with
+    Nesterov momentum) to the backup using the averaged pseudogradient.
+
+    Requires synchronous quorum (``use_async_quorum=False``) exactly like the
+    reference (torchft/local_sgd.py:188-192): a healing group must have the
+    committed weights *before* computing its pseudogradient.
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        get_params: Callable[[], Any],
+        set_params: Callable[[Any], None],
+        outer_tx: Any,
+        sync_every: int,
+    ) -> None:
+        if manager._use_async_quorum:
+            raise ValueError(
+                "DiLoCo requires synchronous quorum: construct the Manager "
+                "with use_async_quorum=False"
+            )
+        assert sync_every >= 1, "sync_every must be >= 1"
+        self._manager = manager
+        self._get_params = get_params
+        self._set_params = set_params
+        self._outer_tx = outer_tx
+        self._sync_every = sync_every
+        self._local_step = 0
+
+        # Host backup of the last-synced params (torchft/local_sgd.py:205-222).
+        self._backup = _tree_to_host(get_params())
+        self._outer_state = outer_tx.init(self._backup)
+
+    def __enter__(self) -> "DiLoCo":
+        return self
+
+    def __exit__(
+        self,
+        exc_type: Optional[Type[BaseException]],
+        exc_value: Optional[BaseException],
+        traceback: Optional[TracebackType],
+    ) -> bool:
+        return False
+
+    @property
+    def backup_params(self) -> Any:
+        return self._backup
+
+    def step(self) -> None:
+        self._local_step += 1
+        if self._local_step >= self._sync_every:
+            self.sync()
+
+    def sync(self) -> None:
+        """Pseudogradient sync (reference: torchft/local_sgd.py:277-303)."""
+        self._manager.start_quorum()
+        self._perform_sync()
+        self._local_step = 0
+
+    def _perform_sync(self) -> None:
+        import jax
+        import optax
+
+        from torchft_tpu.ddp import PerLeafGradientAverager
+
+        local = _tree_to_host(self._get_params())
+        pseudograds = jax.tree.map(lambda b, l: b - l, self._backup, local)
+
+        # Average pseudogradients across participating groups.
+        averaged = PerLeafGradientAverager(self._manager).allreduce(pseudograds)
+
+        if self._manager.should_commit():
+            updates, self._outer_state = self._outer_tx.update(
+                averaged, self._outer_state, self._backup
+            )
+            self._backup = optax.apply_updates(self._backup, updates)
+        # Commit or not, the live params are reset to the (possibly updated)
+        # last-committed weights (torchft/local_sgd.py:294-301).
+        self._set_params(self._backup)

@@ -268,9 +268,12 @@ class Manager:
         if not self._use_async_quorum:
             self.wait_quorum()
             if self._healing:
-                # Sync mode applies the fetched state dict eagerly
+                # Sync mode applies the fetched state dict eagerly and is
+                # then fully healed: the step runs with good weights, so the
+                # commit path must not try to re-apply
                 # (torchft/manager.py:429-438).
                 self._apply_pending_state_dict()
+                self._healing = False
 
     def wait_quorum(self) -> None:
         """Blocks until the current quorum completes (torchft/manager.py:440-449)."""

@@ -1,0 +1,67 @@
+"""Fault-tolerant optimizer wrapper for optax.
+
+Reference parity: torchft/optim.py (OptimizerWrapper, torchft/optim.py:24-63).
+The reference wraps a torch optimizer so that ``zero_grad()`` starts the
+step's quorum and ``step()`` only applies when the commit vote passes.  In
+JAX the optimizer is a pure ``optax.GradientTransformation`` over pytrees, so
+the wrapper holds ``(params, opt_state)`` explicitly and the commit gate
+decides whether the freshly computed pytrees replace the held state or are
+dropped on the floor (the TPU analogue of skipping ``optim.step()``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from torchft_tpu.manager import Manager
+
+
+class Optimizer:
+    """Commit-gated optax optimizer.
+
+    Usage::
+
+        opt = Optimizer(manager, optax.adamw(3e-4), params)
+        for batch in data:
+            opt.step_begin()                  # starts quorum (zero_grad analogue)
+            grads = grad_fn(opt.params, batch)
+            grads = synchronizer.allreduce(grads)  # manager.allreduce per bucket
+            opt.step(grads)                   # applies only if should_commit()
+
+    ``params``/``opt_state`` always hold the last *committed* values.
+    """
+
+    def __init__(self, manager: Manager, tx: Any, params: Any, opt_state: Any = None) -> None:
+        self._manager = manager
+        self._tx = tx
+        self.params = params
+        self.opt_state = opt_state if opt_state is not None else tx.init(params)
+
+    @property
+    def manager(self) -> Manager:
+        return self._manager
+
+    def step_begin(self) -> None:
+        """Starts the quorum for this step (reference: zero_grad →
+        manager.start_quorum, torchft/optim.py:44-49)."""
+        self._manager.start_quorum()
+
+    # Alias matching the reference's API shape.
+    zero_grad = step_begin
+
+    def step(self, grads: Any) -> bool:
+        """Applies ``grads`` iff the commit vote passes (reference:
+        torchft/optim.py:51-55).  Returns True when the update landed."""
+        import optax
+
+        if not self._manager.should_commit():
+            return False
+        updates, self.opt_state = self._tx.update(grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        return True
+
+    def state_dict(self) -> Tuple[Any, Any]:
+        return (self.params, self.opt_state)
+
+    def load_state_dict(self, state: Tuple[Any, Any]) -> None:
+        self.params, self.opt_state = state
