@@ -1,0 +1,143 @@
+"""Headline benchmark: fault-tolerant training goodput on the local chip.
+
+Trains the flagship transformer LM (GPT-small class: 12 layers, d=768,
+seq 1024, bf16 compute) two ways on the real device:
+
+  raw:  the compiled train step alone (no fault-tolerance machinery);
+  ft:   the full per-step fault-tolerance loop — native Lighthouse +
+        Manager servers, per-step async quorum, cross-group allreduce path,
+        two-phase commit vote, checkpoint-transport gating — exactly the
+        train_ddp.py flow, with one replica group on this chip.
+
+Prints ONE JSON line:
+  value        = FT training goodput (tokens/sec)
+  vs_baseline  = FT goodput / raw goodput — the fault-tolerance overhead
+                 fraction.  The reference publishes no absolute numbers
+                 (BASELINE.md); its design target is <5% goodput loss, i.e.
+                 vs_baseline >= 0.95.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu.models import TransformerConfig, init_params, loss_fn
+    from torchft_tpu.models.transformer import param_axes
+    from torchft_tpu.parallel import TrainStep, ft_init_mesh
+
+    cfg = TransformerConfig(
+        vocab_size=32000,
+        d_model=768,
+        n_layers=12,
+        n_heads=12,
+        n_kv_heads=12,
+        d_ff=2048,
+        max_seq=1024,
+    )
+    batch_size, seq = 8, 1024
+    tokens_per_step = batch_size * seq
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(batch_size, seq)), dtype=jnp.int32
+    )
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ftmesh = ft_init_mesh({"data": 1}, devices=jax.devices()[:1])
+    tx = optax.adamw(3e-4)
+    step = TrainStep(ftmesh, tx, lambda p, b: loss_fn(p, b, cfg))
+
+    def timed_loop(fn, steps: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(steps):
+            out = fn()
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    # -- raw --------------------------------------------------------------
+    state = {"params": params, "opt": step.init_opt_state(params)}
+
+    def raw_step():
+        state["params"], state["opt"], loss = step.full_step(
+            state["params"], state["opt"], batch
+        )
+        return loss
+
+    for _ in range(3):  # warmup / compile
+        raw_step()
+    jax.block_until_ready(state["params"])
+    steps = 20
+    raw_tps = tokens_per_step * steps / timed_loop(raw_step, steps)
+
+    # -- ft ---------------------------------------------------------------
+    from torchft_tpu._native import LighthouseServer
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu.collectives import TCPCollective
+    from torchft_tpu.manager import Manager
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100
+    )
+    params2 = init_params(jax.random.PRNGKey(0), cfg)
+    state2 = {"params": params2, "opt": step.init_opt_state(params2)}
+    manager = Manager(
+        collective=TCPCollective(timeout=30.0),
+        load_state_dict=lambda sd: state2.update(sd),
+        state_dict=lambda: dict(state2),
+        min_replica_size=1,
+        rank=0,
+        world_size=1,
+        replica_id="bench",
+        lighthouse_addr=lighthouse.address(),
+        checkpoint_transport=HTTPTransport(timeout=30.0),
+    )
+    ftmesh.manager = manager
+
+    def ft_one_step():
+        manager.start_quorum()
+        state2["params"], state2["opt"], loss, committed = step.ft_step(
+            state2["params"], state2["opt"], batch
+        )
+        assert committed, "bench step failed to commit"
+        return loss
+
+    try:
+        for _ in range(3):
+            ft_one_step()
+        jax.block_until_ready(state2["params"])
+        ft_tps = tokens_per_step * steps / timed_loop(ft_one_step, steps)
+    finally:
+        manager.shutdown()
+        lighthouse.shutdown()
+
+    print(
+        json.dumps(
+            {
+                "metric": "ft_train_goodput",
+                "value": round(ft_tps, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(ft_tps / raw_tps, 4),
+                "detail": {
+                    "model": "transformer-lm 12L d768 bf16 seq1024 batch8",
+                    "raw_tokens_per_sec": round(raw_tps, 1),
+                    "baseline_semantics": "FT/raw goodput fraction; reference "
+                    "publishes no absolute numbers (BASELINE.md), its design "
+                    "target is <5% goodput loss (>=0.95)",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

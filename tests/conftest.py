@@ -7,9 +7,14 @@ import sys
 
 # Force CPU regardless of ambient platform (the axon TPU tunnel may be set in
 # the environment); bench.py and __graft_entry__ use the real device instead.
+# The axon site hook overrides $JAX_PLATFORMS, so pin via jax.config too.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -72,6 +72,16 @@ class GradientAverager:
         if not leaves:
             return grads
 
+        # Alone in the ring and participating: averaging is the identity and
+        # the device->host roundtrip is pure waste — skip before any copy.
+        self._manager.wait_quorum()
+        if (
+            self._manager.errored() is None
+            and self._manager.collective().size() == 1
+            and self._manager.is_participating()
+        ):
+            return grads
+
         is_jax = [isinstance(l, jax.Array) for l in leaves]
         hosts = [np.asarray(l) for l in leaves]
 

@@ -434,6 +434,12 @@ class Manager:
 
         self.wait_quorum()
 
+        # Alone in the ring and participating: averaging is the identity —
+        # skip the device->host->device roundtrip entirely (TPU HBM traffic
+        # is the budget; the reference still pays a no-op pg.allreduce here).
+        if self._collective.size() == 1 and self.is_participating():
+            return completed_future(tensor)
+
         is_jax = _is_jax_array(tensor)
         host = np.asarray(tensor)
         if not self.is_participating():

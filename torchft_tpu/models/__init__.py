@@ -1,0 +1,18 @@
+"""Model zoo: TPU-first reference models for the framework.
+
+The torchft reference trains user-supplied torch models (its examples use a
+CIFAR CNN, and its README targets Llama-class models through torchtitan
+HSDP, README.md:67-74).  This package provides the equivalent first-party
+models for the TPU build: a decoder-only transformer LM (the flagship, the
+Llama-3-class shape), a mixture-of-experts variant (expert parallelism), and
+a small conv net (the train_ddp example class).
+"""
+
+from torchft_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    forward,
+)
+
+__all__ = ["TransformerConfig", "init_params", "loss_fn", "forward"]

@@ -1,0 +1,203 @@
+"""Flagship model: decoder-only transformer LM (Llama-3-class shape).
+
+TPU-first design choices:
+  - parameters are plain pytrees of jax.Arrays with per-layer weights
+    *stacked* along a leading "layers" axis so the decoder runs as one
+    ``lax.scan`` — one compiled layer body instead of L unrolled copies;
+  - compute in bfloat16 (MXU-native), parameters and reductions in float32;
+  - hot ops route through torchft_tpu.ops: fused pallas RMSNorm and flash
+    attention; ring attention over the "sequence" mesh axis for long
+    context;
+  - ``jax.checkpoint`` on the layer body: rematerialize instead of storing
+    per-layer activations (HBM is the bottleneck);
+  - every array axis has a logical name; sharding is applied by annotation
+    (parallel/sharding.py), never hand-placed collectives.
+
+Reference parity note: torchft trains user torch models (CIFAR CNN in
+train_ddp.py; Llama via torchtitan, README.md:67-74); this module is the TPU
+build's first-party equivalent of that model class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.ops import flash_attention, rms_norm
+from torchft_tpu.parallel.sharding import ShardingRules, constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1408
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-native)
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    # Attention backend: "flash" (pallas kernel / XLA fallback) or "ring"
+    # (sequence-parallel ring over the mesh "sequence" axis).
+    attention: str = "flash"
+
+    @property
+    def d_head(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# Logical axis names for every parameter (see parallel/sharding.py).
+def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    layer = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    pd = cfg.param_dtype
+    E, H, KV, Dh, F, L = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.d_head, cfg.d_ff,
+        cfg.n_layers,
+    )
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, pd) * (fan_in ** -0.5)).astype(pd)
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": jnp.ones((L, E), pd),
+        "wq": norm_init(ks[0], (L, E, H * Dh), E),
+        "wk": norm_init(ks[1], (L, E, KV * Dh), E),
+        "wv": norm_init(ks[2], (L, E, KV * Dh), E),
+        "wo": norm_init(ks[3], (L, H * Dh, E), H * Dh),
+        "mlp_norm": jnp.ones((L, E), pd),
+        "w_gate": norm_init(ks[4], (L, E, F), E),
+        "w_up": norm_init(ks[5], (L, E, F), E),
+        "w_down": norm_init(ks[6], (L, F, E), F),
+    }
+    return {
+        "embed": norm_init(k_embed, (cfg.vocab_size, E), E),
+        "layers": layers,
+        "final_norm": jnp.ones((E,), pd),
+        "lm_head": norm_init(k_head, (E, cfg.vocab_size), E),
+    }
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x: [B, S, H, Dh], positions: [B, S] (global)."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(cfg: TransformerConfig, mesh, q, k, v):
+    """q/k/v: [B, H|KV, S, Dh] head-major."""
+    if cfg.attention == "ring" and mesh is not None and "sequence" in mesh.axis_names \
+            and mesh.shape["sequence"] > 1:
+        from torchft_tpu.ops.ring_attention import ring_attention_sharded
+
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        head_axis = "tensor" if "tensor" in mesh.axis_names else None
+        batch_axis = "data" if "data" in mesh.axis_names else None
+        return ring_attention_sharded(
+            mesh, q, k, v, causal=True,
+            batch_axis=batch_axis, head_axis=head_axis, seq_axis="sequence",
+        )
+    return flash_attention(q, k, v, causal=True)
+
+
+def _layer(cfg: TransformerConfig, mesh, rules: ShardingRules, x, w, positions):
+    """One decoder block; x: [B, S, E]."""
+    B, S, E = x.shape
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+
+    h = rms_norm(x, w["attn_norm"])
+    q = (h @ w["wq"].astype(cfg.dtype)).reshape(B, S, H, Dh)
+    k = (h @ w["wk"].astype(cfg.dtype)).reshape(B, S, KV, Dh)
+    v = (h @ w["wv"].astype(cfg.dtype)).reshape(B, S, KV, Dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    q = constrain(q.transpose(0, 2, 1, 3), ("batch", "heads", "seq", None), mesh, rules)
+    k = constrain(k.transpose(0, 2, 1, 3), ("batch", "kv_heads", "seq", None), mesh, rules)
+    v = constrain(v.transpose(0, 2, 1, 3), ("batch", "kv_heads", "seq", None), mesh, rules)
+    attn = _attention(cfg, mesh, q, k, v)            # [B, H, S, Dh]
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+    x = x + (attn @ w["wo"].astype(cfg.dtype))
+    x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+    h = rms_norm(x, w["mlp_norm"])
+    gate = jax.nn.silu(h @ w["w_gate"].astype(cfg.dtype))
+    up = h @ w["w_up"].astype(cfg.dtype)
+    x = x + ((gate * up) @ w["w_down"].astype(cfg.dtype))
+    return constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+) -> jax.Array:
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    rules = rules or ShardingRules()
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+    def body(x, w):
+        return _layer(cfg, mesh, rules, x, w, positions), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"])
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: TransformerConfig,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+) -> jax.Array:
+    """Next-token cross entropy; batch: {"tokens": [B,S], "targets": [B,S]}."""
+    logits = forward(params, batch["tokens"], cfg, mesh, rules)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

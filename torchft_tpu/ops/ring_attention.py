@@ -1,0 +1,135 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+Long-context path: Q/K/V are sharded along sequence across the ``sequence``
+mesh axis; each device keeps its Q shard resident and K/V shards rotate
+around the ring with ``lax.ppermute`` (one neighbor hop per step — this is
+ICI-topology-friendly: traffic only crosses adjacent links).  Blockwise
+attention per incoming K/V shard is merged with the running accumulator via
+the online log-sum-exp recurrence, so no device ever materializes more than
+one [S_local x S_local] score block.
+
+Reference: the torchft reference has no sequence parallelism (SURVEY.md
+§2.3); this is a capability the TPU build adds because long-context is
+first-class here.  Algorithm: Ring Attention (arXiv:2310.01889) with plain
+contiguous sequence partitioning (the causal-skip load imbalance is accepted
+for simplicity; a zigzag layout is a future optimization).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, row0, col0, causal):
+    """One [Sq_local x Sk_local] attention block with global causal masking.
+
+    Returns unnormalized out, running max m and sum l — all f32.
+    q/k/v: [BH, S, D]; row0/col0: global offsets of the blocks.
+    """
+    s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # Rows with every position masked: exp(-inf - -inf) traps; clamp m.
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    return o, m_safe, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Local ring-attention body — call inside shard_map.
+
+    q/k/v: the local sequence shards, [B, H, S_local, D] (kv heads must
+    already match q heads — broadcast GQA groups before sharding).
+    """
+    b, h, s_local, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    idx = jax.lax.axis_index(axis_name)
+
+    qf = q.reshape(b * h, s_local, d).astype(jnp.float32)
+    kf = k.reshape(b * h, s_local, d).astype(jnp.float32)
+    vf = v.reshape(b * h, s_local, d).astype(jnp.float32)
+
+    row0 = idx * s_local
+    acc = jnp.zeros_like(qf)
+    m = jnp.full((b * h, s_local, 1), _NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((b * h, s_local, 1), dtype=jnp.float32)
+
+    # axis_size is static: unrolled ring. Step t sees the K/V block that
+    # started life on device (idx - t) mod n.
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    for t in range(axis_size):
+        col_block = (idx - t) % axis_size
+        o_t, m_t, l_t = _block_attn(
+            qf, kf, vf, scale, row0, col_block * s_local, causal
+        )
+        m_new = jnp.maximum(m, m_t)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_t - m_new)
+        acc = acc * alpha + o_t * beta
+        l = l * alpha + l_t * beta
+        m = m_new
+        if t != axis_size - 1:
+            kf = jax.lax.ppermute(kf, axis_name, perm)
+            vf = jax.lax.ppermute(vf, axis_name, perm)
+
+    out = acc / jnp.where(l == 0.0, 1.0, l)
+    return out.reshape(b, h, s_local, d).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    batch_axis: str = "data",
+    head_axis: str = "tensor",
+    seq_axis: str = "sequence",
+):
+    """shard_map wrapper: batch over `batch_axis`, heads over `head_axis`,
+    sequence ring over `seq_axis`."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+
+        shard_map = functools.partial(_shard_map, mesh=mesh)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        shard_map = functools.partial(_shard_map, mesh=mesh)
+
+    axis_size = mesh.shape[seq_axis]
+    spec = P(batch_axis, head_axis, seq_axis, None)
+    fn = shard_map(
+        functools.partial(
+            ring_attention,
+            axis_name=seq_axis,
+            axis_size=axis_size,
+            causal=causal,
+            scale=scale,
+        ),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

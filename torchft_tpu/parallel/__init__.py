@@ -1,0 +1,22 @@
+"""Parallelism layer: mesh composition, logical sharding rules, train step.
+
+TPU-first equivalent of the reference's mesh/parallelism surface
+(torchft/device_mesh.py, torchft/process_group.py): intra-group parallelism
+(data/fsdp/tensor/sequence/expert) is a static `jax.sharding.Mesh` compiled
+into the pjit program over ICI; the fault-tolerant replica dimension is
+dynamic and lives at the host layer through the Manager (the analogue of
+ManagedDeviceMesh's "replicate dim removed from the torch mesh",
+torchft/device_mesh.py:290-323).
+"""
+
+from torchft_tpu.parallel.mesh import FTMesh, ft_init_mesh
+from torchft_tpu.parallel.sharding import ShardingRules, logical_sharding
+from torchft_tpu.parallel.trainer import TrainStep
+
+__all__ = [
+    "FTMesh",
+    "ft_init_mesh",
+    "ShardingRules",
+    "logical_sharding",
+    "TrainStep",
+]

@@ -1,0 +1,110 @@
+"""FTMesh: static intra-group device mesh × dynamic replica dimension.
+
+Reference parity: torchft/device_mesh.py.  The reference builds a torch
+DeviceMesh with the replicate dim *removed* (world-size-1 lie) and splices a
+ManagedProcessGroup back in so FSDP sees a dynamic replica dimension
+(torchft/device_mesh.py:290-323, 49-251).  On TPU the same split is natural:
+
+  - the *intra-group* axes (data / fsdp / tensor / sequence / expert) form a
+    real ``jax.sharding.Mesh`` over the slice's chips — static, compiled
+    into the pjit program, collectives ride ICI;
+  - the *replica* axis is not an XLA mesh axis at all: its size comes from
+    the quorum each step (Manager.num_participants) and its collectives are
+    the Manager's fault-tolerant host-level allreduce over DCN.
+
+``FTMesh`` is the object that holds both and answers the questions the
+reference answers through ManagedDeviceMesh: axis sizes (with the dynamic
+replica dim, torchft/device_mesh.py:158-173), ranks/coordinates, and which
+collective to use per axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchft_tpu.parallel.sharding import ShardingRules
+
+# Axis names understood by the default sharding rules.
+INTRA_GROUP_AXES = ("data", "fsdp", "tensor", "sequence", "expert", "pipeline")
+REPLICA_AXIS = "replica"
+
+
+@dataclasses.dataclass
+class FTMesh:
+    """A static local mesh plus the managed (dynamic) replica dimension."""
+
+    mesh: Mesh
+    manager: Optional[object] = None  # torchft_tpu.manager.Manager
+    rules: ShardingRules = dataclasses.field(default_factory=ShardingRules)
+
+    # -- axis queries (ManagedDeviceMesh parity) ----------------------------
+
+    def size(self, axis: Optional[str] = None) -> int:
+        """Total size; the replica axis reports the *current quorum* size
+        (the dynamic lie, torchft/device_mesh.py:158-173)."""
+        if axis is None:
+            return int(np.prod([self.size(a) for a in self.axis_names]))
+        if axis == REPLICA_AXIS:
+            if self.manager is None:
+                return 1
+            return max(1, self.manager.num_participants())
+        return int(self.mesh.shape[axis])
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return (REPLICA_AXIS,) + tuple(self.mesh.axis_names)
+
+    def replica_rank(self) -> Optional[int]:
+        if self.manager is None:
+            return 0
+        return self.manager.participating_rank()
+
+    # -- sharding helpers ----------------------------------------------------
+
+    def sharding(self, *logical_axes: Optional[str]) -> NamedSharding:
+        return self.rules.sharding(tuple(logical_axes), self.mesh)
+
+    def spec(self, *logical_axes: Optional[str]) -> P:
+        return self.rules.spec(tuple(logical_axes), self.mesh)
+
+    def shard_params(self, params, axes_tree) -> object:
+        """Places a parameter pytree onto the mesh per its logical axes."""
+        return jax.tree.map(
+            lambda p, axes: jax.device_put(p, self.rules.sharding(axes, self.mesh)),
+            params,
+            axes_tree,
+        )
+
+
+def ft_init_mesh(
+    axis_sizes: Dict[str, int],
+    manager: Optional[object] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    rules: Optional[ShardingRules] = None,
+) -> FTMesh:
+    """Builds an FTMesh from {axis: size} over the local devices.
+
+    The "replica" axis, if present in axis_sizes, is ignored for device
+    placement (it is the cross-group dimension handled by the Manager) —
+    mirroring ft_init_device_mesh's replicate-dim removal
+    (torchft/device_mesh.py:290-323).
+    """
+    sizes = {k: v for k, v in axis_sizes.items() if k != REPLICA_AXIS}
+    for name in sizes:
+        if name not in INTRA_GROUP_AXES:
+            raise ValueError(f"unknown mesh axis {name!r}; use {INTRA_GROUP_AXES}")
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(list(sizes.values()))) if sizes else 1
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(sizes.values()) or (1,))
+    mesh = Mesh(arr, tuple(sizes.keys()) or ("data",))
+    return FTMesh(
+        mesh=mesh, manager=manager, rules=rules or ShardingRules()
+    )

@@ -1,0 +1,87 @@
+"""Logical-axis sharding rules.
+
+Model code names array axes logically ("batch", "seq", "embed", "heads",
+"mlp", "vocab", "expert", "layers"); a ``ShardingRules`` table maps logical
+names to mesh axes ("data", "fsdp", "tensor", "sequence", "expert").  This
+is the TPU-idiomatic replacement for the reference's DTensor placements —
+sharding is annotation, XLA inserts the collectives (scaling-book recipe).
+
+The default rules give Megatron-style TP (heads/mlp over "tensor"),
+FSDP-style parameter sharding (embed over "fsdp"), batch over "data", and
+sequence over "sequence" for the ring-attention path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """logical axis -> mesh axis (or None = replicated)."""
+
+    rules: Tuple[Tuple[str, Optional[str]], ...] = (
+        ("batch", "data"),
+        ("seq", "sequence"),
+        ("embed", "fsdp"),
+        ("heads", "tensor"),
+        ("kv_heads", "tensor"),
+        ("mlp", "tensor"),
+        ("vocab", "tensor"),
+        ("expert", "expert"),
+        ("layers", None),
+    )
+
+    def mesh_axis(self, logical: Optional[str], mesh: Mesh) -> Optional[str]:
+        if logical is None:
+            return None
+        for name, axis in self.rules:
+            if name == logical:
+                # Drop axes the mesh doesn't have (e.g. no "sequence" axis
+                # in a pure-DP mesh) — the dimension is then replicated.
+                return axis if axis in mesh.axis_names else None
+        return None
+
+    def spec(self, logical_axes: Tuple[Optional[str], ...], mesh: Mesh) -> P:
+        seen = set()
+        out = []
+        for ax in logical_axes:
+            m = self.mesh_axis(ax, mesh)
+            # A mesh axis may appear at most once in a PartitionSpec.
+            if m is not None and m in seen:
+                m = None
+            if m is not None:
+                seen.add(m)
+            out.append(m)
+        return P(*out)
+
+    def sharding(
+        self, logical_axes: Tuple[Optional[str], ...], mesh: Mesh
+    ) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes, mesh))
+
+
+def logical_sharding(
+    tree_axes: Any, mesh: Mesh, rules: Optional[ShardingRules] = None
+) -> Any:
+    """Maps a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    rules = rules or ShardingRules()
+    return jax.tree.map(
+        lambda axes: rules.sharding(axes, mesh),
+        tree_axes,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def constrain(x: jax.Array, axes: Tuple[Optional[str], ...], mesh: Optional[Mesh],
+              rules: Optional[ShardingRules] = None) -> jax.Array:
+    """with_sharding_constraint by logical axes; no-op without a mesh."""
+    if mesh is None or mesh.empty:
+        return x
+    rules = rules or ShardingRules()
+    return jax.lax.with_sharding_constraint(x, rules.sharding(axes, mesh))
