@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import logging
 import os
 import sys
 from datetime import timedelta
@@ -31,6 +32,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main() -> None:
+    # INFO so the manager's lifecycle lines ("healing from replica ...",
+    # reconfigures) land in the log — the FT demo's evidence trail.
+    logging.basicConfig(level=logging.INFO)
+    # SIGUSR1 dumps all thread stacks: `kill -USR1 <pid>` is the first move
+    # when a replica looks wedged.
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.register(_signal.SIGUSR1)
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--batch", type=int, default=32)
@@ -39,6 +49,22 @@ def main() -> None:
     args = parser.parse_args()
 
     import jax
+
+    # Env alone cannot force a platform here: the site hook may override
+    # $JAX_PLATFORMS after launch, so honor an explicit pin before backend
+    # init (multi-process drives must not share the single TPU chip).
+    forced = os.environ.get("TPUFT_JAX_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
+    # Persistent compilation cache: a restarted replica re-JITs from disk in
+    # ~no time instead of recompiling, shrinking the recovery window — the
+    # dominant restart cost on both TPU pods and CPU hosts.
+    cache_dir = os.environ.get("TPUFT_COMPILE_CACHE")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -119,10 +145,14 @@ def main() -> None:
             state["opt"].step_begin()
             step = manager.current_step()
 
+            # Shard by the *static* replica group id (reference train_ddp.py
+            # does the same): dynamic quorum state would shift every group's
+            # shard on each membership change, and a healing group
+            # (participating_rank None) would alias group 0's shard.
             sampler = DistributedSampler(
                 len(dataset_x),
-                replica_group=manager.participating_rank() or 0,
-                num_replica_groups=max(1, manager.num_participants()),
+                replica_group=replica_group,
+                num_replica_groups=num_groups,
                 shuffle=True,
                 seed=step,
             )

@@ -286,6 +286,28 @@ void Lighthouse::TickLoop() {
 }
 
 void Lighthouse::TickLocked() {
+  // Log healthy<->stale transitions: when a replica is declared dead (or
+  // comes back) the operator must be able to see it and its heartbeat age.
+  auto tick_now = Clock::now();
+  auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
+  for (const auto& [id, last] : state_.heartbeats) {
+    bool fresh = tick_now - last < hb_timeout;
+    auto it = last_fresh_.find(id);
+    if (it == last_fresh_.end()) {
+      last_fresh_[id] = fresh;
+    } else if (it->second != fresh) {
+      it->second = fresh;
+      auto age_ms =
+          std::chrono::duration_cast<std::chrono::milliseconds>(tick_now - last).count();
+      if (fresh) {
+        LOGI("lighthouse: replica %s heartbeat recovered", id.c_str());
+      } else {
+        LOGW("lighthouse: replica %s heartbeat stale (age %lld ms) — declaring dead",
+             id.c_str(), static_cast<long long>(age_ms));
+      }
+    }
+  }
+
   std::string reason;
   auto members = QuorumCompute(Clock::now(), state_, opt_, &reason);
   if (reason != last_reason_) {

@@ -101,6 +101,9 @@ class Lighthouse {
   // Dedup logging of quorum status changes
   // (reference ChangeLogger, src/lighthouse.rs:68-84).
   std::string last_reason_;
+  // Replicas observed heartbeat-fresh on the previous tick, for logging
+  // healthy<->stale transitions (failure-detection visibility).
+  std::map<std::string, bool> last_fresh_;
 
   std::thread tick_thread_;
   bool shutdown_ = false;

@@ -144,7 +144,14 @@ void ManagerServer::HeartbeatLoop() {
   req.set_replica_id(opt_.replica_id);
   std::string payload, resp, err;
   req.SerializeToString(&payload);
-  bool warned = false;
+  // A single heartbeat RPC must never be allowed to eat a whole
+  // heartbeat_timeout window: fail fast and retry on the next tick.  The
+  // lighthouse only declares a replica dead after ~50 consecutive misses
+  // (5 s timeout / 100 ms interval), so fast-fail is strictly safer than a
+  // long in-call wait.
+  const uint64_t call_timeout_ms = std::max<uint64_t>(opt_.heartbeat_interval_ms * 5, 500);
+  int64_t consecutive_failures = 0;
+  auto last_iter = Clock::now();
   while (true) {
     {
       std::unique_lock<std::mutex> lk(mu_);
@@ -153,13 +160,26 @@ void ManagerServer::HeartbeatLoop() {
         return;
       }
     }
-    Status st = heartbeat_client_->Call(kLighthouseHeartbeat, payload, 5000, &resp, &err);
-    if (st != Status::kOk && !warned) {
-      LOGW("manager %s: heartbeat to %s failed: %s", opt_.replica_id.c_str(),
-           opt_.lighthouse_addr.c_str(), err.c_str());
-      warned = true;
-    } else if (st == Status::kOk) {
-      warned = false;
+    auto now = Clock::now();
+    auto gap_ms =
+        std::chrono::duration_cast<std::chrono::milliseconds>(now - last_iter).count();
+    if (gap_ms > static_cast<int64_t>(opt_.heartbeat_interval_ms) * 10) {
+      LOGW("manager %s: heartbeat loop stalled for %lld ms", opt_.replica_id.c_str(),
+           static_cast<long long>(gap_ms));
+    }
+    last_iter = now;
+    Status st = heartbeat_client_->Call(kLighthouseHeartbeat, payload, call_timeout_ms,
+                                        &resp, &err);
+    if (st != Status::kOk) {
+      consecutive_failures += 1;
+      // First failure and every ~2s of continued failure: visible, bounded.
+      if (consecutive_failures == 1 || consecutive_failures % 20 == 0) {
+        LOGW("manager %s: heartbeat to %s failed (x%lld): %s", opt_.replica_id.c_str(),
+             opt_.lighthouse_addr.c_str(), static_cast<long long>(consecutive_failures),
+             err.c_str());
+      }
+    } else {
+      consecutive_failures = 0;
     }
   }
 }

@@ -50,22 +50,55 @@ class FailureInjector:
 @dataclass
 class Runner:
     """Runs one replica group with restart-on-failure
-    (reference: Runner, torchft/manager_integ_test.py:87-155)."""
+    (reference: Runner, torchft/manager_integ_test.py:87-155).
+
+    With ``world_size > 1`` each attempt runs all local ranks as threads
+    sharing one rendezvous store (rank 0's Manager spawns the group's
+    ManagerServer; the others dial it through the store), and a failure in
+    any rank restarts the whole group — the torchelastic semantics the
+    reference simulates (torchft/manager_integ_test.py:100-141)."""
 
     replica_id: int
     lighthouse_address: str
     failure_injector: FailureInjector
     train_loop: Callable[..., object]
     num_replicas: int = 2
+    world_size: int = 1
     attempts: int = 3
     train_loop_args: Dict[str, Any] = field(default_factory=dict)
+
+    def _attempt(self) -> List[object]:
+        if self.world_size == 1:
+            return [self.train_loop(self, rank=0)]
+
+        from torchft_tpu._native import StoreServer
+
+        # Fresh store per attempt: a restarted group must not see the dead
+        # incarnation's manager_addr/replica_id keys.
+        store = StoreServer(bind="[::]:0")
+        try:
+            with ThreadPoolExecutor(
+                max_workers=self.world_size,
+                thread_name_prefix=f"replica{self.replica_id}",
+            ) as pool:
+                futures = [
+                    pool.submit(
+                        self.train_loop,
+                        self,
+                        rank=rank,
+                        store_addr=store.address(),
+                    )
+                    for rank in range(self.world_size)
+                ]
+                return [f.result(timeout=120) for f in futures]
+        finally:
+            store.shutdown()
 
     def run_replica(self) -> List[object]:
         for i in range(self.attempts):
             try:
                 logger.info("starting replica %s attempt %s", self.replica_id, i)
-                result = self.train_loop(self, rank=0)
-                return [result]
+                return self._attempt()
             except InjectedFailure:
                 logger.info("replica %s died; restarting", self.replica_id)
                 continue

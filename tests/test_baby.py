@@ -1,0 +1,165 @@
+"""Crash-isolation tests for BabyCollective.
+
+Reference parity: the baby-PG suites in torchft/process_group_test.py
+(:612-846 reconfigure/future APIs, :942-998 resiliency) — the collective
+conformance registry runs against the subprocess-isolated backend, then a
+child is killed mid-run and the parent must latch an error (not hang, not
+die) and recover on the next configure().
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List
+
+import numpy as np
+import pytest
+
+from torchft_tpu._native import StoreServer
+from torchft_tpu.baby import BabyCollective, BabyTCPCollective, MonitoredPipe
+from torchft_tpu.collectives import Collective
+
+from test_collectives import _COLLECTIVE_TO_FUNC, fresh_prefix
+
+
+@pytest.fixture(scope="module")
+def store():
+    server = StoreServer(bind="127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+def run_baby_ranks(store, world_size: int, fn: Callable[[Collective, int], object]) -> List[object]:
+    prefix = fresh_prefix()
+    collectives = [BabyTCPCollective(timeout=15.0) for _ in range(world_size)]
+
+    def worker(rank: int) -> object:
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, world_size)
+        try:
+            return fn(c, rank)
+        finally:
+            c.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        futures = [pool.submit(worker, r) for r in range(world_size)]
+        return [f.result(timeout=60) for f in futures]
+
+
+@pytest.mark.parametrize("op", sorted(_COLLECTIVE_TO_FUNC))
+def test_baby_collective_conformance(store, op: str) -> None:
+    """Every collective op behaves identically through the subprocess
+    boundary (reference: baby rows of the conformance matrix,
+    torchft/process_group_test.py:847-912)."""
+    results = run_baby_ranks(store, 2, _COLLECTIVE_TO_FUNC[op])
+    assert all(results)
+
+
+def test_baby_child_crash_latches_and_recovers(store) -> None:
+    """SIGKILL the child mid-collective: the parent latches an error without
+    hanging or dying, and a fresh configure() recovers (reference:
+    shutdown-resiliency test, torchft/process_group_test.py:942-998)."""
+    prefix = fresh_prefix()
+    babies = [BabyTCPCollective(timeout=10.0) for _ in range(2)]
+
+    def worker(rank: int):
+        c = babies[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, 2)
+        x = np.full(64, float(rank + 1), dtype=np.float32)
+        out = c.allreduce([x], op="sum").wait(timeout=20)[0]
+        np.testing.assert_allclose(out, np.full(64, 3.0))
+        return c
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        for f in [pool.submit(worker, r) for r in range(2)]:
+            f.result(timeout=30)
+
+    # Kill rank 1's child; rank 0's next op must fail fast (its ring peer is
+    # gone), and rank 1's parent must observe the death, not hang.
+    assert babies[1]._proc is not None
+    babies[1]._proc.kill()
+    babies[1]._proc.join(timeout=5)
+
+    x = np.ones(64, dtype=np.float32)
+    work = babies[0].allreduce([x], op="sum")
+    with pytest.raises(Exception):
+        work.wait(timeout=20)
+    assert babies[0].errored() is not None
+    assert babies[1].errored() is not None
+
+    # Recovery: reconfigure both onto a fresh prefix (the next quorum's
+    # store prefix in real life) and the ring works again.
+    prefix2 = fresh_prefix()
+
+    def reworker(rank: int):
+        c = babies[rank]
+        c.configure(f"{store.address()}/{prefix2}", rank, 2)
+        out = c.allreduce([np.full(8, float(rank + 1), dtype=np.float32)], op="sum")
+        np.testing.assert_allclose(out.wait(timeout=20)[0], np.full(8, 3.0))
+        c.shutdown()
+        return True
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        assert all(f.result(timeout=30) for f in [pool.submit(reworker, r) for r in range(2)])
+
+
+def test_baby_abort_kills_child(store) -> None:
+    """abort() is the NCCL-abort analogue: the child dies, errors latch, and
+    the object is reusable after configure()."""
+    baby = BabyTCPCollective(timeout=5.0)
+    prefix = fresh_prefix()
+    other = BabyTCPCollective(timeout=5.0)
+
+    def conf(c, rank):
+        c.configure(f"{store.address()}/{prefix}", rank, 2)
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        list(pool.map(lambda args: conf(*args), [(baby, 0), (other, 1)]))
+
+    proc = baby._proc
+    baby.abort()
+    assert baby.errored() is not None
+    proc.join(timeout=5)
+    assert not proc.is_alive()
+    # Post-abort ops fail immediately instead of hanging.
+    assert baby.allreduce([np.ones(4, np.float32)]).exception(timeout=5) is not None
+    baby.shutdown()
+    other.shutdown()
+
+
+def test_monitored_pipe_reraises_exceptions() -> None:
+    """Exceptions sent as payloads re-raise at the receiver (reference:
+    _MonitoredPipe, torchft/multiprocessing.py:10-32)."""
+    import multiprocessing
+
+    a, b = multiprocessing.Pipe()
+    left, right = MonitoredPipe(a), MonitoredPipe(b)
+    left.send(ValueError("boom"))
+    with pytest.raises(ValueError, match="boom"):
+        right.recv(timeout=5)
+    left.send({"ok": 1})
+    assert right.recv(timeout=5) == {"ok": 1}
+    with pytest.raises(TimeoutError):
+        right.recv(timeout=0.05)
+
+
+def test_device_get_timeout() -> None:
+    """The stream_timeout analogue: a wedged materialization surfaces as
+    TimeoutError and later calls still work (fresh thread)."""
+    from torchft_tpu.futures import _MATERIALIZER, device_get
+
+    gate = threading.Event()
+
+    class _Wedge:
+        def __array__(self, dtype=None, copy=None):
+            gate.wait(10)
+            return np.zeros(1, dtype=np.float32)
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="materialization"):
+        device_get(_Wedge(), timeout=0.2)
+    assert time.monotonic() - t0 < 5
+    gate.set()
+    # The wedged worker was abandoned; a fresh one serves this call.
+    out = device_get(np.arange(4, dtype=np.float32), timeout=5)
+    np.testing.assert_array_equal(out, np.arange(4, dtype=np.float32))

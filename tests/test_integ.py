@@ -118,6 +118,73 @@ def ddp_train_loop(runner: Runner, rank: int) -> Dict[str, Any]:
         manager.shutdown()
 
 
+def multi_rank_train_loop(runner: Runner, rank: int, store_addr: str) -> Dict[str, Any]:
+    """One local rank of a world_size>1 replica group.  Both local ranks see
+    the same batch (TP-style: in-group gradients are replicated), so every
+    rank of every group must end bitwise-identical — while exercising the
+    ManagerServer's world_size barriers: quorum aggregation across local
+    ranks, the all-ranks commit vote, and rank-striped heal metadata
+    (reference: test_ddp_recovery_multi_rank,
+    torchft/manager_integ_test.py:375-417)."""
+    import jax
+    import optax
+
+    total_steps = runner.train_loop_args.get("total_steps", 6)
+
+    collective = TCPCollective(timeout=20.0)
+    transport = HTTPTransport(timeout=20.0)
+    state: Dict[str, Any] = {}
+
+    def save():
+        return {"params": state["opt"].params, "opt_state": state["opt"].opt_state}
+
+    def load(sd):
+        state["opt"].params = sd["params"]
+        state["opt"].opt_state = sd["opt_state"]
+
+    manager = Manager(
+        collective=collective,
+        load_state_dict=load,
+        state_dict=save,
+        min_replica_size=1,
+        timeout=timedelta(seconds=20),
+        quorum_timeout=timedelta(seconds=20),
+        rank=rank,
+        world_size=runner.world_size,
+        external_store_addr=store_addr,
+        replica_id=str(runner.replica_id),
+        lighthouse_addr=runner.lighthouse_address,
+        checkpoint_transport=transport,
+    )
+    state["opt"] = Optimizer(manager, optax.sgd(0.05), _init_params())
+    averager = GradientAverager(manager)
+    grad_fn = jax.jit(jax.grad(_loss_fn))
+
+    try:
+        while manager.current_step() < total_steps:
+            state["opt"].step_begin()
+            step = manager.current_step()
+            rrank = manager.participating_rank() or 0
+            x, y = _batch(step, rrank)
+            grads = grad_fn(state["opt"].params, x, y)
+            grads = averager.allreduce(grads)
+            state["opt"].step(grads)
+            # Keyed by LOCAL rank: a multi-rank group must fail every rank at
+            # the same step so the whole group dies as a unit (the reference
+            # scripts .fail_at(0, s).fail_at(1, s) likewise).
+            runner.failure_injector.check(rank, manager.current_step())
+        barrier = runner.train_loop_args.get("barrier")
+        if barrier is not None:
+            barrier.wait(timeout=60)
+        return {
+            "params": {k: np.asarray(v) for k, v in state["opt"].params.items()},
+            "step": manager.current_step(),
+            "rank": rank,
+        }
+    finally:
+        manager.shutdown()
+
+
 class _DoneBarrier:
     """Barrier that only waits for *finishing* participants: restarted
     replicas re-register, so parties is dynamic."""
@@ -204,6 +271,55 @@ def test_ddp_recovery_multiple_failures(lighthouse) -> None:
     results = run_replicas(runners)
     assert inj0.count == 1 and inj1.count == 1
     _assert_params_equal(results)
+
+
+def _make_multi_rank_runners(lighthouse, injectors, world_size=2, total_steps=6):
+    barrier = _DoneBarrier(len(injectors) * world_size)
+    return [
+        Runner(
+            replica_id=i,
+            lighthouse_address=lighthouse.address(),
+            failure_injector=inj,
+            train_loop=multi_rank_train_loop,
+            num_replicas=len(injectors),
+            world_size=world_size,
+            train_loop_args={"total_steps": total_steps, "barrier": barrier},
+        )
+        for i, inj in enumerate(injectors)
+    ]
+
+
+def _assert_all_rank_params_equal(results) -> None:
+    base = results[0][0]["params"]
+    for group in results:
+        for rank_result in group:
+            for k in base:
+                np.testing.assert_array_equal(base[k], rank_result["params"][k])
+
+
+def test_multi_rank_healthy(lighthouse) -> None:
+    """2 groups x 2 local ranks: quorum aggregation and the commit vote wait
+    for every local rank; all four rank states end bitwise-identical."""
+    runners = _make_multi_rank_runners(lighthouse, [FailureInjector(), FailureInjector()])
+    results = run_replicas(runners)
+    assert all(len(group) == 2 for group in results)
+    assert all(r["step"] >= 6 for group in results for r in group)
+    _assert_all_rank_params_equal(results)
+
+
+def test_multi_rank_recovery(lighthouse) -> None:
+    """A 2-rank group dies as a unit mid-run, restarts, and both its ranks
+    heal from the survivor's matching ranks (rank-striped recovery); all four
+    rank states converge bitwise (reference: test_ddp_recovery_multi_rank,
+    torchft/manager_integ_test.py:375-417)."""
+    injector = FailureInjector().fail_at(0, 3).fail_at(1, 3)
+    runners = _make_multi_rank_runners(
+        lighthouse, [FailureInjector(), injector], total_steps=7
+    )
+    results = run_replicas(runners)
+    assert injector.count == 2
+    assert all(r["step"] >= 7 for group in results for r in group)
+    _assert_all_rank_params_equal(results)
 
 
 def test_quorum_timeout(lighthouse) -> None:

@@ -1,0 +1,325 @@
+"""Crash-isolated collective: the communicator runs in a spawned subprocess.
+
+Reference parity: ProcessGroupBaby* (torchft/process_group.py:1117-1745) and
+_MonitoredPipe (torchft/multiprocessing.py:10-32).  The reference's single
+biggest robustness layer: a hard wedge, crash, or poisoned thread inside
+communication code must not take down the training process.  The real
+collective (e.g. TCPCollective) lives in a child process; commands travel
+over monitored pipes; results complete parent-side futures via a reader
+thread.  If the child dies or wedges, the parent latches an error and the
+next ``configure()`` (i.e. the next quorum) respawns a fresh child.
+
+TPU adaptation: tensors are host numpy buffers by the time they reach the
+replica-dimension collective (device work stays inside the pjit program), so
+arrays cross the process boundary by pickling.  That is one extra memcpy on
+a path that is DCN-bandwidth-bound — the price of crash isolation, exactly
+the trade the reference makes with its shared-memory queues.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import threading
+from concurrent.futures import Future
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu.collectives import Collective, DummyCollective, TCPCollective, Work
+from torchft_tpu.futures import completed_future, failed_future, future_timeout
+
+__all__ = ["MonitoredPipe", "BabyCollective", "BabyTCPCollective"]
+
+
+class MonitoredPipe:
+    """Pipe wrapper: ``recv(timeout)`` via poll; exceptions sent as payloads
+    re-raise at the receiver (reference: _MonitoredPipe,
+    torchft/multiprocessing.py:10-32)."""
+
+    def __init__(self, pipe) -> None:
+        self._pipe = pipe
+        self._send_lock = threading.Lock()
+
+    def send(self, obj) -> None:
+        with self._send_lock:
+            self._pipe.send(obj)
+
+    def recv(self, timeout: Optional[float] = None):
+        if timeout is not None and not self._pipe.poll(timeout):
+            raise TimeoutError(f"pipe recv timed out after {timeout}s")
+        out = self._pipe.recv()
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def close(self) -> None:
+        self._pipe.close()
+
+    def closed(self) -> bool:
+        return self._pipe.closed
+
+
+def _tcp_collective_factory(kwargs: dict) -> Collective:
+    return TCPCollective(**kwargs)
+
+
+def _dummy_collective_factory(kwargs: dict) -> Collective:
+    return DummyCollective(**kwargs)
+
+
+def _child_main(factory, factory_kwargs: dict, cmd_pipe, result_pipe) -> None:
+    """Child process loop: owns the real collective, executes ops in arrival
+    order, ships results/exceptions back (reference: _worker,
+    torchft/process_group.py:1224-1367)."""
+    inner: Collective = factory(factory_kwargs)
+    cmds = MonitoredPipe(cmd_pipe)
+    results = MonitoredPipe(result_pipe)
+    try:
+        while True:
+            msg = cmds.recv()
+            kind = msg[0]
+            if kind == "shutdown":
+                inner.shutdown()
+                return
+            if kind == "configure":
+                _, store_addr, rank, world_size = msg
+                try:
+                    inner.configure(store_addr, rank, world_size)
+                    results.send(("configured", None))
+                except Exception as e:  # noqa: BLE001
+                    results.send(("configured", e))
+                continue
+            if kind == "op":
+                _, op_id, name, args, kwargs = msg
+                try:
+                    work: Work = getattr(inner, name)(*args, **kwargs)
+                    value = work.wait()
+                    results.send(("op", op_id, None, value))
+                except Exception as e:  # noqa: BLE001
+                    results.send(("op", op_id, e, None))
+                continue
+            if kind == "abort":
+                inner.abort()
+                results.send(("aborted", None))
+                continue
+    except (EOFError, OSError, KeyboardInterrupt):
+        # Parent went away (or is tearing us down): exit quietly.
+        try:
+            inner.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class BabyCollective(Collective):
+    """Runs an inner collective in a spawned subprocess so that a crash or
+    hard wedge in communication code cannot take down the train process
+    (reference: ProcessGroupBaby, torchft/process_group.py:1117-1745)."""
+
+    def __init__(
+        self,
+        factory: Callable[[dict], Collective] = _tcp_collective_factory,
+        factory_kwargs: Optional[dict] = None,
+        timeout: float = 60.0,
+    ) -> None:
+        self._factory = factory
+        self._factory_kwargs = factory_kwargs or {}
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._proc: Optional[multiprocessing.Process] = None
+        self._cmds: Optional[MonitoredPipe] = None
+        self._results: Optional[MonitoredPipe] = None
+        self._reader: Optional[threading.Thread] = None
+        self._futures: Dict[int, Future] = {}
+        self._next_op = 0
+        self._rank = 0
+        self._world_size = 1
+        self._error: Optional[Exception] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._teardown_child()
+        ctx = multiprocessing.get_context("spawn")
+        cmd_parent, cmd_child = ctx.Pipe()
+        res_parent, res_child = ctx.Pipe()
+        proc = ctx.Process(
+            target=_child_main,
+            args=(self._factory, self._factory_kwargs, cmd_child, res_child),
+            daemon=True,
+            name=f"tpuft_baby_{rank}",
+        )
+        proc.start()
+        cmd_child.close()
+        res_child.close()
+        with self._lock:
+            self._proc = proc
+            self._cmds = MonitoredPipe(cmd_parent)
+            self._results = MonitoredPipe(res_parent)
+            self._futures = {}
+            self._error = None
+            self._rank = rank
+            self._world_size = world_size
+        self._cmds.send(("configure", store_addr, rank, world_size))
+        kind, exc = self._results.recv(timeout=self._timeout)
+        assert kind == "configured", f"unexpected child response {kind}"
+        if exc is not None:
+            self._latch(exc)
+            raise exc
+        reader = threading.Thread(
+            target=self._read_loop,
+            args=(self._results,),
+            name="tpuft_baby_reader",
+            daemon=True,
+        )
+        reader.start()
+        self._reader = reader
+
+    def _teardown_child(self) -> None:
+        with self._lock:
+            proc, self._proc = self._proc, None
+            cmds, self._cmds = self._cmds, None
+            results, self._results = self._results, None
+            futures, self._futures = self._futures, {}
+        for fut in futures.values():
+            if not fut.done():
+                fut.set_exception(RuntimeError("collective reconfigured"))
+        if cmds is not None:
+            try:
+                cmds.send(("shutdown",))
+            except (OSError, BrokenPipeError):
+                pass
+            cmds.close()
+        if results is not None:
+            results.close()  # unblocks the reader thread
+        if proc is not None:
+            proc.join(timeout=2.0)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=2.0)
+
+    def _read_loop(self, results: MonitoredPipe) -> None:
+        """Completes parent-side futures from child results (reference:
+        _future_handler, torchft/process_group.py:1369-1396)."""
+        while True:
+            try:
+                msg = results.recv()
+            except (EOFError, OSError):
+                # Child died or pipe torn down: fail everything outstanding.
+                with self._lock:
+                    futures, self._futures = self._futures, {}
+                    stale = self._results is not results
+                err = RuntimeError("collective subprocess died")
+                if not stale:
+                    self._latch(err)
+                for fut in futures.values():
+                    if not fut.done():
+                        fut.set_exception(err)
+                return
+            except Exception:  # noqa: BLE001
+                continue
+            if msg[0] == "op":
+                _, op_id, exc, value = msg
+                with self._lock:
+                    fut = self._futures.pop(op_id, None)
+                if fut is None or fut.done():
+                    continue
+                if exc is not None:
+                    self._latch(exc)
+                    fut.set_exception(exc)
+                else:
+                    fut.set_result(value)
+
+    def _latch(self, exc: Exception) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+
+    def errored(self) -> Optional[Exception]:
+        with self._lock:
+            if self._error is not None:
+                return self._error
+            if self._proc is not None and not self._proc.is_alive():
+                self._error = RuntimeError("collective subprocess died")
+                return self._error
+        return None
+
+    def abort(self) -> None:
+        # The NCCL-abort analogue: kill the child outright; in-flight ops
+        # fail via the reader's EOF path, and the next configure respawns.
+        with self._lock:
+            proc = self._proc
+            if self._error is None:
+                self._error = RuntimeError("collective aborted")
+        if proc is not None and proc.is_alive():
+            proc.kill()
+
+    def shutdown(self) -> None:
+        self._teardown_child()
+
+    # -- ops ----------------------------------------------------------------
+
+    def _submit(self, name: str, *args, **kwargs) -> Work:
+        with self._lock:
+            if self._error is not None:
+                return Work(failed_future(self._error))
+            cmds = self._cmds
+            if cmds is None:
+                return Work(failed_future(RuntimeError("collective not configured")))
+            op_id = self._next_op
+            self._next_op += 1
+            fut: Future = Future()
+            self._futures[op_id] = fut
+        try:
+            cmds.send(("op", op_id, name, args, kwargs))
+        except (OSError, BrokenPipeError) as e:
+            with self._lock:
+                self._futures.pop(op_id, None)
+            self._latch(e)
+            return Work(failed_future(e))
+        # A wedged child must surface as a timeout, not a hang: this is the
+        # isolation contract (the reference arms the same deadline on baby
+        # futures, torchft/process_group.py:1497-1504).
+        return Work(future_timeout(fut, self._timeout))
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        return self._submit("allreduce", [np.ascontiguousarray(a) for a in arrays], op)
+
+    def allgather(self, array: np.ndarray) -> Work:
+        return self._submit("allgather", np.ascontiguousarray(array))
+
+    def broadcast(self, array: np.ndarray, root: int = 0) -> Work:
+        return self._submit("broadcast", np.ascontiguousarray(array), root)
+
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        return self._submit(
+            "reduce_scatter", [np.ascontiguousarray(a) for a in arrays], op
+        )
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._submit("alltoall", [np.ascontiguousarray(a) for a in arrays])
+
+    def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
+        return self._submit("send", np.ascontiguousarray(array), dst, tag)
+
+    def recv(self, shape: tuple, dtype, src: int, tag: int = 0) -> Work:
+        return self._submit("recv", tuple(shape), dtype, src, tag)
+
+    def barrier(self) -> Work:
+        if self._world_size == 1:
+            return Work(completed_future(None))
+        return self._submit("barrier")
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+
+def BabyTCPCollective(timeout: float = 60.0, chunk_bytes: int = 4 << 20) -> BabyCollective:
+    """Crash-isolated TCPCollective (the BabyNCCL analogue)."""
+    return BabyCollective(
+        factory=_tcp_collective_factory,
+        factory_kwargs={"timeout": timeout, "chunk_bytes": chunk_bytes},
+        timeout=timeout,
+    )

@@ -128,9 +128,12 @@ class HTTPTransport(CheckpointTransport):
         elif what == "metadata":
             out.write(pickle.dumps(self._chunk_count(buffers)))
         elif what.startswith("chunk_"):
-            idx = int(what[len("chunk_"):])
+            try:
+                idx = int(what[len("chunk_"):])
+            except ValueError:
+                return None  # malformed chunk index -> 404, not a 500 traceback
             n = self._chunk_count(buffers)
-            if idx >= n:
+            if idx < 0 or idx >= n:
                 return None
             # Round-robin assignment keeps chunk sizes balanced without
             # reordering metadata (torchft/checkpointing/http_transport.py:287-298).

@@ -248,12 +248,20 @@ class _Peer:
 
 
 class _FifoQueue:
-    """Submission-order turnstile for one (direction, peer, tag) stream."""
+    """Submission-order turnstile for one (direction, peer, tag) stream.
+
+    A stream is all-or-nothing: once any op on it fails (timeout or socket
+    error) the stream is poisoned and every later op fails immediately.
+    Skipping a failed slot instead would let the remote side's matching op
+    pair with the *next* op's frame — a silent payload swap that consumers
+    outside the commit gate (checkpoint transports) could act on before any
+    reconfigure clears the error."""
 
     def __init__(self) -> None:
         self.cond = threading.Condition()
         self.next_submit = 0
         self.next_serve = 0
+        self.poison: Optional[Exception] = None
 
     def take_ticket(self) -> int:
         with self.cond:
@@ -263,12 +271,24 @@ class _FifoQueue:
 
     def wait_turn(self, seq: int, timeout: float) -> None:
         with self.cond:
-            if not self.cond.wait_for(lambda: self.next_serve >= seq, timeout=timeout):
+            ok = self.cond.wait_for(
+                lambda: self.poison is not None or self.next_serve >= seq,
+                timeout=timeout,
+            )
+            if self.poison is not None:
+                raise RuntimeError(f"channel poisoned by earlier failure: {self.poison}")
+            if not ok:
                 raise TimeoutError("timed out waiting for earlier op on this channel")
 
     def done(self) -> None:
         with self.cond:
             self.next_serve += 1
+            self.cond.notify_all()
+
+    def poison_with(self, exc: Exception) -> None:
+        with self.cond:
+            if self.poison is None:
+                self.poison = exc
             self.cond.notify_all()
 
 
@@ -315,6 +335,7 @@ class TCPCollective(Collective):
         # this, two same-tag ops could be silently swapped by the tag demux.
         self._fifo_lock = threading.Lock()
         self._fifo: dict[tuple, "_FifoQueue"] = {}
+        self._p2p_submit_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -714,42 +735,87 @@ class TCPCollective(Collective):
                 q = self._fifo[key] = _FifoQueue()
             return q
 
+    def _sever_peer(self, peer_rank: int, gen: int, used: Optional[_Peer]) -> None:
+        """Closes the p2p socket a failed op was using so its in-flight or
+        matching remote ops fail fast instead of pairing with a later op's
+        frame.  Guards: the generation check keeps a failure that straddles a
+        reconfigure from touching the NEW quorum's socket, and the identity
+        check keeps a stale failure (op blocked on an already-severed socket)
+        from closing a freshly re-dialed healthy replacement."""
+        if used is None:
+            return
+        with self._accept_cond:
+            if self._generation != gen or self._peers.get(peer_rank) is not used:
+                used = None  # registered peer is not the one that failed
+            else:
+                del self._peers[peer_rank]
+        if used is not None:
+            used.close()
+
+    def _p2p_op(
+        self, q: _FifoQueue, peer_rank: int, body: Callable[[List[_Peer]], object]
+    ) -> Work:
+        # Ticket + submit must be atomic: with 4 p2p workers, an inverted
+        # executor order could park every worker in wait_turn on later
+        # tickets while the earliest is still queued behind them, stalling
+        # the stream for the whole timeout window.  (Dedicated lock:
+        # _fifo_lock nests inside _lock in configure(), and _submit takes
+        # _lock, so reusing _fifo_lock here would invert that order.)
+        with self._p2p_submit_lock:
+            seq = q.take_ticket()
+            gen = self._generation
+
+            def run() -> object:
+                # Never advance the turnstile past a never-executed slot:
+                # poison the stream so the remote side's matching op errors
+                # instead of silently pairing with the next frame.
+                try:
+                    q.wait_turn(seq, self._timeout)
+                except Exception as e:  # noqa: BLE001
+                    # Queue stall: poison only.  Severing here would kill a
+                    # healthy transfer still progressing on the shared socket
+                    # (its per-syscall timeouts never fired); the remote's
+                    # matching op simply times out on its own socket.
+                    q.poison_with(e)
+                    raise
+                used: List[_Peer] = []
+                try:
+                    out = body(used)
+                except Exception as e:  # noqa: BLE001
+                    # Body failure may have left a partial frame on the wire:
+                    # sever the exact link this op used so both sides fail fast.
+                    q.poison_with(e)
+                    self._sever_peer(peer_rank, gen, used[0] if used else None)
+                    raise
+                q.done()
+                return out
+
+            return self._submit(run, ring=False)
+
     def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
         array = np.ascontiguousarray(array)
         q = self._fifo_queue(("send", dst, tag))
-        seq = q.take_ticket()
 
-        def run() -> None:
+        def body(used: List[_Peer]) -> None:
             import pickle
 
-            # done() must run even when wait_turn itself times out: a skipped
-            # slot keeps the channel moving (the error is latched and the
-            # next quorum reconfigures); a missing done() would poison every
-            # later op on this (peer, tag) stream.
-            try:
-                q.wait_turn(seq, self._timeout)
-                peer = self._dial(dst)
-                peer.send_msg(100 + tag, memoryview(pickle.dumps(array)))
-            finally:
-                q.done()
+            peer = self._dial(dst)
+            used.append(peer)
+            peer.send_msg(100 + tag, memoryview(pickle.dumps(array)))
 
-        return self._submit(run, ring=False)
+        return self._p2p_op(q, dst, body)
 
     def recv(self, shape: tuple, dtype, src: int, tag: int = 0) -> Work:
         q = self._fifo_queue(("recv", src, tag))
-        seq = q.take_ticket()
 
-        def run() -> np.ndarray:
+        def body(used: List[_Peer]) -> np.ndarray:
             import pickle
 
-            try:
-                q.wait_turn(seq, self._timeout)
-                peer = self._dial(src)
-                return pickle.loads(peer.recv_msg(100 + tag))
-            finally:
-                q.done()
+            peer = self._dial(src)
+            used.append(peer)
+            return pickle.loads(peer.recv_msg(100 + tag))
 
-        return self._submit(run, ring=False)
+        return self._p2p_op(q, src, body)
 
     def barrier(self) -> Work:
         if self._world_size == 1:

@@ -83,7 +83,15 @@ class GradientAverager:
             return grads
 
         is_jax = [isinstance(l, jax.Array) for l in leaves]
-        hosts = [np.asarray(l) for l in leaves]
+        try:
+            # Deadline-guarded device->host: wedged device work latches an
+            # error instead of hanging the step (stream_timeout analogue).
+            from torchft_tpu.futures import device_get_tree
+
+            hosts = device_get_tree(leaves, self._manager._timeout.total_seconds())
+        except TimeoutError as e:
+            self._manager.report_error(e)
+            return grads
 
         futures: List[Tuple[_Bucket, Future]] = []
         for bucket in self._make_buckets(hosts):

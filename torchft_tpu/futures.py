@@ -133,6 +133,67 @@ def context_timeout(callback: Callable[[], None], timeout: float) -> Generator[N
         _TIMEOUT_MANAGER.cancel(handle)
 
 
+class _Materializer:
+    """Deadline-guarded device->host materialization (the ``stream_timeout``
+    analogue, torchft/futures.py:129-148,255).
+
+    ``np.asarray(jax_array)`` blocks indefinitely if the device computation
+    feeding it wedges; the reference arms a CUDA-event timer for the same
+    edge.  Here the transfer runs on a dedicated thread with a deadline: on
+    timeout the caller gets ``TimeoutError`` (to latch into the step error)
+    and the wedged thread is abandoned — a fresh one serves later calls, so
+    one stuck transfer cannot poison the next step's path."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._executor = None
+
+    def _get_executor(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="tpuft_materialize"
+                )
+            return self._executor
+
+    def _abandon(self) -> None:
+        with self._lock:
+            old, self._executor = self._executor, None
+        if old is not None:
+            old.shutdown(wait=False, cancel_futures=True)
+
+    def get(self, fn: Callable[[], T], timeout: float) -> T:
+        fut = self._get_executor().submit(fn)
+        try:
+            return fut.result(timeout=timeout)
+        except TimeoutError:
+            self._abandon()
+            raise TimeoutError(
+                f"device->host materialization did not complete within {timeout}s "
+                "(stuck device computation?)"
+            ) from None
+
+
+_MATERIALIZER = _Materializer()
+
+
+def device_get(x: Any, timeout: float) -> Any:
+    """Materializes a (possibly device-backed) array to host numpy with a
+    deadline; raises TimeoutError instead of hanging on wedged device work."""
+    import numpy as np
+
+    return _MATERIALIZER.get(lambda: np.asarray(x), timeout)
+
+
+def device_get_tree(leaves: list, timeout: float) -> list:
+    """Materializes a list of arrays with one shared deadline."""
+    import numpy as np
+
+    return _MATERIALIZER.get(lambda: [np.asarray(l) for l in leaves], timeout)
+
+
 def completed_future(value: T = None) -> Future:
     """A future already resolved with `value`."""
     fut: Future = Future()

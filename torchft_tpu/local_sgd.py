@@ -137,6 +137,16 @@ class DiLoCo:
         self._backup = _tree_to_host(get_params())
         self._outer_state = outer_tx.init(self._backup)
 
+        # The outer-loop state must travel with the model when a restarted
+        # group heals from a peer: a fresh-init backup would make the next
+        # sync compute pseudogradients against the wrong base and silently
+        # diverge (the reference's DiLoCo recovery test checkpoints
+        # original_parameters + outer optimizer state for exactly this,
+        # torchft/local_sgd_integ_test.py:124-158).
+        manager.register_state_dict_fn(
+            "diloco", self._load_outer_state, self._save_outer_state
+        )
+
     def __enter__(self) -> "DiLoCo":
         return self
 
@@ -151,6 +161,20 @@ class DiLoCo:
     @property
     def backup_params(self) -> Any:
         return self._backup
+
+    @backup_params.setter
+    def backup_params(self, value: Any) -> None:
+        self._backup = _tree_to_host(value)
+
+    def _save_outer_state(self) -> Any:
+        return {
+            "backup": self._backup,
+            "outer_state": _tree_to_host(self._outer_state),
+        }
+
+    def _load_outer_state(self, state: Any) -> None:
+        self.backup_params = state["backup"]
+        self._outer_state = state["outer_state"]
 
     def step(self) -> None:
         self._local_step += 1

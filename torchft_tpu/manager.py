@@ -441,7 +441,17 @@ class Manager:
             return completed_future(tensor)
 
         is_jax = _is_jax_array(tensor)
-        host = np.asarray(tensor)
+        try:
+            # Deadline-guarded: a wedged device computation surfaces as a
+            # latched TimeoutError, not a hung train loop (the reference's
+            # stream_timeout edge, torchft/futures.py:129-148).
+            from torchft_tpu.futures import device_get
+
+            host = device_get(tensor, self._timeout.total_seconds())
+        except TimeoutError as e:
+            self._logger.exception(f"allreduce input materialization: {e}")
+            self.report_error(e)
+            return completed_future(tensor)
         if not self.is_participating():
             # Healing replicas / spares contribute zeros (torchft/manager.py:287-288).
             host = np.zeros_like(host)
