@@ -226,14 +226,6 @@ def chip_benchmark() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _count_committed(workdir: str, group: int) -> int:
-    path = os.path.join(workdir, f"g{group}.log")
-    if not os.path.exists(path):
-        return 0
-    with open(path, "rb") as f:
-        return sum(1 for line in f if b"committed=True" in line)
-
-
 def _run_scenario(
     workdir: str, window_s: float, kill_at_s: float | None, cache_dir: str
 ) -> dict:
@@ -345,10 +337,17 @@ def _run_scenario(
 
 def kill_benchmark() -> dict:
     window = float(os.environ.get("TPUFT_BENCH_KILL_WINDOW_S", "45"))
-    with tempfile.TemporaryDirectory(prefix="tpuft_bench_nokill_") as d:
-        base = _run_scenario(d, window_s=window, kill_at_s=None)
-    with tempfile.TemporaryDirectory(prefix="tpuft_bench_kill_") as d:
-        killed = _run_scenario(d, window_s=window, kill_at_s=window / 3)
+    # One compile cache shared by every process of both scenarios: the
+    # post-kill restart must not pay JIT compilation again (on a single-core
+    # host a recompile starves every process and would swamp the FT cost
+    # being measured).
+    with tempfile.TemporaryDirectory(prefix="tpuft_bench_cache_") as cache_dir:
+        with tempfile.TemporaryDirectory(prefix="tpuft_bench_nokill_") as d:
+            base = _run_scenario(d, window_s=window, kill_at_s=None, cache_dir=cache_dir)
+        with tempfile.TemporaryDirectory(prefix="tpuft_bench_kill_") as d:
+            killed = _run_scenario(
+                d, window_s=window, kill_at_s=window / 3, cache_dir=cache_dir
+            )
     frac = killed["committed_batches"] / max(1, base["committed_batches"])
     return {
         "window_s": window,
@@ -364,28 +363,51 @@ def kill_benchmark() -> dict:
 
 
 def main() -> None:
+    # The chip result is computed, assembled, and (on any kill-scenario
+    # failure) still printed first: a failure on the subprocess-heavy kill
+    # path must never discard the on-chip measurement again (round 2 lost its
+    # numbers exactly that way).
     chip = chip_benchmark()
-    kill = kill_benchmark()
-    print(
-        json.dumps(
-            {
-                "metric": "ft_train_goodput",
-                "value": chip["ft_tokens_per_sec"],
-                "unit": "tokens/sec",
-                "vs_baseline": kill["goodput_under_kill_fraction"],
-                "detail": {
-                    **chip,
-                    **kill,
-                    "baseline_semantics": "vs_baseline = committed work in a "
-                    "fixed window with one SIGKILL + live heal, relative to "
-                    "the same window undisturbed (BASELINE.md north star; "
-                    "target >= 0.95).  The reference publishes no absolute "
-                    "numbers.",
-                },
-            }
-        )
-    )
+    result = {
+        "metric": "ft_train_goodput",
+        "value": chip["ft_tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "detail": {
+            **chip,
+            "baseline_semantics": "vs_baseline = committed work in a "
+            "fixed window with one SIGKILL + live heal, relative to "
+            "the same window undisturbed (BASELINE.md north star; "
+            "target >= 0.95).  The reference publishes no absolute "
+            "numbers.",
+        },
+    }
+    try:
+        kill = kill_benchmark()
+    except Exception as e:  # noqa: BLE001
+        result["detail"]["kill_benchmark_error"] = repr(e)
+        print(json.dumps(result))
+        raise
+    result["vs_baseline"] = kill["goodput_under_kill_fraction"]
+    result["detail"].update(kill)
+    print(json.dumps(result))
+
+
+def selftest() -> None:
+    """Fast structural check (no chip, no subprocess windows): verifies both
+    scenario entry points are callable with their real signatures so a
+    refactor cannot silently break the headline artifact again."""
+    import inspect
+
+    sig = inspect.signature(_run_scenario)
+    assert list(sig.parameters) == ["workdir", "window_s", "kill_at_s", "cache_dir"]
+    inspect.signature(kill_benchmark).bind()
+    inspect.signature(chip_benchmark).bind()
+    print("bench selftest ok")
 
 
 if __name__ == "__main__":
-    main()
+    if "--selftest" in sys.argv:
+        selftest()
+    else:
+        main()

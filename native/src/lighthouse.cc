@@ -307,6 +307,30 @@ void Lighthouse::TickLocked() {
       }
     }
   }
+  // Evict replicas dead for >10x the heartbeat timeout: they are invisible
+  // to quorum already (the healthy filter uses age < timeout, so this cannot
+  // change quorum or split-brain arithmetic) and under replica-id churn
+  // (uuid-suffixed ids across restarts) the maps otherwise grow without
+  // bound, with every tick iterating the graveyard.  Pending joiners are
+  // exempt: a replica with a blocked Join RPC that stalls past the horizon
+  // (e.g. JIT-compile starvation) and then recovers must still be counted
+  // when the quorum finally forms — participants is cleared every quorum
+  // round anyway, so this exemption cannot leak.
+  for (auto it = state_.heartbeats.begin(); it != state_.heartbeats.end();) {
+    if (tick_now - it->second > hb_timeout * 10 &&
+        state_.participants.find(it->first) == state_.participants.end()) {
+      it = state_.heartbeats.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  for (auto it = last_fresh_.begin(); it != last_fresh_.end();) {
+    if (state_.heartbeats.find(it->first) == state_.heartbeats.end()) {
+      it = last_fresh_.erase(it);
+    } else {
+      ++it;
+    }
+  }
 
   std::string reason;
   auto members = QuorumCompute(Clock::now(), state_, opt_, &reason);

@@ -145,10 +145,11 @@ void ManagerServer::HeartbeatLoop() {
   std::string payload, resp, err;
   req.SerializeToString(&payload);
   // A single heartbeat RPC must never be allowed to eat a whole
-  // heartbeat_timeout window: fail fast and retry on the next tick.  The
-  // lighthouse only declares a replica dead after ~50 consecutive misses
-  // (5 s timeout / 100 ms interval), so fast-fail is strictly safer than a
-  // long in-call wait.
+  // heartbeat_timeout window: the lighthouse keeps a replica alive as long
+  // as one heartbeat lands within each heartbeat_timeout window, so a
+  // bounded per-call timeout with an immediate retry on the next tick is
+  // strictly safer than one long in-call wait that could blow through the
+  // whole window on a single stuck connection.
   const uint64_t call_timeout_ms = std::max<uint64_t>(opt_.heartbeat_interval_ms * 5, 500);
   int64_t consecutive_failures = 0;
   auto last_iter = Clock::now();

@@ -75,7 +75,7 @@ class Runner:
 
         # Fresh store per attempt: a restarted group must not see the dead
         # incarnation's manager_addr/replica_id keys.
-        store = StoreServer(bind="[::]:0")
+        store = StoreServer(bind="127.0.0.1:0")
         try:
             with ThreadPoolExecutor(
                 max_workers=self.world_size,

@@ -58,32 +58,38 @@ def test_baby_collective_conformance(store, op: str) -> None:
 def test_baby_child_crash_latches_and_recovers(store) -> None:
     """SIGKILL the child mid-collective: the parent latches an error without
     hanging or dying, and a fresh configure() recovers (reference:
-    shutdown-resiliency test, torchft/process_group_test.py:942-998)."""
+    shutdown-resiliency test, torchft/process_group_test.py:942-998).
+
+    Timeouts are load-tolerant: process spawn + interpreter start can take
+    tens of seconds on a busy single-core host (this test runs in the full
+    suite concurrently with JIT-heavy tests), so waits sit far above the
+    expected latency — the failure mode being guarded is a *hang*, and the
+    harness's per-test timeout still bounds that."""
     prefix = fresh_prefix()
-    babies = [BabyTCPCollective(timeout=10.0) for _ in range(2)]
+    babies = [BabyTCPCollective(timeout=60.0) for _ in range(2)]
 
     def worker(rank: int):
         c = babies[rank]
         c.configure(f"{store.address()}/{prefix}", rank, 2)
         x = np.full(64, float(rank + 1), dtype=np.float32)
-        out = c.allreduce([x], op="sum").wait(timeout=20)[0]
+        out = c.allreduce([x], op="sum").wait(timeout=90)[0]
         np.testing.assert_allclose(out, np.full(64, 3.0))
         return c
 
     with ThreadPoolExecutor(max_workers=2) as pool:
         for f in [pool.submit(worker, r) for r in range(2)]:
-            f.result(timeout=30)
+            f.result(timeout=120)
 
-    # Kill rank 1's child; rank 0's next op must fail fast (its ring peer is
+    # Kill rank 1's child; rank 0's next op must fail (its ring peer is
     # gone), and rank 1's parent must observe the death, not hang.
     assert babies[1]._proc is not None
     babies[1]._proc.kill()
-    babies[1]._proc.join(timeout=5)
+    babies[1]._proc.join(timeout=30)
 
     x = np.ones(64, dtype=np.float32)
     work = babies[0].allreduce([x], op="sum")
     with pytest.raises(Exception):
-        work.wait(timeout=20)
+        work.wait(timeout=90)
     assert babies[0].errored() is not None
     assert babies[1].errored() is not None
 
@@ -95,12 +101,14 @@ def test_baby_child_crash_latches_and_recovers(store) -> None:
         c = babies[rank]
         c.configure(f"{store.address()}/{prefix2}", rank, 2)
         out = c.allreduce([np.full(8, float(rank + 1), dtype=np.float32)], op="sum")
-        np.testing.assert_allclose(out.wait(timeout=20)[0], np.full(8, 3.0))
+        np.testing.assert_allclose(out.wait(timeout=90)[0], np.full(8, 3.0))
         c.shutdown()
         return True
 
     with ThreadPoolExecutor(max_workers=2) as pool:
-        assert all(f.result(timeout=30) for f in [pool.submit(reworker, r) for r in range(2)])
+        assert all(
+            f.result(timeout=120) for f in [pool.submit(reworker, r) for r in range(2)]
+        )
 
 
 def test_baby_abort_kills_child(store) -> None:

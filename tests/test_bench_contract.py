@@ -1,0 +1,91 @@
+"""Guards the contracts between bench.py and the code it measures.
+
+The kill-goodput benchmark counts committed work and verified heals by
+grepping subprocess logs (bench.py) for strings emitted by
+examples/train_ddp.py and torchft_tpu/manager.py.  Nothing else ties those
+strings together — a log-format tweak would silently zero the headline
+metric — so this test pins all three ends of the contract, and bench.py's
+structural selftest catches signature drift between its scenario functions
+(the exact failure that cost round 2 its numbers).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(relpath: str) -> str:
+    with open(os.path.join(REPO, relpath), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_bench_greps_match_emitters() -> None:
+    bench = _read("bench.py")
+    example = _read(os.path.join("examples", "train_ddp.py"))
+    manager = _read(os.path.join("torchft_tpu", "manager.py"))
+
+    # bench.py counts committed steps by this literal...
+    assert 'b"committed=True"' in bench
+    # ...which the example emits as an f-string ending in the bool repr.
+    assert "committed={committed}" in example
+
+    # bench.py verifies the heal ran by this literal...
+    assert 'b"healing from replica"' in bench
+    # ...which the Manager logs on the recovery-destination path.
+    assert '"healing from replica' in manager
+
+
+def test_bench_selftest() -> None:
+    """bench.py --selftest verifies its own scenario-call signatures without
+    touching the chip or spawning training subprocesses."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--selftest"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "bench selftest ok" in out.stdout
+
+
+def test_example_emits_committed_line(tmp_path) -> None:
+    """Runs the example app for a couple of steps in a subprocess (tiny
+    model, CPU platform, 1 replica group) and asserts the exact log line the
+    kill-bench greps for appears — the runtime end of the string contract."""
+    from torchft_tpu._native import LighthouseServer
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200
+    )
+    env = dict(os.environ)
+    env.update(
+        {
+            "TPUFT_JAX_PLATFORM": "cpu",
+            "JAX_PLATFORMS": "cpu",
+            "TPUFT_LIGHTHOUSE": lighthouse.address(),
+            "REPLICA_GROUP_ID": "0",
+            "NUM_REPLICA_GROUPS": "1",
+            "MASTER_ADDR": "localhost",
+        }
+    )
+    try:
+        out = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "examples", "train_ddp.py"),
+                "--steps",
+                "2",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            cwd=REPO,
+            env=env,
+        )
+    finally:
+        lighthouse.shutdown()
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "committed=True" in out.stdout

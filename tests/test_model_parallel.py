@@ -138,10 +138,13 @@ def test_train_step_split_matches_full() -> None:
 
 
 def test_ft_step_commit_gate() -> None:
+    from datetime import timedelta
+
     import optax
 
     manager = create_autospec(Manager, instance=True)
     manager.num_participants.return_value = 2
+    manager.timeout = timedelta(seconds=60)
     manager.allreduce.side_effect = lambda arr, should_average=True: completed_future(
         np.asarray(arr)
     )

@@ -16,10 +16,13 @@ from torchft_tpu.manager import Manager
 
 
 def _mock_manager(num_participants: int = 2, commit: bool = True) -> MagicMock:
+    from datetime import timedelta
+
     manager = create_autospec(Manager, instance=True)
     manager.num_participants.return_value = num_participants
     manager.should_commit.return_value = commit
     manager._use_async_quorum = False
+    manager.timeout = timedelta(seconds=60)
 
     def fake_allreduce(arr, should_average: bool = True):
         # Pretend every participant contributed identical values: the average

@@ -204,13 +204,18 @@ class BabyCollective(Collective):
             try:
                 msg = results.recv()
             except (EOFError, OSError):
-                # Child died or pipe torn down: fail everything outstanding.
-                with self._lock:
-                    futures, self._futures = self._futures, {}
-                    stale = self._results is not results
+                # Child died or pipe torn down: fail everything outstanding —
+                # unless this reader is stale (a new configure() installed a
+                # fresh child); then the futures dict belongs to the new
+                # generation and is not ours to touch (teardown already failed
+                # the old generation's futures with "collective reconfigured").
                 err = RuntimeError("collective subprocess died")
-                if not stale:
-                    self._latch(err)
+                with self._lock:
+                    if self._results is not results:
+                        return
+                    futures, self._futures = self._futures, {}
+                    if self._error is None:
+                        self._error = err
                 for fut in futures.values():
                     if not fut.done():
                         fut.set_exception(err)

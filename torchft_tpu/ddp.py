@@ -88,7 +88,7 @@ class GradientAverager:
             # error instead of hanging the step (stream_timeout analogue).
             from torchft_tpu.futures import device_get_tree
 
-            hosts = device_get_tree(leaves, self._manager._timeout.total_seconds())
+            hosts = device_get_tree(leaves, self._manager.timeout.total_seconds())
         except TimeoutError as e:
             self._manager.report_error(e)
             return grads

@@ -139,37 +139,83 @@ class _Materializer:
 
     ``np.asarray(jax_array)`` blocks indefinitely if the device computation
     feeding it wedges; the reference arms a CUDA-event timer for the same
-    edge.  Here the transfer runs on a dedicated thread with a deadline: on
-    timeout the caller gets ``TimeoutError`` (to latch into the step error)
-    and the wedged thread is abandoned — a fresh one serves later calls, so
-    one stuck transfer cannot poison the next step's path."""
+    edge.  Here the transfer runs on a dedicated **daemon** thread with a
+    deadline: on timeout the caller gets ``TimeoutError`` (to latch into the
+    step error) and the wedged thread is abandoned — a fresh one serves later
+    calls, so one stuck transfer cannot poison the next step's path, and a
+    genuinely wedged worker cannot block interpreter shutdown the way a
+    ThreadPoolExecutor worker (joined at exit since Python 3.9) would."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._executor = None
+        self._queue = None  # type: Optional[object]
+        self._thread: Optional[threading.Thread] = None
 
-    def _get_executor(self):
-        from concurrent.futures import ThreadPoolExecutor
+    @staticmethod
+    def _worker(q) -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            fn, fut = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+    def _get_queue(self):
+        import queue as _queue
 
         with self._lock:
-            if self._executor is None:
-                self._executor = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="tpuft_materialize"
+            if self._thread is None or not self._thread.is_alive():
+                self._queue = _queue.SimpleQueue()
+                self._thread = threading.Thread(
+                    target=self._worker,
+                    args=(self._queue,),
+                    name="tpuft_materialize",
+                    daemon=True,
                 )
-            return self._executor
+                self._thread.start()
+            return self._queue
 
-    def _abandon(self) -> None:
+    def _abandon(self, q) -> None:
         with self._lock:
-            old, self._executor = self._executor, None
-        if old is not None:
-            old.shutdown(wait=False, cancel_futures=True)
+            if self._queue is not q:
+                # Another timed-out caller already abandoned this generation
+                # (and drained it); a fresh queue is serving new work.
+                return
+            old, self._queue = self._queue, None
+            self._thread = None
+        # Concurrent callers may have queued work behind the wedged item;
+        # fail it now rather than letting those callers burn their full
+        # deadline on futures nothing will ever run.
+        while True:
+            try:
+                item = old.get_nowait()
+            except Exception:  # queue.Empty
+                break
+            if item is None:
+                continue
+            _, fut = item
+            if not fut.done():
+                fut.set_exception(
+                    TimeoutError(
+                        "materializer abandoned after a concurrent timeout; "
+                        "transfer not attempted"
+                    )
+                )
+        old.put(None)  # exit signal, honored if the worker ever unwedges
 
     def get(self, fn: Callable[[], T], timeout: float) -> T:
-        fut = self._get_executor().submit(fn)
+        fut: Future = Future()
+        q = self._get_queue()
+        q.put((fn, fut))
         try:
             return fut.result(timeout=timeout)
         except TimeoutError:
-            self._abandon()
+            self._abandon(q)
             raise TimeoutError(
                 f"device->host materialization did not complete within {timeout}s "
                 "(stuck device computation?)"

@@ -498,6 +498,14 @@ class Manager:
         self._pending_work.append(out)
         return out
 
+    @property
+    def timeout(self) -> timedelta:
+        """Default per-operation deadline.  Public so wrappers can bound their
+        own device->host materializations and RPC waits without reaching into
+        private state (reference exposes the same knob as a ctor arg,
+        torchft/manager.py:95-97)."""
+        return self._timeout
+
     # -- error handling -----------------------------------------------------
 
     def report_error(self, e: Exception) -> None:
