@@ -22,6 +22,7 @@ import numpy as np
 from torchft_tpu.checkpointing.serialization import (
     as_u8,
     flatten_state_dict,
+    sharding_restorer,
     unflatten_state_dict,
 )
 from torchft_tpu.checkpointing.transport import CheckpointTransport
@@ -108,35 +109,12 @@ class CollectiveTransport(CheckpointTransport):
                     .view(tm.dtype)
                     .reshape(tm.shape)
                 )
-        restore = self._make_restorer()
+        restore = (
+            sharding_restorer(self._state_dict_fn)
+            if self._state_dict_fn is not None
+            else None
+        )
         return unflatten_state_dict(meta, buffers, restore)
-
-    def _make_restorer(self) -> Optional[Callable[[Any], Any]]:
-        """Builds a sharding resolver from the live state dict: fetched leaves
-        adopt the placement of the arrays they replace (in-place receive)."""
-        if self._state_dict_fn is None:
-            return None
-        try:
-            import jax
-
-            live = self._state_dict_fn()
-            specs = {}
-            for leaf in jax.tree_util.tree_leaves(live):
-                if isinstance(leaf, jax.Array) and isinstance(
-                    leaf.sharding, jax.sharding.NamedSharding
-                ):
-                    key = (
-                        tuple(leaf.sharding.mesh.axis_names),
-                        tuple(leaf.sharding.spec),
-                    )
-                    specs[key] = leaf.sharding
-
-            def restore(spec: Any):
-                return specs.get(tuple(spec) if isinstance(spec, list) else spec)
-
-            return restore
-        except Exception:  # noqa: BLE001
-            return None
 
     def shutdown(self, wait: bool = True) -> None:
         # The collective is owned by the manager; nothing to release here.

@@ -25,6 +25,7 @@ __all__ = [
     "unflatten_state_dict",
     "write_state_dict",
     "read_state_dict",
+    "sharding_restorer",
 ]
 
 
@@ -142,6 +143,48 @@ def unflatten_state_dict(
                 arr = jax.numpy.asarray(arr)
         leaves.append(arr)
     return jax.tree_util.tree_unflatten(treespec, leaves)
+
+
+def sharding_restorer(state_dict_fn: Any) -> Any:
+    """Builds a spec -> live ``jax.sharding.NamedSharding`` resolver from the
+    *current* state dict: fetched leaves adopt the placement of the local
+    arrays they replace, so an in-place receive restores a sharded tree onto
+    this replica's own mesh without re-deciding placement (the
+    DTensor-restore analogue, torchft/checkpointing/pg_transport.py:230-301).
+
+    Keys are the transferable form recorded by ``_spec_of``: (mesh axis
+    names, partition spec) — identical across replica groups whose meshes
+    share axis names, which is exactly the HSDP setup.
+    """
+
+    specs: dict = {}
+
+    def rebuild() -> None:
+        import jax
+
+        specs.clear()
+        for leaf in jax.tree_util.tree_leaves(state_dict_fn()):
+            if isinstance(leaf, jax.Array) and isinstance(
+                leaf.sharding, jax.sharding.NamedSharding
+            ):
+                key = (
+                    tuple(leaf.sharding.mesh.axis_names),
+                    tuple(leaf.sharding.spec),
+                )
+                specs[key] = leaf.sharding
+
+    def restore(spec: Any):
+        key = tuple(spec) if isinstance(spec, list) else spec
+        try:
+            if key not in specs:
+                # Rebuild lazily: the mesh is static so known keys stay
+                # valid, but the live tree may have grown new placements.
+                rebuild()
+            return specs.get(key)
+        except Exception:  # noqa: BLE001
+            return None
+
+    return restore
 
 
 def write_state_dict(meta: StateDictMeta, buffers: List[np.ndarray], stream: io.RawIOBase) -> None:
