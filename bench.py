@@ -31,9 +31,6 @@ from __future__ import annotations
 
 import json
 import os
-import signal
-import socket
-import subprocess
 import sys
 import tempfile
 import time
@@ -56,14 +53,6 @@ def _peak_flops(device) -> float | None:
         if sub in kind:
             return peak
     return None
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 # ---------------------------------------------------------------------------
@@ -128,11 +117,13 @@ def chip_benchmark() -> dict:
         loss = raw_step()
     fetch(loss)
 
-    # Estimate step time to size the measured run (>= ~3 s of device time).
+    # Estimate step time to size the measured run (>= ~6 s of device time,
+    # and never fewer than 20 steps: at ~240 ms/step an 8-step window showed
+    # ±1% run-to-run noise — larger than the FT overhead being measured).
     t0 = time.perf_counter()
     fetch(raw_step())
     est = max(1e-3, time.perf_counter() - t0)
-    steps = max(5, min(100, int(3.0 / est)))
+    steps = max(20, min(200, int(6.0 / est)))
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -237,90 +228,41 @@ def _run_scenario(
     step: startup JIT compilation is excluded from both scenarios, and a
     shared persistent compilation cache keeps the post-kill restart from
     paying it again (on this single-core host a restart recompile starves
-    every process, which would swamp the FT cost being measured)."""
+    every process, which would swamp the FT cost being measured).
+
+    Process management is the framework's own Launcher (torchft_tpu/launch.py)
+    — the same supervisor a user gets from ``python -m torchft_tpu.launch``;
+    the bench only adds the scripted SIGKILL."""
     repo = os.path.dirname(os.path.abspath(__file__))
-    lh_port = _free_port()
+    from torchft_tpu.launch import Launcher
 
-    env_base = dict(os.environ)
-    env_base.pop("JAX_PLATFORMS", None)
-    env_base.update(
-        {
+    launcher = Launcher(
+        [sys.executable, os.path.join(repo, "examples", "train_ddp.py"),
+         "--steps", "1000000"],
+        num_groups=2,
+        lighthouse="embed",
+        min_replicas=1,
+        join_timeout_ms=2000,
+        log_dir=workdir,
+        cache_dir=cache_dir,
+        env={
+            "JAX_PLATFORMS": None,  # parent may have pinned the TPU platform
             "TPUFT_JAX_PLATFORM": "cpu",  # env alone is overridden by site hooks
-            "TPUFT_COMPILE_CACHE": cache_dir,
-            "TPUFT_LIGHTHOUSE": f"127.0.0.1:{lh_port}",
-            "NUM_REPLICA_GROUPS": "2",
-            "MASTER_ADDR": "localhost",
-        }
+        },
+        cwd=repo,
     )
-
-    procs: dict[int, subprocess.Popen] = {}
-    logs: dict[int, object] = {}
-    lighthouse = None
-
-    def spawn(group: int) -> None:
-        if group in logs:
-            logs[group].close()  # respawns must not leak the old handle
-        logs[group] = open(os.path.join(workdir, f"g{group}.log"), "ab")
-        env = dict(env_base)
-        env["REPLICA_GROUP_ID"] = str(group)
-        procs[group] = subprocess.Popen(
-            [sys.executable, os.path.join(repo, "examples", "train_ddp.py"),
-             "--steps", "1000000"],
-            env=env,
-            stdout=logs[group],
-            stderr=subprocess.STDOUT,
-            cwd=repo,
-        )
-
-    lh_log = None
-    try:
-        lh_log = open(os.path.join(workdir, "lighthouse.log"), "ab")
-        lighthouse = subprocess.Popen(
-            [sys.executable, "-m", "torchft_tpu.lighthouse_cli",
-             "--bind", f"127.0.0.1:{lh_port}", "--min_replicas", "1",
-             "--join_timeout_ms", "2000"],
-            env=env_base,
-            stdout=lh_log,
-            stderr=subprocess.STDOUT,
-            cwd=repo,
-        )
-        time.sleep(1.0)
+    with launcher:
         start = time.monotonic()
-        spawn(0)
-        spawn(1)
-
         killed = kill_at_s is None
         while time.monotonic() - start < window_s:
             time.sleep(0.25)
             if not killed and time.monotonic() - start >= kill_at_s:
-                procs[1].kill()  # SIGKILL, the real thing
-                procs[1].wait()
+                launcher.kill(1)  # SIGKILL, the real thing
                 killed = True
                 time.sleep(3.0)  # restart delay: the dead window is real
-                spawn(1)
+                launcher.spawn(1)
             # Supervisor: restart any group that died for other reasons.
-            for g, p in list(procs.items()):
-                if p.poll() is not None and (g != 1 or killed):
-                    spawn(g)
-    finally:
-        for p in procs.values():
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs.values():
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        if lighthouse is not None:
-            lighthouse.send_signal(signal.SIGTERM)
-            try:
-                lighthouse.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                lighthouse.kill()
-        for f in logs.values():
-            f.close()
-        if lh_log is not None:
-            lh_log.close()
+            launcher.supervise_once()
 
     committed = 0
     healed = 0

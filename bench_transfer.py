@@ -1,0 +1,159 @@
+"""Checkpoint-transfer benchmarks: how fast can a recovering replica heal?
+
+Reference parity: torchft/checkpointing/http_transport_bench.py:22-51 (12 GB
+state dict, --num-chunks sweep) and pg_transport_bench.py:24-93 (2-rank
+send/recv).  Healing cost is the FT system's recovery-latency floor: a dead
+replica is useless until the full state dict lands, so GB/s here bounds how
+quickly goodput returns after a kill.
+
+Measures, for a synthetic multi-buffer state dict of --gb total:
+
+  http/chunks=N   — HTTPTransport snapshot + recv_checkpoint (the pull path a
+                    healing replica takes), N parallel round-robin chunks;
+  collective      — CollectiveTransport send/recv over a 2-rank TCPCollective
+                    (the in-band path that shares the manager's data plane).
+
+Prints one JSON line per configuration plus a trailing summary line; run as
+  python bench_transfer.py [--gb 2] [--buffers 32] [--out TRANSFER_BENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+def make_state_dict(total_bytes: int, n_buffers: int) -> Dict[str, np.ndarray]:
+    """n_buffers float32 arrays summing to ~total_bytes (the reference uses a
+    dict of equal CUDA tensors; host numpy is the TPU-side unit of transfer)."""
+    per = max(1, total_bytes // n_buffers // 4)
+    return {
+        f"layer_{i}.weight": np.full((per,), float(i), dtype=np.float32)
+        for i in range(n_buffers)
+    }
+
+
+def _gb(nbytes: int) -> float:
+    return nbytes / 1e9
+
+
+def bench_http(state: Dict[str, np.ndarray], nbytes: int, num_chunks: int) -> Dict[str, Any]:
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    src = HTTPTransport(timeout=120.0, num_chunks=num_chunks)
+    dst = HTTPTransport(timeout=120.0)
+    try:
+        t0 = time.perf_counter()
+        src.send_checkpoint([1], step=0, state_dict=state, timeout=120.0)
+        snapshot_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        out = dst.recv_checkpoint(1, src.metadata(), step=0, timeout=120.0)
+        fetch_s = time.perf_counter() - t0
+        assert set(out) == set(state) and out["layer_1.weight"][0] == 1.0
+        return {
+            "transport": "http",
+            "num_chunks": num_chunks,
+            "snapshot_s": round(snapshot_s, 3),
+            "fetch_s": round(fetch_s, 3),
+            "fetch_gb_per_s": round(_gb(nbytes) / fetch_s, 3),
+        }
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+def bench_collective(state: Dict[str, np.ndarray], nbytes: int) -> Dict[str, Any]:
+    from torchft_tpu._native import StoreServer
+    from torchft_tpu.checkpointing.collective_transport import CollectiveTransport
+    from torchft_tpu.collectives import TCPCollective
+
+    store = StoreServer(bind="127.0.0.1:0")
+    cols = [TCPCollective(timeout=120.0) for _ in range(2)]
+    try:
+        threads = [
+            threading.Thread(
+                target=cols[r].configure, args=(f"{store.address()}/xfer", r, 2)
+            )
+            for r in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        send_done: List[float] = []
+
+        def send() -> None:
+            t0 = time.perf_counter()
+            CollectiveTransport(cols[0], timeout=120.0).send_checkpoint(
+                [1], step=0, state_dict=state, timeout=120.0
+            )
+            send_done.append(time.perf_counter() - t0)
+
+        sender = threading.Thread(target=send)
+        t0 = time.perf_counter()
+        sender.start()
+        out = CollectiveTransport(cols[1], timeout=120.0).recv_checkpoint(
+            0, "<collective>", step=0, timeout=120.0
+        )
+        recv_s = time.perf_counter() - t0
+        sender.join()
+        assert set(out) == set(state) and out["layer_1.weight"][0] == 1.0
+        return {
+            "transport": "collective",
+            "send_s": round(send_done[0], 3),
+            "recv_s": round(recv_s, 3),
+            "recv_gb_per_s": round(_gb(nbytes) / recv_s, 3),
+        }
+    finally:
+        for c in cols:
+            c.shutdown()
+        store.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gb", type=float, default=2.0, help="state dict size")
+    parser.add_argument("--buffers", type=int, default=32)
+    parser.add_argument("--chunks", type=int, nargs="*", default=[0, 2, 4, 8])
+    parser.add_argument("--out", default=None, help="also write results JSON here")
+    args = parser.parse_args()
+
+    nbytes = int(args.gb * 1e9)
+    state = make_state_dict(nbytes, args.buffers)
+    actual = sum(a.nbytes for a in state.values())
+
+    results: List[Dict[str, Any]] = []
+    for n in args.chunks:
+        r = bench_http(state, actual, num_chunks=n)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    r = bench_collective(state, actual)
+    results.append(r)
+    print(json.dumps(r), flush=True)
+
+    best_http = max(
+        (x for x in results if x["transport"] == "http"),
+        key=lambda x: x["fetch_gb_per_s"],
+    )
+    summary = {
+        "state_dict_gb": round(_gb(actual), 2),
+        "buffers": args.buffers,
+        "best_http_gb_per_s": best_http["fetch_gb_per_s"],
+        "best_http_chunks": best_http["num_chunks"],
+        "collective_gb_per_s": results[-1]["recv_gb_per_s"],
+    }
+    print(json.dumps({"summary": summary}), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"results": results, "summary": summary}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

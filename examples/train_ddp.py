@@ -2,10 +2,16 @@
 
 Reference parity: train_ddp.py at the reference root — one process is one
 replica group; gradients are averaged across groups through the Manager's
-fault-tolerant allreduce; a killed process restarts (supervisor loop), heals
-live weights from a peer, and rejoins without stopping the others.
+fault-tolerant allreduce; a killed process is restarted by the launcher's
+supervisor (torchft_tpu/launch.py), heals live weights from a peer, and
+rejoins without stopping the others.
 
-Run (two replica groups on one machine)::
+Run (two supervised replica groups + embedded Lighthouse, one command)::
+
+    python -m torchft_tpu.launch --groups 2 -- \
+        python examples/train_ddp.py --steps 20
+
+or by hand against an external Lighthouse::
 
     python -m torchft_tpu.lighthouse_cli --bind [::]:29510 --min_replicas 1 &
     TPUFT_LIGHTHOUSE=localhost:29510 REPLICA_GROUP_ID=0 NUM_REPLICA_GROUPS=2 \

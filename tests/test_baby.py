@@ -55,6 +55,38 @@ def test_baby_collective_conformance(store, op: str) -> None:
     assert all(results)
 
 
+def test_baby_concurrent_op_streams(store) -> None:
+    """Interleaved op streams stay concurrent through the subprocess
+    boundary: each rank submits a blocking p2p recv BEFORE the matching send
+    (plus a ring allreduce in between), a pattern that deadlocks until
+    timeout if the child executes ops to completion in submission order.
+    Reference semantics: the worker's issue/wait split keeps multiple ops
+    outstanding (torchft/process_group.py:1224-1396)."""
+    prefix = fresh_prefix()
+    babies = [BabyTCPCollective(timeout=30.0) for _ in range(2)]
+
+    def worker(rank: int):
+        c = babies[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, 2)
+        peer = 1 - rank
+        # recv first: in-order child execution would wedge here, since the
+        # matching send sits behind it in this rank's own submission queue.
+        r = c.recv((1024,), np.float32, src=peer, tag=10 + peer)
+        a = c.allreduce([np.full(16, float(rank + 1), dtype=np.float32)], op="sum")
+        s = c.send(np.full(1024, float(rank + 1), dtype=np.float32), dst=peer, tag=10 + rank)
+        got = r.wait(timeout=25)
+        np.testing.assert_allclose(got, np.full(1024, float(peer + 1)))
+        np.testing.assert_allclose(a.wait(timeout=25)[0], np.full(16, 3.0))
+        s.wait(timeout=25)
+        c.shutdown()
+        return True
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        assert all(
+            f.result(timeout=90) for f in [pool.submit(worker, r) for r in range(2)]
+        )
+
+
 def test_baby_child_crash_latches_and_recovers(store) -> None:
     """SIGKILL the child mid-collective: the parent latches an error without
     hanging or dying, and a fresh configure() recovers (reference:

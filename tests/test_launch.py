@@ -1,0 +1,100 @@
+"""Launcher / restart-supervisor tests.
+
+Reference parity: torchft/torchx.py:11-80 — env plumbing per replica group
+and the max_restarts budget; the supervisor itself replaces torchelastic.
+The commands under test are tiny python -c scripts so the suite stays fast.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from torchft_tpu.launch import Launcher, main
+
+_PRINT_ENV_AND_SLEEP = (
+    "import os,time;"
+    "print('gid', os.environ['REPLICA_GROUP_ID'], os.environ['NUM_REPLICA_GROUPS'],"
+    " os.environ.get('TPUFT_LIGHTHOUSE',''), flush=True);"
+    "time.sleep(60)"
+)
+
+
+def _wait(predicate, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError("condition not reached in time")
+
+
+def test_launcher_env_plumbing_and_restart(tmp_path) -> None:
+    """Each group gets REPLICA_GROUP_ID/NUM_REPLICA_GROUPS/TPUFT_LIGHTHOUSE;
+    a SIGKILLed group is respawned by supervise_once (the --max_restarts
+    analogue, torchft/torchx.py:54)."""
+    with Launcher(
+        [sys.executable, "-c", _PRINT_ENV_AND_SLEEP],
+        num_groups=2,
+        lighthouse="embed",
+        max_restarts=3,
+        log_dir=str(tmp_path),
+    ) as launcher:
+        assert launcher.lighthouse_address
+        _wait(lambda: all(
+            (tmp_path / f"g{g}.log").exists()
+            and b"gid" in (tmp_path / f"g{g}.log").read_bytes()
+            for g in (0, 1)
+        ))
+        # Fault injection: SIGKILL group 1, no hold -> supervisor respawns it.
+        launcher.kill(1, hold=False)
+        assert launcher.supervise_once() == [1]
+        assert launcher.restarts(1) == 1
+        _wait(lambda: (tmp_path / "g1.log").read_bytes().count(b"gid") >= 2)
+
+    log0 = (tmp_path / "g0.log").read_text()
+    assert f"gid 0 2 {launcher.lighthouse_address}" in log0
+
+
+def test_launcher_hold_and_budget(tmp_path) -> None:
+    """kill() with hold keeps the supervisor's hands off until spawn();
+    an exhausted restart budget is reported, not retried."""
+    with Launcher(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        num_groups=1,
+        lighthouse="127.0.0.1:1",  # never dialed: command ignores it
+        max_restarts=0,
+        log_dir=str(tmp_path),
+    ) as launcher:
+        launcher.kill(0)  # hold=True default
+        assert launcher.supervise_once() == []  # held: not restarted
+        launcher.spawn(0)  # caller-controlled respawn clears the hold
+        _wait(lambda: launcher.running())
+        launcher.kill(0, hold=False)
+        assert launcher.supervise_once() == []  # budget (0) exhausted
+        assert launcher.exhausted() == [0]
+
+
+def test_launch_cli_clean_exit(tmp_path) -> None:
+    """The CLI supervises to completion and exits 0 when every group does."""
+    rc = main(
+        [
+            "--groups",
+            "2",
+            "--log-dir",
+            str(tmp_path),
+            "--",
+            sys.executable,
+            "-c",
+            "import os; print('done', os.environ['REPLICA_GROUP_ID'], flush=True)",
+        ]
+    )
+    assert rc == 0
+    for g in (0, 1):
+        assert f"done {g}" in (tmp_path / f"g{g}.log").read_text()
+
+
+def test_launch_cli_requires_command() -> None:
+    with pytest.raises(SystemExit):
+        main(["--groups", "1", "--"])

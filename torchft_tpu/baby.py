@@ -67,10 +67,35 @@ def _dummy_collective_factory(kwargs: dict) -> Collective:
     return DummyCollective(**kwargs)
 
 
+def _send_result(results: MonitoredPipe, op_id: int, exc, value) -> None:
+    try:
+        results.send(("op", op_id, exc, value))
+    except (OSError, BrokenPipeError, ValueError):
+        pass  # parent is gone; nothing to report to
+    except Exception as send_exc:  # noqa: BLE001  (unpicklable exc OR value)
+        try:
+            results.send(
+                (
+                    "op",
+                    op_id,
+                    RuntimeError(
+                        f"result not picklable ({send_exc!r}); "
+                        f"original exc={exc!r}"
+                    ),
+                    None,
+                )
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _child_main(factory, factory_kwargs: dict, cmd_pipe, result_pipe) -> None:
-    """Child process loop: owns the real collective, executes ops in arrival
-    order, ships results/exceptions back (reference: _worker,
-    torchft/process_group.py:1224-1367)."""
+    """Child process loop: owns the real collective.  Ops are *submitted* to
+    the inner collective and their completions shipped back as they land (a
+    done-callback on each Work), so overlapping parent ops — e.g. a ring
+    allreduce concurrent with p2p sends — stay concurrent through the process
+    boundary instead of serializing in submission order (reference: _worker
+    issue/wait split, torchft/process_group.py:1224-1396)."""
     inner: Collective = factory(factory_kwargs)
     cmds = MonitoredPipe(cmd_pipe)
     results = MonitoredPipe(result_pipe)
@@ -91,12 +116,23 @@ def _child_main(factory, factory_kwargs: dict, cmd_pipe, result_pipe) -> None:
                 continue
             if kind == "op":
                 _, op_id, name, args, kwargs = msg
+
+                def _complete(fut, op_id=op_id) -> None:
+                    exc = fut.exception()
+                    if exc is not None:
+                        _send_result(results, op_id, exc, None)
+                    else:
+                        _send_result(results, op_id, None, fut.result())
+
                 try:
                     work: Work = getattr(inner, name)(*args, **kwargs)
-                    value = work.wait()
-                    results.send(("op", op_id, None, value))
                 except Exception as e:  # noqa: BLE001
-                    results.send(("op", op_id, e, None))
+                    _send_result(results, op_id, e, None)
+                    continue
+                # Completion fires on the inner collective's worker thread;
+                # MonitoredPipe.send is lock-serialized, so concurrent
+                # completions interleave safely on the one result pipe.
+                work.add_done_callback(_complete)
                 continue
             if kind == "abort":
                 inner.abort()

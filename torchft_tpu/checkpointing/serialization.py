@@ -158,6 +158,7 @@ def sharding_restorer(state_dict_fn: Any) -> Any:
     """
 
     specs: dict = {}
+    rebuilt = [False]
 
     def rebuild() -> None:
         import jax
@@ -176,9 +177,13 @@ def sharding_restorer(state_dict_fn: Any) -> Any:
     def restore(spec: Any):
         key = tuple(spec) if isinstance(spec, list) else spec
         try:
-            if key not in specs:
-                # Rebuild lazily: the mesh is static so known keys stay
-                # valid, but the live tree may have grown new placements.
+            if key not in specs and not rebuilt[0]:
+                # Rebuild lazily, at most once per restorer: the mesh is
+                # static so known keys stay valid, and a key still missing
+                # after one rebuild (sender has placements this replica's
+                # live tree lacks) would otherwise re-flatten the whole tree
+                # on every miss of the recovery hot path.
+                rebuilt[0] = True
                 rebuild()
             return specs.get(key)
         except Exception:  # noqa: BLE001

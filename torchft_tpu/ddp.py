@@ -59,6 +59,10 @@ class GradientAverager:
         self._manager = manager
         self._bucket_bytes = bucket_bytes
 
+    @property
+    def manager(self) -> Manager:
+        return self._manager
+
     def allreduce(self, grads: Any) -> Any:
         """Averages a gradient pytree across participating replica groups.
 

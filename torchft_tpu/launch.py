@@ -1,0 +1,312 @@
+"""Replica-group launcher + restart supervisor.
+
+Reference parity: torchft/torchx.py:11-80 — the reference ships a TorchX
+component that launches N single-node replica groups with per-group env
+(``REPLICA_GROUP_ID``, ``NUM_REPLICA_GROUPS``, ``TORCHFT_LIGHTHOUSE``) and
+relies on torchelastic's ``--max_restarts`` to resurrect a killed group so it
+can heal live from a peer.  TorchX/torchelastic don't exist here, so the
+supervisor itself is part of the framework: ``Launcher`` owns the replica
+group subprocesses, restarts the ones that die (each restart is a fresh
+process that re-rendezvouses via the Lighthouse and heals from a healthy
+peer), and optionally embeds the native Lighthouse server in-process.
+
+CLI::
+
+    python -m torchft_tpu.launch --groups 2 --max-restarts 3 -- \
+        python examples/train_ddp.py --steps 20
+
+Programmatic (this is what ``bench.py``'s kill scenario drives)::
+
+    with Launcher([sys.executable, "train.py"], num_groups=2,
+                  lighthouse="embed", log_dir=workdir) as launcher:
+        while launcher.running():
+            time.sleep(0.25)
+            launcher.supervise_once()
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["Launcher", "main"]
+
+
+@dataclass
+class _Group:
+    proc: Optional[subprocess.Popen] = None
+    log: Optional[object] = None
+    restarts: int = 0
+    held: bool = False  # killed on purpose; don't auto-restart until spawn()
+    exited_clean: bool = False
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+class Launcher:
+    """Launches and supervises ``num_groups`` replica-group processes.
+
+    Args:
+        cmd: argv of one replica group (e.g. ``[sys.executable, "train.py"]``).
+        num_groups: number of replica groups (``NUM_REPLICA_GROUPS``).
+        lighthouse: ``"embed"`` to run the native Lighthouse in-process,
+            an ``"host:port"`` address to use an external one, or None to
+            inherit ``TPUFT_LIGHTHOUSE`` from the environment.
+        max_restarts: per-group restart budget (None = unlimited), the
+            ``--max_restarts`` analogue (torchft/torchx.py:54).
+        min_replicas: embedded Lighthouse quorum floor.
+        join_timeout_ms: embedded Lighthouse straggler wait.
+        log_dir: per-group logs land in ``<log_dir>/g<i>.log`` (append);
+            None inherits this process's stdout/stderr.
+        cache_dir: shared persistent XLA compile cache — a restarted group
+            re-JITs from disk instead of recompiling, shrinking recovery.
+        env: extra environment for every group (overrides inherited; a None
+            value unsets the variable).
+        cwd: working directory for the groups.
+    """
+
+    def __init__(
+        self,
+        cmd: List[str],
+        num_groups: int,
+        *,
+        lighthouse: Optional[str] = None,
+        max_restarts: Optional[int] = None,
+        min_replicas: int = 1,
+        join_timeout_ms: int = 2000,
+        log_dir: Optional[str] = None,
+        cache_dir: Optional[str] = None,
+        env: Optional[Dict[str, Optional[str]]] = None,
+        cwd: Optional[str] = None,
+    ) -> None:
+        self._cmd = list(cmd)
+        self._num_groups = num_groups
+        self._max_restarts = max_restarts
+        self._log_dir = log_dir
+        self._cwd = cwd
+        self._groups: Dict[int, _Group] = {i: _Group() for i in range(num_groups)}
+        self._embedded = None
+
+        if lighthouse == "embed":
+            from torchft_tpu._native import LighthouseServer
+
+            self._embedded = LighthouseServer(
+                bind="127.0.0.1:0",
+                min_replicas=min_replicas,
+                join_timeout_ms=join_timeout_ms,
+            )
+            lighthouse_addr = self._embedded.address()
+        elif lighthouse is not None:
+            lighthouse_addr = lighthouse
+        else:
+            lighthouse_addr = os.environ.get("TPUFT_LIGHTHOUSE", "")
+
+        base = dict(os.environ)
+        for k, v in (env or {}).items():
+            if v is None:
+                base.pop(k, None)
+            else:
+                base[k] = v
+        base.update(
+            {
+                "NUM_REPLICA_GROUPS": str(num_groups),
+                "MASTER_ADDR": base.get("MASTER_ADDR", "localhost"),
+            }
+        )
+        if lighthouse_addr:
+            base["TPUFT_LIGHTHOUSE"] = lighthouse_addr
+        if cache_dir:
+            base["TPUFT_COMPILE_CACHE"] = cache_dir
+        self._base_env = base
+        self.lighthouse_address = lighthouse_addr
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Launcher":
+        for i in range(self._num_groups):
+            self.spawn(i)
+        return self
+
+    def __enter__(self) -> "Launcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def spawn(self, group: int) -> None:
+        """(Re)starts one replica group; clears any kill-hold on it."""
+        g = self._groups[group]
+        if g.proc is not None and g.proc.poll() is None:
+            raise RuntimeError(f"group {group} is already running")
+        g.held = False
+        g.exited_clean = False
+        env = dict(self._base_env)
+        env["REPLICA_GROUP_ID"] = str(group)
+        env.update(g.env)
+        stdout = stderr = None
+        if self._log_dir is not None:
+            if g.log is not None:
+                g.log.close()  # respawns must not leak the old handle
+            g.log = open(os.path.join(self._log_dir, f"g{group}.log"), "ab")
+            stdout, stderr = g.log, subprocess.STDOUT
+        g.proc = subprocess.Popen(
+            self._cmd, env=env, stdout=stdout, stderr=stderr, cwd=self._cwd
+        )
+
+    def kill(self, group: int, sig: int = signal.SIGKILL, hold: bool = True) -> None:
+        """Kills one group (default SIGKILL — the fault-injection path).  With
+        ``hold``, the supervisor won't restart it until ``spawn`` is called,
+        so callers control the dead window."""
+        g = self._groups[group]
+        if g.proc is not None and g.proc.poll() is None:
+            g.proc.send_signal(sig)
+            g.proc.wait()
+        g.held = hold
+
+    def supervise_once(self) -> List[int]:
+        """One supervision pass: restarts groups that died (non-held), unless
+        they exited cleanly or exhausted max_restarts.  Returns the groups
+        restarted this pass."""
+        restarted: List[int] = []
+        for i, g in self._groups.items():
+            if g.proc is None or g.held or g.exited_clean:
+                continue
+            code = g.proc.poll()
+            if code is None:
+                continue
+            if code == 0:
+                g.exited_clean = True
+                continue
+            if self._max_restarts is not None and g.restarts >= self._max_restarts:
+                continue
+            g.restarts += 1
+            self.spawn(i)
+            restarted.append(i)
+        return restarted
+
+    def running(self) -> bool:
+        """True while any group process is alive."""
+        return any(
+            g.proc is not None and g.proc.poll() is None for g in self._groups.values()
+        )
+
+    def all_exited_clean(self) -> bool:
+        return all(g.exited_clean for g in self._groups.values())
+
+    def exhausted(self) -> List[int]:
+        """Groups that died and have no restart budget left."""
+        out = []
+        for i, g in self._groups.items():
+            if g.exited_clean or g.held or g.proc is None:
+                continue
+            code = g.proc.poll()
+            if (
+                code is not None
+                and code != 0
+                and self._max_restarts is not None
+                and g.restarts >= self._max_restarts
+            ):
+                out.append(i)
+        return out
+
+    def restarts(self, group: int) -> int:
+        return self._groups[group].restarts
+
+    def stop(self) -> None:
+        """SIGTERM every group, escalate to SIGKILL, close logs and the
+        embedded Lighthouse."""
+        for g in self._groups.values():
+            if g.proc is not None and g.proc.poll() is None:
+                g.proc.send_signal(signal.SIGTERM)
+        for g in self._groups.values():
+            if g.proc is not None:
+                try:
+                    g.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    g.proc.kill()
+                    g.proc.wait(timeout=5)
+        for g in self._groups.values():
+            if g.log is not None:
+                g.log.close()
+                g.log = None
+        if self._embedded is not None:
+            self._embedded.shutdown()
+            self._embedded = None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchft_tpu.launch",
+        description="Launch N fault-tolerant replica groups with a restart "
+        "supervisor (the torchx.hsdp component analogue).",
+    )
+    parser.add_argument("--groups", type=int, default=2, help="replica groups")
+    parser.add_argument(
+        "--max-restarts", type=int, default=None, help="per-group restart budget"
+    )
+    parser.add_argument(
+        "--lighthouse",
+        default="embed",
+        help='"embed" (default: in-process native Lighthouse), or host:port '
+        "of an external one",
+    )
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--join-timeout-ms", type=int, default=2000)
+    parser.add_argument("--log-dir", default=None)
+    parser.add_argument(
+        "--cache-dir", default=None, help="shared persistent XLA compile cache"
+    )
+    parser.add_argument(
+        "cmd", nargs=argparse.REMAINDER, help="-- <command for one replica group>"
+    )
+    args = parser.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("missing replica-group command (after --)")
+
+    launcher = Launcher(
+        cmd,
+        args.groups,
+        lighthouse=args.lighthouse,
+        max_restarts=args.max_restarts,
+        min_replicas=args.min_replicas,
+        join_timeout_ms=args.join_timeout_ms,
+        log_dir=args.log_dir,
+        cache_dir=args.cache_dir,
+    )
+    with launcher:
+        print(
+            f"[tpuft_launch] {args.groups} groups, lighthouse="
+            f"{launcher.lighthouse_address or '(inherited)'}",
+            flush=True,
+        )
+        try:
+            while launcher.running() or not (
+                launcher.all_exited_clean() or launcher.exhausted()
+            ):
+                time.sleep(0.25)
+                launcher.supervise_once()
+                if launcher.all_exited_clean():
+                    return 0
+                if launcher.exhausted():
+                    print(
+                        f"[tpuft_launch] groups {launcher.exhausted()} exhausted "
+                        "their restart budget",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    return 1
+        except KeyboardInterrupt:
+            return 130
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,12 +36,18 @@ class TrainStep:
         tx: optax GradientTransformation.
         loss_fn: (params, batch) -> scalar loss (model closure).
         bucket_bytes: DCN bucket size for the cross-group averaging path.
+        overlap_commit: hide the commit-vote RPC behind a speculatively
+            dispatched update (see ft_step).  MEMORY TRADE: the speculative
+            apply cannot donate its inputs, so params+opt_state residency
+            transiently doubles during the update — set False for models
+            sized against the donated (in-place) apply path.
     """
 
     ftmesh: FTMesh
     tx: Any
     loss_fn: Callable[[Any, Any], jax.Array]
     bucket_bytes: int = 25 << 20
+    overlap_commit: bool = True
 
     def __post_init__(self) -> None:
         mesh = self.ftmesh.mesh
@@ -63,7 +69,13 @@ class TrainStep:
         del mesh  # shardings are explicit NamedShardings; no ambient mesh needed
         self._grads_fn = jax.jit(value_and_grad)
         self._apply_fn = jax.jit(apply, donate_argnums=(0, 1))
+        # Speculative variant for the overlapped commit path: the old
+        # params/opt_state must survive a failed vote, so nothing is donated
+        # (transiently doubles params+opt residency — disable overlap_commit
+        # if that doesn't fit).
+        self._apply_spec_fn = jax.jit(apply)
         self._full_fn = jax.jit(full, donate_argnums=(0, 1))
+        self._averager = None  # lazy: the manager may be attached post-init
 
     # -- pure compute --------------------------------------------------------
 
@@ -88,13 +100,30 @@ class TrainStep:
 
         Requires ftmesh.manager.  The caller must have called
         manager.start_quorum() (the Optimizer wrapper's step_begin does).
+
+        The commit vote (a host RPC barrier across the group's local ranks,
+        reference torchft/manager.py:587-663) is hidden behind device work:
+        the update is dispatched *speculatively* before the vote — XLA async
+        dispatch returns immediately and the device crunches the apply while
+        the host blocks in ``should_commit`` — and the new state is adopted
+        only when the vote passes.  The reference hides its quorum under
+        backward the same way (torchft/manager.py:420); votes are rare-fail,
+        so speculation wastes work only on genuinely broken steps.
         """
         manager = self.ftmesh.manager
         assert manager is not None, "ft_step requires an FTMesh with a Manager"
         from torchft_tpu.ddp import GradientAverager
 
+        if self._averager is None or self._averager.manager is not manager:
+            self._averager = GradientAverager(manager, self.bucket_bytes)
+
         loss, grads = self._grads_fn(params, batch)
-        grads = GradientAverager(manager, self.bucket_bytes).allreduce(grads)
+        grads = self._averager.allreduce(grads)
+        if self.overlap_commit:
+            new_params, new_opt = self._apply_spec_fn(params, opt_state, grads)
+            if manager.should_commit():
+                return new_params, new_opt, loss, True
+            return params, opt_state, loss, False
         if manager.should_commit():
             params, opt_state = self._apply_fn(params, opt_state, grads)
             return params, opt_state, loss, True
