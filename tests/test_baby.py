@@ -146,9 +146,12 @@ def test_baby_child_crash_latches_and_recovers(store) -> None:
 def test_baby_abort_kills_child(store) -> None:
     """abort() is the NCCL-abort analogue: the child dies, errors latch, and
     the object is reusable after configure()."""
-    baby = BabyTCPCollective(timeout=5.0)
+    # Generous op timeout: child spawn + re-import under pytest can exceed
+    # 5s on a busy single-core host, and nothing below depends on it —
+    # post-abort ops fail via the latched error, not a deadline.
+    baby = BabyTCPCollective(timeout=30.0)
     prefix = fresh_prefix()
-    other = BabyTCPCollective(timeout=5.0)
+    other = BabyTCPCollective(timeout=30.0)
 
     def conf(c, rank):
         c.configure(f"{store.address()}/{prefix}", rank, 2)

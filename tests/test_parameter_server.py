@@ -1,0 +1,66 @@
+"""ParameterServer prototype tests (reference: torchft/parameter_server.py).
+
+A server hands out sessions over HTTP; each session is a fresh 2-rank
+collective (server rank 0, client rank 1).  A failed session must not take
+the server down.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from torchft_tpu.parameter_server import TCPParameterServer
+
+
+@pytest.fixture()
+def ps():
+    def forward(session_id: str, collective) -> None:
+        # Echo-style parameter pull: client sends a delta, server returns
+        # the (pretend) updated weights = delta + 1.
+        delta = collective.recv((8,), np.float32, src=1, tag=1).wait(timeout=30)
+        collective.send(delta + 1.0, dst=1, tag=2).wait(timeout=30)
+
+    server = TCPParameterServer(forward, store_bind="127.0.0.1:0")
+    yield server
+    server.shutdown()
+
+
+def _local_address(ps) -> str:
+    # gethostname may not resolve in the sandbox; pin to loopback.
+    return ps.address().replace(socket.gethostname(), "127.0.0.1")
+
+
+def test_session_roundtrip(ps) -> None:
+    client = TCPParameterServer.new_session(_local_address(ps))
+    try:
+        assert client.rank() == 1 and client.size() == 2
+        client.send(np.arange(8, dtype=np.float32), dst=0, tag=1).wait(timeout=30)
+        out = client.recv((8,), np.float32, src=0, tag=2).wait(timeout=30)
+        np.testing.assert_allclose(out, np.arange(8, dtype=np.float32) + 1.0)
+    finally:
+        client.shutdown()
+
+
+def test_sessions_are_isolated(ps) -> None:
+    """Each session gets its own store prefix + collective: two sequential
+    sessions both work, and an abandoned session doesn't poison the next."""
+    first = TCPParameterServer.new_session(_local_address(ps))
+    first.shutdown()  # walk away mid-session: server thread errors, survives
+
+    second = TCPParameterServer.new_session(_local_address(ps))
+    try:
+        second.send(np.zeros(8, dtype=np.float32), dst=0, tag=1).wait(timeout=30)
+        out = second.recv((8,), np.float32, src=0, tag=2).wait(timeout=30)
+        np.testing.assert_allclose(out, np.ones(8, dtype=np.float32))
+    finally:
+        second.shutdown()
+
+
+def test_bad_path_is_rejected(ps) -> None:
+    import urllib.error
+    import urllib.request
+
+    url = _local_address(ps).replace("/new_session", "/nope")
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(url, timeout=10)

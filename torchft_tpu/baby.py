@@ -59,6 +59,26 @@ class MonitoredPipe:
         return self._pipe.closed
 
 
+def _mp_context():
+    """Child processes come from a forkserver where available: each child is
+    a fork of a small preloaded server process rather than a cold interpreter
+    (children do still replay the parent's ``__main__`` as ``__mp_main__``,
+    so a heavyweight entrypoint should keep its imports under ``if __name__``
+    or extend the preload list).  Measured under the test suite this cuts a
+    2-rank configure round from tens of seconds to well under one.  Unlike
+    plain fork it is safe with the parent's native/reader threads — the
+    server is exec'd fresh.  The reference must use spawn for CUDA re-init
+    (torchft/process_group.py:1117); nothing in the TPU child touches a
+    device, so the cheap method is correct.
+    """
+    try:
+        ctx = multiprocessing.get_context("forkserver")
+        ctx.set_forkserver_preload(["torchft_tpu.baby"])
+        return ctx
+    except (ValueError, AttributeError):  # platform without forkserver
+        return multiprocessing.get_context("spawn")
+
+
 def _tcp_collective_factory(kwargs: dict) -> Collective:
     return TCPCollective(**kwargs)
 
@@ -175,7 +195,7 @@ class BabyCollective(Collective):
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         self._teardown_child()
-        ctx = multiprocessing.get_context("spawn")
+        ctx = _mp_context()
         cmd_parent, cmd_child = ctx.Pipe()
         res_parent, res_child = ctx.Pipe()
         proc = ctx.Process(

@@ -185,7 +185,11 @@ def forward(
     x, _ = jax.lax.scan(body, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"])
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    # bf16 operands on the MXU, f32 accumulation/output: full systolic-array
+    # rate with f32 logits (an f32xf32 matmul runs at a fraction of MXU peak).
+    logits = jnp.matmul(
+        x, params["lm_head"].astype(cfg.dtype), preferred_element_type=jnp.float32
+    )
     return constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
 
 
@@ -196,8 +200,13 @@ def loss_fn(
     mesh=None,
     rules: Optional[ShardingRules] = None,
 ) -> jax.Array:
-    """Next-token cross entropy; batch: {"tokens": [B,S], "targets": [B,S]}."""
+    """Next-token cross entropy; batch: {"tokens": [B,S], "targets": [B,S]}.
+
+    Computed as logsumexp - target_logit rather than materializing the full
+    [B, S, vocab] log-softmax: the logits array is the single biggest
+    activation (B*S*V f32), and one extra copy of it is pure HBM traffic.
+    """
     logits = forward(params, batch["tokens"], cfg, mesh, rules)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    tgt = jnp.take_along_axis(logits, batch["targets"][..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    return jnp.mean(lse - tgt)

@@ -1,0 +1,161 @@
+"""Fault-tolerant parameter-server prototype over reconfigurable collectives.
+
+Reference parity: torchft/parameter_server.py:31-195.  A threaded HTTP
+endpoint hands out sessions: ``GET /new_session`` returns
+``{session_id, store_addr}``, then the serving thread is hijacked to
+rendezvous a fresh 2-rank collective on that store prefix (server rank 0,
+client rank 1) and run the user's ``forward`` loop over it.  A wedged or
+crashed session costs one collective, not the server: the client just opens
+a new session.  No Lighthouse involved — sessions ARE the membership.
+
+TPU adaptation: the rendezvous store is the native C++ StoreServer (one per
+ParameterServer, shared by all sessions via per-session prefixes) and the
+data plane is a host-level ``Collective`` (DCN path), since device arrays
+are host buffers by the time they cross replica boundaries.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import urllib.request
+import uuid
+from abc import ABC, abstractmethod
+from http.server import BaseHTTPRequestHandler
+
+from torchft_tpu._native import StoreServer
+from torchft_tpu.collectives import Collective, TCPCollective
+from torchft_tpu.http import ThreadingHTTPServerV6
+
+__all__ = ["ParameterServer", "TCPParameterServer"]
+
+logger = logging.getLogger("torchft_tpu.parameter_server")
+
+
+class ParameterServer(ABC):
+    """Threaded parameter server; subclasses provide the collective factory
+    and the per-session ``forward`` body (reference:
+    torchft/parameter_server.py:31-195).
+
+    Args:
+        port: HTTP bind port (0 = ephemeral).
+        store_bind: bind address for the shared rendezvous StoreServer.
+    """
+
+    def __init__(self, port: int = 0, store_bind: str = "0.0.0.0:0") -> None:
+        self._store = StoreServer(bind=store_bind)
+        ps = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: object) -> None:
+                logger.debug(fmt % args)
+
+            def do_GET(self) -> None:
+                if self.path != "/new_session":
+                    self.send_error(400, f"invalid path {self.path}")
+                    return
+                session_id = str(uuid.uuid4())
+                store_addr = f"{ps.store_address()}/session/{session_id}"
+                payload = json.dumps(
+                    {"session_id": session_id, "store_addr": store_addr}
+                ).encode() + b"\n"
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                # Flush the complete JSON (Content-Length lets the client
+                # finish the request) before hijacking this thread for the
+                # session; the socket itself stays open harmlessly.
+                self.wfile.flush()
+                self.close_connection = True
+                logger.info("new session %s", session_id)
+                try:
+                    ps._run_session(session_id, store_addr)
+                except Exception:  # noqa: BLE001
+                    # Session death frees one collective; the server lives on.
+                    logger.exception("session %s failed", session_id)
+
+        self._server = ThreadingHTTPServerV6(("", port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpuft_parameter_server",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("parameter server on %s", self.address())
+
+    # -- addresses -----------------------------------------------------------
+
+    def address(self) -> str:
+        """HTTP address clients hit to open a session."""
+        return f"http://{socket.gethostname()}:{self._port}/new_session"
+
+    def store_address(self) -> str:
+        return self._store.address()
+
+    # -- session plumbing ----------------------------------------------------
+
+    def _run_session(self, session_id: str, store_addr: str) -> None:
+        collective = self.new_collective()
+        try:
+            collective.configure(store_addr, rank=0, world_size=2)
+            self.forward(session_id, collective)
+        finally:
+            collective.shutdown()
+
+    @classmethod
+    def new_session(cls, address: str, timeout: float = 60.0) -> Collective:
+        """Client side: opens a session and returns a configured collective
+        (client is rank 1, server rank 0 — reference:
+        torchft/parameter_server.py:148-168)."""
+        with urllib.request.urlopen(address, timeout=timeout) as resp:
+            data = json.load(resp)
+        logger.info(
+            "connecting to session %s at %s", data["session_id"], data["store_addr"]
+        )
+        collective = cls.new_collective()
+        collective.configure(data["store_addr"], rank=1, world_size=2)
+        return collective
+
+    # -- subclass surface ----------------------------------------------------
+
+    @classmethod
+    @abstractmethod
+    def new_collective(cls) -> Collective:
+        """A fresh, unconfigured collective for one session."""
+
+    @abstractmethod
+    def forward(self, session_id: str, collective: Collective) -> None:
+        """Runs once per session on a dedicated thread; loop inside for
+        multi-request sessions.  Errors tear down this session only."""
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+        self._store.shutdown()
+
+
+class TCPParameterServer(ParameterServer):
+    """ParameterServer over TCPCollective with a user-supplied forward
+    callable — the concrete flavor the prototype tests exercise."""
+
+    def __init__(
+        self,
+        forward_fn,
+        port: int = 0,
+        store_bind: str = "0.0.0.0:0",
+    ) -> None:
+        self._forward_fn = forward_fn
+        super().__init__(port=port, store_bind=store_bind)
+
+    @classmethod
+    def new_collective(cls) -> Collective:
+        return TCPCollective(timeout=60.0)
+
+    def forward(self, session_id: str, collective: Collective) -> None:
+        self._forward_fn(session_id, collective)
