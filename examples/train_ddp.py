@@ -71,7 +71,6 @@ def main() -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
-    import jax.numpy as jnp
     import numpy as np
     import optax
 
@@ -88,31 +87,10 @@ def main() -> None:
     num_groups = int(os.environ.get("NUM_REPLICA_GROUPS", 2))
 
     # -- model: tiny convnet on 32x32x3 inputs (CIFAR shaped) ----------------
-    def init_params(key):
-        k1, k2, k3 = jax.random.split(key, 3)
-        return {
-            "conv": jax.random.normal(k1, (3, 3, 3, 16), jnp.float32) * 0.1,
-            "w1": jax.random.normal(k2, (16 * 16 * 16, 64), jnp.float32) * 0.02,
-            "b1": jnp.zeros((64,), jnp.float32),
-            "w2": jax.random.normal(k3, (64, 10), jnp.float32) * 0.02,
-            "b2": jnp.zeros((10,), jnp.float32),
-        }
+    from torchft_tpu.models import convnet_loss, init_convnet_params
 
-    def forward(params, x):
-        h = jax.lax.conv_general_dilated(
-            x, params["conv"], window_strides=(2, 2), padding="SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
-        h = jax.nn.relu(h)
-        h = h.reshape(h.shape[0], -1)
-        h = jax.nn.relu(h @ params["w1"] + params["b1"])
-        return h @ params["w2"] + params["b2"]
-
-    def loss_fn(params, x, y):
-        logits = forward(params, x)
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    init_params = init_convnet_params
+    grad_fn = jax.jit(jax.value_and_grad(convnet_loss))
 
     # Synthetic dataset, identical in every process (seeded).
     rng = np.random.default_rng(0)

@@ -8,11 +8,28 @@ Llama-3-class shape), a mixture-of-experts variant (expert parallelism), and
 a small conv net (the train_ddp example class).
 """
 
+from torchft_tpu.models.convnet import (
+    convnet_forward,
+    convnet_loss,
+    init_convnet_params,
+)
+from torchft_tpu.models.moe import moe_ffn
 from torchft_tpu.models.transformer import (
     TransformerConfig,
+    forward,
+    forward_with_aux,
     init_params,
     loss_fn,
-    forward,
 )
 
-__all__ = ["TransformerConfig", "init_params", "loss_fn", "forward"]
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "loss_fn",
+    "forward",
+    "forward_with_aux",
+    "moe_ffn",
+    "convnet_forward",
+    "convnet_loss",
+    "init_convnet_params",
+]

@@ -1,0 +1,43 @@
+"""Small conv net on 32x32x3 inputs — the train_ddp example's model class.
+
+Reference parity: the reference example trains a CIFAR-10 CNN
+(train_ddp.py:116-130 at the reference root); this is the first-party
+equivalent so the example and tests share one definition.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def init_convnet_params(key: jax.Array, n_classes: int = 10) -> Dict[str, Any]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "conv": jax.random.normal(k1, (3, 3, 3, 16), jnp.float32) * 0.1,
+        "w1": jax.random.normal(k2, (16 * 16 * 16, 64), jnp.float32) * 0.02,
+        "b1": jnp.zeros((64,), jnp.float32),
+        "w2": jax.random.normal(k3, (64, n_classes), jnp.float32) * 0.02,
+        "b2": jnp.zeros((n_classes,), jnp.float32),
+    }
+
+
+def convnet_forward(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    """x: [B, 32, 32, 3] -> logits [B, n_classes]."""
+    h = jax.lax.conv_general_dilated(
+        x, params["conv"], window_strides=(2, 2), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    h = jax.nn.relu(h)
+    h = h.reshape(h.shape[0], -1)
+    h = jax.nn.relu(h @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def convnet_loss(params: Dict[str, Any], x: jax.Array, y: jax.Array) -> jax.Array:
+    import optax
+
+    logits = convnet_forward(params, x)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()

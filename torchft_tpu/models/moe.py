@@ -1,0 +1,120 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Capability beyond the reference: torchft has no EP anywhere (SURVEY.md §2.3
+— PP/CP/EP absent); this is part of the TPU build's first-class parallelism
+surface alongside ring/Ulysses sequence parallelism.
+
+TPU-first design (GShard/Switch style, arXiv:2006.16668):
+  - routing builds dense dispatch/combine tensors ([T, n_exp, capacity])
+    with STATIC shapes — no sorting, no ragged buffers, nothing
+    data-dependent for XLA to choke on; over-capacity tokens are dropped
+    (their residual path carries them, standard MoE practice);
+  - expert compute is a batched einsum over experts *stacked on a leading
+    axis* (one compiled FFN body for all experts — same trick as the
+    scan-over-layers transformer);
+  - expert parallelism is pure annotation: the stacked expert axis maps to
+    the "expert" mesh axis (parallel/sharding.py); the dispatch/combine
+    einsums then compile to the all-to-all exchanges, inserted by XLA/GSPMD
+    rather than hand-placed.
+
+The load-balance auxiliary loss (mean fraction * mean router prob per
+expert, scaled by n_exp^2) follows Switch Transformer (arXiv:2101.03961).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.parallel.sharding import ShardingRules, constrain
+
+
+def moe_capacity(tokens: int, n_experts: int, top_k: int, capacity_factor: float) -> int:
+    """Static per-expert token capacity, padded to the 8-sublane boundary."""
+    cap = int(tokens * top_k * capacity_factor / n_experts) + 1
+    return max(8, -(-cap // 8) * 8)
+
+
+def moe_ffn(
+    x: jax.Array,
+    router: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    dtype: Any = jnp.bfloat16,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """MoE feed-forward.
+
+    Args:
+        x: [B, S, E] activations.
+        router: [E, n_exp] routing weights (kept f32 — routing logits are
+            numerically sensitive).
+        w_gate/w_up: [n_exp, E, F]; w_down: [n_exp, F, E] stacked experts.
+
+    Returns:
+        (y, aux_loss): y [B, S, E]; aux_loss scalar f32 load-balance term.
+    """
+    rules = rules or ShardingRules()
+    B, S, E = x.shape
+    n_exp = router.shape[1]
+    T = B * S
+    C = moe_capacity(T, n_exp, top_k, capacity_factor)
+
+    xf = x.reshape(T, E)
+    logits = xf.astype(jnp.float32) @ router.astype(jnp.float32)  # [T, n_exp]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [T, k]
+    # Renormalize the kept gates so the combine is a convex mixture.
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # Position of each (token, choice) in its expert's capacity buffer:
+    # choices are prioritized k-major (all rank-0 choices first), so a
+    # token's primary expert wins buffer slots over anyone's secondary.
+    onehot = jax.nn.one_hot(gate_idx, n_exp, dtype=jnp.float32)  # [T, k, n_exp]
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * T, n_exp)    # k-major
+    pos_flat = jnp.cumsum(flat, axis=0) - 1.0                     # [kT, n_exp]
+    pos = pos_flat.reshape(top_k, T, n_exp).transpose(1, 0, 2)    # [T, k, n_exp]
+    within = (pos < C) & (onehot > 0)
+
+    # dispatch[t, e, c] = 1 where token t landed in slot c of expert e;
+    # combine carries the gate weight instead.
+    slot = jax.nn.one_hot(
+        jnp.where(within, pos, -1).astype(jnp.int32).max(axis=-1).clip(0),
+        C,
+        dtype=jnp.float32,
+    )  # [T, k, C] (clip is safe: masked rows are zeroed below)
+    kept = within.any(axis=-1).astype(jnp.float32)                 # [T, k]
+    expert_oh = onehot * within.astype(jnp.float32)                # [T, k, n_exp]
+    dispatch = jnp.einsum("tke,tkc,tk->tec", expert_oh, slot, kept)
+    combine = jnp.einsum("tke,tkc,tk->tec", expert_oh, slot, kept * gate_vals)
+
+    # Dispatch -> stacked expert FFN -> combine.  The "expert" leading axis
+    # is sharded over the expert mesh axis; these einsums ARE the
+    # all-to-alls once partitioned.
+    xin = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xf.astype(dtype))
+    xin = constrain(xin, ("expert", None, "embed"), mesh, rules)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, w_gate.astype(dtype)))
+    h = h * jnp.einsum("ecd,edf->ecf", xin, w_up.astype(dtype))
+    h = constrain(h, ("expert", None, "mlp"), mesh, rules)
+    out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(dtype))
+    out = constrain(out, ("expert", None, "embed"), mesh, rules)
+    y = jnp.einsum("tec,ecd->td", combine.astype(dtype), out)
+
+    # Switch-style load balance: encourage uniform (tokens, probability)
+    # mass per expert.  f = fraction of primary-choice tokens per expert.
+    primary = onehot[:, 0, :]                                      # [T, n_exp]
+    f = jnp.mean(primary, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = n_exp * jnp.sum(f * p)
+
+    return y.reshape(B, S, E).astype(x.dtype), aux.astype(jnp.float32)

@@ -43,9 +43,22 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-native)
     param_dtype: Any = jnp.float32
     remat: bool = True
-    # Attention backend: "flash" (pallas kernel / XLA fallback) or "ring"
-    # (sequence-parallel ring over the mesh "sequence" axis).
+    # Attention backend: "flash" (pallas kernel / XLA fallback), "ring"
+    # (sequence-parallel K/V rotation), or "ulysses" (all-to-all head<->seq
+    # resharding) — the latter two engage over the mesh "sequence" axis.
     attention: str = "flash"
+    # Mixture-of-experts: > 0 replaces the dense MLP with moe_experts
+    # experts (stacked, shardable over the "expert" mesh axis).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
+
+    def __post_init__(self) -> None:
+        assert self.attention in ("flash", "ring", "ulysses"), (
+            f"unknown attention backend {self.attention!r}; "
+            "expected 'flash', 'ring', or 'ulysses'"
+        )
 
     @property
     def d_head(self) -> int:
@@ -66,6 +79,15 @@ def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
         "w_up": ("layers", "embed", "mlp"),
         "w_down": ("layers", "mlp", "embed"),
     }
+    if cfg.moe_experts > 0:
+        layer.update(
+            {
+                "router": ("layers", "embed", "expert"),
+                "w_gate": ("layers", "expert", "embed", "mlp"),
+                "w_up": ("layers", "expert", "embed", "mlp"),
+                "w_down": ("layers", "expert", "mlp", "embed"),
+            }
+        )
     return {
         "embed": ("vocab", "embed"),
         "layers": layer,
@@ -85,7 +107,7 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
     def norm_init(k, shape, fan_in):
         return (jax.random.normal(k, shape, pd) * (fan_in ** -0.5)).astype(pd)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
     layers = {
         "attn_norm": jnp.ones((L, E), pd),
         "wq": norm_init(ks[0], (L, E, H * Dh), E),
@@ -93,10 +115,26 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
         "wv": norm_init(ks[2], (L, E, KV * Dh), E),
         "wo": norm_init(ks[3], (L, H * Dh, E), H * Dh),
         "mlp_norm": jnp.ones((L, E), pd),
-        "w_gate": norm_init(ks[4], (L, E, F), E),
-        "w_up": norm_init(ks[5], (L, E, F), E),
-        "w_down": norm_init(ks[6], (L, F, E), F),
     }
+    if cfg.moe_experts > 0:
+        X = cfg.moe_experts
+        kr, kg, ku, kd = jax.random.split(ks[7], 4)
+        layers.update(
+            {
+                "router": norm_init(kr, (L, E, X), E),
+                "w_gate": norm_init(kg, (L, X, E, F), E),
+                "w_up": norm_init(ku, (L, X, E, F), E),
+                "w_down": norm_init(kd, (L, X, F, E), F),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": norm_init(ks[4], (L, E, F), E),
+                "w_up": norm_init(ks[5], (L, E, F), E),
+                "w_down": norm_init(ks[6], (L, F, E), F),
+            }
+        )
     return {
         "embed": norm_init(k_embed, (cfg.vocab_size, E), E),
         "layers": layers,
@@ -119,19 +157,47 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def _attention(cfg: TransformerConfig, mesh, q, k, v):
     """q/k/v: [B, H|KV, S, Dh] head-major."""
-    if cfg.attention == "ring" and mesh is not None and "sequence" in mesh.axis_names \
-            and mesh.shape["sequence"] > 1:
-        from torchft_tpu.ops.ring_attention import ring_attention_sharded
+    seq_parallel = (
+        cfg.attention in ("ring", "ulysses")
+        and mesh is not None
+        and "sequence" in mesh.axis_names
+        and mesh.shape["sequence"] > 1
+    )
+    if cfg.attention != "flash" and not seq_parallel:
+        # Trace-time (once per compile), not per step.
+        import warnings
 
-        if cfg.n_kv_heads != cfg.n_heads:
+        warnings.warn(
+            f"attention={cfg.attention!r} requested but the mesh has no "
+            ">1-sized 'sequence' axis; falling back to single-shard flash "
+            "attention",
+            stacklevel=2,
+        )
+    if seq_parallel:
+        if cfg.attention == "ring":
+            from torchft_tpu.ops.ring_attention import ring_attention_sharded as fn
+
+            # The ring body assumes equal q/kv head counts.
+            broadcast_gqa = cfg.n_kv_heads != cfg.n_heads
+        else:
+            from torchft_tpu.ops.ulysses import ulysses_attention_sharded as fn
+
+            # Ulysses keeps GQA compressed through the all_to_all (the local
+            # flash kernel broadcasts groups afterwards) unless the kv-head
+            # count doesn't tile the sequence axis.
+            broadcast_gqa = (
+                cfg.n_kv_heads != cfg.n_heads
+                and cfg.n_kv_heads % mesh.shape["sequence"] != 0
+            )
+        if broadcast_gqa:
             rep = cfg.n_heads // cfg.n_kv_heads
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
-        head_axis = "tensor" if "tensor" in mesh.axis_names else None
-        batch_axis = "data" if "data" in mesh.axis_names else None
-        return ring_attention_sharded(
+        return fn(
             mesh, q, k, v, causal=True,
-            batch_axis=batch_axis, head_axis=head_axis, seq_axis="sequence",
+            batch_axis="data" if "data" in mesh.axis_names else None,
+            head_axis="tensor" if "tensor" in mesh.axis_names else None,
+            seq_axis="sequence",
         )
     return flash_attention(q, k, v, causal=True)
 
@@ -156,10 +222,62 @@ def _layer(cfg: TransformerConfig, mesh, rules: ShardingRules, x, w, positions):
     x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
 
     h = rms_norm(x, w["mlp_norm"])
-    gate = jax.nn.silu(h @ w["w_gate"].astype(cfg.dtype))
-    up = h @ w["w_up"].astype(cfg.dtype)
-    x = x + ((gate * up) @ w["w_down"].astype(cfg.dtype))
-    return constrain(x, ("batch", "seq", "embed"), mesh, rules)
+    if cfg.moe_experts > 0:
+        from torchft_tpu.models.moe import moe_ffn
+
+        y, aux = moe_ffn(
+            h,
+            w["router"],
+            w["w_gate"],
+            w["w_up"],
+            w["w_down"],
+            top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            dtype=cfg.dtype,
+            mesh=mesh,
+            rules=rules,
+        )
+        x = x + y
+    else:
+        gate = jax.nn.silu(h @ w["w_gate"].astype(cfg.dtype))
+        up = h @ w["w_up"].astype(cfg.dtype)
+        x = x + ((gate * up) @ w["w_down"].astype(cfg.dtype))
+        aux = jnp.zeros((), jnp.float32)
+    return constrain(x, ("batch", "seq", "embed"), mesh, rules), aux
+
+
+def forward_with_aux(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """tokens: [B, S] int32 -> (logits [B, S, vocab] f32, aux scalar f32 —
+    the summed MoE load-balance loss; zero for dense models)."""
+    rules = rules or ShardingRules()
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+    def body(x, w):
+        x, aux = _layer(cfg, mesh, rules, x, w, positions)
+        return x, aux
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, aux_layers = jax.lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"])
+    # bf16 operands on the MXU, f32 accumulation/output: full systolic-array
+    # rate with f32 logits (an f32xf32 matmul runs at a fraction of MXU peak).
+    logits = jnp.matmul(
+        x, params["lm_head"].astype(cfg.dtype), preferred_element_type=jnp.float32
+    )
+    logits = constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+    return logits, jnp.sum(aux_layers)
 
 
 def forward(
@@ -170,27 +288,7 @@ def forward(
     rules: Optional[ShardingRules] = None,
 ) -> jax.Array:
     """tokens: [B, S] int32 -> logits [B, S, vocab] (f32)."""
-    rules = rules or ShardingRules()
-    B, S = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
-
-    def body(x, w):
-        return _layer(cfg, mesh, rules, x, w, positions), None
-
-    if cfg.remat:
-        body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, params["layers"])
-
-    x = rms_norm(x, params["final_norm"])
-    # bf16 operands on the MXU, f32 accumulation/output: full systolic-array
-    # rate with f32 logits (an f32xf32 matmul runs at a fraction of MXU peak).
-    logits = jnp.matmul(
-        x, params["lm_head"].astype(cfg.dtype), preferred_element_type=jnp.float32
-    )
-    return constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+    return forward_with_aux(params, tokens, cfg, mesh, rules)[0]
 
 
 def loss_fn(
@@ -205,8 +303,13 @@ def loss_fn(
     Computed as logsumexp - target_logit rather than materializing the full
     [B, S, vocab] log-softmax: the logits array is the single biggest
     activation (B*S*V f32), and one extra copy of it is pure HBM traffic.
+
+    MoE configs add moe_aux_coef * load-balance loss (Switch-style).
     """
-    logits = forward(params, batch["tokens"], cfg, mesh, rules)
+    logits, aux = forward_with_aux(params, batch["tokens"], cfg, mesh, rules)
     tgt = jnp.take_along_axis(logits, batch["targets"][..., None], axis=-1)[..., 0]
     lse = jax.nn.logsumexp(logits, axis=-1)
-    return jnp.mean(lse - tgt)
+    ce = jnp.mean(lse - tgt)
+    if cfg.moe_experts > 0:
+        ce = ce + cfg.moe_aux_coef * aux
+    return ce
