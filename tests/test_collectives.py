@@ -146,6 +146,28 @@ def check_send_recv_ring(c: Collective, rank: int):
     return True
 
 
+def check_bfloat16_send_recv_allreduce(c: Collective, rank: int):
+    """bf16 (an ml_dtypes extension dtype) is the framework's default compute
+    dtype; it must survive the raw-buffer p2p framing and the ring — .str
+    stringifies as '<V2' and memoryview cannot cast it, both historical
+    corruption/crash hazards on this path."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    n = c.size()
+    out = c.allreduce([np.ones(16, dtype=bf16)], op="sum").wait(timeout=20)[0]
+    assert out.dtype == bf16, out.dtype
+    np.testing.assert_allclose(np.asarray(out, np.float32), float(n))
+    if n > 1:
+        nxt, prv = (rank + 1) % n, (rank - 1) % n
+        send = c.send(np.full(8, rank + 1, dtype=bf16), nxt, tag=6)
+        got = c.recv((8,), bf16, prv, tag=6).wait(timeout=20)
+        send.wait(timeout=20)
+        assert got.dtype == bf16, got.dtype
+        np.testing.assert_allclose(np.asarray(got, np.float32), float(prv + 1))
+    return True
+
+
 _COLLECTIVE_TO_FUNC: Dict[str, Callable[[Collective, int], object]] = {
     "allreduce": check_allreduce,
     "allreduce_avg": check_allreduce_avg,
@@ -156,6 +178,7 @@ _COLLECTIVE_TO_FUNC: Dict[str, Callable[[Collective, int], object]] = {
     "alltoall": check_alltoall,
     "barrier": check_barrier,
     "send_recv": check_send_recv_ring,
+    "bfloat16": check_bfloat16_send_recv_allreduce,
 }
 
 

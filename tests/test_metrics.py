@@ -1,0 +1,59 @@
+"""Structured metrics (JSONL event stream) tests.
+
+Beyond-parity observability (the reference has logs + dashboard only,
+SURVEY.md §5): the Manager emits machine-readable lifecycle events when
+TPUFT_METRICS_PATH is set.
+"""
+
+import json
+
+import numpy as np
+from unittest.mock import MagicMock
+
+from torchft_tpu.metrics import METRICS_PATH_ENV, MetricsLogger
+
+from test_manager import FakeCollective, make_manager, make_quorum, store  # noqa: F401
+
+
+def test_metrics_logger_roundtrip(tmp_path) -> None:
+    path = tmp_path / "m.jsonl"
+    m = MetricsLogger(str(path), replica_id="r0")
+    assert m.enabled
+    m.emit("commit", step=3, committed=True)
+    m.emit("error", error=repr(RuntimeError("x")))
+    m.close()
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [e["event"] for e in events] == ["commit", "error"]
+    assert events[0]["replica_id"] == "r0" and events[0]["step"] == 3
+    assert events[0]["committed"] is True and "ts" in events[0]
+
+
+def test_metrics_disabled_is_noop(tmp_path) -> None:
+    m = MetricsLogger(None)
+    assert not m.enabled
+    m.emit("anything", x=1)  # must not raise
+    m.close()
+
+
+def test_manager_emits_lifecycle_events(store, tmp_path, monkeypatch) -> None:  # noqa: F811
+    path = tmp_path / "manager.jsonl"
+    monkeypatch.setenv(METRICS_PATH_ENV, str(path))
+
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(max_world_size=2)
+    client.should_commit.return_value = True
+    manager, collective, _ = make_manager(store, client_mock=client)
+    try:
+        manager.start_quorum()
+        manager.allreduce(np.ones(4, dtype=np.float32)).result()
+        assert manager.should_commit()
+    finally:
+        manager.shutdown()
+
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    kinds = [e["event"] for e in events]
+    assert "quorum" in kinds and "commit" in kinds
+    commit = next(e for e in events if e["event"] == "commit")
+    assert commit["committed"] is True and commit["participants"] == 2
+    quorum = next(e for e in events if e["event"] == "quorum")
+    assert quorum["quorum_id"] is not None

@@ -201,6 +201,30 @@ def test_ulysses_gqa_compressed_kv() -> None:
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+def test_ulysses_gqa_with_tp_broadcasts_when_needed() -> None:
+    """TP x SP GQA config where kv heads per TP shard don't tile the
+    sequence axis: the transformer must auto-broadcast K/V (per-shard
+    divisibility, not global) instead of tripping the Ulysses assert."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=1, n_heads=8, n_kv_heads=2,
+        d_ff=64, max_seq=32, dtype=jnp.float32, attention="ulysses",
+    )
+    ftmesh = ft_init_mesh({"tensor": 2, "sequence": 2})
+    params = ftmesh.shard_params(init_params(jax.random.PRNGKey(0), cfg), param_axes(cfg))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, size=(2, 32)), dtype=jnp.int32
+    )
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+    loss = loss_fn(
+        params,
+        jax.device_put(batch, ftmesh.sharding("batch", "seq")),
+        cfg,
+        ftmesh.mesh,
+        ftmesh.rules,
+    )
+    assert np.isfinite(float(loss))
+
+
 def test_ulysses_head_divisibility_guard() -> None:
     ftmesh = ft_init_mesh({"sequence": 4})
     q = jnp.zeros((1, 2, 64, 16), jnp.float32)  # 2 heads < 4-way axis

@@ -104,10 +104,10 @@ class CollectiveTransport(CheckpointTransport):
                 raw = self._collective.recv((tm.nbytes,), np.uint8, src_rank, tag=3 + i).wait(
                     timeout=timeout
                 )
+                # recv returns a contiguous uint8 ndarray; reinterpret in
+                # place (a bytes() roundtrip here would copy every buffer).
                 buffers.append(
-                    np.frombuffer(bytes(raw), dtype=np.uint8)
-                    .view(tm.dtype)
-                    .reshape(tm.shape)
+                    np.ascontiguousarray(raw).view(tm.dtype).reshape(tm.shape)
                 )
         restore = (
             sharding_restorer(self._state_dict_fn)

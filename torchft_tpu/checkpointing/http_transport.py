@@ -93,6 +93,24 @@ class HTTPTransport(CheckpointTransport):
                             )
                             return
                         meta, buffers = transport._state
+                        if what == "full":
+                            # Stream header + raw buffers straight to the
+                            # socket: materializing a multi-GB BytesIO first
+                            # is an extra full copy on the default healing
+                            # path.
+                            total = 8 + len(pickle.dumps(meta)) + sum(
+                                b.nbytes for b in buffers
+                            )
+                            self.send_response(200)
+                            self.send_header(
+                                "Content-Type", "application/octet-stream"
+                            )
+                            self.send_header("Content-Length", str(total))
+                            self.end_headers()
+                            # One source of truth for the wire format: the
+                            # same writer read_state_dict decodes.
+                            write_state_dict(meta, buffers, self.wfile)
+                            return
                         payload = transport._render(meta, buffers, what)
                         if payload is None:
                             self.send_error(404, f"unknown object {what}")
@@ -116,9 +134,7 @@ class HTTPTransport(CheckpointTransport):
 
     def _render(self, meta: StateDictMeta, buffers: List[np.ndarray], what: str) -> Optional[bytes]:
         out = io.BytesIO()
-        if what == "full":
-            write_state_dict(meta, buffers, out)
-        elif what == "header":
+        if what == "header":
             # Just the length-prefixed pickled StateDictMeta — what a chunked
             # receiver needs to size its buffers, without making the server
             # materialize the full multi-GB stream.
@@ -181,8 +197,11 @@ class HTTPTransport(CheckpointTransport):
         base = f"{metadata}/checkpoint/{step}"
         n_chunks = pickle.loads(_fetch(f"{base}/metadata", timeout))
         if n_chunks <= 1:
-            stream = io.BytesIO(_fetch(f"{base}/full", timeout))
-            meta, buffers = read_state_dict(stream)
+            # Deserialize straight off the socket: buffering the whole
+            # multi-GB response into bytes first doubles peak memory and
+            # adds a full copy.
+            with urllib.request.urlopen(f"{base}/full", timeout=timeout) as resp:
+                meta, buffers = read_state_dict(resp)
         else:
             with ThreadPoolExecutor(max_workers=n_chunks) as pool:
                 parts = list(

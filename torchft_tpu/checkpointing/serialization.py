@@ -212,11 +212,24 @@ def read_state_dict(stream: io.RawIOBase) -> Tuple[StateDictMeta, List[np.ndarra
     return meta, buffers
 
 
-def _read_exact(stream: io.RawIOBase, n: int) -> bytes:
-    out = bytearray()
-    while len(out) < n:
-        chunk = stream.read(n - len(out))
-        if not chunk:
-            raise EOFError(f"stream ended after {len(out)}/{n} bytes")
-        out.extend(chunk)
-    return bytes(out)
+def _read_exact(stream: io.RawIOBase, n: int) -> bytearray:
+    """Reads exactly n bytes into a preallocated buffer (readinto when the
+    stream supports it — no grow-and-recopy, and the result is returned
+    without a final bytes() copy; np.frombuffer/pickle accept bytearray)."""
+    out = bytearray(n)
+    view = memoryview(out)
+    got = 0
+    readinto = getattr(stream, "readinto", None)
+    while got < n:
+        if readinto is not None:
+            r = readinto(view[got:])
+            if not r:
+                raise EOFError(f"stream ended after {got}/{n} bytes")
+            got += r
+        else:
+            chunk = stream.read(n - got)
+            if not chunk:
+                raise EOFError(f"stream ended after {got}/{n} bytes")
+            view[got : got + len(chunk)] = chunk
+            got += len(chunk)
+    return out

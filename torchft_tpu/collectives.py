@@ -205,14 +205,20 @@ class _Peer:
         self.sock = sock
         self.send_lock = threading.Lock()
         self.recv_lock = threading.Lock()
-        self._stash: dict[int, "collections.deque[bytes]"] = {}
+        self._stash: dict[int, "collections.deque[bytearray]"] = {}
 
-    def send_msg(self, tag: int, payload: memoryview) -> None:
+    def send_msg(self, tag: int, payload) -> None:
+        """payload: one buffer, or a list of buffers sent as a single frame
+        (scatter-gather — lets callers frame header+raw-array without
+        concatenating into yet another copy)."""
+        parts = payload if isinstance(payload, (list, tuple)) else [payload]
+        total = sum(len(p) for p in parts)
         with self.send_lock:
-            self.sock.sendall(_HDR.pack(tag, len(payload)))
-            self.sock.sendall(payload)
+            self.sock.sendall(_HDR.pack(tag, total))
+            for p in parts:
+                self.sock.sendall(p)
 
-    def recv_msg(self, expect_tag: int) -> bytes:
+    def recv_msg(self, expect_tag: int) -> bytearray:
         with self.recv_lock:
             q = self._stash.get(expect_tag)
             if q:
@@ -228,7 +234,10 @@ class _Peer:
                     return payload
                 self._stash.setdefault(tag, collections.deque()).append(payload)
 
-    def _recv_exact(self, n: int) -> bytes:
+    def _recv_exact(self, n: int) -> bytearray:
+        # Returned as the bytearray itself (writable, no bytes() copy):
+        # np.frombuffer over it yields mutable arrays and every ring exchange
+        # saves a full payload memcpy.
         buf = bytearray(n)
         view = memoryview(buf)
         got = 0
@@ -237,7 +246,7 @@ class _Peer:
             if r == 0:
                 raise ConnectionError("peer connection closed")
             got += r
-        return bytes(buf)
+        return buf
 
     def close(self) -> None:
         try:
@@ -616,6 +625,8 @@ class TCPCollective(Collective):
         return received
 
     def _ring_allreduce(self, arrays: List[np.ndarray], op: str) -> List[np.ndarray]:
+        from torchft_tpu.checkpointing.serialization import as_u8
+
         n = self._world_size
         rank = self._rank
         # Flatten all arrays into one contiguous f64-safe working buffer of
@@ -626,11 +637,12 @@ class TCPCollective(Collective):
         offsets = np.cumsum([0] + [c.size for c in chunks])
 
         # Reduce-scatter phase: after n-1 steps, chunk (rank+1)%n holds the
-        # full reduction on this rank.
+        # full reduction on this rank.  as_u8 (not memoryview.cast) so
+        # ml_dtypes payloads like bfloat16 frame correctly.
         for step in range(n - 1):
             send_idx = (rank - step) % n
             recv_idx = (rank - step - 1) % n
-            payload = memoryview(np.ascontiguousarray(chunks[send_idx])).cast("B")
+            payload = memoryview(as_u8(chunks[send_idx]))
             incoming = np.frombuffer(self._exchange(1, payload), dtype=flat.dtype)
             chunks[recv_idx] = chunks[recv_idx] + incoming
 
@@ -638,7 +650,7 @@ class TCPCollective(Collective):
         for step in range(n - 1):
             send_idx = (rank - step + 1) % n
             recv_idx = (rank - step) % n
-            payload = memoryview(np.ascontiguousarray(chunks[send_idx])).cast("B")
+            payload = memoryview(as_u8(chunks[send_idx]))
             chunks[recv_idx] = np.frombuffer(self._exchange(2, payload), dtype=flat.dtype).copy()
 
         out_flat = np.concatenate(chunks)
@@ -792,6 +804,13 @@ class TCPCollective(Collective):
 
             return self._submit(run, ring=False)
 
+    # p2p frame: u32 meta_len | pickled (np.dtype, shape) | raw array bytes.
+    # The array body crosses the wire without pickling — on the GB-scale
+    # healing path a pickle.dumps is a full extra memcpy of the state dict.
+    # The dtype OBJECT is pickled (not .str): custom dtypes like bfloat16
+    # stringify as '<V2' and would round-trip as void16.
+    _P2P_META = struct.Struct("<I")
+
     def send(self, array: np.ndarray, dst: int, tag: int = 0) -> Work:
         array = np.ascontiguousarray(array)
         q = self._fifo_queue(("send", dst, tag))
@@ -799,9 +818,16 @@ class TCPCollective(Collective):
         def body(used: List[_Peer]) -> None:
             import pickle
 
+            from torchft_tpu.checkpointing.serialization import as_u8
+
             peer = self._dial(dst)
             used.append(peer)
-            peer.send_msg(100 + tag, memoryview(pickle.dumps(array)))
+            meta = pickle.dumps((array.dtype, array.shape))
+            # as_u8 handles ml_dtypes (bfloat16) that memoryview cannot cast.
+            peer.send_msg(
+                100 + tag,
+                [self._P2P_META.pack(len(meta)), meta, memoryview(as_u8(array))],
+            )
 
         return self._p2p_op(q, dst, body)
 
@@ -813,7 +839,19 @@ class TCPCollective(Collective):
 
             peer = self._dial(src)
             used.append(peer)
-            return pickle.loads(peer.recv_msg(100 + tag))
+            raw = peer.recv_msg(100 + tag)
+            (mlen,) = self._P2P_META.unpack_from(raw, 0)
+            rdtype, rshape = pickle.loads(
+                bytes(raw[self._P2P_META.size : self._P2P_META.size + mlen])
+            )
+            body_off = self._P2P_META.size + mlen
+            # raw is a writable bytearray: the returned array is mutable and
+            # copy-free, matching the old pickle path's contract.
+            return (
+                np.frombuffer(raw, dtype=np.uint8, offset=body_off)
+                .view(rdtype)
+                .reshape(rshape)
+            )
 
         return self._p2p_op(q, src, body)
 

@@ -223,6 +223,10 @@ class Manager:
         self._participating_replica_world_size: int = 0
 
         self._logger = _ManagerLogger(self, self._replica_id, self._rank)
+        # JSONL event stream when TPUFT_METRICS_PATH is set (no-op otherwise).
+        from torchft_tpu.metrics import MetricsLogger
+
+        self._metrics = MetricsLogger.from_env(self._replica_id)
 
     # -- registration -------------------------------------------------------
 
@@ -340,6 +344,16 @@ class Manager:
             ):
                 self._participating_replica_rank = None
 
+        self._metrics.emit(
+            "quorum",
+            step=self._step,
+            quorum_id=quorum_id,
+            replica_rank=replica_rank,
+            replica_world_size=replica_world_size,
+            participating=self._participating_replica_world_size,
+            heal=heal,
+        )
+
         if quorum_id != self._quorum_id:
             # Unique store prefix per (quorum, local rank): local rank r of
             # every group forms one ring (torchft/manager.py:502-509).
@@ -376,6 +390,7 @@ class Manager:
                     f"healing from replica {src_rank} "
                     f"({quorum.recover_src_manager_address}) at step {max_step}"
                 )
+                self._metrics.emit("heal_start", src_rank=src_rank, max_step=max_step)
                 src_client = self._manager_client_factory(
                     quorum.recover_src_manager_address,
                     connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
@@ -393,6 +408,7 @@ class Manager:
                 self._pending_state_dict = cast(Dict[str, object], state)
                 # Fast-forward to the healed step (torchft/manager.py:562-568).
                 self._step = max_step
+                self._metrics.emit("heal_fetched", src_rank=src_rank, step=max_step)
         elif heal:
             self._healing = True
 
@@ -512,6 +528,7 @@ class Manager:
         """Latches an error for this step; cleared at the next start_quorum
         (reference: torchft/manager.py:325-337)."""
         self._errored = e
+        self._metrics.emit("error", step=self._step, error=repr(e))
 
     def errored(self) -> Optional[Exception]:
         return self._errored
@@ -546,6 +563,14 @@ class Manager:
         self._logger.info(
             f"should_commit={should_commit} (local={local_should_commit}, "
             f"enough_replicas={enough_replicas}, error={self._errored})"
+        )
+        self._metrics.emit(
+            "commit",
+            step=self._step,
+            committed=should_commit,
+            local=local_should_commit,
+            participants=self.num_participants(),
+            error=repr(self._errored) if self._errored else None,
         )
 
         if self._checkpoint_transport is not None:
@@ -614,6 +639,7 @@ class Manager:
         return self._collective
 
     def shutdown(self) -> None:
+        self._metrics.close()
         self._executor.shutdown(wait=True)
         if self._checkpoint_transport is not None:
             self._checkpoint_transport.shutdown(wait=False)

@@ -183,11 +183,13 @@ def _attention(cfg: TransformerConfig, mesh, q, k, v):
             from torchft_tpu.ops.ulysses import ulysses_attention_sharded as fn
 
             # Ulysses keeps GQA compressed through the all_to_all (the local
-            # flash kernel broadcasts groups afterwards) unless the kv-head
-            # count doesn't tile the sequence axis.
+            # flash kernel broadcasts groups afterwards) unless the kv heads
+            # PER TENSOR-PARALLEL SHARD don't tile the sequence axis — the
+            # divisibility the local body actually requires.
+            tp = mesh.shape.get("tensor", 1) if "tensor" in mesh.axis_names else 1
             broadcast_gqa = (
                 cfg.n_kv_heads != cfg.n_heads
-                and cfg.n_kv_heads % mesh.shape["sequence"] != 0
+                and (cfg.n_kv_heads // tp) % mesh.shape["sequence"] != 0
             )
         if broadcast_gqa:
             rep = cfg.n_heads // cfg.n_kv_heads
