@@ -1,0 +1,16 @@
+"""Packaging sanity: pyproject parses and console-script targets resolve."""
+
+import os
+import tomllib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_pyproject_parses_and_scripts_resolve() -> None:
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    assert meta["project"]["name"] == "torchft-tpu"
+    for target in meta["project"]["scripts"].values():
+        module, func = target.split(":")
+        mod = __import__(module, fromlist=[func])
+        assert callable(getattr(mod, func)), target

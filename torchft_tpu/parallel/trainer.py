@@ -101,6 +101,15 @@ class TrainStep:
         Requires ftmesh.manager.  The caller must have called
         manager.start_quorum() (the Optimizer wrapper's step_begin does).
 
+        State-ownership note: a HEALED step delivers weights through the
+        Manager's load_state_dict callback, not through this function's
+        return value — the (params, opt_state) returned on a step where the
+        manager healed are computed from the pre-heal inputs.  Loops that
+        enable healing should hold state behind the Manager's state-dict
+        callbacks and re-read it after such a step (the Optimizer wrapper's
+        pattern; see examples/train_hsdp.py), or run ft_step only on
+        up-to-date groups.
+
         The commit vote (a host RPC barrier across the group's local ranks,
         reference torchft/manager.py:587-663) is hidden behind device work:
         the update is dispatched *speculatively* before the vote — XLA async
