@@ -26,7 +26,12 @@ def test_bench_greps_match_emitters() -> None:
     example = _read(os.path.join("examples", "train_ddp.py"))
     manager = _read(os.path.join("torchft_tpu", "manager.py"))
 
-    # bench.py counts committed steps by this literal...
+    # Primary contract: bench counts the Manager's structured metrics
+    # events — the emitter and the consumer must name the same events.
+    assert '"commit"' in bench and '"heal_fetched"' in bench
+    assert '"commit",' in manager and '"heal_fetched"' in manager
+
+    # Fallback contract: bench greps these literals from the logs...
     assert 'b"committed=True"' in bench
     # ...which the example emits as an f-string ending in the bool repr.
     assert "committed={committed}" in example

@@ -47,6 +47,10 @@ class TransformerConfig:
     # (sequence-parallel K/V rotation), or "ulysses" (all-to-all head<->seq
     # resharding) — the latter two engage over the mesh "sequence" axis.
     attention: str = "flash"
+    # Unroll factor for the scan-over-layers (1 = pure scan).  Unrolling
+    # lets XLA fuse/pipeline across layer boundaries at the cost of compile
+    # time; worthwhile on the perf path, keep 1 for fast test iteration.
+    scan_unroll: int = 1
     # Mixture-of-experts: > 0 replaces the dense MLP with moe_experts
     # experts (stacked, shardable over the "expert" mesh axis).
     moe_experts: int = 0
@@ -270,7 +274,9 @@ def forward_with_aux(
 
     if cfg.remat:
         body = jax.checkpoint(body)
-    x, aux_layers = jax.lax.scan(body, x, params["layers"])
+    x, aux_layers = jax.lax.scan(
+        body, x, params["layers"], unroll=cfg.scan_unroll
+    )
 
     x = rms_norm(x, params["final_norm"])
     # bf16 operands on the MXU, f32 accumulation/output: full systolic-array
