@@ -143,6 +143,49 @@ def test_baby_child_crash_latches_and_recovers(store) -> None:
         )
 
 
+def test_baby_reconfigure_storm(store) -> None:
+    """Regression: repeated kill -> reconfigure generations.
+
+    The parent used to close the results Connection from teardown while the
+    old reader thread was blocked inside Connection.recv() on the same fd;
+    recv captures the raw fd once per call, the freed number was reused by
+    the next configure()'s Pipe(), and the stale reader then consumed and
+    corrupted the NEW generation's byte stream (ops on a healthy child
+    failing with 'collective subprocess died', or configure dying with
+    EOFError).  ~20-30%% repro per generation before the fix; readers now
+    own closing the pipes they block on."""
+    babies = [BabyTCPCollective(timeout=60.0) for _ in range(2)]
+    try:
+        for gen in range(6):
+            prefix = fresh_prefix()
+
+            def worker(rank: int):
+                c = babies[rank]
+                c.configure(f"{store.address()}/{prefix}", rank, 2)
+                out = c.allreduce(
+                    [np.full(8, float(rank + 1), dtype=np.float32)], op="sum"
+                )
+                np.testing.assert_allclose(out.wait(timeout=90)[0], np.full(8, 3.0))
+
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                for f in [pool.submit(worker, r) for r in range(2)]:
+                    f.result(timeout=120)
+
+            # Kill one child (alternating) mid-generation; the survivor's
+            # next op fails; both latch; next generation reconfigures.
+            victim = gen % 2
+            babies[victim]._proc.kill()
+            babies[victim]._proc.join(timeout=30)
+            work = babies[1 - victim].allreduce([np.ones(8, dtype=np.float32)])
+            with pytest.raises(Exception):
+                work.wait(timeout=90)
+            assert babies[1 - victim].errored() is not None
+            assert babies[victim].errored() is not None
+    finally:
+        for c in babies:
+            c.shutdown()
+
+
 def test_baby_abort_kills_child(store) -> None:
     """abort() is the NCCL-abort analogue: the child dies, errors latch, and
     the object is reusable after configure()."""

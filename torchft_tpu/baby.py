@@ -53,7 +53,14 @@ class MonitoredPipe:
         return out
 
     def close(self) -> None:
-        self._pipe.close()
+        # Serialized against send(): Connection._send captures the raw fd
+        # once per call, so closing mid-send would free the fd number for
+        # reuse while the sender keeps writing to it — onto whatever pipe
+        # grabs the number next.  (recv has the same hazard; reader threads
+        # therefore own the close of pipes they block on — see
+        # BabyCollective._teardown_child.)
+        with self._send_lock:
+            self._pipe.close()
 
     def closed(self) -> bool:
         return self._pipe.closed
@@ -235,6 +242,7 @@ class BabyCollective(Collective):
             proc, self._proc = self._proc, None
             cmds, self._cmds = self._cmds, None
             results, self._results = self._results, None
+            reader, self._reader = self._reader, None
             futures, self._futures = self._futures, {}
         for fut in futures.values():
             if not fut.done():
@@ -245,8 +253,17 @@ class BabyCollective(Collective):
             except (OSError, BrokenPipeError):
                 pass
             cmds.close()
-        if results is not None:
-            results.close()  # unblocks the reader thread
+        # The results pipe is closed by its READER thread, never here: the
+        # reader may be blocked inside Connection.recv(), which captures the
+        # raw fd once per call — closing out from under it frees the fd
+        # number, the next configure()'s Pipe() immediately reuses it, and
+        # the stale reader then consumes (and corrupts) the NEW generation's
+        # byte stream.  The reader is guaranteed to wake and self-close:
+        # killing the child below closes the peer end, delivering EOF.
+        # Only when no reader was ever started (configure failed before
+        # spawning one) is the pipe ours to close.
+        if results is not None and reader is None:
+            results.close()
         if proc is not None:
             proc.join(timeout=2.0)
             if proc.is_alive():
@@ -260,18 +277,28 @@ class BabyCollective(Collective):
             try:
                 msg = results.recv()
             except (EOFError, OSError):
-                # Child died or pipe torn down: fail everything outstanding —
-                # unless this reader is stale (a new configure() installed a
-                # fresh child); then the futures dict belongs to the new
-                # generation and is not ours to touch (teardown already failed
-                # the old generation's futures with "collective reconfigured").
+                # Child died (its pipe end closed): fail everything
+                # outstanding — unless this reader is stale.
                 err = RuntimeError("collective subprocess died")
                 with self._lock:
-                    if self._results is not results:
-                        return
-                    futures, self._futures = self._futures, {}
-                    if self._error is None:
-                        self._error = err
+                    stale = self._results is not results
+                    if not stale:
+                        futures, self._futures = self._futures, {}
+                        if self._error is None:
+                            self._error = err
+                # This thread owns the pipe's lifetime (see _teardown_child):
+                # only now that no recv() can ever run on it again is closing
+                # (and thereby freeing the fd number for reuse) safe.
+                try:
+                    results.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                if stale:
+                    # A new configure() installed a fresh child; the futures
+                    # dict belongs to the new generation and is not ours to
+                    # touch (teardown already failed the old generation's
+                    # futures with "collective reconfigured").
+                    return
                 for fut in futures.values():
                     if not fut.done():
                         fut.set_exception(err)
