@@ -52,6 +52,12 @@ def main() -> None:
     parser.add_argument("--batch", type=int, default=32)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--min_replicas", type=int, default=1)
+    parser.add_argument(
+        "--ckpt_dir",
+        default=os.environ.get("TPUFT_CKPT_DIR", ""),
+        help="durable checkpoint directory; empty disables disk checkpoints",
+    )
+    parser.add_argument("--ckpt_every", type=int, default=10)
     args = parser.parse_args()
 
     import jax
@@ -124,6 +130,35 @@ def main() -> None:
     )
     averager = GradientAverager(manager)
 
+    # Durable disk checkpoints: peer transports heal a restarted group from
+    # a live one, but a cold start (every group gone) would otherwise begin
+    # at step 0.  Restore must happen before the first quorum join so this
+    # group advertises its resumed step.
+    ckpt = None
+    if args.ckpt_dir:
+        from torchft_tpu.checkpointing import DiskCheckpointer
+
+        ckpt = DiskCheckpointer(
+            os.path.join(args.ckpt_dir, f"group_{replica_group}")
+        )
+
+        # The disk state dict wraps the peer-heal one: user state plus the
+        # Manager's own bookkeeping ({step, batches_committed} — the latter
+        # advances by num_participants per step, so it cannot be derived
+        # from the step number).
+        def disk_save():
+            return {"user": save(), "manager": manager.state_dict()}
+
+        ckpt_step, sd = ckpt.restore_latest(template_fn=disk_save)
+        if sd is not None:
+            load(sd["user"])
+            manager.load_state_dict(sd["manager"])
+            print(
+                f"[group {replica_group}] resumed from disk checkpoint "
+                f"step={ckpt_step}",
+                flush=True,
+            )
+
     try:
         while manager.current_step() < args.steps:
             state["opt"].step_begin()
@@ -146,6 +181,12 @@ def main() -> None:
             loss, grads = grad_fn(state["opt"].params, x, y)
             grads = averager.allreduce(grads)
             committed = state["opt"].step(grads)
+            if (
+                ckpt is not None
+                and committed
+                and manager.current_step() % args.ckpt_every == 0
+            ):
+                ckpt.save(manager.current_step(), disk_save())
             print(
                 f"[group {replica_group}] step={step} loss={float(loss):.4f} "
                 f"participants={manager.num_participants()} committed={committed}",
@@ -158,6 +199,8 @@ def main() -> None:
         print(f"[group {replica_group}] FINAL step={manager.current_step()} "
               f"params_sha256={digest.hexdigest()}", flush=True)
     finally:
+        if ckpt is not None:
+            ckpt.shutdown()
         manager.shutdown()
 
 

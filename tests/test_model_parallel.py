@@ -49,6 +49,26 @@ def test_forward_shapes_and_loss() -> None:
     assert abs(float(loss) - np.log(CFG.vocab_size)) < 1.0
 
 
+def test_scan_unroll_matches_scan() -> None:
+    """Unrolling the layer scan (the bench perf config) is a pure scheduling
+    change: logits and grads must match scan_unroll=1 up to fusion-order
+    rounding."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    batch = _batch()
+    cfg_u = TransformerConfig(**{**CFG.__dict__, "scan_unroll": CFG.n_layers})
+
+    ref = np.asarray(forward(params, batch["tokens"], CFG))
+    got = np.asarray(forward(params, batch["tokens"], cfg_u))
+    # Tight tolerance, not bitwise: unrolling changes XLA's fusion choices,
+    # which may differ in the last ulp on TPU.
+    np.testing.assert_allclose(ref, got, rtol=1e-6, atol=1e-6)
+
+    g_ref = jax.grad(lambda p: loss_fn(p, batch, CFG))(params)
+    g_got = jax.grad(lambda p: loss_fn(p, batch, cfg_u))(params)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
 def test_sharded_matches_single_device() -> None:
     params = init_params(jax.random.PRNGKey(0), CFG)
     batch = _batch()

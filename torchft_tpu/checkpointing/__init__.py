@@ -1,3 +1,4 @@
+from torchft_tpu.checkpointing.disk import DiskCheckpointer
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
-__all__ = ["CheckpointTransport"]
+__all__ = ["CheckpointTransport", "DiskCheckpointer"]

@@ -1,0 +1,238 @@
+"""Durable disk checkpoints: async atomic save, cold-start resume.
+
+The peer transports (http_transport.py, collective_transport.py) heal a
+*restarted* replica from a *live* one; they cannot help when every replica
+group is gone (host maintenance, full-job preemption — routine on TPU
+pods).  This module closes that gap: each group persists its state to disk
+on a cadence and a cold-started job resumes from the newest complete
+checkpoint instead of step 0.
+
+Reference parity note: the torchft reference delegates durable checkpoints
+to the application (torchtitan's checkpoint manager; its own transports are
+peer-to-peer only — torchft/checkpointing/transport.py:14-69 has no disk
+path).  A standalone framework needs this first-party.
+
+TPU-first design choices:
+  - the on-disk format IS the transport wire format (serialization.py):
+    one flatten/restore path for network heal and disk resume, and
+    NamedShardings round-trip, so a resumed HSDP replica gets its arrays
+    placed back on its own mesh without re-deciding placement;
+  - ``save`` flattens on the caller's thread (the device->host fetch is the
+    checkpoint barrier — it blocks until the step's arrays are real) and
+    writes on a background thread so training overlaps the disk write;
+  - atomicity via write-to-tempfile + fsync + ``os.replace``: a crash
+    mid-write leaves a ``.tmp`` that restore ignores and the next save
+    overwrites.  No partial checkpoint is ever visible under its final
+    name;
+  - retention keeps the newest ``keep`` checkpoints; deletion happens only
+    after the newer save is durable, so there is always at least one
+    complete checkpoint on disk once the first save lands.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from torchft_tpu.checkpointing.serialization import (
+    StateDictMeta,
+    flatten_state_dict,
+    read_state_dict,
+    sharding_restorer,
+    unflatten_state_dict,
+    write_state_dict,
+)
+
+logger = logging.getLogger("tpuft")
+
+_CKPT_RE = re.compile(r"^step_(\d{12})\.tpuft$")
+
+
+def _path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step:012d}.tpuft")
+
+
+class DiskCheckpointer:
+    """Persists one replica group's state dict to a local directory.
+
+    Typical wiring (see examples/train_ddp.py)::
+
+        ckpt = DiskCheckpointer(dir, keep=3)
+        step, sd = ckpt.restore_latest(template_fn=save)   # cold start
+        if sd is not None: load(sd); manager.load_state_dict({...})
+        ...
+        if committed and step % every == 0:
+            ckpt.save(step, save())                        # async
+
+    Thread model: ``save`` may be called from the training loop; writes run
+    on a single daemon worker.  A second ``save`` while one is writing
+    blocks until the worker drains (backpressure — checkpoints are ordered
+    and never dropped).  A write failure is raised from the *next* ``save``
+    or ``wait`` call, never swallowed.
+    """
+
+    def __init__(self, directory: str, keep: int = 3) -> None:
+        assert keep >= 1, "must retain at least one checkpoint"
+        self._dir = directory
+        self._keep = keep
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Condition()
+        self._pending: Optional[Tuple[int, StateDictMeta, List[np.ndarray]]] = None
+        self._error: Optional[BaseException] = None
+        self._shutdown = False
+        self._worker = threading.Thread(
+            target=self._run, name="tpuft_disk_ckpt", daemon=True
+        )
+        self._worker.start()
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, step: int, state_dict: Any) -> None:
+        """Snapshots ``state_dict`` (device->host fetch happens here, so the
+        caller controls what step the checkpoint captures) and enqueues the
+        disk write.  Returns once the write is *enqueued*, not durable; call
+        ``wait()`` for durability."""
+        meta, buffers = flatten_state_dict(state_dict, step=step)
+        with self._lock:
+            self._raise_pending_error()
+            while self._pending is not None and not self._shutdown:
+                self._lock.wait(timeout=0.1)
+            if self._shutdown:
+                raise RuntimeError("DiskCheckpointer is shut down")
+            self._pending = (step, meta, buffers)
+            self._lock.notify_all()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Blocks until every enqueued save is durable (or raises its
+        failure)."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._pending is not None:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError("checkpoint write still in flight")
+                # None blocks until the worker's notify_all — no polling.
+                self._lock.wait(timeout=remaining)
+            self._raise_pending_error()
+
+    # -- restore ------------------------------------------------------------
+
+    def steps(self) -> List[int]:
+        """Completed checkpoint steps on disk, ascending."""
+        out = []
+        try:
+            for name in os.listdir(self._dir):
+                m = _CKPT_RE.match(name)
+                if m:
+                    out.append(int(m.group(1)))
+        except FileNotFoundError:
+            pass
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(
+        self, step: int, template_fn: Optional[Any] = None
+    ) -> Any:
+        """Loads the checkpoint at ``step``.  ``template_fn`` (a zero-arg
+        callable returning the live state dict, i.e. the same callable the
+        Manager gets as ``state_dict``) lets restored jax leaves adopt the
+        placement of the arrays they replace — required for sharded (HSDP)
+        resume, optional for single-device trees."""
+        restore_fn = sharding_restorer(template_fn) if template_fn else None
+        with open(_path(self._dir, step), "rb") as f:
+            meta, buffers = read_state_dict(f)
+        return unflatten_state_dict(meta, buffers, restore_sharding=restore_fn)
+
+    def restore_latest(
+        self, template_fn: Optional[Any] = None
+    ) -> Tuple[Optional[int], Any]:
+        """(step, state_dict) of the newest complete checkpoint, or
+        (None, None) on a truly cold start.  A checkpoint that fails to
+        parse (e.g. torn by a crash of a pre-atomic writer) is skipped with
+        a warning and the next-newest is tried."""
+        for step in reversed(self.steps()):
+            try:
+                return step, self.restore(step, template_fn=template_fn)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "skipping unreadable checkpoint step %d: %s", step, e
+                )
+        return None, None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Drains in-flight writes, then stops the worker."""
+        try:
+            self.wait()
+        finally:
+            with self._lock:
+                self._shutdown = True
+                self._lock.notify_all()
+            self._worker.join(timeout=5.0)
+
+    # -- worker -------------------------------------------------------------
+
+    def _raise_pending_error(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(f"previous checkpoint write failed: {err!r}") from err
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while self._pending is None and not self._shutdown:
+                    self._lock.wait()
+                if self._shutdown and self._pending is None:
+                    return
+                step, meta, buffers = self._pending  # type: ignore[misc]
+            try:
+                self._write(step, meta, buffers)
+                self._retain()
+            except BaseException as e:  # noqa: BLE001
+                logger.error("checkpoint write for step %d failed: %s", step, e)
+                with self._lock:
+                    self._error = e
+            finally:
+                with self._lock:
+                    self._pending = None
+                    self._lock.notify_all()
+
+    def _write(self, step: int, meta: StateDictMeta, buffers: List[np.ndarray]) -> None:
+        final = _path(self._dir, step)
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            write_state_dict(meta, buffers, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        # Make the rename itself durable (POSIX: fsync the directory).
+        try:
+            dfd = os.open(self._dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        logger.info("wrote checkpoint step %d (%s)", step, final)
+
+    def _retain(self) -> None:
+        steps = self.steps()
+        for step in steps[: -self._keep]:
+            try:
+                os.remove(_path(self._dir, step))
+            except OSError:
+                pass
