@@ -1,0 +1,101 @@
+"""Multi-host slice bootstrap: rendezvous through the group Store."""
+
+import threading
+
+import pytest
+
+from torchft_tpu.coordination import StoreServer
+from torchft_tpu.multihost import (
+    SliceConfig,
+    initialize_slice,
+    slice_config_from_env,
+)
+
+
+def test_config_from_env_defaults() -> None:
+    cfg = slice_config_from_env(env={})
+    assert cfg.host_rank == 0 and cfg.num_hosts == 1
+    assert not cfg.is_multihost
+
+
+def test_single_host_is_noop() -> None:
+    calls = []
+    out = initialize_slice(
+        SliceConfig(host_rank=0, num_hosts=1, store_addr=None),
+        _initialize=lambda **kw: calls.append(kw),
+    )
+    assert out is None and calls == []
+
+
+def test_multihost_requires_store() -> None:
+    with pytest.raises(RuntimeError, match="TPUFT_STORE"):
+        initialize_slice(
+            SliceConfig(host_rank=0, num_hosts=2, store_addr=None),
+            _initialize=lambda **kw: None,
+        )
+
+
+def test_rendezvous_all_hosts_agree() -> None:
+    """4 'hosts' (threads) rendezvous through one real StoreServer; every
+    jax.distributed.initialize call must get the same coordinator, the
+    right process_id, and num_processes=4."""
+    server = StoreServer(bind="127.0.0.1:0")
+    try:
+        calls = {}
+        lock = threading.Lock()
+
+        def host(rank: int):
+            def fake_init(coordinator_address, num_processes, process_id):
+                with lock:
+                    calls[process_id] = (coordinator_address, num_processes)
+
+            initialize_slice(
+                SliceConfig(
+                    host_rank=rank,
+                    num_hosts=4,
+                    store_addr=server.address(),
+                    coord_port=9999,
+                ),
+                key_prefix="test_slice",
+                _initialize=fake_init,
+            )
+
+        threads = [threading.Thread(target=host, args=(r,)) for r in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(calls) == [0, 1, 2, 3]
+        coords = {c for c, _ in calls.values()}
+        assert len(coords) == 1, f"hosts disagree on coordinator: {coords}"
+        assert all(n == 4 for _, n in calls.values())
+        assert next(iter(coords)).endswith(":9999")
+
+        # Restart incarnation: generation 1 must NOT read generation 0's
+        # (stale) coordinator from the still-live store.
+        got = {}
+
+        def host2(rank: int):
+            initialize_slice(
+                SliceConfig(
+                    host_rank=rank,
+                    num_hosts=2,
+                    store_addr=server.address(),
+                    coord_port=7777,
+                    generation=1,
+                ),
+                key_prefix="test_slice",
+                _initialize=lambda coordinator_address, num_processes, process_id: got.setdefault(
+                    process_id, coordinator_address
+                ),
+            )
+
+        threads = [threading.Thread(target=host2, args=(r,)) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(got) == [0, 1]
+        assert all(c.endswith(":7777") for c in got.values()), got
+    finally:
+        server.shutdown()

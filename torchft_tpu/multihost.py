@@ -1,0 +1,143 @@
+"""Multi-host slice bootstrap: wire one replica group across N hosts.
+
+On a TPU pod, one *replica group* (the fault-tolerance unit the Manager
+coordinates over DCN) is typically one multi-host *slice*: N host
+processes, each owning its local chips, joined into a single JAX runtime by
+``jax.distributed.initialize`` so that ``jax.devices()`` sees the whole
+slice and XLA collectives ride ICI.  This module is the bootstrap glue
+between the launcher's replica-group env and that per-slice JAX init.
+
+Reference parity: the reference's per-group bootstrap is torchrun's
+TCPStore rendezvous (torchft/torchx.py:11-80 builds one torchrun role per
+group; torchft/manager.py:88-245 then rendezvouses ranks through the
+store).  The TPU design splits the same two layers:
+
+  - WITHIN a slice: ``initialize_slice`` — rank 0 publishes a coordinator
+    address through the group's Store (the same framed-TCP store the
+    Manager uses), every host calls ``jax.distributed.initialize``; XLA
+    owns all intra-slice communication from then on.  No per-op process
+    group exists, because intra-slice collectives are compiled into the
+    program (SURVEY.md §2.4).
+  - ACROSS slices: the Manager + Lighthouse + TCPCollective path,
+    unchanged — only host-level code talks DCN.
+
+Env contract (set by the cluster scheduler / pod launcher — the local
+``torchft_tpu.launch`` supervisor runs single-host groups and does not set
+these):
+
+  TPUFT_HOST_RANK        this process's host index within its slice
+  TPUFT_NUM_HOSTS        hosts per slice (1 = single-host: init is a no-op
+                         unless forced)
+  TPUFT_STORE            host:port of the group's StoreServer (rendezvous)
+  TPUFT_COORD_PORT       port rank 0 binds for the JAX coordinator
+                         (default 8476)
+  TPUFT_SLICE_GEN        restart generation (the supervisor's attempt
+                         counter).  The Store outlives the group's
+                         processes, so without a generation in the
+                         rendezvous key a restarted slice would read the
+                         PREVIOUS incarnation's coordinator address and
+                         dial a dead host.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["SliceConfig", "slice_config_from_env", "initialize_slice"]
+
+
+@dataclass(frozen=True)
+class SliceConfig:
+    host_rank: int
+    num_hosts: int
+    store_addr: Optional[str]
+    coord_port: int = 8476
+    # Restart incarnation; part of the rendezvous key so a restarted slice
+    # never reads a previous incarnation's coordinator from the long-lived
+    # Store (cf. the per-generation store prefix in Collective.configure).
+    generation: int = 0
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_hosts > 1
+
+
+def slice_config_from_env(env: Optional[dict] = None) -> SliceConfig:
+    e = os.environ if env is None else env
+    return SliceConfig(
+        host_rank=int(e.get("TPUFT_HOST_RANK", 0)),
+        num_hosts=int(e.get("TPUFT_NUM_HOSTS", 1)),
+        store_addr=e.get("TPUFT_STORE") or None,
+        coord_port=int(e.get("TPUFT_COORD_PORT", 8476)),
+        generation=int(e.get("TPUFT_SLICE_GEN", 0)),
+    )
+
+
+def _local_address(port: int) -> str:
+    """Best-effort routable address for this host's coordinator."""
+    host = socket.gethostname()
+    try:
+        # A UDP "connect" performs routing without sending anything; the
+        # bound source address is what peers should dial.
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            host = s.getsockname()[0]
+    except OSError:
+        pass
+    return f"{host}:{port}"
+
+
+def initialize_slice(
+    cfg: Optional[SliceConfig] = None,
+    *,
+    key_prefix: str = "tpuft_slice",
+    timeout_ms: int = 60000,
+    _initialize=None,
+) -> Optional[str]:
+    """Joins this host process into its slice's JAX runtime.
+
+    Rank 0 publishes ``<key_prefix>/coordinator`` in the group Store; every
+    host blocks on that key, then calls ``jax.distributed.initialize``
+    (``_initialize`` is injectable for tests).  Must run before the first
+    touch of the JAX backend, same constraint as jax.distributed itself.
+
+    Returns the coordinator address used, or None when single-host (no-op).
+    """
+    cfg = cfg or slice_config_from_env()
+    if not cfg.is_multihost:
+        return None
+    if _initialize is None:
+        import jax
+
+        _initialize = jax.distributed.initialize
+
+    if cfg.store_addr is None:
+        raise RuntimeError(
+            "multi-host slice bootstrap needs TPUFT_STORE (the replica "
+            "group's StoreServer address) for coordinator rendezvous"
+        )
+
+    from torchft_tpu.coordination import StoreClient
+
+    store = StoreClient(cfg.store_addr)
+    key = f"{key_prefix}/gen{cfg.generation}/coordinator"
+    if cfg.host_rank == 0:
+        coordinator = _local_address(cfg.coord_port)
+        store.set(key, coordinator.encode(), timeout_ms=timeout_ms)
+    else:
+        raw = store.get(key, wait=True, timeout_ms=timeout_ms)
+        if raw is None:
+            raise TimeoutError(
+                f"no coordinator published at {key!r} within {timeout_ms} ms"
+            )
+        coordinator = raw.decode()
+
+    _initialize(
+        coordinator_address=coordinator,
+        num_processes=cfg.num_hosts,
+        process_id=cfg.host_rank,
+    )
+    return coordinator
