@@ -132,27 +132,18 @@ def main() -> None:
 
     # Durable disk checkpoints: peer transports heal a restarted group from
     # a live one, but a cold start (every group gone) would otherwise begin
-    # at step 0.  Restore must happen before the first quorum join so this
-    # group advertises its resumed step.
+    # at step 0.
     ckpt = None
     if args.ckpt_dir:
-        from torchft_tpu.checkpointing import DiskCheckpointer
+        from torchft_tpu.checkpointing import ManagedDiskCheckpoint
 
-        ckpt = DiskCheckpointer(
-            os.path.join(args.ckpt_dir, f"group_{replica_group}")
+        ckpt = ManagedDiskCheckpoint(
+            manager, save, load,
+            os.path.join(args.ckpt_dir, f"group_{replica_group}"),
+            every=args.ckpt_every,
         )
-
-        # The disk state dict wraps the peer-heal one: user state plus the
-        # Manager's own bookkeeping ({step, batches_committed} — the latter
-        # advances by num_participants per step, so it cannot be derived
-        # from the step number).
-        def disk_save():
-            return {"user": save(), "manager": manager.state_dict()}
-
-        ckpt_step, sd = ckpt.restore_latest(template_fn=disk_save)
-        if sd is not None:
-            load(sd["user"])
-            manager.load_state_dict(sd["manager"])
+        ckpt_step = ckpt.restore()
+        if ckpt_step is not None:
             print(
                 f"[group {replica_group}] resumed from disk checkpoint "
                 f"step={ckpt_step}",
@@ -181,12 +172,8 @@ def main() -> None:
             loss, grads = grad_fn(state["opt"].params, x, y)
             grads = averager.allreduce(grads)
             committed = state["opt"].step(grads)
-            if (
-                ckpt is not None
-                and committed
-                and manager.current_step() % args.ckpt_every == 0
-            ):
-                ckpt.save(manager.current_step(), disk_save())
+            if ckpt is not None:
+                ckpt.maybe_save(committed)
             print(
                 f"[group {replica_group}] step={step} loss={float(loss):.4f} "
                 f"participants={manager.num_participants()} committed={committed}",

@@ -40,6 +40,12 @@ def main() -> None:
         "--devices", type=int, default=4,
         help="virtual devices forming this group's (fsdp x tensor) mesh",
     )
+    parser.add_argument(
+        "--ckpt_dir",
+        default=os.environ.get("TPUFT_CKPT_DIR", ""),
+        help="durable checkpoint directory; empty disables disk checkpoints",
+    )
+    parser.add_argument("--ckpt_every", type=int, default=20)
     args = parser.parse_args()
 
     # Each process simulates one multi-device slice (demo only): the flag
@@ -130,6 +136,27 @@ def main() -> None:
     state["opt"] = Optimizer(manager, optax.sgd(args.lr), params)
     averager = GradientAverager(manager)
 
+    # Durable SHARDED checkpoints: the disk format records NamedShardings,
+    # and restore places every leaf back onto this group's own
+    # (fsdp x tensor) mesh via the live tree's shardings — cold-start
+    # resume for a whole HSDP job, where peer healing has no live peer.
+    ckpt = None
+    if args.ckpt_dir:
+        from torchft_tpu.checkpointing import ManagedDiskCheckpoint
+
+        ckpt = ManagedDiskCheckpoint(
+            manager, save, load,
+            os.path.join(args.ckpt_dir, f"group_{replica_group}"),
+            every=args.ckpt_every,
+        )
+        ckpt_step = ckpt.restore()
+        if ckpt_step is not None:
+            print(
+                f"[group {replica_group}] resumed from disk checkpoint "
+                f"step={ckpt_step}",
+                flush=True,
+            )
+
     sampler = DistributedSampler(
         len(dataset),
         replica_group=replica_group,
@@ -155,6 +182,8 @@ def main() -> None:
             loss, grads = step_fn.grads(state["opt"].params, batch)
             grads = averager.allreduce(grads)
             committed = state["opt"].step(grads)
+            if ckpt is not None:
+                ckpt.maybe_save(committed)
             print(
                 f"[group {replica_group}] step={step} loss={float(loss):.4f} "
                 f"participants={manager.num_participants()} committed={committed}",
@@ -180,6 +209,8 @@ def main() -> None:
             flush=True,
         )
     finally:
+        if ckpt is not None:
+            ckpt.shutdown()
         manager.shutdown()
 
 

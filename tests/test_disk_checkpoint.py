@@ -14,7 +14,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from torchft_tpu.checkpointing import DiskCheckpointer
+from torchft_tpu.checkpointing import DiskCheckpointer, ManagedDiskCheckpoint
 
 
 def _tree(seed=0):
@@ -128,6 +128,71 @@ def test_write_failure_surfaces_on_next_save(tmp_path) -> None:
         ckpt._dir = str(tmp_path)
         ckpt._error = None
         ckpt.shutdown()
+
+
+class _FakeManager:
+    def __init__(self):
+        self.step = 0
+        self.batches = 0
+        self.loaded = None
+
+    def current_step(self):
+        return self.step
+
+    def state_dict(self):
+        return {"step": self.step, "batches_committed": self.batches}
+
+    def load_state_dict(self, sd):
+        self.loaded = sd
+        self.step = sd["step"]
+        self.batches = sd["batches_committed"]
+
+
+def test_managed_wiring_roundtrip(tmp_path) -> None:
+    """ManagedDiskCheckpoint: cadence-gated saves, manager bookkeeping
+    round-trips exactly (not derived from the step number), cold restore
+    applies user state through load_fn."""
+    mgr = _FakeManager()
+    user = {"params": jnp.arange(4.0)}
+    applied = {}
+    mdc = ManagedDiskCheckpoint(
+        mgr, lambda: user, lambda sd: applied.update(sd), str(tmp_path), every=10
+    )
+    assert mdc.restore() is None  # cold start
+
+    for step, batches, committed in [(9, 17, True), (10, 23, True), (11, 24, False)]:
+        mgr.step, mgr.batches = step, batches
+        mdc.maybe_save(committed)
+    mgr.step, mgr.batches = 20, 41
+    mdc.maybe_save(True)
+    mdc.shutdown()
+    # Only the committed on-cadence steps landed.
+    assert DiskCheckpointer(str(tmp_path)).steps() == [10, 20]
+
+    mgr2 = _FakeManager()
+    mdc2 = ManagedDiskCheckpoint(
+        mgr2, lambda: user, lambda sd: applied.update(sd), str(tmp_path)
+    )
+    assert mdc2.restore() == 20
+    assert mgr2.step == 20 and mgr2.batches == 41  # exact, not ==step
+    np.testing.assert_array_equal(np.asarray(applied["params"]), np.arange(4.0))
+    mdc2.shutdown()
+
+
+def test_managed_shutdown_never_raises(tmp_path) -> None:
+    """A deferred write failure must not escape shutdown() — the caller's
+    manager.shutdown() after it must always run."""
+    mgr = _FakeManager()
+    mdc = ManagedDiskCheckpoint(
+        mgr, lambda: {"x": jnp.zeros(2)}, lambda sd: None, str(tmp_path), every=1
+    )
+    mgr.step = 1
+    mdc.maybe_save(True)
+    mdc._ckpt.wait()
+    mdc._ckpt._dir = str(tmp_path / "gone" / "deeper")  # break the worker
+    mgr.step = 2
+    mdc.maybe_save(True)
+    mdc.shutdown()  # must swallow the write failure
 
 
 def test_backpressure_orders_saves(tmp_path) -> None:

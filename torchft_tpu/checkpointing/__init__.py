@@ -1,4 +1,4 @@
-from torchft_tpu.checkpointing.disk import DiskCheckpointer
+from torchft_tpu.checkpointing.disk import DiskCheckpointer, ManagedDiskCheckpoint
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
-__all__ = ["CheckpointTransport", "DiskCheckpointer"]
+__all__ = ["CheckpointTransport", "DiskCheckpointer", "ManagedDiskCheckpoint"]

@@ -236,3 +236,70 @@ class DiskCheckpointer:
                 os.remove(_path(self._dir, step))
             except OSError:
                 pass
+
+
+class ManagedDiskCheckpoint:
+    """The standard train-loop wiring of a DiskCheckpointer to a Manager.
+
+    The disk state dict wraps the peer-heal one (the ``save_fn`` the Manager
+    already has) plus the Manager's own ``{step, batches_committed}`` —
+    the latter advances by num_participants per committed step, so it
+    cannot be derived from the step number.  Usage::
+
+        mdc = ManagedDiskCheckpoint(manager, save, load, ckpt_dir, every=10)
+        resumed = mdc.restore()          # before the first quorum join
+        ...
+        committed = opt.step(grads)
+        mdc.maybe_save(committed)        # in the loop
+        ...
+        mdc.shutdown()                   # never raises; manager.shutdown()
+                                         # after it always runs
+    """
+
+    def __init__(
+        self,
+        manager,
+        save_fn,
+        load_fn,
+        directory: str,
+        *,
+        every: int = 10,
+        keep: int = 3,
+    ) -> None:
+        assert every >= 1, "checkpoint cadence must be >= 1 step"
+        self._manager = manager
+        self._save_fn = save_fn
+        self._load_fn = load_fn
+        self._every = every
+        self._ckpt = DiskCheckpointer(directory, keep=keep)
+
+    def _disk_state(self):
+        return {"user": self._save_fn(), "manager": self._manager.state_dict()}
+
+    def restore(self) -> Optional[int]:
+        """Cold-start restore of the newest complete checkpoint; returns its
+        step, or None on a truly cold start.  Must run before the first
+        quorum join so the group advertises its resumed step."""
+        step, sd = self._ckpt.restore_latest(template_fn=self._disk_state)
+        if sd is None:
+            return None
+        self._load_fn(sd["user"])
+        self._manager.load_state_dict(sd["manager"])
+        logger.info("resumed from disk checkpoint step=%d", step)
+        return step
+
+    def maybe_save(self, committed: bool) -> None:
+        """Enqueues an async checkpoint on the cadence (committed steps
+        only — an uncommitted step's state may be rolled back)."""
+        step = self._manager.current_step()
+        if committed and step % self._every == 0:
+            self._ckpt.save(step, self._disk_state())
+
+    def shutdown(self) -> None:
+        """Drains in-flight writes.  Never raises: a deferred write failure
+        at exit must not mask the loop's own outcome or skip the caller's
+        remaining teardown (manager.shutdown())."""
+        try:
+            self._ckpt.shutdown()
+        except Exception as e:  # noqa: BLE001
+            logger.error("disk checkpoint shutdown failed: %s", e)
