@@ -278,14 +278,36 @@ def forward_with_aux(
         body, x, params["layers"], unroll=cfg.scan_unroll
     )
 
+    return head(params, x, cfg, mesh, rules), jnp.sum(aux_layers)
+
+
+def head(
+    params: Dict[str, Any],
+    x: jax.Array,
+    cfg: TransformerConfig,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+) -> jax.Array:
+    """Final norm + lm head: decoder output [B, S, E] -> logits [B, S, V].
+
+    Shared by the dense path (forward_with_aux) and the pipelined path
+    (parallel/pipeline.pipeline_loss_fn) so the two can never diverge."""
     x = rms_norm(x, params["final_norm"])
     # bf16 operands on the MXU, f32 accumulation/output: full systolic-array
     # rate with f32 logits (an f32xf32 matmul runs at a fraction of MXU peak).
     logits = jnp.matmul(
         x, params["lm_head"].astype(cfg.dtype), preferred_element_type=jnp.float32
     )
-    logits = constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
-    return logits, jnp.sum(aux_layers)
+    return constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+
+
+def token_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token CE, computed as logsumexp - target_logit rather than
+    materializing the full [B, S, vocab] log-softmax: the logits array is
+    the single biggest activation, and one extra copy is pure HBM traffic."""
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    return jnp.mean(lse - tgt)
 
 
 def forward(
@@ -308,16 +330,10 @@ def loss_fn(
 ) -> jax.Array:
     """Next-token cross entropy; batch: {"tokens": [B,S], "targets": [B,S]}.
 
-    Computed as logsumexp - target_logit rather than materializing the full
-    [B, S, vocab] log-softmax: the logits array is the single biggest
-    activation (B*S*V f32), and one extra copy of it is pure HBM traffic.
-
     MoE configs add moe_aux_coef * load-balance loss (Switch-style).
     """
     logits, aux = forward_with_aux(params, batch["tokens"], cfg, mesh, rules)
-    tgt = jnp.take_along_axis(logits, batch["targets"][..., None], axis=-1)[..., 0]
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    ce = jnp.mean(lse - tgt)
+    ce = token_cross_entropy(logits, batch["targets"])
     if cfg.moe_experts > 0:
         ce = ce + cfg.moe_aux_coef * aux
     return ce

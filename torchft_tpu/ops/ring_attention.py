@@ -110,14 +110,7 @@ def ring_attention_sharded(
     sequence ring over `seq_axis`."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map as _shard_map
-
-        shard_map = functools.partial(_shard_map, mesh=mesh)
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as _shard_map
-
-        shard_map = functools.partial(_shard_map, mesh=mesh)
+    from torchft_tpu.ops._shard_map import shard_map
 
     axis_size = mesh.shape[seq_axis]
     spec = P(batch_axis, head_axis, seq_axis, None)
@@ -129,6 +122,7 @@ def ring_attention_sharded(
             causal=causal,
             scale=scale,
         ),
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )

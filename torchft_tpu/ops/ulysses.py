@@ -64,10 +64,7 @@ def ulysses_attention_sharded(
     `batch_axis`, heads over `head_axis` (TP), sequence over `seq_axis`."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map as _shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as _shard_map
+    from torchft_tpu.ops._shard_map import shard_map
 
     n = mesh.shape[seq_axis]
     tp = max(1, mesh.shape.get(head_axis, 1) if head_axis else 1)
@@ -78,11 +75,11 @@ def ulysses_attention_sharded(
             f"by the sequence axis ({n}); use ring attention otherwise"
         )
     spec = P(batch_axis, head_axis, seq_axis, None)
-    fn = _shard_map(
+    fn = shard_map(
         functools.partial(
             ulysses_attention, axis_name=seq_axis, causal=causal, scale=scale
         ),
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )

@@ -1,0 +1,199 @@
+"""Pipeline parallelism: GPipe schedule over the "pipeline" mesh axis.
+
+Layers are stacked on a leading axis (the transformer already stores them
+that way for the scan-over-layers) and sharded across pipeline stages;
+activations hop stage-to-stage with ``lax.ppermute`` — one neighbor link
+per tick, the ICI-friendly pattern.  The whole schedule is a single
+``lax.scan`` inside ``shard_map``: every stage runs the same compiled tick
+body (SPMD), with warmup/drain bubbles realized as masked compute rather
+than control flow, so XLA sees static shapes throughout.
+
+Reference parity note: the torchft reference has NO pipeline parallelism
+(SURVEY.md §2.3 — PP named only as a dimension users may bring); this is a
+capability the TPU build adds, composing with the fault-tolerant replica
+dimension the same way tp/fsdp/sp do (inside the replica group, invisible
+to the Manager).
+
+Autodiff gives the reverse schedule for free: ``ppermute`` transposes to
+the inverse permutation and the scan reverses, so ``jax.grad`` of the
+pipelined loss is itself a (reverse) pipeline.  Memory follows GPipe:
+per-tick activations are scan residuals; wrap ``body_fn`` in
+``jax.checkpoint`` (cfg.remat) to trade recompute for residency.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pipeline_apply", "pipeline_apply_sharded", "pipeline_loss_fn"]
+
+
+def pipeline_apply(
+    layers: Any,
+    x: jax.Array,
+    body_fn: Callable[[Any, jax.Array], jax.Array],
+    *,
+    axis_name: str,
+    axis_size: int,
+    num_microbatches: int,
+) -> jax.Array:
+    """Local GPipe body — call inside shard_map.
+
+    Args:
+        layers: stage-LOCAL stacked layer params, leading axis = layers
+            owned by this stage (in global order).
+        x: this data-shard's activations [B, S, E]; B must divide into
+            ``num_microbatches``.
+        body_fn: one layer: (layer_params, [mb, S, E]) -> [mb, S, E].
+        axis_name/axis_size: the pipeline mesh axis.
+        num_microbatches: M >= axis_size fills the pipe; the bubble
+            fraction is (P-1)/(M+P-1).
+    """
+    P = axis_size
+    M = num_microbatches
+    B, S, E = x.shape
+    assert B % M == 0, f"batch {B} not divisible into {M} microbatches"
+    mb = B // M
+    x_mb = x.reshape(M, mb, S, E)
+    stage = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def apply_stage(act: jax.Array) -> jax.Array:
+        out, _ = jax.lax.scan(lambda a, w: (body_fn(w, a), None), act, layers)
+        return out
+
+    def tick(carry, t):
+        act, out_buf = carry
+        # Stage 0 ingests microbatch t (clipped: past-the-end ticks re-read
+        # the last microbatch into stages whose output is never emitted).
+        fresh = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+        )
+        act = jnp.where(stage == 0, fresh, act)
+        act = apply_stage(act)
+        # The last stage emits microbatch t-(P-1) once the pipe is full.
+        m_out = t - (P - 1)
+        emit = jnp.logical_and(stage == P - 1, m_out >= 0)
+        out_buf = jnp.where(
+            emit,
+            jax.lax.dynamic_update_index_in_dim(
+                out_buf, act, jnp.clip(m_out, 0, M - 1), axis=0
+            ),
+            out_buf,
+        )
+        # One neighbor hop: stage s's activation moves to s+1 (the wrap to
+        # stage 0 is dead — overwritten by the next tick's ingestion).
+        act = jax.lax.ppermute(act, axis_name, perm)
+        return (act, out_buf), None
+
+    init = (
+        jnp.zeros((mb, S, E), x.dtype),
+        jnp.zeros((M, mb, S, E), x.dtype),
+    )
+    (_, out_buf), _ = jax.lax.scan(tick, init, jnp.arange(M + P - 1))
+    # Replicate the last stage's buffer everywhere (masked psum rides ICI
+    # once; every stage leaves with the full output, which is what the
+    # unsharded head/loss downstream expects).
+    out = jax.lax.psum(
+        jnp.where(stage == P - 1, out_buf, jnp.zeros_like(out_buf)), axis_name
+    )
+    return out.reshape(B, S, E)
+
+
+def pipeline_apply_sharded(
+    mesh,
+    layers: Any,
+    x: jax.Array,
+    body_fn: Callable[[Any, jax.Array], jax.Array],
+    *,
+    num_microbatches: int,
+    pipe_axis: str = "pipeline",
+    batch_axis: Optional[str] = "data",
+) -> jax.Array:
+    """shard_map wrapper: layers sharded over ``pipe_axis`` (leading axis),
+    activations over ``batch_axis`` — PP x DP composition."""
+    from jax.sharding import PartitionSpec as P
+
+    from torchft_tpu.ops._shard_map import shard_map
+
+    if batch_axis is not None and (
+        batch_axis not in mesh.axis_names or mesh.shape[batch_axis] == 1
+    ):
+        batch_axis = None
+    axis_size = mesh.shape[pipe_axis]
+    n_layers = jax.tree.leaves(layers)[0].shape[0]
+    assert n_layers % axis_size == 0, (
+        f"{n_layers} layers not divisible over {axis_size} pipeline stages"
+    )
+
+    layer_specs = jax.tree.map(lambda _: P(pipe_axis), layers)
+    act_spec = P(batch_axis, None, None)
+    fn = shard_map(
+        functools.partial(
+            pipeline_apply,
+            body_fn=body_fn,
+            axis_name=pipe_axis,
+            axis_size=axis_size,
+            num_microbatches=num_microbatches,
+        ),
+        mesh,
+        in_specs=(layer_specs, act_spec),
+        out_specs=act_spec,
+        # The output is replicated over the pipeline axis by an explicit
+        # masked psum, which the static replication checker cannot see.
+        check=False,
+    )
+    return fn(layers, x)
+
+
+def pipeline_loss_fn(
+    params: Any,
+    batch: Any,
+    cfg,
+    mesh,
+    *,
+    num_microbatches: int,
+    pipe_axis: str = "pipeline",
+    batch_axis: Optional[str] = "data",
+) -> jax.Array:
+    """Next-token CE of the flagship transformer with its layer stack
+    pipelined over ``pipe_axis``.
+
+    Embedding and the lm head run outside the pipeline (replicated over the
+    pipeline axis; sharded over whatever the params' own shardings say), the
+    decoder stack runs as a GPipe schedule.  Dense configs only — the MoE
+    aux loss needs the all-stage reduction the dense path doesn't have.
+    """
+    from torchft_tpu.models.transformer import _layer, head, token_cross_entropy
+
+    assert cfg.moe_experts == 0, "pipeline_loss_fn supports dense configs only"
+    tokens = batch["tokens"]
+    B, S = tokens.shape
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def body(w, a):
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32), (a.shape[0], S)
+        )
+        out, _ = _layer(cfg, None, None, a, w, positions)
+        return out
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    x = pipeline_apply_sharded(
+        mesh,
+        params["layers"],
+        x,
+        body,
+        num_microbatches=num_microbatches,
+        pipe_axis=pipe_axis,
+        batch_axis=batch_axis,
+    )
+
+    return token_cross_entropy(head(params, x, cfg), batch["targets"])

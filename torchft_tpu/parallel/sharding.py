@@ -33,7 +33,9 @@ class ShardingRules:
         ("mlp", "tensor"),
         ("vocab", "tensor"),
         ("expert", "expert"),
-        ("layers", None),
+        # Stacked-layer leading axis: sharded over pipeline stages when the
+        # mesh has them (parallel/pipeline.py), replicated otherwise.
+        ("layers", "pipeline"),
     )
 
     def mesh_axis(self, logical: Optional[str], mesh: Mesh) -> Optional[str]:
