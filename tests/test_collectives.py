@@ -78,6 +78,23 @@ def check_allreduce_avg(c: Collective, rank: int):
     return True
 
 
+def check_allreduce_max_min(c: Collective, rank: int):
+    n = c.size()
+    # Values chosen so max/min differ per position and per rank.
+    x = np.arange(8, dtype=np.float32) * (1 if rank % 2 == 0 else -1) + rank
+    outs = {
+        op: c.allreduce([x.copy()], op=op).wait(timeout=20)[0]
+        for op in ("max", "min")
+    }
+    all_ranks = np.stack(
+        [np.arange(8, dtype=np.float32) * (1 if r % 2 == 0 else -1) + r
+         for r in range(n)]
+    )
+    np.testing.assert_allclose(outs["max"], all_ranks.max(axis=0))
+    np.testing.assert_allclose(outs["min"], all_ranks.min(axis=0))
+    return True
+
+
 def check_allreduce_multi_array(c: Collective, rank: int):
     n = c.size()
     xs = [
@@ -171,6 +188,7 @@ def check_bfloat16_send_recv_allreduce(c: Collective, rank: int):
 _COLLECTIVE_TO_FUNC: Dict[str, Callable[[Collective, int], object]] = {
     "allreduce": check_allreduce,
     "allreduce_avg": check_allreduce_avg,
+    "allreduce_max_min": check_allreduce_max_min,
     "allreduce_multi": check_allreduce_multi_array,
     "allgather": check_allgather,
     "broadcast": check_broadcast,
@@ -194,6 +212,36 @@ def test_dummy_collective_conformance(op: str) -> None:
     c = DummyCollective()
     c.configure("unused", 0, 1)
     assert _COLLECTIVE_TO_FUNC[op](c, 0)
+
+
+def test_invalid_reduce_op_fails_even_at_world_size_one(store) -> None:
+    """A typo'd op must fail on a single-replica config too — not only
+    after scaling up past the world-size-1 fast path."""
+    c = TCPCollective(timeout=5.0)
+    c.configure(f"{store.address()}/{fresh_prefix()}", 0, 1)
+    try:
+        for call in (
+            lambda: c.allreduce([np.ones(4, dtype=np.float32)], op="prod"),
+            lambda: c.reduce_scatter([np.ones(4, dtype=np.float32)], op="mx"),
+        ):
+            with pytest.raises(ValueError, match="unsupported reduce op"):
+                call().wait(timeout=5)
+    finally:
+        c.shutdown()
+
+
+def test_managed_collective_rejects_non_average_ops() -> None:
+    """Manager.allreduce averages over participants; max/min through the
+    managed facade must fail loud, never silently return averaged data."""
+    from unittest.mock import MagicMock
+
+    from torchft_tpu.collectives import ManagedCollective
+
+    manager = MagicMock()
+    mc = ManagedCollective(manager)
+    with pytest.raises(ValueError, match="not expressible"):
+        mc.allreduce([np.ones(4, dtype=np.float32)], op="max").wait(timeout=5)
+    manager.allreduce.assert_not_called()
 
 
 def test_tcp_collective_reconfigure(store) -> None:

@@ -57,3 +57,7 @@ def test_manager_emits_lifecycle_events(store, tmp_path, monkeypatch) -> None:  
     assert commit["committed"] is True and commit["participants"] == 2
     quorum = next(e for e in events if e["event"] == "quorum")
     assert quorum["quorum_id"] is not None
+    # Span durations turn the stream into a trace: every lifecycle event
+    # carries how long its phase took.
+    assert quorum["quorum_ms"] >= 0
+    assert commit["vote_ms"] >= 0

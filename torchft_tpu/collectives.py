@@ -44,6 +44,22 @@ __all__ = [
     "ManagedCollective",
 ]
 
+# Elementwise combine per reduce op ("avg" divides by world size after the
+# sum).  Membership doubles as the validity check for allreduce/
+# reduce_scatter op arguments.
+_REDUCE_COMBINE = {
+    "sum": np.add,
+    "avg": np.add,
+    "max": np.maximum,
+    "min": np.minimum,
+}
+
+
+def _bad_reduce_op(op: str) -> ValueError:
+    return ValueError(
+        f"unsupported reduce op {op!r}; expected one of {sorted(_REDUCE_COMBINE)}"
+    )
+
 
 class Work:
     """Handle for an async collective operation (the c10d Work analogue)."""
@@ -597,6 +613,10 @@ class TCPCollective(Collective):
         return Work(executor.submit(run))
 
     def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        # Validate BEFORE the world-size-1 fast path: a typo'd op must fail
+        # on a single-replica config too, not only after scaling up.
+        if op not in _REDUCE_COMBINE:
+            return Work(failed_future(_bad_reduce_op(op)))
         arrays = [np.ascontiguousarray(a) for a in arrays]
         if self._world_size == 1:
             return Work(completed_future(list(arrays)))
@@ -629,6 +649,7 @@ class TCPCollective(Collective):
 
         n = self._world_size
         rank = self._rank
+        combine = _REDUCE_COMBINE[op]
         # Flatten all arrays into one contiguous f64-safe working buffer of
         # the common dtype to cut per-message overhead.
         flat = np.concatenate([a.reshape(-1) for a in arrays]) if len(arrays) > 1 \
@@ -644,7 +665,7 @@ class TCPCollective(Collective):
             recv_idx = (rank - step - 1) % n
             payload = memoryview(as_u8(chunks[send_idx]))
             incoming = np.frombuffer(self._exchange(1, payload), dtype=flat.dtype)
-            chunks[recv_idx] = chunks[recv_idx] + incoming
+            chunks[recv_idx] = combine(chunks[recv_idx], incoming)
 
         # Allgather phase: circulate the reduced chunks.
         for step in range(n - 1):
@@ -656,8 +677,6 @@ class TCPCollective(Collective):
         out_flat = np.concatenate(chunks)
         if op == "avg":
             out_flat = out_flat / n
-        elif op == "max":
-            raise NotImplementedError("ring max: use allgather")
         out: List[np.ndarray] = []
         pos = 0
         for a in arrays:
@@ -696,6 +715,8 @@ class TCPCollective(Collective):
         return self._submit(run)
 
     def reduce_scatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        if op not in _REDUCE_COMBINE:
+            return Work(failed_future(_bad_reduce_op(op)))
         arrays = [np.ascontiguousarray(a) for a in arrays]
         if self._world_size == 1:
             return Work(completed_future(arrays[0].copy()))
@@ -954,6 +975,20 @@ class ManagedCollective(Collective):
         self._manager._collective.configure(store_addr, rank, world_size)
 
     def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+        # Manager.allreduce implements exactly the fault-tolerant gradient
+        # semantic: sum over participants / num_participants (an average).
+        # Other reduce ops must not silently return averaged data — use the
+        # raw collective (manager.collective()) for those.
+        if op not in ("sum", "avg"):
+            return Work(
+                failed_future(
+                    ValueError(
+                        f"ManagedCollective.allreduce implements the "
+                        f"participant-averaged gradient reduction; op={op!r} "
+                        "is not expressible through it"
+                    )
+                )
+            )
         futs = [self._manager.allreduce(a) for a in arrays]
         out: Future = Future()
 

@@ -31,6 +31,7 @@ import logging
 import os
 import socket
 import threading
+import time
 import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from datetime import timedelta
@@ -302,6 +303,7 @@ class Manager:
         metadata = (
             self._checkpoint_transport.metadata() if self._checkpoint_transport else ""
         )
+        t_quorum = time.monotonic()
         quorum = self._client._quorum(
             group_rank=self._rank,
             step=self._step,
@@ -352,6 +354,9 @@ class Manager:
             replica_world_size=replica_world_size,
             participating=self._participating_replica_world_size,
             heal=heal,
+            # Span durations make the stream a trace: where a slow step
+            # went (quorum wait vs reconfigure vs heal) without a profiler.
+            quorum_ms=round((time.monotonic() - t_quorum) * 1e3, 3),
         )
 
         if quorum_id != self._quorum_id:
@@ -362,10 +367,18 @@ class Manager:
                 f"reconfiguring collective for quorum {quorum_id} "
                 f"(rank {replica_rank}/{replica_world_size})"
             )
+            t_cfg = time.monotonic()
             self._collective.configure(
                 f"{store_address}/{prefix}", replica_rank, replica_world_size
             )
             self._quorum_id = quorum_id
+            self._metrics.emit(
+                "reconfigure",
+                quorum_id=quorum_id,
+                replica_rank=replica_rank,
+                replica_world_size=replica_world_size,
+                configure_ms=round((time.monotonic() - t_cfg) * 1e3, 3),
+            )
 
         if allow_heal and self._checkpoint_transport is not None:
             # Recovery source: serve our weights to the assigned destinations
@@ -391,6 +404,7 @@ class Manager:
                     f"({quorum.recover_src_manager_address}) at step {max_step}"
                 )
                 self._metrics.emit("heal_start", src_rank=src_rank, max_step=max_step)
+                t_heal = time.monotonic()
                 src_client = self._manager_client_factory(
                     quorum.recover_src_manager_address,
                     connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
@@ -408,7 +422,12 @@ class Manager:
                 self._pending_state_dict = cast(Dict[str, object], state)
                 # Fast-forward to the healed step (torchft/manager.py:562-568).
                 self._step = max_step
-                self._metrics.emit("heal_fetched", src_rank=src_rank, step=max_step)
+                self._metrics.emit(
+                    "heal_fetched",
+                    src_rank=src_rank,
+                    step=max_step,
+                    heal_ms=round((time.monotonic() - t_heal) * 1e3, 3),
+                )
         elif heal:
             self._healing = True
 
@@ -554,6 +573,7 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
+        t_vote = time.monotonic()
         should_commit = self._client.should_commit(
             self._rank,
             self._step,
@@ -571,6 +591,7 @@ class Manager:
             local=local_should_commit,
             participants=self.num_participants(),
             error=repr(self._errored) if self._errored else None,
+            vote_ms=round((time.monotonic() - t_vote) * 1e3, 3),
         )
 
         if self._checkpoint_transport is not None:
