@@ -114,6 +114,32 @@ def test_rms_norm_matches_and_grads() -> None:
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
 
+    # The pallas-kernel variant (custom VJP; XLA fallback off-TPU) must
+    # agree with both, values and grads.
+    from torchft_tpu.ops import rms_norm_pallas
+
+    np.testing.assert_allclose(
+        np.asarray(rms_norm_pallas(x, w)), np.asarray(ref(x, w)),
+        rtol=1e-5, atol=1e-5,
+    )
+    g3 = jax.grad(lambda x, w: jnp.sum(rms_norm_pallas(x, w) ** 2), argnums=(0, 1))(x, w)
+    for a, b in zip(g3, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_rms_norm_pallas_kernel_interpret_matches() -> None:
+    """The pallas KERNEL body (not just the off-TPU fallback) vs reference,
+    via interpret mode — same pattern as the flash-attention kernel test."""
+    from torchft_tpu.ops.rmsnorm import _rms_pallas, rms_norm
+
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((96, 64)), dtype=jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64,)), dtype=jnp.float32)
+    out = _rms_pallas(x, w, eps=1e-6, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(rms_norm(x, w)), rtol=1e-5, atol=1e-5
+    )
+
 
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_full(causal) -> None:

@@ -1,9 +1,18 @@
-"""Fused RMSNorm.
+"""RMSNorm: XLA-fused default + a pallas kernel variant.
 
-Forward is a single pallas kernel (one HBM read of x, one write) on TPU;
-backward is expressed in XLA from the saved inverse-rms — cheaper than
-saving normalized activations and fully fusable into neighboring matmuls.
-Falls back to pure XLA off-TPU (the CPU test mesh runs the same model code).
+Two implementations, chosen by measurement:
+
+- ``rms_norm`` (the default, what the models use): plain XLA ops under
+  autodiff.  XLA fuses the normalization into the neighboring matmul
+  prologue/epilogue, so it costs ~no extra HBM pass.  Measured on v5e in
+  the full flagship model (12L d768 b16 s1024): 133.6 ms/step vs 137.6
+  with the hand-written kernel below — a custom kernel is a fusion
+  BARRIER, and for a memory-light op that costs more than the kernel
+  saves.
+- ``rms_norm_pallas``: single-kernel forward (one HBM read of x, one
+  write) with a custom VJP.  Wins when the norm genuinely stands alone
+  (no adjacent op to fuse into) or under compilers that fail to fuse;
+  kept tested (interpret mode on CPU) and exported for such workloads.
 """
 
 from __future__ import annotations
@@ -12,6 +21,22 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+__all__ = ["rms_norm", "rms_norm_pallas"]
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm over the last axis: ``x * rsqrt(mean(x^2)+eps) * w``.
+
+    f32 statistics regardless of input dtype; differentiable by autodiff
+    (no custom VJP — XLA's fused backward is the fast path, see module
+    docstring)."""
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * inv * w.astype(jnp.float32)).astype(x.dtype)
+
+
+# -- pallas kernel variant ---------------------------------------------------
 
 
 def _use_pallas() -> bool:
@@ -27,7 +52,9 @@ def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
     o_ref[...] = (x * inv * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def _rms_pallas(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def _rms_pallas(
+    x: jax.Array, w: jax.Array, eps: float, interpret: bool = False
+) -> jax.Array:
     from jax.experimental import pallas as pl
 
     rows = x.shape[0]
@@ -45,21 +72,14 @@ def _rms_pallas(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
             pl.BlockSpec((d,), lambda i: (0,)),
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        interpret=interpret,
     )(x, w)
 
 
-def _rms_reference(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
-    xf = x.astype(jnp.float32)
-    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (xf * inv * w.astype(jnp.float32)).astype(x.dtype)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def rms_norm(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
-    """RMSNorm over the last axis: ``x * rsqrt(mean(x^2)+eps) * w``.
-
-    Accepts any leading shape; the reduction axis is the last one.
-    """
+def rms_norm_pallas(x: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm as one pallas kernel (TPU) with a hand-written backward;
+    XLA fallback off-TPU.  See module docstring for when to prefer this."""
     return _rms_forward_impl(x, w, eps)
 
 
@@ -67,7 +87,7 @@ def _rms_forward_impl(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     if _use_pallas() and x.ndim >= 2:
         flat = x.reshape(-1, x.shape[-1])
         return _rms_pallas(flat, w, eps).reshape(x.shape)
-    return _rms_reference(x, w, eps)
+    return rms_norm(x, w, eps)
 
 
 def _rms_fwd(x, w, eps):
@@ -88,4 +108,4 @@ def _rms_bwd(eps, res, g):
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
-rms_norm.defvjp(_rms_fwd, _rms_bwd)
+rms_norm_pallas.defvjp(_rms_fwd, _rms_bwd)
