@@ -158,6 +158,103 @@ def test_manager_quorum_and_commit() -> None:
         lh.shutdown()
 
 
+def _multi_group_quorum(steps, init_sync=True, min_replicas=None):
+    """Runs one real Lighthouse + one real ManagerServer per replica group
+    (world_size=1) and collects each group's quorum response.
+
+    Exercises the NATIVE compute_quorum_results recovery planning end to
+    end (reference's pure-function tests: src/manager.rs:381-509 edge
+    cases), not a mocked QuorumResult."""
+    n = len(steps)
+    lh = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=min_replicas or n,
+        join_timeout_ms=2000,
+    )
+    mgrs = []
+    try:
+        for g in range(n):
+            mgrs.append(
+                ManagerServer(
+                    replica_id=f"g{g}",
+                    lighthouse_addr=lh.address(),
+                    bind="127.0.0.1:0",
+                    store_addr=f"store{g}:0",
+                    world_size=1,
+                )
+            )
+        results = {}
+
+        def flow(g: int) -> None:
+            client = ManagerClient(mgrs[g].address())
+            try:
+                results[g] = client._quorum(
+                    group_rank=0,
+                    step=steps[g],
+                    checkpoint_metadata=f"ckpt{g}",
+                    shrink_only=False,
+                    timeout_ms=10000,
+                    init_sync=init_sync,
+                )
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=flow, args=(g,)) for g in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(results) == list(range(n)), f"missing quorums: {results.keys()}"
+        return results
+    finally:
+        for m in mgrs:
+            m.shutdown()
+        lh.shutdown()
+
+
+def test_quorum_recovery_plan_behind_group_heals() -> None:
+    """Groups at steps (5, 5, 0): the behind group gets heal=True with an
+    up-to-date source; that source's response lists it as a destination."""
+    res = _multi_group_quorum([5, 5, 0])
+    behind = res[2]
+    assert behind.heal
+    assert behind.max_step == 5
+    up_to_date_ranks = {res[0].replica_rank, res[1].replica_rank}
+    assert behind.recover_src_replica_rank in up_to_date_ranks
+    assert behind.recover_src_manager_address
+    # Exactly one healthy group is assigned the behind group's rank.
+    dsts = [list(res[g].recover_dst_replica_ranks) for g in (0, 1)]
+    assert sorted(d for ds in dsts for d in ds) == [behind.replica_rank]
+    # Up-to-date groups do not heal and agree on max_step.
+    for g in (0, 1):
+        assert not res[g].heal
+        assert res[g].max_step == 5
+
+
+def test_quorum_recovery_round_robin_spreads_sources() -> None:
+    """Two behind groups, two up to date: recovery sources are striped, not
+    all assigned to one server (reference round-robin, (i+rank)%up_to_date)."""
+    res = _multi_group_quorum([7, 7, 0, 0])
+    behind = [res[g] for g in (2, 3)]
+    assert all(b.heal for b in behind)
+    srcs = {b.recover_src_replica_rank for b in behind}
+    assert len(srcs) == 2, f"both behind groups healed from one source: {srcs}"
+
+
+def test_quorum_init_sync_at_step_zero() -> None:
+    """All at step 0 with init_sync: everyone but replica 0 syncs initial
+    weights from it; with init_sync=False nobody heals."""
+    res = _multi_group_quorum([0, 0, 0], init_sync=True)
+    healers = [g for g in res if res[g].heal]
+    nonhealers = [g for g in res if not res[g].heal]
+    assert len(nonhealers) == 1 and len(healers) == 2
+    src_rank = res[nonhealers[0]].replica_rank
+    assert all(res[g].recover_src_replica_rank == src_rank for g in healers)
+
+    res2 = _multi_group_quorum([0, 0, 0], init_sync=False)
+    assert not any(res2[g].heal for g in res2)
+
+
 def test_store_roundtrip_and_prefix() -> None:
     store = StoreServer(bind="127.0.0.1:0")
     try:
