@@ -230,6 +230,54 @@ def test_invalid_reduce_op_fails_even_at_world_size_one(store) -> None:
         c.shutdown()
 
 
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+def test_bf16_wire_allreduce_accuracy_and_consistency(store, world_size) -> None:
+    """wire_dtype='bf16' halves ring payload bytes; results must stay
+    within bf16 rounding of the f32 reduction AND be BITWISE-identical
+    across ranks (replica consistency — the commit protocol's premise)."""
+    prefix = fresh_prefix()
+    rng = np.random.default_rng(11)
+    data = [rng.standard_normal(4096).astype(np.float32) for _ in range(world_size)]
+    expected = np.sum(data, axis=0)
+
+    def worker(rank: int):
+        c = TCPCollective(timeout=10.0, wire_dtype="bf16")
+        try:
+            c.configure(f"{store.address()}/{prefix}", rank, world_size)
+            out = c.allreduce([data[rank].copy()], op="sum").wait(timeout=20)[0]
+            # A MIXED float+int call must disable compression entirely
+            # (concatenate promotes to float64; quantizing would corrupt
+            # the int payload): both outputs exact.
+            fout, iout = c.allreduce(
+                [
+                    np.full(8, rank + 0.5, dtype=np.float32),
+                    np.full(16, 1000 * (rank + 1), dtype=np.int64),
+                ],
+                op="sum",
+            ).wait(timeout=20)
+            return out, fout, iout
+        finally:
+            c.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        results = [f.result(timeout=30) for f in
+                   [pool.submit(worker, r) for r in range(world_size)]]
+
+    for out, fout, iout in results:
+        # Per-hop bf16 quantization: error bounded by ~world_size ulps.
+        np.testing.assert_allclose(out, expected, rtol=0.02, atol=0.02 * world_size)
+        np.testing.assert_allclose(
+            fout, np.full(8, sum(r + 0.5 for r in range(world_size)),
+                          dtype=np.float32)
+        )
+        np.testing.assert_array_equal(
+            iout,
+            np.full(16, 1000 * sum(range(1, world_size + 1)), dtype=np.int64),
+        )
+    for out, _, _ in results[1:]:
+        np.testing.assert_array_equal(out, results[0][0])
+
+
 def test_managed_collective_rejects_non_average_ops() -> None:
     """Manager.allreduce averages over participants; max/min through the
     managed facade must fail loud, never silently return averaged data."""

@@ -404,10 +404,18 @@ class BabyCollective(Collective):
         return self._rank
 
 
-def BabyTCPCollective(timeout: float = 60.0, chunk_bytes: int = 4 << 20) -> BabyCollective:
+def BabyTCPCollective(
+    timeout: float = 60.0,
+    chunk_bytes: int = 4 << 20,
+    wire_dtype: str = "f32",
+) -> BabyCollective:
     """Crash-isolated TCPCollective (the BabyNCCL analogue)."""
     return BabyCollective(
         factory=_tcp_collective_factory,
-        factory_kwargs={"timeout": timeout, "chunk_bytes": chunk_bytes},
+        factory_kwargs={
+            "timeout": timeout,
+            "chunk_bytes": chunk_bytes,
+            "wire_dtype": wire_dtype,
+        },
         timeout=timeout,
     )
