@@ -1,0 +1,98 @@
+"""Shared mechanics for the example trainers.
+
+Only the non-instructive plumbing lives here (platform pinning, compile
+cache, Manager wiring, the FINAL digest); each example keeps its own train
+loop inline so it still reads as a tutorial for its parallelism style.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Callable, Optional
+
+
+def pin_platform_and_cache(virtual_devices: Optional[int] = None) -> None:
+    """Applies the environment contract every example shares, BEFORE the
+    first touch of the JAX backend:
+
+    - ``virtual_devices``: simulate one multi-device slice per process
+      (demo only; real hardware drops this).
+    - ``TPUFT_JAX_PLATFORM``: explicit platform pin — env JAX_PLATFORMS
+      alone can be overridden by site hooks after launch, and multi-process
+      drives must not share a single TPU chip.
+    - ``TPUFT_COMPILE_CACHE``: persistent compile cache so a restarted
+      replica re-JITs from disk, shrinking the recovery window.
+    """
+    if virtual_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={virtual_devices}"
+            ).strip()
+
+    import jax
+
+    forced = os.environ.get("TPUFT_JAX_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+    cache_dir = os.environ.get("TPUFT_COMPILE_CACHE")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def replica_env() -> tuple:
+    """(replica_group, num_replica_groups) from the launcher's env."""
+    return (
+        int(os.environ.get("REPLICA_GROUP_ID", 0)),
+        int(os.environ.get("NUM_REPLICA_GROUPS", 2)),
+    )
+
+
+def make_manager(
+    save: Callable[[], Any],
+    load: Callable[[Any], None],
+    replica_group: int,
+    *,
+    min_replicas: int = 1,
+    timeout_s: float = 30.0,
+    restore_sharding: Any = None,
+) -> Any:
+    """One-replica-group Manager with the examples' standard wiring:
+    TCPCollective data plane + HTTP checkpoint transport (optionally with a
+    sharding restorer for sharded-state healing)."""
+    from datetime import timedelta
+
+    from torchft_tpu import Manager, TCPCollective
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    return Manager(
+        collective=TCPCollective(timeout=timeout_s),
+        load_state_dict=load,
+        state_dict=save,
+        min_replica_size=min_replicas,
+        timeout=timedelta(seconds=timeout_s),
+        rank=0,
+        world_size=1,
+        replica_id=str(replica_group),
+        checkpoint_transport=HTTPTransport(
+            timeout=timeout_s, restore_sharding=restore_sharding
+        ),
+    )
+
+
+def params_digest(params: Any) -> str:
+    """Order-stable sha256 over every parameter leaf — the cross-group
+    convergence evidence each example prints at FINAL."""
+    import jax
+    import numpy as np
+
+    digest = hashlib.sha256()
+    leaves = sorted(
+        jax.tree_util.tree_leaves_with_path(params),
+        key=lambda kv: jax.tree_util.keystr(kv[0]),
+    )
+    for _, leaf in leaves:
+        digest.update(np.asarray(leaf).tobytes())
+    return digest.hexdigest()

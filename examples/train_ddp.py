@@ -28,13 +28,13 @@ print the same checksum.
 from __future__ import annotations
 
 import argparse
-import hashlib
 import logging
 import os
 import sys
-from datetime import timedelta
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from _common import make_manager, params_digest, pin_platform_and_cache, replica_env
 
 
 def main() -> None:
@@ -60,37 +60,16 @@ def main() -> None:
     parser.add_argument("--ckpt_every", type=int, default=10)
     args = parser.parse_args()
 
+    pin_platform_and_cache()
+
     import jax
-
-    # Env alone cannot force a platform here: the site hook may override
-    # $JAX_PLATFORMS after launch, so honor an explicit pin before backend
-    # init (multi-process drives must not share the single TPU chip).
-    forced = os.environ.get("TPUFT_JAX_PLATFORM")
-    if forced:
-        jax.config.update("jax_platforms", forced)
-
-    # Persistent compilation cache: a restarted replica re-JITs from disk in
-    # ~no time instead of recompiling, shrinking the recovery window — the
-    # dominant restart cost on both TPU pods and CPU hosts.
-    cache_dir = os.environ.get("TPUFT_COMPILE_CACHE")
-    if cache_dir:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-
     import numpy as np
     import optax
 
-    from torchft_tpu import (
-        GradientAverager,
-        Manager,
-        Optimizer,
-        TCPCollective,
-    )
-    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu import GradientAverager, Optimizer
     from torchft_tpu.data import DistributedSampler
 
-    replica_group = int(os.environ.get("REPLICA_GROUP_ID", 0))
-    num_groups = int(os.environ.get("NUM_REPLICA_GROUPS", 2))
+    replica_group, num_groups = replica_env()
 
     # -- model: tiny convnet on 32x32x3 inputs (CIFAR shaped) ----------------
     from torchft_tpu.models import convnet_loss, init_convnet_params
@@ -113,16 +92,8 @@ def main() -> None:
         state["opt"].params = sd["params"]
         state["opt"].opt_state = sd["opt_state"]
 
-    manager = Manager(
-        collective=TCPCollective(timeout=30.0),
-        load_state_dict=load,
-        state_dict=save,
-        min_replica_size=args.min_replicas,
-        timeout=timedelta(seconds=30),
-        rank=0,
-        world_size=1,
-        replica_id=str(replica_group),
-        checkpoint_transport=HTTPTransport(timeout=30.0),
+    manager = make_manager(
+        save, load, replica_group, min_replicas=args.min_replicas
     )
 
     state["opt"] = Optimizer(
@@ -180,11 +151,8 @@ def main() -> None:
                 flush=True,
             )
 
-        digest = hashlib.sha256()
-        for k in sorted(state["opt"].params):
-            digest.update(np.asarray(state["opt"].params[k]).tobytes())
         print(f"[group {replica_group}] FINAL step={manager.current_step()} "
-              f"params_sha256={digest.hexdigest()}", flush=True)
+              f"params_sha256={params_digest(state['opt'].params)}", flush=True)
     finally:
         if ckpt is not None:
             ckpt.shutdown()

@@ -1,22 +1,23 @@
-"""Fault-tolerant HSDP training example: shard inside the group, replicate
-across groups, heal sharded state live.
+"""Fault-tolerant pipeline-parallel training: GPipe inside the group,
+replicate across groups, heal pipeline-sharded state live.
 
-Reference parity: the reference's HSDP story is torch FSDP2 over a
-ManagedDeviceMesh (torchft/device_mesh.py:290-323, torchft/fsdp_test.py) —
-fault tolerance across the replicated dimension with FSDP/TP inside each
-replica group.  Here each process is one replica group whose transformer
-params are sharded over the group's own (fsdp x tensor) device mesh; groups
-average gradients through the Manager's fault-tolerant allreduce; a killed
-group restarts, heals its SHARDED state in place (NamedShardings restored on
-its own mesh) from a healthy peer, and rejoins.
+The composition the reference describes for FSDP/TP ("fault tolerance
+across the replicated dimension with any mix of ... across the other
+dimensions", reference README) — demonstrated here for PIPELINE
+parallelism, which the reference does not have at all (SURVEY.md §2.3).
+Each process is one replica group whose transformer layer stack is sharded
+across a pipeline mesh axis (stage-to-stage ppermute hops inside the jit
+step, parallel/pipeline.py); groups average gradients through the
+Manager's fault-tolerant allreduce; a killed group restarts and heals its
+PIPELINE-SHARDED state in place (NamedShardings restored onto its own
+mesh) from a healthy peer.
 
-Run (two supervised groups; each simulates a 4-device slice on CPU)::
+Run (two supervised groups; each simulates a pipeline x data slice on
+CPU — pin TPUFT_JAX_PLATFORM=cpu when a TPU is attached, it cannot be
+shared by two processes)::
 
-    python -m torchft_tpu.launch --groups 2 --max-restarts 3 -- \
-        python examples/train_hsdp.py --steps 200
-
-On real hardware drop the virtual-device flag: the group mesh is the TPU
-slice's ICI devices and the cross-group dimension rides DCN unchanged.
+    TPUFT_JAX_PLATFORM=cpu python -m torchft_tpu.launch --groups 2 \
+        --max-restarts 3 -- python examples/train_pipeline.py --steps 200
 """
 
 from __future__ import annotations
@@ -36,18 +37,28 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=100)
     parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--microbatches", type=int, default=2)
     parser.add_argument("--lr", type=float, default=3e-3)
     parser.add_argument(
-        "--devices", type=int, default=4,
-        help="virtual devices forming this group's (fsdp x tensor) mesh",
+        "--pipe", type=int, default=2, help="pipeline stages per group"
     )
     parser.add_argument(
-        "--ckpt_dir",
-        default=os.environ.get("TPUFT_CKPT_DIR", ""),
-        help="durable checkpoint directory; empty disables disk checkpoints",
+        "--devices", type=int, default=4,
+        help="virtual devices forming this group's (pipeline x data) mesh",
     )
-    parser.add_argument("--ckpt_every", type=int, default=20)
     args = parser.parse_args()
+
+    n_layers = 4
+    if args.devices % args.pipe:
+        parser.error(f"--devices {args.devices} not divisible by --pipe {args.pipe}")
+    data = args.devices // args.pipe
+    if n_layers % args.pipe:
+        parser.error(f"{n_layers} layers not divisible over --pipe {args.pipe}")
+    if args.batch % data or (args.batch // data) % args.microbatches:
+        parser.error(
+            f"--batch {args.batch} must divide over data axis {data} and "
+            f"then into --microbatches {args.microbatches}"
+        )
 
     pin_platform_and_cache(virtual_devices=args.devices)
 
@@ -59,30 +70,32 @@ def main() -> None:
     from torchft_tpu import GradientAverager, Optimizer
     from torchft_tpu.checkpointing.serialization import sharding_restorer
     from torchft_tpu.data import DistributedSampler
-    from torchft_tpu.models import TransformerConfig, init_params, loss_fn
+    from torchft_tpu.models import TransformerConfig, init_params
     from torchft_tpu.models.transformer import param_axes
     from torchft_tpu.parallel import TrainStep, ft_init_mesh
+    from torchft_tpu.parallel.pipeline import pipeline_loss_fn
 
     replica_group, num_groups = replica_env()
 
     cfg = TransformerConfig(
         vocab_size=512,
         d_model=128,
-        n_layers=2,
+        n_layers=n_layers,
         n_heads=4,
         n_kv_heads=4,
         d_ff=256,
         max_seq=64,
         dtype=jnp.float32,  # exact cross-group convergence for the demo
+        remat=False,
     )
     seq = 64
 
-    fsdp = max(1, args.devices // 2)
-    tensor = max(1, args.devices // fsdp)
-    ftmesh = ft_init_mesh({"fsdp": fsdp, "tensor": tensor})
+    ftmesh = ft_init_mesh({"pipeline": args.pipe, "data": data})
     step_fn = TrainStep(
         ftmesh, optax.sgd(args.lr),
-        lambda p, b: loss_fn(p, b, cfg, ftmesh.mesh, ftmesh.rules),
+        lambda p, b: pipeline_loss_fn(
+            p, b, cfg, ftmesh.mesh, num_microbatches=args.microbatches
+        ),
     )
 
     # Synthetic token stream, identical in every process (seeded).
@@ -95,8 +108,8 @@ def main() -> None:
         return {"params": state["opt"].params, "opt_state": state["opt"].opt_state}
 
     def load(sd):
-        # The transport restored NamedShardings onto THIS group's mesh
-        # (in-place sharded receive); adopt the healed trees as-is.
+        # The transport restored NamedShardings onto THIS group's mesh —
+        # the layer stack lands back sharded over the pipeline axis.
         state["opt"].params = sd["params"]
         state["opt"].opt_state = sd["opt_state"]
 
@@ -109,27 +122,6 @@ def main() -> None:
     state["opt"] = Optimizer(manager, optax.sgd(args.lr), params)
     averager = GradientAverager(manager)
 
-    # Durable SHARDED checkpoints: the disk format records NamedShardings,
-    # and restore places every leaf back onto this group's own
-    # (fsdp x tensor) mesh via the live tree's shardings — cold-start
-    # resume for a whole HSDP job, where peer healing has no live peer.
-    ckpt = None
-    if args.ckpt_dir:
-        from torchft_tpu.checkpointing import ManagedDiskCheckpoint
-
-        ckpt = ManagedDiskCheckpoint(
-            manager, save, load,
-            os.path.join(args.ckpt_dir, f"group_{replica_group}"),
-            every=args.ckpt_every,
-        )
-        ckpt_step = ckpt.restore()
-        if ckpt_step is not None:
-            print(
-                f"[group {replica_group}] resumed from disk checkpoint "
-                f"step={ckpt_step}",
-                flush=True,
-            )
-
     sampler = DistributedSampler(
         len(dataset),
         replica_group=replica_group,
@@ -141,8 +133,6 @@ def main() -> None:
         while manager.current_step() < args.steps:
             state["opt"].step_begin()
             step = manager.current_step()
-            # One sampler, re-seeded per step: a restarted group resumes the
-            # same shard permutation at the healed step.
             sampler.set_epoch(step)
             idx = [i for _, i in zip(range(args.batch), iter(sampler))]
             tokens = jnp.asarray(dataset[idx])
@@ -155,29 +145,22 @@ def main() -> None:
             loss, grads = step_fn.grads(state["opt"].params, batch)
             grads = averager.allreduce(grads)
             committed = state["opt"].step(grads)
-            if ckpt is not None:
-                ckpt.maybe_save(committed)
             print(
                 f"[group {replica_group}] step={step} loss={float(loss):.4f} "
                 f"participants={manager.num_participants()} committed={committed}",
                 flush=True,
             )
 
-        shardings = {
-            path[-1].key if hasattr(path[-1], "key") else str(path[-1]): str(leaf.sharding.spec)
-            for path, leaf in jax.tree_util.tree_leaves_with_path(
-                state["opt"].params["layers"]
-            )[:2]
-        }
+        layer_spec = str(
+            jax.tree_util.tree_leaves(state["opt"].params["layers"])[0].sharding.spec
+        )
         print(
             f"[group {replica_group}] FINAL step={manager.current_step()} "
             f"params_sha256={params_digest(state['opt'].params)} "
-            f"sample_shardings={shardings}",
+            f"layer_sharding={layer_spec}",
             flush=True,
         )
     finally:
-        if ckpt is not None:
-            ckpt.shutdown()
         manager.shutdown()
 
 

@@ -152,6 +152,7 @@ class Launcher:
         if self._log_dir is not None:
             if g.log is not None:
                 g.log.close()  # respawns must not leak the old handle
+            os.makedirs(self._log_dir, exist_ok=True)
             g.log = open(os.path.join(self._log_dir, f"g{group}.log"), "ab")
             stdout, stderr = g.log, subprocess.STDOUT
         g.proc = subprocess.Popen(
