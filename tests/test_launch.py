@@ -57,6 +57,19 @@ def test_launcher_env_plumbing_and_restart(tmp_path) -> None:
     assert f"gid 0 2 {launcher.lighthouse_address}" in log0
 
 
+def test_launcher_creates_log_dir(tmp_path) -> None:
+    """A nonexistent --log-dir is created, not a FileNotFoundError at the
+    first spawn (regression: the CLI died before starting any group)."""
+    log_dir = tmp_path / "nested" / "logs"
+    with Launcher(
+        [sys.executable, "-c", "print('ok')"],
+        num_groups=1,
+        lighthouse="embed",
+        log_dir=str(log_dir),
+    ):
+        _wait(lambda: (log_dir / "g0.log").exists())
+
+
 def test_launcher_hold_and_budget(tmp_path) -> None:
     """kill() with hold keeps the supervisor's hands off until spawn();
     an exhausted restart budget is reported, not retried."""
