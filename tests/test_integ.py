@@ -94,15 +94,46 @@ def ddp_train_loop(runner: Runner, rank: int) -> Dict[str, Any]:
     averager = GradientAverager(manager)
     grad_fn = jax.jit(jax.grad(_loss_fn))
 
+    # Optional scale-up-test knobs: ``keep_going`` keeps this group training
+    # past its target until the event is set (a finished group that merely
+    # heartbeats would starve a late joiner's collectives — real jobs train
+    # indefinitely, so the window never closes there);
+    # ``extra_steps_after_join`` makes the target RELATIVE to wherever this
+    # group lands after its first quorum/heal (a late joiner cannot know the
+    # leader's step in advance).  The first step seen and the max
+    # participant count observed are reported as evidence.
+    keep_going = runner.train_loop_args.get("keep_going")
+    extra_after_join = runner.train_loop_args.get("extra_steps_after_join")
+    progress_event = runner.train_loop_args.get("progress_event")
+    first_observed_step = None
+    max_participants = 0
+    target = None if extra_after_join is not None else total_steps
+
     try:
-        while manager.current_step() < total_steps:
+        while (
+            target is None
+            or manager.current_step() < target
+            or (keep_going is not None and not keep_going.is_set())
+        ):
             state["opt"].step_begin()
             step = manager.current_step()
             rrank = manager.participating_rank() or 0
             x, y = _batch(step, rrank)
             grads = grad_fn(state["opt"].params, x, y)
             grads = averager.allreduce(grads)
-            state["opt"].step(grads)
+            committed = state["opt"].step(grads)
+            if committed and first_observed_step is None:
+                # Latched only on a COMMITTED step (a transient first-step
+                # fault must not poison the relative target), read
+                # post-commit: with async quorum the heal fast-forward only
+                # lands by should_commit, so the pre-step counter still
+                # shows 0 on a healing joiner's first iteration.
+                first_observed_step = manager.current_step()
+                if target is None:
+                    target = first_observed_step + extra_after_join - 1
+            if progress_event is not None and manager.current_step() >= 3:
+                progress_event.set()
+            max_participants = max(max_participants, manager.num_participants())
             runner.failure_injector.check(runner.replica_id, manager.current_step())
         # Keep serving heals until every group is done: a replica that exits
         # early would strand a healing peer (its manager stops answering).
@@ -113,6 +144,8 @@ def ddp_train_loop(runner: Runner, rank: int) -> Dict[str, Any]:
             "params": {k: np.asarray(v) for k, v in state["opt"].params.items()},
             "step": manager.current_step(),
             "batches_committed": manager.batches_committed(),
+            "first_observed_step": first_observed_step,
+            "max_participants": max_participants,
         }
     finally:
         manager.shutdown()
@@ -326,6 +359,85 @@ def test_multi_rank_recovery(lighthouse) -> None:
     assert injector.count == 2
     assert all(r["step"] >= 7 for group in results for r in group)
     _assert_all_rank_params_equal(results)
+
+
+def test_elastic_scale_up_late_joiner() -> None:
+    """A BRAND-NEW group (not a restart) joins a running quorum mid-train:
+    the quorum grows, the joiner heals the leader's live state from behind
+    and trains merged to the target (the elasticity half of the reference's
+    membership model — the recovery tests only cover rejoin-after-kill).
+
+    The leader trains until the joiner is done (keep_going): a finished
+    group that merely heartbeats stays in the quorum and would starve the
+    joiner's collectives — real jobs train indefinitely, so the merged
+    window never closes there."""
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200)
+    try:
+        total = 12
+        joiner_done = threading.Event()
+
+        def make_runner(rid: int, args: Dict[str, Any]) -> Runner:
+            return Runner(
+                replica_id=rid,
+                lighthouse_address=lh.address(),
+                failure_injector=FailureInjector(),
+                train_loop=ddp_train_loop,
+                num_replicas=2,
+                train_loop_args=args,
+            )
+
+        results: Dict[int, Any] = {}
+        errors: List[BaseException] = []
+
+        def run(rid: int, args: Dict[str, Any]) -> None:
+            try:
+                results[rid] = make_runner(rid, args).run_replica()[0]
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                if rid == 1:
+                    joiner_done.set()  # never strand the leader
+
+        leader_progressed = threading.Event()
+        t0 = threading.Thread(
+            target=run,
+            args=(0, {
+                "total_steps": total,
+                "keep_going": joiner_done,
+                "progress_event": leader_progressed,
+            }),
+        )
+        t0.start()
+        # The newcomer must not exist until the leader has real progress
+        # (polling, not a fixed sleep — first-compile time varies with load).
+        assert leader_progressed.wait(timeout=60), "leader never reached step 3"
+        # The joiner's target is relative: heal to wherever the free-running
+        # leader is, then train `total` MERGED steps.
+        t1 = threading.Thread(
+            target=run, args=(1, {"extra_steps_after_join": total})
+        )
+        t1.start()
+        t1.join(timeout=120)
+        if t1.is_alive():
+            joiner_done.set()  # release the leader even on a wedged joiner
+        t0.join(timeout=120)
+        assert not t1.is_alive() and not t0.is_alive(), "threads still running"
+        assert not errors, errors
+        assert sorted(results) == [0, 1]
+
+        joiner = results[1]
+        # Scale-up evidence: the joiner healed forward instead of training
+        # from step 0 (a from-scratch group's first commit lands at step 1,
+        # and the leader was at >= 3 before the joiner existed)...
+        assert joiner["first_observed_step"] > 1
+        # ...and the window it trained was genuinely MERGED: the leader was
+        # present throughout (keep_going), so committed batches accumulate
+        # ~2 per step, which a solo run of the same steps cannot reach.
+        assert joiner["max_participants"] == 2
+        solo_max = joiner["step"] - joiner["first_observed_step"] + 1
+        assert joiner["batches_committed"] > solo_max + total // 2
+    finally:
+        lh.shutdown()
 
 
 def test_quorum_timeout(lighthouse) -> None:
