@@ -59,14 +59,17 @@ def test_scan_unroll_matches_scan() -> None:
 
     ref = np.asarray(forward(params, batch["tokens"], CFG))
     got = np.asarray(forward(params, batch["tokens"], cfg_u))
-    # Tight tolerance, not bitwise: unrolling changes XLA's fusion choices,
-    # which may differ in the last ulp on TPU.
-    np.testing.assert_allclose(ref, got, rtol=1e-6, atol=1e-6)
+    # Tight tolerance, not bitwise: full unroll is a static Python loop
+    # (different op association than scan), and fusion choices differ in
+    # the last ulps.
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
 
     g_ref = jax.grad(lambda p: loss_fn(p, batch, CFG))(params)
     g_got = jax.grad(lambda p: loss_fn(p, batch, cfg_u))(params)
     for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_got)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
 
 
 def test_sharded_matches_single_device() -> None:

@@ -50,6 +50,8 @@ class TransformerConfig:
     # Unroll factor for the scan-over-layers (1 = pure scan).  Unrolling
     # lets XLA fuse/pipeline across layer boundaries at the cost of compile
     # time; worthwhile on the perf path, keep 1 for fast test iteration.
+    # >= n_layers switches to a static Python loop (constant-folded layer
+    # indexing — see forward_with_aux), the fastest measured form.
     scan_unroll: int = 1
     # Mixture-of-experts: > 0 replaces the dense MLP with moe_experts
     # experts (stacked, shardable over the "expert" mesh axis).
@@ -274,6 +276,21 @@ def forward_with_aux(
 
     if cfg.remat:
         body = jax.checkpoint(body)
+    if cfg.scan_unroll > 1 and cfg.scan_unroll >= cfg.n_layers:
+        # Full unroll as a STATIC Python loop rather than lax.scan(unroll=L):
+        # scan's internal layer slicing survives as dynamic-update-slice
+        # fusions in the backward (profiled: ~17 ms/step of DUS on the v5e
+        # flagship config); static integer indexing lets XLA constant-fold
+        # the slices and fold the per-layer grad writes, measured ~4 ms/step
+        # faster end-to-end.  Same math, different op association — results
+        # agree with the scan path to fusion-order rounding, not bitwise
+        # (pinned by test_scan_unroll_matches_scan).
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.n_layers):
+            w_i = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+            x, aux = body(x, w_i)
+            aux_total = aux_total + aux
+        return head(params, x, cfg, mesh, rules), aux_total
     x, aux_layers = jax.lax.scan(
         body, x, params["layers"], unroll=cfg.scan_unroll
     )
