@@ -60,14 +60,12 @@ def _peak_flops(device) -> float | None:
 # ---------------------------------------------------------------------------
 
 
-def chip_benchmark() -> dict:
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
+def flagship_config():
+    """The headline benchmark model: (TransformerConfig, batch_size, seq).
 
-    from torchft_tpu.models import TransformerConfig, init_params, loss_fn
-    from torchft_tpu.parallel import TrainStep, ft_init_mesh
+    Shared with tools/profile_step.py so the per-op profile always
+    corresponds to the shape the recorded numbers describe."""
+    from torchft_tpu.models import TransformerConfig
 
     cfg = TransformerConfig(
         vocab_size=32000,
@@ -94,7 +92,19 @@ def chip_benchmark() -> dict:
         # Partial unroll (4) was slower than any of these.
         scan_unroll=12,
     )
-    batch_size, seq = 16, 1024
+    return cfg, 16, 1024
+
+
+def chip_benchmark() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu.models import init_params, loss_fn
+    from torchft_tpu.parallel import TrainStep, ft_init_mesh
+
+    cfg, batch_size, seq = flagship_config()
     tokens_per_step = batch_size * seq
 
     rng = np.random.default_rng(0)
