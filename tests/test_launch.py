@@ -111,3 +111,32 @@ def test_launch_cli_clean_exit(tmp_path) -> None:
 def test_launch_cli_requires_command() -> None:
     with pytest.raises(SystemExit):
         main(["--groups", "1", "--"])
+
+
+def test_crash_loop_backoff(tmp_path) -> None:
+    """A group that exits nonzero almost immediately is restarted with
+    exponential backoff, not at the supervisor's poll rate (ADVICE r3:
+    unbounded ~4 restarts/s on an instant-fail command)."""
+    with Launcher(
+        [sys.executable, "-c", "raise SystemExit(3)"],
+        num_groups=1,
+        lighthouse=None,
+        max_restarts=None,
+        log_dir=str(tmp_path),
+    ) as launcher:
+        _wait(lambda: launcher._groups[0].proc.poll() is not None)
+        # Tight supervision loop for 1.2s: without the brake this would
+        # restart ~5 times (0.25s/attempt incl. spawn); with 0.5s doubling
+        # backoff at most 2 restarts fit.
+        deadline = time.monotonic() + 1.2
+        while time.monotonic() < deadline:
+            launcher.supervise_once()
+            time.sleep(0.02)
+        assert launcher.restarts(0) <= 2
+        # And the brake does not wedge the supervisor: ANOTHER restart still
+        # lands once its (longer) backoff expires.
+        before = launcher.restarts(0)
+        _wait(
+            lambda: (launcher.supervise_once(), launcher.restarts(0) > before)[1],
+            timeout=10.0,
+        )

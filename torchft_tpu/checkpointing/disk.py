@@ -104,6 +104,10 @@ class DiskCheckpointer:
                 self._lock.wait(timeout=0.1)
             if self._shutdown:
                 raise RuntimeError("DiskCheckpointer is shut down")
+            # A write failure observed WHILE blocked in the backpressure wait
+            # must surface from this save, not the next one ("raised by the
+            # next save" contract counts from the call, not from entry).
+            self._raise_pending_error()
             self._pending = (step, meta, buffers)
             self._lock.notify_all()
 

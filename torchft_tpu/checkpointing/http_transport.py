@@ -28,8 +28,8 @@ from torchft_tpu.checkpointing.serialization import (
     as_u8,
     flatten_state_dict,
     read_state_dict,
+    state_dict_frames,
     unflatten_state_dict,
-    write_state_dict,
 )
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.http import ThreadingHTTPServerV6
@@ -97,19 +97,19 @@ class HTTPTransport(CheckpointTransport):
                             # Stream header + raw buffers straight to the
                             # socket: materializing a multi-GB BytesIO first
                             # is an extra full copy on the default healing
-                            # path.
-                            total = 8 + len(pickle.dumps(meta)) + sum(
-                                b.nbytes for b in buffers
-                            )
+                            # path.  state_dict_frames is the writer's own
+                            # framing, so Content-Length cannot drift from
+                            # what read_state_dict expects.
+                            prefix, total = state_dict_frames(meta, buffers)
                             self.send_response(200)
                             self.send_header(
                                 "Content-Type", "application/octet-stream"
                             )
                             self.send_header("Content-Length", str(total))
                             self.end_headers()
-                            # One source of truth for the wire format: the
-                            # same writer read_state_dict decodes.
-                            write_state_dict(meta, buffers, self.wfile)
+                            self.wfile.write(prefix)
+                            for b in buffers:
+                                self.wfile.write(memoryview(as_u8(b)))
                             return
                         payload = transport._render(meta, buffers, what)
                         if payload is None:
@@ -137,10 +137,9 @@ class HTTPTransport(CheckpointTransport):
         if what == "header":
             # Just the length-prefixed pickled StateDictMeta — what a chunked
             # receiver needs to size its buffers, without making the server
-            # materialize the full multi-GB stream.
-            header = pickle.dumps(meta)
-            out.write(len(header).to_bytes(8, "little"))
-            out.write(header)
+            # materialize the full multi-GB stream.  Same framing source as
+            # the /full path so the prefix format cannot drift.
+            out.write(state_dict_frames(meta, [])[0])
         elif what == "metadata":
             out.write(pickle.dumps(self._chunk_count(buffers)))
         elif what.startswith("chunk_"):

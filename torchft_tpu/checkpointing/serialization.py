@@ -23,6 +23,7 @@ __all__ = [
     "as_u8",
     "flatten_state_dict",
     "unflatten_state_dict",
+    "state_dict_frames",
     "write_state_dict",
     "read_state_dict",
     "sharding_restorer",
@@ -192,12 +193,23 @@ def sharding_restorer(state_dict_fn: Any) -> Any:
     return restore
 
 
+def state_dict_frames(
+    meta: StateDictMeta, buffers: List[np.ndarray]
+) -> Tuple[bytes, int]:
+    """Encodes the wire prefix (length header + pickled meta) ONCE and
+    returns it with the total frame length.  Callers that need a
+    Content-Length (http_transport) share this with the writer so the
+    framing can never drift from what write_state_dict emits."""
+    header = pickle.dumps(meta)
+    prefix = len(header).to_bytes(8, "little") + header
+    return prefix, len(prefix) + sum(b.nbytes for b in buffers)
+
+
 def write_state_dict(meta: StateDictMeta, buffers: List[np.ndarray], stream: io.RawIOBase) -> None:
     """Streams header + raw buffers (reference: streaming ser/de,
     torchft/checkpointing/_serialization.py:28-33)."""
-    header = pickle.dumps(meta)
-    stream.write(len(header).to_bytes(8, "little"))
-    stream.write(header)
+    prefix, _ = state_dict_frames(meta, buffers)
+    stream.write(prefix)
     for buf in buffers:
         stream.write(memoryview(as_u8(buf)))
 

@@ -27,6 +27,7 @@ Programmatic (this is what ``bench.py``'s kill scenario drives)::
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 import signal
 import subprocess
@@ -34,6 +35,12 @@ import sys
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# A group that exits in under this many seconds is treated as crash-looping
+# and restarted with exponential backoff rather than immediately.
+_MIN_UPTIME_S = 5.0
 
 __all__ = ["Launcher", "main"]
 
@@ -46,6 +53,15 @@ class _Group:
     held: bool = False  # killed on purpose; don't auto-restart until spawn()
     exited_clean: bool = False
     env: Dict[str, str] = field(default_factory=dict)
+    spawned_at: float = 0.0
+    # Crash-loop brake: a group that dies almost immediately (bad argv,
+    # import error) is restarted with exponential backoff instead of at the
+    # supervisor's poll rate (~4/s unbounded without this).
+    backoff_until: float = 0.0
+    backoff_s: float = 0.0
+    # Set when the death was OUR kill() (fault injection): exempt from the
+    # brake — the uptime check targets spontaneous fast-exits only.
+    killed_by_us: bool = False
 
 
 class Launcher:
@@ -145,6 +161,8 @@ class Launcher:
             raise RuntimeError(f"group {group} is already running")
         g.held = False
         g.exited_clean = False
+        g.backoff_until = 0.0  # explicit spawn overrides a pending backoff
+        g.killed_by_us = False  # the new process's exits are its own
         env = dict(self._base_env)
         env["REPLICA_GROUP_ID"] = str(group)
         env.update(g.env)
@@ -158,6 +176,7 @@ class Launcher:
         g.proc = subprocess.Popen(
             self._cmd, env=env, stdout=stdout, stderr=stderr, cwd=self._cwd
         )
+        g.spawned_at = time.monotonic()
 
     def kill(self, group: int, sig: int = signal.SIGKILL, hold: bool = True) -> None:
         """Kills one group (default SIGKILL — the fault-injection path).  With
@@ -167,6 +186,11 @@ class Launcher:
         if g.proc is not None and g.proc.poll() is None:
             g.proc.send_signal(sig)
             g.proc.wait()
+            # Only a death WE caused is exempt from the crash-loop brake; a
+            # process found already dead crashed on its own.  Reset the
+            # doubled delay too — the next incarnation's exits start fresh.
+            g.killed_by_us = True
+            g.backoff_s = 0.0
         g.held = hold
 
     def supervise_once(self) -> List[int]:
@@ -185,6 +209,31 @@ class Launcher:
                 continue
             if self._max_restarts is not None and g.restarts >= self._max_restarts:
                 continue
+            now = time.monotonic()
+            if g.killed_by_us:
+                g.killed_by_us = False
+                g.backoff_until = 0.0
+            elif g.backoff_until:
+                if now < g.backoff_until:
+                    continue
+                g.backoff_until = 0.0  # backoff served; fall through to restart
+            else:
+                uptime = now - g.spawned_at
+                if uptime < _MIN_UPTIME_S:
+                    # Died almost immediately: double the delay before the
+                    # next attempt (0.5s -> ... -> 30s cap) instead of
+                    # crash-looping at the caller's poll rate.
+                    g.backoff_s = min(30.0, max(0.5, g.backoff_s * 2))
+                    g.backoff_until = now + g.backoff_s
+                    logger.warning(
+                        "group %d exited with code %s after %.2fs; backing off "
+                        "%.1fs before restart %d",
+                        i, code, uptime, g.backoff_s, g.restarts + 1,
+                    )
+                    continue
+                g.backoff_s = 0.0  # healthy uptime resets the brake
+            logger.info("group %d exited with code %s; restarting (restart %d)",
+                        i, code, g.restarts + 1)
             g.restarts += 1
             self.spawn(i)
             restarted.append(i)

@@ -37,7 +37,11 @@ class MetricsLogger:
         self._file = None
         if path:
             try:
-                self._file = open(path, "a", buffering=1)  # line-buffered
+                # Unbuffered binary append: each record reaches the kernel as
+                # ONE write() call, so O_APPEND keeps whole lines atomic even
+                # with several processes sharing the file (stdio line
+                # buffering splits lines longer than ~8KB mid-record).
+                self._file = open(path, "ab", buffering=0)
             except OSError:
                 self._file = None  # metrics must never break training
 
@@ -55,9 +59,20 @@ class MetricsLogger:
         record = {"ts": time.time(), "replica_id": self._replica_id, "event": event}
         record.update(fields)
         try:
-            line = json.dumps(record, default=str)
+            line = (json.dumps(record, default=str) + "\n").encode()
             with self._lock:
-                self._file.write(line + "\n")
+                # Raw FileIO.write may return a short count without raising
+                # (signal mid-write, near-full disk).  Finish the line: a
+                # record with no trailing newline corrupts the NEXT record
+                # too.  The continuation write can interleave with another
+                # process in the (rare) short-write case — one torn record
+                # beats two.
+                view = memoryview(line)
+                while view:
+                    n = self._file.write(view)
+                    if not n:
+                        break
+                    view = view[n:]
         except Exception:  # noqa: BLE001 — see module docstring
             pass
 

@@ -69,6 +69,14 @@ def ulysses_attention_sharded(
     n = mesh.shape[seq_axis]
     tp = max(1, mesh.shape.get(head_axis, 1) if head_axis else 1)
     for name, heads in (("q", q.shape[1]), ("kv", k.shape[1])):
+        # Guard TP divisibility first (e.g. 2 kv heads over tp=4): without
+        # it, heads//tp floors to 0, 0 % n == 0 passes the check below, and
+        # the misconfiguration surfaces later as an opaque shard_map
+        # partitioning error instead of this message.
+        assert heads % tp == 0, (
+            f"Ulysses needs {name} heads ({heads}) divisible by the "
+            f"'{head_axis}' axis ({tp}); use ring attention otherwise"
+        )
         heads_local = heads // tp
         assert heads_local % n == 0, (
             f"Ulysses needs {name} heads-per-TP-shard ({heads_local}) divisible "
