@@ -95,6 +95,43 @@ def test_flash_attention_pallas_interpret_matches(causal) -> None:
     np.testing.assert_allclose(np.asarray(lse_pl), np.asarray(lse_ref), rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq", [1024, 4096])
+def test_flash_attention_bwd_pallas_interpret_matches(causal, seq) -> None:
+    """The backward pallas kernels vs the XLA flash backward, in interpret
+    mode on CPU — same pattern as the forward kernel test.  seq=1024
+    exercises the merged one-pass kernel (dq via f32 partials); seq=4096
+    has num_k=8 > _DQ_PARTIAL_MAX_K and exercises the two-pass
+    long-context form."""
+    from torchft_tpu.ops.attention import (
+        _DQ_PARTIAL_MAX_K,
+        _block_sizes,
+        _fa_bwd_pallas,
+        _fa_bwd_xla,
+        _fa_reference,
+    )
+
+    num_k = seq // _block_sizes(seq, seq)[1]
+    assert (num_k <= _DQ_PARTIAL_MAX_K) == (seq == 1024)
+
+    rng = np.random.default_rng(7)
+    bh = 2 if seq == 1024 else 1
+    q = jnp.asarray(rng.standard_normal((bh, seq, 128)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, seq, 128)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, seq, 128)), dtype=jnp.float32)
+    g = jnp.asarray(rng.standard_normal((bh, seq, 128)), dtype=jnp.float32)
+    scale = 0.088
+    o, lse = _fa_reference(q, k, v, scale, causal)
+    # _fa_bwd_xla explicitly, NOT _flash_bwd: on a TPU backend the latter
+    # dispatches to the pallas kernels, making the comparison vacuous.
+    d_ref = _fa_bwd_xla(q, k, v, o, lse, g, scale, causal)
+    d_pl = _fa_bwd_pallas(q, k, v, o, lse, g, scale, causal, interpret=True)
+    for a, b, name in zip(d_pl, d_ref, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3, err_msg=name
+        )
+
+
 def test_rms_norm_matches_and_grads() -> None:
     from torchft_tpu.ops import rms_norm
 

@@ -30,6 +30,7 @@ from torchft_tpu.checkpointing.serialization import (
     read_state_dict,
     state_dict_frames,
     unflatten_state_dict,
+    write_state_dict,
 )
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.http import ThreadingHTTPServerV6
@@ -107,9 +108,7 @@ class HTTPTransport(CheckpointTransport):
                             )
                             self.send_header("Content-Length", str(total))
                             self.end_headers()
-                            self.wfile.write(prefix)
-                            for b in buffers:
-                                self.wfile.write(memoryview(as_u8(b)))
+                            write_state_dict(meta, buffers, self.wfile, prefix=prefix)
                             return
                         payload = transport._render(meta, buffers, what)
                         if payload is None:

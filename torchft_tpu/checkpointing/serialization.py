@@ -205,10 +205,18 @@ def state_dict_frames(
     return prefix, len(prefix) + sum(b.nbytes for b in buffers)
 
 
-def write_state_dict(meta: StateDictMeta, buffers: List[np.ndarray], stream: io.RawIOBase) -> None:
+def write_state_dict(
+    meta: StateDictMeta,
+    buffers: List[np.ndarray],
+    stream: io.RawIOBase,
+    prefix: Optional[bytes] = None,
+) -> None:
     """Streams header + raw buffers (reference: streaming ser/de,
-    torchft/checkpointing/_serialization.py:28-33)."""
-    prefix, _ = state_dict_frames(meta, buffers)
+    torchft/checkpointing/_serialization.py:28-33).  A caller that already
+    encoded the prefix via state_dict_frames (to send a Content-Length)
+    passes it back in so the body framing comes from one place."""
+    if prefix is None:
+        prefix, _ = state_dict_frames(meta, buffers)
     stream.write(prefix)
     for buf in buffers:
         stream.write(memoryview(as_u8(buf)))

@@ -1,15 +1,24 @@
-"""Flash attention: pallas TPU forward kernel + flash-style XLA backward.
+"""Flash attention: pallas TPU forward + backward kernels.
 
 Design notes (MXU/HBM-minded):
   - forward streams K/V blocks through VMEM with the classic online-softmax
     accumulator, so HBM traffic is O(S*D) instead of materializing the
     O(S^2) score matrix;
   - the log-sum-exp per query row is saved, and the backward pass recomputes
-    scores blockwise in XLA from (q, k, lse) — the flash recompute trade:
-    extra FLOPs on the MXU instead of an O(S^2) residual in HBM;
-  - grid layout (batch*heads, q_blocks, kv_blocks) with the kv axis
-    innermost: TPU executes the innermost grid dimension sequentially, which
-    is what makes the VMEM scratch accumulator across kv blocks legal.
+    scores blockwise from (q, k, lse) — the flash recompute trade: extra
+    FLOPs on the MXU instead of an O(S^2) residual in HBM.  On TPU the
+    backward is ONE merged pallas kernel for typical shapes (q axis
+    innermost; dk/dv accumulate in VMEM scratch, dq is emitted as
+    per-kv-block f32 partials in HBM and summed in XLA — the s/p/dp/ds
+    tile work that dominates on the VPU is computed once).  When num_k
+    exceeds _DQ_PARTIAL_MAX_K the partials' (num_k, BH, S, D) transient
+    would dwarf dq itself, so long-context shapes switch to two passes
+    (dk/dv with q innermost, dq with kv innermost, both O(S*D) memory).
+    Off-TPU the same math is expressed in XLA with the scores
+    materialized;
+  - grid layout (batch*heads, outer_blocks, inner_blocks) with the
+    reduction axis innermost: TPU executes the innermost grid dimension
+    sequentially, which is what makes the VMEM scratch accumulator legal.
 
 Falls back to reference XLA attention off-TPU (CPU test mesh) or for shapes
 the kernel does not tile (seq not divisible by the block size).
@@ -42,6 +51,11 @@ def _use_pallas(seq_q: int, seq_k: int, head_dim: int) -> bool:
 
 
 def _block_sizes(seq_q: int, seq_k: int) -> Tuple[int, int]:
+    # 512x512: these kernels are VPU-bound on the S^2 elementwise tile, so
+    # the finest block that keeps the MXU fed wins — fatter q blocks were
+    # measured slower because causal masking can only skip whole blocks
+    # (a 1024-row block straddling the diagonal computes 33% more masked
+    # elements at the flagship seq=1024 than two 512-row blocks).
     return min(512, seq_q), min(512, seq_k)
 
 
@@ -65,12 +79,15 @@ def _fa_kernel(
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # Matmuls run in the INPUT dtype with f32 accumulation: bf16 model
+        # activations hit the MXU at full rate (an f32xf32 matmul runs at a
+        # fraction of it); softmax statistics stay f32 throughout.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [block_q, block_k]
+        ) * scale  # [block_q, block_k] f32
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -137,6 +154,230 @@ def _fa_pallas_call(q, k, v, scale: float, causal: bool, interpret: bool = False
     return out, lse_padded[:, :, 0]
 
 
+def _lse_col(lse_ref, qi, block_q: int):
+    """Select q-block rows from a row-stat block (1, 1, S) -> column
+    (block_q, 1).
+
+    Row statistics (lse, delta) enter as compact [BH, 1, S] arrays (4 KB
+    per visit) instead of the official kernels' lane-padded [BH, S, 128]
+    layout (260 KB per visit); the in-kernel slice + lane->sublane
+    relayout of block_q floats is measured noise."""
+    from jax.experimental import pallas as pl
+
+    seg = lse_ref[0, 0:1, pl.ds(qi * block_q, block_q)]  # (1, block_q)
+    return jnp.transpose(seg, (1, 0))
+
+
+# Above this many kv blocks the merged backward's per-kv-block dq partials
+# ((num_k, BH, S, D) f32 transient in HBM) cost more than a second
+# recompute pass; long-context shapes switch to the two-kernel form.
+_DQ_PARTIAL_MAX_K = 4
+
+
+def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+               *, scale, causal, block_q, block_k):
+    """Shared flash-backward block body: recomputes p and ds for the
+    (q-block qi, kv-block ki) tile.  Matmul operands stay in the input
+    dtype (bf16 on the model path = full MXU rate); probabilities and
+    statistics are f32.  Returns (p, ds) with ds cast to the input dtype
+    for the downstream MXU products."""
+    q = q_ref[0]
+    k = k_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                   # [block_q, block_k] f32
+    p = jnp.exp(s - _lse_col(lse_ref, qi, block_q))
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        p = jnp.where(rows >= cols, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                           # [block_q, block_k]
+    ds = (p * (dp - _lse_col(delta_ref, qi, block_q)) * scale).astype(q.dtype)
+    return p, ds
+
+
+def _fa_bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *rest,
+    scale: float, causal: bool, block_q: int, block_k: int, num_q: int,
+    emit_dq: bool,
+):
+    """Flash backward with the q axis innermost: dk/dv accumulate in VMEM
+    scratch across the sequential inner q dimension.  With emit_dq (the
+    merged one-pass form for typical shapes) the dq contribution of this
+    kv block is additionally emitted to a per-kv-block f32 partial (one
+    visit per output block, summed in XLA) — the s/p/dp/ds tile work that
+    dominates on the VPU is then computed once instead of twice."""
+    from jax.experimental import pallas as pl
+
+    if emit_dq:
+        dqp_ref, dk_scr, dv_scr = rest
+    else:
+        dk_scr, dv_scr = rest
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # Causal: a q block strictly above this kv block's diagonal contributes
+    # nothing — but its dq partial (if any) must still be zeroed.
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        p, ds = _bwd_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        do = do_ref[0]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # p^T @ do: [block_k, d]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # ds^T @ q: [block_k, d]
+        if emit_dq:
+            dqp_ref[0, 0] = jax.lax.dot(
+                ds, k_ref[0], preferred_element_type=jnp.float32
+            ).astype(dqp_ref.dtype)                 # ds @ k: [block_q, d]
+
+    if emit_dq and causal:
+        @pl.when(jnp.logical_not(run))
+        def _zero():
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    @pl.when(qi == num_q - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, num_k: int,
+):
+    """dq-only pass for the long-context form, kv axis innermost: dq
+    accumulates in f32 VMEM scratch, so memory stays O(S*D) regardless of
+    num_k (at the price of recomputing p/ds once more)."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        _, ds = _bwd_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        dq_scr[...] += jax.lax.dot(
+            ds, k_ref[0], preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == num_k - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _fa_bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
+                   interpret: bool = False):
+    """Flash backward on TPU; q/k/v/o/g: [BH, S, D], lse: [BH, S] f32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    block_q, block_k = _block_sizes(seq_q, seq_k)
+    num_q, num_k = seq_q // block_q, seq_k // block_k
+    # Row stats as [BH, 1, S]: whole row per visit (4 KB).  delta_i =
+    # rowsum(do * o) is O(S*D) and computed once here instead of per tile.
+    lse = lse[:, None, :]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )[:, None, :]
+
+    qo_spec_ji = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec_ji = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    row_spec_ji = pl.BlockSpec((1, 1, seq_q), lambda b, j, i: (b, 0, 0))
+    in_specs_ji = [qo_spec_ji, kv_spec_ji, kv_spec_ji, qo_spec_ji,
+                   row_spec_ji, row_spec_ji]
+    dkdv_scratch = [
+        pltpu.VMEM((block_k, d), jnp.float32),
+        pltpu.VMEM((block_k, d), jnp.float32),
+    ]
+    merged = num_k <= _DQ_PARTIAL_MAX_K
+    out_shape = [
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    out_specs = [kv_spec_ji, kv_spec_ji]
+    if merged:
+        # dq as f32 per-kv-block partials: the cross-block sum loses no
+        # precision vs the f32 XLA backward this replaced.
+        out_shape.append(
+            jax.ShapeDtypeStruct(
+                (num_k, bh, seq_q, d), q.dtype if num_k == 1 else jnp.float32
+            )
+        )
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q, d), lambda b, j, i: (j, b, i, 0))
+        )
+    outs = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dkdv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q=num_q, emit_dq=merged,
+        ),
+        out_shape=tuple(out_shape),
+        grid=(bh, num_k, num_q),
+        in_specs=in_specs_ji,
+        out_specs=tuple(out_specs),
+        scratch_shapes=dkdv_scratch,
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    if merged:
+        dk, dv, dq_part = outs
+        if num_k == 1:
+            dq = dq_part[0]
+        else:
+            dq = jnp.sum(dq_part, axis=0).astype(q.dtype)
+        return dq, dk, dv
+    dk, dv = outs
+
+    # Long-context second pass: dq with the kv axis innermost.
+    qo_spec_ij = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec_ij = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec_ij = pl.BlockSpec((1, 1, seq_q), lambda b, i, j: (b, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k=num_k,
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, num_q, num_k),
+        in_specs=[qo_spec_ij, kv_spec_ij, kv_spec_ij, qo_spec_ij,
+                  row_spec_ij, row_spec_ij],
+        out_specs=qo_spec_ij,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
 def _fa_reference(q, k, v, scale: float, causal: bool):
     """Stable XLA attention returning (out, lse); q/k/v: [BH, S, D]."""
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
@@ -172,6 +413,14 @@ def _flash_fwd(q, k, v, scale, causal):
 
 def _flash_bwd(scale, causal, res, g):
     q, k, v, o, lse = res
+    if _use_pallas(q.shape[1], k.shape[1], q.shape[2]):
+        return _fa_bwd_pallas(q, k, v, o, lse, g, scale, causal)
+    return _fa_bwd_xla(q, k, v, o, lse, g, scale, causal)
+
+
+def _fa_bwd_xla(q, k, v, o, lse, g, scale, causal):
+    """Off-TPU backward: same math with the scores materialized in XLA.
+    Also the oracle the pallas backward kernels are tested against."""
     qf, kf, vf, gf = (t.astype(jnp.float32) for t in (q, k, v, g))
     s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
     if causal:
