@@ -36,11 +36,16 @@ import tempfile
 import time
 
 # (device_kind substring, bf16 peak FLOP/s) — checked in order.
+# NOTE: v5e's widely-quoted 394 TFLOP/s is the INT8 figure; bf16 peak is
+# 197 TFLOP/s.  Rounds 1-3 used 394 here, which understated MFU by 2x and
+# manufactured the "4x off roofline" mystery — per-op profiling (round 4)
+# shows the big bf16 matmul fusions sustaining ~187 TFLOP/s, i.e. ~95% of
+# the real peak, which is what pinned the error to this table.
 _PEAKS = [
     ("v6", 918e12),
     ("v5p", 459e12),
-    ("v5 lite", 394e12),  # v5e reports "TPU v5 lite"
-    ("v5e", 394e12),
+    ("v5 lite", 197e12),  # v5e reports "TPU v5 lite"
+    ("v5e", 197e12),
     ("v4", 275e12),
     ("v3", 123e12),
     ("v2", 46e12),

@@ -254,15 +254,16 @@ def _layer(cfg: TransformerConfig, mesh, rules: ShardingRules, x, w, positions):
     return constrain(x, ("batch", "seq", "embed"), mesh, rules), aux
 
 
-def forward_with_aux(
+def _decoder(
     params: Dict[str, Any],
     tokens: jax.Array,
     cfg: TransformerConfig,
     mesh=None,
     rules: Optional[ShardingRules] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """tokens: [B, S] int32 -> (logits [B, S, vocab] f32, aux scalar f32 —
-    the summed MoE load-balance loss; zero for dense models)."""
+    """Embedding + decoder stack (everything before the lm head).
+    tokens: [B, S] int32 -> (hidden [B, S, E], aux scalar f32 — the summed
+    MoE load-balance loss; zero for dense models)."""
     rules = rules or ShardingRules()
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -290,12 +291,24 @@ def forward_with_aux(
             w_i = jax.tree.map(lambda a, i=i: a[i], params["layers"])
             x, aux = body(x, w_i)
             aux_total = aux_total + aux
-        return head(params, x, cfg, mesh, rules), aux_total
+        return x, aux_total
     x, aux_layers = jax.lax.scan(
         body, x, params["layers"], unroll=cfg.scan_unroll
     )
+    return x, jnp.sum(aux_layers)
 
-    return head(params, x, cfg, mesh, rules), jnp.sum(aux_layers)
+
+def forward_with_aux(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """tokens: [B, S] int32 -> (logits [B, S, vocab] f32, aux scalar f32 —
+    the summed MoE load-balance loss; zero for dense models)."""
+    x, aux = _decoder(params, tokens, cfg, mesh, rules)
+    return head(params, x, cfg, mesh, rules), aux
 
 
 def head(
@@ -338,6 +351,37 @@ def forward(
     return forward_with_aux(params, tokens, cfg, mesh, rules)[0]
 
 
+def lm_head_loss(
+    params: Dict[str, Any],
+    x: jax.Array,
+    cfg: TransformerConfig,
+    targets: jax.Array,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+) -> jax.Array:
+    """Mean next-token CE from decoder output x [B, S, E].
+
+    On a single TPU device this fuses the lm-head matmul with the CE
+    reduction (ops/cross_entropy.py) so the f32 [B, S, vocab] logits —
+    the single biggest activation, ~2 GB at the flagship config — never
+    reach HBM in either direction of autodiff.  Sharded meshes and
+    off-TPU backends keep the plain XLA formulation, whose shardings
+    (e.g. vocab-parallel logsumexp) propagate natively."""
+    from torchft_tpu.ops.cross_entropy import (
+        fused_ce_applicable,
+        fused_linear_cross_entropy,
+    )
+
+    B, S, E = x.shape
+    if fused_ce_applicable(B * S, E, cfg.vocab_size, mesh):
+        h = rms_norm(x, params["final_norm"])
+        w = params["lm_head"].astype(cfg.dtype)
+        return fused_linear_cross_entropy(
+            h.reshape(B * S, E), w, targets.reshape(B * S)
+        )
+    return token_cross_entropy(head(params, x, cfg, mesh, rules), targets)
+
+
 def loss_fn(
     params: Dict[str, Any],
     batch: Dict[str, jax.Array],
@@ -349,8 +393,8 @@ def loss_fn(
 
     MoE configs add moe_aux_coef * load-balance loss (Switch-style).
     """
-    logits, aux = forward_with_aux(params, batch["tokens"], cfg, mesh, rules)
-    ce = token_cross_entropy(logits, batch["targets"])
+    x, aux = _decoder(params, batch["tokens"], cfg, mesh, rules)
+    ce = lm_head_loss(params, x, cfg, batch["targets"], mesh, rules)
     if cfg.moe_experts > 0:
         ce = ce + cfg.moe_aux_coef * aux
     return ce

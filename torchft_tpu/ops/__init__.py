@@ -8,12 +8,18 @@ so the same model code runs under the CPU test mesh.
 """
 
 from torchft_tpu.ops.attention import flash_attention
+from torchft_tpu.ops.cross_entropy import (
+    fused_ce_applicable,
+    fused_linear_cross_entropy,
+)
 from torchft_tpu.ops.ring_attention import ring_attention
 from torchft_tpu.ops.rmsnorm import rms_norm, rms_norm_pallas
 from torchft_tpu.ops.ulysses import ulysses_attention
 
 __all__ = [
     "flash_attention",
+    "fused_ce_applicable",
+    "fused_linear_cross_entropy",
     "ring_attention",
     "rms_norm",
     "rms_norm_pallas",

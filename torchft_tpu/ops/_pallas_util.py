@@ -1,0 +1,29 @@
+"""Small helpers shared by the pallas TPU kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LANE = 128  # TPU lane width
+
+
+def on_tpu() -> bool:
+    """True when the default backend is a real TPU (pallas kernels apply)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def row_stat_col(ref, idx, block: int):
+    """Row-stat block (1, 1, N) -> column (block, 1) for row-block idx.
+
+    Row statistics (lse, delta, targets) enter kernels as compact
+    [.., 1, N] arrays (4 KB per visit) instead of the official kernels'
+    lane-padded [.., N, 128] layout (260 KB per visit); the in-kernel
+    slice + lane->sublane relayout of `block` elements is measured noise."""
+    from jax.experimental import pallas as pl
+
+    seg = ref[0, 0:1, pl.ds(idx * block, block)]  # (1, block)
+    return jnp.transpose(seg, (1, 0))

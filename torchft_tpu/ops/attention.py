@@ -32,6 +32,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from torchft_tpu.ops._pallas_util import row_stat_col
+
 _NEG_INF = -1e30
 _LANE = 128  # TPU lane width: scratch row-stats are kept (block_q, 128)
 
@@ -154,20 +156,6 @@ def _fa_pallas_call(q, k, v, scale: float, causal: bool, interpret: bool = False
     return out, lse_padded[:, :, 0]
 
 
-def _lse_col(lse_ref, qi, block_q: int):
-    """Select q-block rows from a row-stat block (1, 1, S) -> column
-    (block_q, 1).
-
-    Row statistics (lse, delta) enter as compact [BH, 1, S] arrays (4 KB
-    per visit) instead of the official kernels' lane-padded [BH, S, 128]
-    layout (260 KB per visit); the in-kernel slice + lane->sublane
-    relayout of block_q floats is measured noise."""
-    from jax.experimental import pallas as pl
-
-    seg = lse_ref[0, 0:1, pl.ds(qi * block_q, block_q)]  # (1, block_q)
-    return jnp.transpose(seg, (1, 0))
-
-
 # Above this many kv blocks the merged backward's per-kv-block dq partials
 # ((num_k, BH, S, D) f32 transient in HBM) cost more than a second
 # recompute pass; long-context shapes switch to the two-kernel form.
@@ -187,7 +175,7 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale                                   # [block_q, block_k] f32
-    p = jnp.exp(s - _lse_col(lse_ref, qi, block_q))
+    p = jnp.exp(s - row_stat_col(lse_ref, qi, block_q))
     if causal:
         rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -196,7 +184,7 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
         do, v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                           # [block_q, block_k]
-    ds = (p * (dp - _lse_col(delta_ref, qi, block_q)) * scale).astype(q.dtype)
+    ds = (p * (dp - row_stat_col(delta_ref, qi, block_q)) * scale).astype(q.dtype)
     return p, ds
 
 

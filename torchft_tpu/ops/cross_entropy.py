@@ -1,0 +1,273 @@
+"""Fused lm-head + cross-entropy: pallas TPU kernels, never materializing
+the f32 [N, vocab] logits in HBM.
+
+Motivation (flagship profile, v5e): the unfused path — bf16 [N, E] @ [E, V]
+matmul to f32 logits, logsumexp, target gather, then the backward's softmax
+recompute and two grad matmuls — moves the 2.1 GB f32 logits array through
+HBM repeatedly (~18 ms/step of pure bandwidth), and holds it as an autodiff
+residual.  The fused op:
+
+  forward   — one kernel, grid (row_blocks, vocab_blocks) with vocab
+              innermost: online logsumexp in VMEM scratch; only the O(N)
+              lse ever reaches HBM.  The target logit is extracted
+              OUTSIDE the kernel as rowsum(x * w.T[targets]) — an O(N*E)
+              gather+reduce in XLA — because the in-kernel
+              iota/compare/select variant added ~4 VPU passes over the
+              full [N, V] tile stream (measured slower than the XLA
+              gather by ~1 ms).
+  backward  — one kernel recomputes the logits block, forms the scaled
+              bf16 dlogits = (softmax - onehot) * g/N tile, and writes it
+              once; dx and dw are then plain XLA bf16 matmuls (XLA runs
+              them near MXU peak, which hand-written accumulation kernels
+              measured 2x worse at).  Peak transient is the bf16 [N, V]
+              dlogits (half the f32 logits the unfused path keeps alive),
+              and the f32 logits never exist.
+
+The reference has no analogue (torch CE over materialized logits); this op
+exists because the TPU build owns its compute path.  Off-TPU (CPU test
+mesh) and for shapes the kernels do not tile, callers should use the plain
+XLA formulation (see models/transformer.lm_head_loss) — this module only
+decides applicability via `fused_ce_applicable`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchft_tpu.ops._pallas_util import on_tpu, row_stat_col
+
+_LANE = 128
+
+# Per-operand VMEM budgets the block sizes are solved against (double
+# buffering means each block effectively costs ~2x its size; the f32
+# logits tile [block_rows, block_v] is the largest single allocation).
+_X_BLOCK_BYTES = 2 * 1024 * 1024
+_W_BLOCK_BYTES = 3 * 1024 * 1024
+
+
+def _block_v(v: int, e: int) -> Optional[int]:
+    """Largest multiple of 128 dividing V whose [E, block_v] bf16 tile
+    fits the weight budget, capped at 2048."""
+    cap = min(2048, _W_BLOCK_BYTES // (2 * e) // _LANE * _LANE)
+    best = None
+    for mult in range(1, max(cap, _LANE) // _LANE + 1):
+        cand = mult * _LANE
+        if v % cand == 0:
+            best = cand
+    return best
+
+
+def _block_rows(n: int, e: int) -> Optional[int]:
+    """Largest power-of-two row block whose [block_rows, E] bf16 tile
+    fits the activation budget."""
+    cap = _X_BLOCK_BYTES // (2 * e)
+    for cand in (1024, 512, 256, 128):
+        if cand <= cap and n % cand == 0:
+            return cand
+    return None
+
+
+def fused_ce_applicable(n: int, e: int, v: int, mesh=None) -> bool:
+    """True when the pallas kernels can and should run.
+
+    mesh.size > 1 is excluded: a pallas custom call has no SPMD
+    partitioning rule, so under a real multi-device mesh XLA would
+    all-gather the operands to run it replicated — correct but a perf
+    cliff.  Sharded configurations keep the plain XLA formulation, which
+    propagates shardings (vocab-parallel logsumexp etc.) natively.
+    """
+    if not on_tpu():
+        return False
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        return False
+    # Blocks are solved against explicit per-operand VMEM budgets, so the
+    # gate is simply "a valid tiling exists" — no separate size check that
+    # could drift from what the kernels actually allocate.
+    return (
+        _block_v(v, e) is not None
+        and _block_rows(n, e) is not None
+        and e % _LANE == 0
+    )
+
+
+def _ce_lse_kernel(
+    x_ref, w_ref, lse_ref, m_scr, l_scr, *, num_v: int,
+):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    # Logits block in the input dtype (bf16 = full MXU rate), f32 accum.
+    s = jax.lax.dot(
+        x_ref[...], w_ref[...], preferred_element_type=jnp.float32
+    )                                              # [block_rows, block_v]
+    m_prev = m_scr[:, :1]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_cur)
+    l_new = alpha * l_scr[:, :1] + jnp.sum(
+        jnp.exp(s - m_cur), axis=-1, keepdims=True
+    )
+    m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_v - 1)
+    def _emit():
+        lse = m_scr[:, :1] + jnp.log(l_scr[:, :1])     # (block_rows, 1)
+        lse_ref[0, 0:1, :] = jnp.transpose(lse, (1, 0))
+
+
+def _ce_dlogits_kernel(
+    x_ref, w_ref, tgt_ref, lse_ref, scale_ref, dl_ref,
+    *, block_rows: int, block_v: int,
+):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    s = jax.lax.dot(
+        x_ref[...], w_ref[...], preferred_element_type=jnp.float32
+    )
+    p = jnp.exp(s - row_stat_col(lse_ref, i, block_rows))
+    tg = row_stat_col(tgt_ref, i, block_rows)
+    cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    p = jnp.where(cols == tg, p - 1.0, p)          # softmax - onehot
+    dl_ref[...] = (p * scale_ref[0, 0]).astype(dl_ref.dtype)
+
+
+def _ce_lse_pallas(x, w, interpret: bool = False):
+    """x: [N, E], w: [E, V] (same dtype as x) -> lse [N] f32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, e = x.shape
+    v = w.shape[1]
+    br, bv = _block_rows(n, e), _block_v(v, e)
+    num_i, num_v = n // br, v // bv
+
+    lse = pl.pallas_call(
+        functools.partial(_ce_lse_kernel, num_v=num_v),
+        out_shape=jax.ShapeDtypeStruct((1, 1, n), jnp.float32),
+        grid=(num_i, num_v),
+        in_specs=[
+            pl.BlockSpec((br, e), lambda i, j: (i, 0)),        # x
+            pl.BlockSpec((e, bv), lambda i, j: (0, j)),        # w
+        ],
+        out_specs=pl.BlockSpec((1, 1, br), lambda i, j: (0, 0, i)),
+        scratch_shapes=[
+            pltpu.VMEM((br, _LANE), jnp.float32),   # running max
+            pltpu.VMEM((br, _LANE), jnp.float32),   # running sumexp
+        ],
+        interpret=interpret,
+    )(x, w)
+    return lse[0, 0]
+
+
+def _ce_dlogits_pallas(x, w, targets, lse, scale, interpret: bool = False):
+    """Scaled bf16 dlogits = (softmax(x@w) - onehot(targets)) * scale.
+    scale is a traced scalar (folded in here so no extra [N, V] pass)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, e = x.shape
+    v = w.shape[1]
+    br, bv = _block_rows(n, e), _block_v(v, e)
+    num_i, num_v = n // br, v // bv
+    tgt = targets.astype(jnp.int32)[None, None, :]
+    lse3 = lse[None, None, :]
+    scale2 = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+
+    return pl.pallas_call(
+        functools.partial(_ce_dlogits_kernel, block_rows=br, block_v=bv),
+        out_shape=jax.ShapeDtypeStruct((n, v), x.dtype),
+        grid=(num_i, num_v),
+        in_specs=[
+            pl.BlockSpec((br, e), lambda i, j: (i, 0)),        # x
+            pl.BlockSpec((e, bv), lambda i, j: (0, j)),        # w
+            pl.BlockSpec((1, 1, n), lambda i, j: (0, 0, 0)),   # targets
+            pl.BlockSpec((1, 1, n), lambda i, j: (0, 0, 0)),   # lse
+            pl.BlockSpec(memory_space=pltpu.SMEM),             # scale
+        ],
+        out_specs=pl.BlockSpec((br, bv), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(x, w, tgt, lse3, scale2)
+
+
+def _target_logit(x, w, targets):
+    """rowsum(x * w[:, t]): O(N*E) gather + reduce, no [N, V] involved.
+    w.T is materialized so the gather reads contiguous rows."""
+    wt = jnp.transpose(w)[targets]                 # [N, E]
+    return jnp.einsum(
+        "ne,ne->n", x, wt, preferred_element_type=jnp.float32
+    )
+
+
+@jax.custom_vjp
+def fused_linear_cross_entropy(x, w, targets):
+    """Mean cross-entropy of softmax(x @ w) against integer targets,
+    computed blockwise on TPU so the f32 [N, V] logits never reach HBM.
+
+    x: [N, E] (bf16 on the model path), w: [E, V] same dtype, targets:
+    [N] integer.  Returns a f32 scalar.  Callers gate on
+    fused_ce_applicable; off-TPU the same math runs as one materialized
+    XLA computation (used by the correctness tests)."""
+    lse, tl = _ce_fwd(x, w, targets)
+    return jnp.mean(lse - tl)
+
+
+def _ce_fwd(x, w, targets, interpret: bool = False):
+    if on_tpu() or interpret:
+        lse = _ce_lse_pallas(x, w, interpret=interpret)
+        return lse, _target_logit(x, w, targets)
+    logits = jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tl = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
+    return lse, tl
+
+
+def _ce_vjp_fwd(x, w, targets):
+    lse, tl = _ce_fwd(x, w, targets)
+    return jnp.mean(lse - tl), (x, w, targets, lse)
+
+
+def _ce_vjp_bwd(res, g):
+    x, w, targets, lse = res
+    n = x.shape[0]
+    scale = g / n
+    if on_tpu():
+        # dlogits tile-by-tile in bf16 (pallas) — the f32 logits never
+        # exist in HBM.
+        dl = _ce_dlogits_pallas(x, w, targets, lse, scale)
+    else:
+        logits = jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])
+        p = p - jax.nn.one_hot(targets, w.shape[1], dtype=jnp.float32)
+        dl = (p * scale).astype(x.dtype)
+    # Two plain XLA matmuls — XLA runs these bf16 matmuls near MXU peak,
+    # which hand-written scratch-accumulation kernels measured 2x worse at.
+    dx = jax.lax.dot_general(
+        dl, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dw = jax.lax.dot_general(
+        x, dl, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (
+        dx.astype(x.dtype),
+        dw.astype(w.dtype),
+        np.zeros(targets.shape, jax.dtypes.float0),
+    )
+
+
+fused_linear_cross_entropy.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)

@@ -168,7 +168,7 @@ def pipeline_loss_fn(
     decoder stack runs as a GPipe schedule.  Dense configs only — the MoE
     aux loss needs the all-stage reduction the dense path doesn't have.
     """
-    from torchft_tpu.models.transformer import _layer, head, token_cross_entropy
+    from torchft_tpu.models.transformer import _layer, lm_head_loss
 
     assert cfg.moe_experts == 0, "pipeline_loss_fn supports dense configs only"
     tokens = batch["tokens"]
@@ -196,4 +196,7 @@ def pipeline_loss_fn(
         batch_axis=batch_axis,
     )
 
-    return token_cross_entropy(head(params, x, cfg), batch["targets"])
+    # Shared lm-head + CE helper (fused on single-chip TPU, plain XLA under
+    # the pipeline mesh) so the pipelined loss can never diverge from the
+    # dense loss_fn.
+    return lm_head_loss(params, x, cfg, batch["targets"], mesh)
