@@ -289,12 +289,14 @@ def _run_scenario(
         },
         cwd=repo,
     )
+    kill_ts = None
     with launcher:
         start = time.monotonic()
         killed = kill_at_s is None
         while time.monotonic() - start < window_s:
             time.sleep(0.25)
             if not killed and time.monotonic() - start >= kill_at_s:
+                kill_ts = time.time()  # metrics events use time.time()
                 launcher.kill(1)  # SIGKILL, the real thing
                 killed = True
                 time.sleep(3.0)  # restart delay: the dead window is real
@@ -302,61 +304,220 @@ def _run_scenario(
             # Supervisor: restart any group that died for other reasons.
             launcher.supervise_once()
 
-    committed = 0
-    healed = 0
+    return _scenario_stats(workdir, metrics_path, kill_ts)
+
+
+def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> dict:
+    """Parses the metrics stream into per-group committed counts and (for
+    kill runs) the victim's measured downtime.
+
+    Counting starts at t0 = the first moment BOTH groups have committed a
+    step, so startup JIT compilation is excluded from the counts (not just
+    from the wall window).  Group identity is the prefix of replica_id
+    ("<group>:<uuid>")."""
+    events = []
     try:
         with open(metrics_path, "rb") as f:
             for line in f:
                 try:
-                    ev = json.loads(line)
+                    events.append(json.loads(line))
                 except ValueError:
                     continue
-                if ev.get("event") == "commit" and ev.get("committed"):
-                    committed += 1
-                if ev.get("event") == "heal_fetched":
-                    healed += 1
     except OSError:
         pass
-    if committed == 0:
+
+    commits: dict[str, list[float]] = {}
+    heals = 0
+    heal_ms: list[float] = []
+    for ev in events:
+        if ev.get("event") == "commit" and ev.get("committed"):
+            group = str(ev.get("replica_id", "")).split(":", 1)[0]
+            commits.setdefault(group, []).append(float(ev["ts"]))
+        elif ev.get("event") == "heal_fetched":
+            heals += 1
+            if ev.get("heal_ms") is not None:
+                heal_ms.append(float(ev["heal_ms"]))
+
+    if not commits:
         # Metrics stream missing or empty: fall back to the log contract
-        # (pinned by tests/test_bench_contract.py).  Drop any metrics-derived
-        # heal count so the two sources are never mixed.
-        healed = 0
+        # (pinned by tests/test_bench_contract.py) — totals only, no
+        # per-group timing.
+        committed = 0
+        heals = 0
         for g in (0, 1):
             path = os.path.join(workdir, f"g{g}.log")
-            with open(path, "rb") as f:
-                for line in f:
-                    if b"committed=True" in line:
-                        committed += 1
-                    if b"healing from replica" in line:
-                        healed += 1
-    return {"committed_batches": committed, "heals": healed}
+            try:
+                with open(path, "rb") as f:
+                    for line in f:
+                        if b"committed=True" in line:
+                            committed += 1
+                        if b"healing from replica" in line:
+                            heals += 1
+            except OSError:
+                pass
+        return {
+            "committed_batches": committed,
+            "per_group": {},
+            "heals": heals,
+            "heal_ms": [],
+            "victim_downtime_s": None,
+            "goodput_self_fraction": None,
+            "metrics_stream": False,
+        }
+
+    t0 = max(min(ts_list) for ts_list in commits.values())
+    per_group = {
+        g: sum(1 for ts in ts_list if ts >= t0)
+        for g, ts_list in sorted(commits.items())
+    }
+
+    victim_downtime = None
+    self_fraction = None
+    if kill_ts is not None and "1" in commits:
+        before = [ts for ts in commits["1"] if ts <= kill_ts]
+        after = [ts for ts in commits["1"] if ts > kill_ts]
+        if before and after:
+            victim_downtime = min(after) - max(before)
+        # Self-normalized goodput: the victim's total committed count vs
+        # its own pre-kill rate extrapolated over the whole measurement
+        # span.  Normalizing within one run makes the fraction immune to
+        # run-to-run host-load variance (which dwarfed the effect when
+        # comparing across runs) and <= 1 by construction up to rate
+        # noise: the victim runs at the merged-quorum rate whenever it is
+        # alive and simply loses its dead window.
+        pre = [ts for ts in before if ts >= t0]
+        span_pre = kill_ts - t0
+        t_end = max(max(ts_list) for ts_list in commits.values())
+        if len(pre) >= 10 and span_pre > 5.0 and t_end > kill_ts:
+            rate_pre = len(pre) / span_pre
+            expected = rate_pre * (t_end - t0)
+            if expected > 0:
+                self_fraction = per_group.get("1", 0) / expected
+
+    return {
+        "committed_batches": sum(per_group.values()),
+        "per_group": per_group,
+        "heals": heals,
+        "heal_ms": heal_ms,
+        "victim_downtime_s": victim_downtime,
+        "goodput_self_fraction": self_fraction,
+        "metrics_stream": True,
+    }
 
 
 def kill_benchmark() -> dict:
+    """Goodput under SIGKILL, measured per replica group over paired trials.
+
+    Round-3 lesson: on this single-core host, TOTAL committed batches is
+    the wrong unit — when group 1 dies, the surviving group's steps get
+    FASTER (it stops sharing the CPU and the quorum shrinks), so the
+    killed run committed 8% MORE total batches than the undisturbed run
+    and the fraction could not resolve the <5% target.  The headline
+    fraction is therefore computed on the VICTIM group only: the victim
+    runs at the merged-quorum rate in both scenarios and simply loses its
+    dead window, so victim_kill/victim_base <= 1 up to run-to-run noise,
+    and the survivor speed-up cannot inflate it.  Totals are still
+    reported (explained) as a secondary, and the baseline's own
+    run-to-run spread is reported so the effect size can be judged
+    against measurement noise."""
     window = float(os.environ.get("TPUFT_BENCH_KILL_WINDOW_S", "45"))
-    # One compile cache shared by every process of both scenarios: the
-    # post-kill restart must not pay JIT compilation again (on a single-core
-    # host a recompile starves every process and would swamp the FT cost
-    # being measured).
+    trials = max(1, int(os.environ.get("TPUFT_BENCH_KILL_TRIALS", "3")))
+    # One compile cache shared by every process of all scenarios: restarts
+    # must not pay JIT compilation again (on a single-core host a recompile
+    # starves every process and would swamp the FT cost being measured).
+    bases, kills = [], []
     with tempfile.TemporaryDirectory(prefix="tpuft_bench_cache_") as cache_dir:
-        with tempfile.TemporaryDirectory(prefix="tpuft_bench_nokill_") as d:
-            base = _run_scenario(d, window_s=window, kill_at_s=None, cache_dir=cache_dir)
-        with tempfile.TemporaryDirectory(prefix="tpuft_bench_kill_") as d:
-            killed = _run_scenario(
-                d, window_s=window, kill_at_s=window / 3, cache_dir=cache_dir
-            )
-    frac = killed["committed_batches"] / max(1, base["committed_batches"])
+        for t in range(trials):
+            with tempfile.TemporaryDirectory(prefix="tpuft_bench_nokill_") as d:
+                bases.append(
+                    _run_scenario(d, window_s=window, kill_at_s=None, cache_dir=cache_dir)
+                )
+            with tempfile.TemporaryDirectory(prefix="tpuft_bench_kill_") as d:
+                kills.append(
+                    _run_scenario(
+                        d, window_s=window, kill_at_s=window / 3, cache_dir=cache_dir
+                    )
+                )
+
+    def _victim(stats: dict) -> int:
+        return stats["per_group"].get("1", 0)
+
+    per_group_ok = all(b["per_group"] and k["per_group"] for b, k in zip(bases, kills))
+    self_fracs = [k["goodput_self_fraction"] for k in kills]
+    if all(f is not None for f in self_fracs):
+        # Primary: within-run self-normalized victim goodput (see
+        # _scenario_stats) — immune to run-to-run host-load variance.
+        fractions = self_fracs
+        unit = "victim_self_normalized"
+    elif per_group_ok and all(_victim(b) > 0 for b in bases):
+        fractions = [_victim(k) / _victim(b) for b, k in zip(bases, kills)]
+        unit = "victim_group_paired"
+    else:
+        # Metrics stream unavailable: legacy total-count fraction (noisy).
+        fractions = [
+            k["committed_batches"] / max(1, b["committed_batches"])
+            for b, k in zip(bases, kills)
+        ]
+        unit = "total(legacy)"
+
+    mean = sum(fractions) / len(fractions)
+    paired = (
+        [round(_victim(k) / _victim(b), 4) for b, k in zip(bases, kills)]
+        if per_group_ok and all(_victim(b) > 0 for b in bases)
+        else None
+    )
+    base_victims = [_victim(b) for b in bases] if per_group_ok else []
+    base_spread = (
+        (max(base_victims) - min(base_victims)) / max(1, min(base_victims))
+        if base_victims
+        else None
+    )
+    downtimes = [k["victim_downtime_s"] for k in kills if k["victim_downtime_s"]]
+    heal_ms = sorted(ms for k in kills for ms in k["heal_ms"])
+    heals = sum(k["heals"] for k in kills)
     return {
         "window_s": window,
-        "committed_batches_undisturbed": base["committed_batches"],
-        "committed_batches_with_kill": killed["committed_batches"],
+        "trials": trials,
+        "goodput_unit": unit,
+        "goodput_under_kill_fraction": round(mean, 4),
+        "goodput_fraction_trials": [round(f, 4) for f in fractions],
+        "goodput_fraction_spread": round(max(fractions) - min(fractions), 4),
+        # Secondary: victim count vs the PAIRED undisturbed run — across-run
+        # comparison, so host-load variance between trials shows up here.
+        "goodput_paired_fraction_trials": paired,
+        # Baseline noise floor: the undisturbed victim count's own
+        # run-to-run spread.  The fraction is only meaningful if the
+        # effect being measured exceeds this.
+        "baseline_victim_committed": base_victims,
+        "baseline_relative_spread": (
+            round(base_spread, 4) if base_spread is not None else None
+        ),
+        "victim_downtime_s": (
+            round(sum(downtimes) / len(downtimes), 2) if downtimes else None
+        ),
+        "victim_downtime_s_trials": [round(d, 2) for d in downtimes],
+        "heal_ms_median": heal_ms[len(heal_ms) // 2] if heal_ms else None,
+        "committed_batches_undisturbed": sum(b["committed_batches"] for b in bases),
+        "committed_batches_with_kill": sum(k["committed_batches"] for k in kills),
+        "per_group_undisturbed": [b["per_group"] for b in bases],
+        "per_group_with_kill": [k["per_group"] for k in kills],
         # A kill run where the victim never healed is NOT a valid goodput
         # measurement — surface it rather than presenting fraction as if the
         # north-star heal path had been exercised.
-        "heals_with_kill": killed["heals"],
-        "heal_verified": killed["heals"] >= 1,
-        "goodput_under_kill_fraction": round(frac, 4),
+        "heals_with_kill": heals,
+        "heal_verified": all(k["heals"] >= 1 for k in kills),
+        # The per-window fraction charges ONE kill against a window_s-sized
+        # window — a failure every 45 s, ~100x any realistic rate.  The
+        # victim's downtime is a fixed per-failure cost (dominated by
+        # process restart + JAX init on this host), so the steady-state
+        # goodput loss at a given MTBF is downtime/MTBF; this field states
+        # it for hourly failures, which is already far beyond BASELINE.md's
+        # <5% target.
+        "goodput_fraction_at_hourly_failures": (
+            round(1 - (sum(downtimes) / len(downtimes)) / 3600.0, 5)
+            if downtimes
+            else None
+        ),
     }
 
 
@@ -373,11 +534,18 @@ def main() -> None:
         "vs_baseline": None,
         "detail": {
             **chip,
-            "baseline_semantics": "vs_baseline = committed work in a "
-            "fixed window with one SIGKILL + live heal, relative to "
-            "the same window undisturbed (BASELINE.md north star; "
-            "target >= 0.95).  The reference publishes no absolute "
-            "numbers.",
+            "baseline_semantics": "vs_baseline = the KILLED group's "
+            "committed batches over a window with one SIGKILL + live heal, "
+            "relative to its own pre-kill commit rate extrapolated over "
+            "the same window (self-normalized; mean of trials; <= 1 by "
+            "construction).  Victim-only, within-run normalization: on a "
+            "1-core host the survivor speeds up when its peer dies and "
+            "run-to-run load variance exceeds the effect, which made the "
+            "round-3 total-vs-paired-run fraction land above 1.  The "
+            "fraction charges one kill per window (~100x any realistic "
+            "failure rate); see goodput_fraction_at_hourly_failures for "
+            "the steady-state number vs BASELINE.md's <5% target.  The "
+            "reference publishes no absolute numbers.",
         },
     }
     try:

@@ -12,6 +12,7 @@
 #include <memory>
 #include <mutex>
 #include <optional>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -100,7 +101,12 @@ class Lighthouse {
   std::optional<Quorum> latest_quorum_;
   // Dedup logging of quorum status changes
   // (reference ChangeLogger, src/lighthouse.rs:68-84).
-  std::string last_reason_;
+  // Reasons already logged for the CURRENT membership situation; cleared
+  // whenever quorum membership changes.  Plain last-value dedup was not
+  // enough: during healthy steady state the tick alternates between the
+  // waiting reason and the formed reason every round, which defeated it
+  // (reference logs only on change, src/lighthouse.rs:68-84).
+  std::set<std::string> logged_reasons_;
   // Replicas observed heartbeat-fresh on the previous tick, for logging
   // healthy<->stale transitions (failure-detection visibility).
   std::map<std::string, bool> last_fresh_;

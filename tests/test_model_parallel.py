@@ -160,6 +160,81 @@ def test_train_step_split_matches_full() -> None:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
+def test_tree_device_bytes_counts_shards_not_globals() -> None:
+    """A sharded leaf costs each device only its shard; a replicated leaf
+    costs the full array — the budget the auto overlap decision uses."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from torchft_tpu.parallel.trainer import tree_device_bytes
+
+    ftmesh = ft_init_mesh({"data": 4})
+    x = jnp.zeros((8, 16), jnp.float32)  # 512 bytes global
+    sharded = jax.device_put(
+        x, NamedSharding(ftmesh.mesh, PartitionSpec("data", None))
+    )
+    replicated = jax.device_put(
+        x, NamedSharding(ftmesh.mesh, PartitionSpec(None, None))
+    )
+    assert tree_device_bytes({"a": sharded}) == 512 // 4
+    assert tree_device_bytes({"a": replicated}) == 512
+    assert tree_device_bytes({"a": sharded, "b": replicated}) == 512 + 128
+
+
+def test_speculation_fits_budget_arithmetic() -> None:
+    from torchft_tpu.parallel.trainer import speculation_fits
+
+    class FakeDevice:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    # 10 GB free, 90% headroom => 9 GB budget.
+    stats = {"bytes_limit": 16 << 30, "bytes_in_use": 6 << 30}
+    assert speculation_fits(8 << 30, FakeDevice(stats)) is True
+    assert speculation_fits(10 << 30, FakeDevice(stats)) is False
+    # No statistics (CPU devices, some TPU tunnels): undecidable.
+    assert speculation_fits(1, FakeDevice(None)) is None
+    assert speculation_fits(1, FakeDevice({})) is None
+
+
+def test_ft_step_auto_overlap_falls_back_when_memory_tight(monkeypatch) -> None:
+    """overlap_commit=None (the default) must take the donated in-place
+    apply when the device reports the speculative copy won't fit."""
+    from datetime import timedelta
+
+    import optax
+
+    import torchft_tpu.parallel.trainer as trainer_mod
+
+    manager = create_autospec(Manager, instance=True)
+    manager.num_participants.return_value = 2
+    manager.timeout = timedelta(seconds=60)
+    manager.allreduce.side_effect = lambda arr, should_average=True: completed_future(
+        np.asarray(arr)
+    )
+    manager.should_commit.return_value = True
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    ftmesh = ft_init_mesh({"data": 2}, manager=manager)
+    step = TrainStep(ftmesh, optax.sgd(0.1), lambda p, b: loss_fn(p, b, CFG))
+    assert step.overlap_commit is None
+
+    monkeypatch.setattr(trainer_mod, "speculation_fits", lambda extra, dev: False)
+    opt_state = step.init_opt_state(params)
+    params, opt_state, _, committed = step.ft_step(params, opt_state, batch=_batch())
+    assert committed is True
+    assert step._overlap_resolved is False  # donated path chosen
+
+    # Unknown stats (None) keeps the overlap, and the choice is sticky.
+    step2 = TrainStep(ftmesh, optax.sgd(0.1), lambda p, b: loss_fn(p, b, CFG))
+    monkeypatch.setattr(trainer_mod, "speculation_fits", lambda extra, dev: None)
+    opt_state2 = step2.init_opt_state(params)
+    step2.ft_step(params, opt_state2, batch=_batch())
+    assert step2._overlap_resolved is True
+
+
 def test_ft_step_commit_gate() -> None:
     from datetime import timedelta
 

@@ -54,9 +54,10 @@ def _build_native() -> None:
         check=True,
         capture_output=True,
     )
-    subprocess.run(
-        ["ninja", "-C", build_dir, "tpuft", "py_proto"], check=True, capture_output=True
-    )
+    # Default target set (not just tpuft+py_proto): ALL includes tpuft_test,
+    # so an out-of-the-box `ctest --test-dir native/build` passes with no
+    # manual target — round 3 shipped a build dir where it reported Not Run.
+    subprocess.run(["ninja", "-C", build_dir], check=True, capture_output=True)
 
 
 def _ensure_built() -> None:

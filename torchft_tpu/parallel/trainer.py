@@ -20,11 +20,62 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Any, Callable, Optional
 
 import jax
 
 from torchft_tpu.parallel.mesh import FTMesh
+
+logger = logging.getLogger(__name__)
+
+# Fraction of the remaining HBM the speculative apply may claim; the rest
+# is headroom for XLA temporaries inside the update program.
+_SPECULATION_HEADROOM = 0.9
+
+
+def tree_device_bytes(tree: Any) -> int:
+    """PER-DEVICE resident bytes of a pytree of (possibly sharded) arrays.
+
+    A sharded leaf costs each device only its shard; a replicated leaf
+    costs every device the full array.  Using global sizes here would
+    overestimate the speculative-apply cost by the shard factor on
+    FSDP-style meshes and wrongly disable the overlap."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+        if itemsize is None:
+            continue
+        shape = getattr(leaf, "shape", ())
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = sharding.shard_shape(shape)
+            except Exception:  # noqa: BLE001
+                pass
+        count = 1
+        for dim in shape:
+            count *= int(dim)
+        total += count * int(itemsize)
+    return total
+
+
+def speculation_fits(extra_bytes: int, device: Any) -> Optional[bool]:
+    """Whether an extra `extra_bytes` fits the device's free HBM.
+
+    Returns None when the runtime exposes no memory statistics (CPU
+    devices; some TPU tunnels) — the caller decides the default."""
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    in_use = stats.get("bytes_in_use")
+    if limit is None or in_use is None:
+        return None
+    return extra_bytes <= (limit - in_use) * _SPECULATION_HEADROOM
 
 
 @dataclasses.dataclass
@@ -39,15 +90,20 @@ class TrainStep:
         overlap_commit: hide the commit-vote RPC behind a speculatively
             dispatched update (see ft_step).  MEMORY TRADE: the speculative
             apply cannot donate its inputs, so params+opt_state residency
-            transiently doubles during the update — set False for models
-            sized against the donated (in-place) apply path.
+            transiently doubles during the update.  Default None = decide
+            automatically on the first ft_step: overlap iff an extra
+            params+opt_state copy fits the device's free HBM (with 10%
+            headroom for XLA temporaries); when the runtime exposes no
+            memory statistics the overlap is kept (its failure mode — an
+            allocator OOM — is loud, while silently serializing the vote
+            would be an invisible perf cliff).  Pass True/False to force.
     """
 
     ftmesh: FTMesh
     tx: Any
     loss_fn: Callable[[Any, Any], jax.Array]
     bucket_bytes: int = 25 << 20
-    overlap_commit: bool = True
+    overlap_commit: Optional[bool] = None
 
     def __post_init__(self) -> None:
         mesh = self.ftmesh.mesh
@@ -76,6 +132,7 @@ class TrainStep:
         self._apply_spec_fn = jax.jit(apply)
         self._full_fn = jax.jit(full, donate_argnums=(0, 1))
         self._averager = None  # lazy: the manager may be attached post-init
+        self._overlap_resolved: Optional[bool] = self.overlap_commit
 
     # -- pure compute --------------------------------------------------------
 
@@ -126,9 +183,29 @@ class TrainStep:
         if self._averager is None or self._averager.manager is not manager:
             self._averager = GradientAverager(manager, self.bucket_bytes)
 
+        if self._overlap_resolved is None:
+            extra = tree_device_bytes(params) + tree_device_bytes(opt_state)
+            device = None
+            for leaf in jax.tree.leaves(params):
+                devs = getattr(leaf, "devices", None)
+                if callable(devs):
+                    ds = devs()
+                    if ds:
+                        device = next(iter(ds))
+                        break
+            fits = speculation_fits(extra, device) if device is not None else None
+            self._overlap_resolved = True if fits is None else fits
+            logger.info(
+                "overlap_commit auto: %s (extra %.2f GB for the speculative "
+                "apply, device stats %s)",
+                self._overlap_resolved,
+                extra / 1e9,
+                "unavailable" if fits is None else "available",
+            )
+
         loss, grads = self._grads_fn(params, batch)
         grads = self._averager.allreduce(grads)
-        if self.overlap_commit:
+        if self._overlap_resolved:
             new_params, new_opt = self._apply_spec_fn(params, opt_state, grads)
             if manager.should_commit():
                 return new_params, new_opt, loss, True
