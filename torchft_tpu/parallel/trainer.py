@@ -104,11 +104,18 @@ class TrainStep:
     loss_fn: Callable[[Any, Any], jax.Array]
     bucket_bytes: int = 25 << 20
     overlap_commit: Optional[bool] = None
+    # Optional (params, batch) -> (loss, grads) override replacing
+    # jax.value_and_grad(loss_fn) — for losses that compute their own
+    # backward, e.g. the 1F1B pipeline schedule
+    # (parallel.pipeline.pipeline_1f1b_value_and_grad).
+    value_and_grad_fn: Optional[Callable[[Any, Any], Any]] = None
 
     def __post_init__(self) -> None:
         mesh = self.ftmesh.mesh
 
         def value_and_grad(params, batch):
+            if self.value_and_grad_fn is not None:
+                return self.value_and_grad_fn(params, batch)
             return jax.value_and_grad(self.loss_fn)(params, batch)
 
         def apply(params, opt_state, grads):
