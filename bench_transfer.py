@@ -117,11 +117,115 @@ def bench_collective(state: Dict[str, np.ndarray], nbytes: int) -> Dict[str, Any
         store.shutdown()
 
 
+def _allreduce_pair(
+    wire_dtype: str, nbytes: int, buckets: int = 1
+) -> Dict[str, Any]:
+    """2-rank ring allreduce wall time under the ambient link shaping.
+    buckets > 1 issues the payload as that many allreduce calls (the
+    GradientAverager pattern); ring ops intentionally serialize on the
+    shared ring sockets, so this measures the per-bucket overhead (extra
+    RTTs), not cross-bucket overlap."""
+    from torchft_tpu._native import StoreServer
+    from torchft_tpu.collectives import TCPCollective
+
+    store = StoreServer(bind="127.0.0.1:0")
+    cols = [TCPCollective(timeout=300.0, wire_dtype=wire_dtype) for _ in range(2)]
+    results: Dict[int, float] = {}
+    try:
+        threads = [
+            threading.Thread(
+                target=cols[r].configure,
+                args=(f"{store.address()}/ar_{wire_dtype}_{buckets}", r, 2),
+            )
+            for r in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        per = nbytes // 4 // buckets
+        errors: List[BaseException] = []
+
+        def run(rank: int) -> None:
+            try:
+                arrays = [
+                    np.ones((per,), np.float32) * (rank + 1)
+                    for _ in range(buckets)
+                ]
+                t0 = time.perf_counter()
+                works = [cols[rank].allreduce([a], op="sum") for a in arrays]
+                outs = [w.wait() for w in works]
+                results[rank] = time.perf_counter() - t0
+                assert float(outs[0][0][0]) == 3.0, outs[0][0][0]
+            except BaseException as e:  # noqa: BLE001 — re-raised in parent
+                errors.append(e)
+
+        rs = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in rs:
+            t.start()
+        for t in rs:
+            t.join()
+        if errors:
+            raise errors[0]
+        wall = max(results.values())
+        return {
+            "op": "allreduce_64mb" if nbytes == 64 << 20 else f"allreduce_{nbytes}",
+            "wire_dtype": wire_dtype,
+            "buckets": buckets,
+            "wall_s": round(wall, 3),
+            "gb_per_s": round(_gb(nbytes) / wall, 3),
+        }
+    finally:
+        for c in cols:
+            c.shutdown()
+        store.shutdown()
+
+
+def bench_shaped_link(mbps: float = 200.0, rtt_ms: float = 20.0) -> Dict[str, Any]:
+    """DCN-shaped validation: under a bandwidth/latency-shaped link the
+    bf16 wire should win ~2x on an allreduce (it halves the bytes on the
+    bandwidth-bound path), "auto" should resolve to bf16, and splitting
+    the payload into gradient buckets should cost only the extra
+    per-bucket RTTs.  Ring ops intentionally serialize on the shared ring
+    sockets (program order keeps the rings aligned), so buckets do not
+    overlap EACH OTHER — their purpose is overlapping DCN time with the
+    backward compute — and the bucketed_overhead factor shows that
+    bucketing sacrifices almost no wire efficiency for that.  Runs
+    in-process via TPUFT_SHAPED_LINK (sender pacing in the peer layer)."""
+    import os
+
+    nbytes = 64 << 20
+    prior = os.environ.get("TPUFT_SHAPED_LINK")
+    os.environ["TPUFT_SHAPED_LINK"] = f"{mbps}:{rtt_ms}"
+    try:
+        f32 = _allreduce_pair("f32", nbytes)
+        bf16 = _allreduce_pair("bf16", nbytes)
+        auto = _allreduce_pair("auto", nbytes)
+        f32_b = _allreduce_pair("f32", nbytes, buckets=8)
+    finally:
+        if prior is None:
+            del os.environ["TPUFT_SHAPED_LINK"]
+        else:
+            os.environ["TPUFT_SHAPED_LINK"] = prior
+    return {
+        "link": {"mbps": mbps, "rtt_ms": rtt_ms},
+        "results": [f32, bf16, auto, f32_b],
+        "bf16_speedup": round(f32["wall_s"] / bf16["wall_s"], 2),
+        "auto_resolves_bf16": abs(auto["wall_s"] - bf16["wall_s"])
+        < abs(auto["wall_s"] - f32["wall_s"]),
+        "bucketed_overhead": round(f32_b["wall_s"] / f32["wall_s"], 2),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--gb", type=float, default=2.0, help="state dict size")
     parser.add_argument("--buffers", type=int, default=32)
     parser.add_argument("--chunks", type=int, nargs="*", default=[0, 2, 4, 8])
+    parser.add_argument("--shaped-mbps", type=float, default=200.0)
+    parser.add_argument("--shaped-rtt-ms", type=float, default=20.0)
+    parser.add_argument("--no-shaped", action="store_true")
     parser.add_argument("--out", default=None, help="also write results JSON here")
     args = parser.parse_args()
 
@@ -149,10 +253,17 @@ def main() -> None:
         "best_http_chunks": best_http["num_chunks"],
         "collective_gb_per_s": results[-1]["recv_gb_per_s"],
     }
+    shaped = None
+    if not args.no_shaped:
+        shaped = bench_shaped_link(args.shaped_mbps, args.shaped_rtt_ms)
+        print(json.dumps(shaped), flush=True)
     print(json.dumps({"summary": summary}), flush=True)
     if args.out:
+        payload = {"results": results, "summary": summary}
+        if shaped is not None:
+            payload["shaped_link"] = shaped
         with open(args.out, "w") as f:
-            json.dump({"results": results, "summary": summary}, f, indent=1)
+            json.dump(payload, f, indent=1)
 
 
 if __name__ == "__main__":

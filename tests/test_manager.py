@@ -37,7 +37,7 @@ class FakeCollective(Collective):
         self._world_size = world_size
         self._errored = None
 
-    def allreduce(self, arrays, op="sum") -> Work:
+    def allreduce(self, arrays, op="sum", allow_wire_compression=True) -> Work:
         if self.fail_next:
             self.fail_next = False
             exc = RuntimeError("injected allreduce failure")

@@ -24,7 +24,7 @@ def _mock_manager(num_participants: int = 2, commit: bool = True) -> MagicMock:
     manager._use_async_quorum = False
     manager.timeout = timedelta(seconds=60)
 
-    def fake_allreduce(arr, should_average: bool = True):
+    def fake_allreduce(arr, should_average: bool = True, allow_wire_compression: bool = True):
         # Pretend every participant contributed identical values: the average
         # equals the input, so averaging is an identity we can verify around.
         return completed_future(np.asarray(arr))
@@ -295,7 +295,7 @@ def test_local_sgd_commit_gates_copyback() -> None:
 
     manager = _mock_manager(commit=False)
 
-    def fake_allreduce(arr, should_average=True):
+    def fake_allreduce(arr, should_average=True, allow_wire_compression=True):
         return completed_future(np.zeros_like(np.asarray(arr)))
 
     manager.allreduce.side_effect = fake_allreduce

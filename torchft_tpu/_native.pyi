@@ -1,0 +1,121 @@
+# Typed public surface of the ctypes bindings over the native C++ core,
+# so the runtime-loaded classes type-check for callers — the analogue of
+# the reference's PyO3 stub (torchft/_torchft.pyi).
+from typing import Any, Dict, List, Optional
+
+LIGHTHOUSE_QUORUM: int
+LIGHTHOUSE_HEARTBEAT: int
+MANAGER_QUORUM: int
+MANAGER_CHECKPOINT_METADATA: int
+MANAGER_SHOULD_COMMIT: int
+MANAGER_KILL: int
+STORE_SET: int
+STORE_GET: int
+STORE_ADD: int
+STORE_DELETE: int
+
+class QuorumResult:
+    quorum_id: int
+    replica_rank: int
+    replica_world_size: int
+    recover_src_manager_address: str
+    recover_src_replica_rank: Optional[int]
+    recover_dst_replica_ranks: List[int]
+    store_address: str
+    max_step: int
+    max_replica_rank: Optional[int]
+    max_world_size: int
+    heal: bool
+    def __init__(
+        self,
+        quorum_id: int = ...,
+        replica_rank: int = ...,
+        replica_world_size: int = ...,
+        recover_src_manager_address: str = ...,
+        recover_src_replica_rank: Optional[int] = ...,
+        recover_dst_replica_ranks: List[int] = ...,
+        store_address: str = ...,
+        max_step: int = ...,
+        max_replica_rank: Optional[int] = ...,
+        max_world_size: int = ...,
+        heal: bool = ...,
+    ) -> None: ...
+
+class LighthouseServer:
+    def __init__(
+        self,
+        bind: str = ...,
+        min_replicas: int = ...,
+        join_timeout_ms: int = ...,
+        quorum_tick_ms: int = ...,
+        heartbeat_timeout_ms: int = ...,
+        http_bind: str = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def http_address(self) -> str: ...
+    def shutdown(self) -> None: ...
+
+class LighthouseClient:
+    def __init__(self, addr: str, connect_timeout_ms: int = ...) -> None: ...
+    def quorum(
+        self,
+        replica_id: str,
+        timeout_ms: int = ...,
+        address: str = ...,
+        store_address: str = ...,
+        step: int = ...,
+        world_size: int = ...,
+        shrink_only: bool = ...,
+        data: Optional[Dict[str, Any]] = ...,
+    ) -> Any: ...  # pb.Quorum
+    def heartbeat(self, replica_id: str, timeout_ms: int = ...) -> None: ...
+    def close(self) -> None: ...
+
+class ManagerServer:
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        bind: str = ...,
+        store_addr: str = ...,
+        world_size: int = ...,
+        heartbeat_interval_ms: int = ...,
+        connect_timeout_ms: int = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    def shutdown(self) -> None: ...
+
+class ManagerClient:
+    def __init__(self, addr: str, connect_timeout_ms: int = ...) -> None: ...
+    def _quorum(
+        self,
+        group_rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout_ms: int,
+        init_sync: bool = ...,
+        commit_failures: int = ...,
+    ) -> QuorumResult: ...
+    def _checkpoint_metadata(self, rank: int, timeout_ms: int) -> str: ...
+    def should_commit(
+        self, group_rank: int, step: int, should_commit: bool, timeout_ms: int
+    ) -> bool: ...
+    def close(self) -> None: ...
+
+class StoreServer:
+    def __init__(self, bind: str = ...) -> None: ...
+    def address(self) -> str: ...
+    def shutdown(self) -> None: ...
+
+class StoreClient:
+    def __init__(
+        self, addr: str, prefix: str = ..., connect_timeout_ms: int = ...
+    ) -> None: ...
+    def set(self, key: str, value: bytes, timeout_ms: int = ...) -> None: ...
+    def get(
+        self, key: str, wait: bool = ..., timeout_ms: int = ...
+    ) -> Optional[bytes]: ...
+    def add(self, key: str, delta: int, timeout_ms: int = ...) -> int: ...
+    def delete(self, key: str, timeout_ms: int = ...) -> None: ...
+    def close(self) -> None: ...

@@ -369,8 +369,18 @@ class BabyCollective(Collective):
         # futures, torchft/process_group.py:1497-1504).
         return Work(future_timeout(fut, self._timeout))
 
-    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
-        return self._submit("allreduce", [np.ascontiguousarray(a) for a in arrays], op)
+    def allreduce(
+        self,
+        arrays: Sequence[np.ndarray],
+        op: str = "sum",
+        allow_wire_compression: bool = True,
+    ) -> Work:
+        return self._submit(
+            "allreduce",
+            [np.ascontiguousarray(a) for a in arrays],
+            op,
+            allow_wire_compression,
+        )
 
     def allgather(self, array: np.ndarray) -> Work:
         return self._submit("allgather", np.ascontiguousarray(array))

@@ -223,6 +223,7 @@ def write_state_dict(
 
 
 def read_state_dict(stream: io.RawIOBase) -> Tuple[StateDictMeta, List[np.ndarray]]:
+    """Reads one write_state_dict frame: (header, raw host buffers)."""
     header_len = int.from_bytes(_read_exact(stream, 8), "little")
     meta: StateDictMeta = pickle.loads(_read_exact(stream, header_len))
     buffers: List[np.ndarray] = []

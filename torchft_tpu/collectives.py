@@ -22,6 +22,7 @@ Semantics carried over from the reference:
 from __future__ import annotations
 
 import collections
+import os
 import socket
 import struct
 import threading
@@ -101,9 +102,19 @@ class Collective(ABC):
         rendezvous collisions with stale rounds (torchft/manager.py:503)."""
 
     @abstractmethod
-    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+    def allreduce(
+        self,
+        arrays: Sequence[np.ndarray],
+        op: str = "sum",
+        allow_wire_compression: bool = True,
+    ) -> Work:
         """Elementwise reduction across ranks; results replace `arrays`
-        contents in the returned Work's result list."""
+        contents in the returned Work's result list.
+
+        allow_wire_compression=False opts this call out of lossy wire
+        encodings (wire_dtype="bf16"): gradient-like payloads tolerate
+        per-hop bf16 rounding, but direct PARAMETER averaging (LocalSGD)
+        must not accumulate quantization across syncs."""
 
     @abstractmethod
     def allgather(self, array: np.ndarray) -> Work:
@@ -169,7 +180,12 @@ class DummyCollective(Collective):
         self._world_size = world_size
         self.configure_count += 1
 
-    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+    def allreduce(
+        self,
+        arrays: Sequence[np.ndarray],
+        op: str = "sum",
+        allow_wire_compression: bool = True,
+    ) -> Work:
         out = [np.array(a, copy=True) for a in arrays]
         if op == "avg":
             out = [a / 1.0 for a in out]
@@ -210,6 +226,42 @@ class DummyCollective(Collective):
 _HDR = struct.Struct("<IQ")  # tag, nbytes
 
 
+class LinkShaper:
+    """DCN-shaped link emulation for transport validation on localhost.
+
+    Applied at the sender: each frame pays half the RTT (propagation) and
+    its bytes are paced at the configured bandwidth (serialization), so a
+    loopback TCP link behaves like a latency/bandwidth-bound cross-site
+    link.  Enabled for all TCPCollective peers via
+    ``TPUFT_SHAPED_LINK="<mbps>:<rtt_ms>"``; wire-byte counters let tests
+    assert traffic (e.g. the bf16 wire halving) without timing flakiness.
+    """
+
+    def __init__(self, mbps: float, rtt_ms: float) -> None:
+        self.bytes_per_s = mbps * 1e6 / 8.0
+        self.half_rtt_s = rtt_ms / 2000.0
+        self.bytes_sent = 0
+        self.frames_sent = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> Optional["LinkShaper"]:
+        spec = os.environ.get("TPUFT_SHAPED_LINK")
+        if not spec:
+            return None
+        mbps, _, rtt = spec.partition(":")
+        return cls(float(mbps), float(rtt or "0"))
+
+    def delay_s(self, nbytes: int) -> float:
+        return self.half_rtt_s + nbytes / self.bytes_per_s
+
+    def on_send(self, nbytes: int) -> None:
+        with self._lock:
+            self.bytes_sent += nbytes
+            self.frames_sent += 1
+        time.sleep(self.delay_s(nbytes))
+
+
 class _Peer:
     """A framed duplex TCP link to one peer rank.
 
@@ -217,10 +269,11 @@ class _Peer:
     demultiplexed by tag: a frame for a tag nobody asked for yet is stashed
     until the matching recv_msg arrives."""
 
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(self, sock: socket.socket, shaper: Optional[LinkShaper] = None) -> None:
         self.sock = sock
         self.send_lock = threading.Lock()
         self.recv_lock = threading.Lock()
+        self.shaper = shaper if shaper is not None else LinkShaper.from_env()
         self._stash: dict[int, "collections.deque[bytearray]"] = {}
 
     def send_msg(self, tag: int, payload) -> None:
@@ -230,6 +283,8 @@ class _Peer:
         parts = payload if isinstance(payload, (list, tuple)) else [payload]
         total = sum(len(p) for p in parts)
         with self.send_lock:
+            if self.shaper is not None:
+                self.shaper.on_send(total + _HDR.size)
             self.sock.sendall(_HDR.pack(tag, total))
             for p in parts:
                 self.sock.sendall(p)
@@ -339,7 +394,7 @@ class TCPCollective(Collective):
         self,
         timeout: float = 60.0,
         chunk_bytes: int = 4 << 20,
-        wire_dtype: str = "f32",
+        wire_dtype: str = "auto",
     ) -> None:
         """``wire_dtype="bf16"`` halves allreduce bytes on the wire (DCN is
         the cross-slice bottleneck): ring payloads are cast to bfloat16 per
@@ -348,16 +403,28 @@ class TCPCollective(Collective):
         the allgather phase, so all replicas still receive BITWISE-equal
         results — the property the commit protocol depends on.
 
-        Opt-in, twice over: (1) each hop quantizes, so error grows with
-        ring size — at the replica dimension's small world sizes (2-8
-        groups) the rounding is well inside gradient noise; (2) it trades
-        host CPU (the casts) for wire bytes, so it wins only when the
-        network is the bottleneck — on real DCN, yes; on localhost
-        loopback it measured SLOWER (64 MB 2-rank: 0.57 s vs 0.46 s f32 on
-        a 1-core host), which is why f32 stays the default."""
+        ``"auto"`` (default) picks bf16 when the link is declared
+        bandwidth-bound — ``TPUFT_LINK_PROFILE=dcn`` in the environment,
+        or a shaped-link emulation is active (``TPUFT_SHAPED_LINK``) —
+        and f32 otherwise.  Why not bf16 always: (1) each hop quantizes,
+        so error grows with ring size — at the replica dimension's small
+        world sizes (2-8 groups) the rounding is well inside gradient
+        noise; (2) it trades host CPU (the casts) for wire bytes, so it
+        wins only when the network is the bottleneck — on a 200 Mbps /
+        20 ms shaped link a 64 MB 2-rank allreduce measured ~1.75x faster
+        with bf16 (see TRANSFER_BENCH.json shaped_link), while on
+        localhost loopback it measured SLOWER (0.57 s vs 0.46 s f32 on a
+        1-core host)."""
+        if wire_dtype == "auto":
+            wire_dtype = (
+                "bf16"
+                if os.environ.get("TPUFT_LINK_PROFILE") == "dcn"
+                or os.environ.get("TPUFT_SHAPED_LINK")
+                else "f32"
+            )
         if wire_dtype not in ("f32", "bf16"):
             raise ValueError(
-                f"unsupported wire_dtype {wire_dtype!r}; expected 'f32' or 'bf16'"
+                f"unsupported wire_dtype {wire_dtype!r}; expected 'f32' or 'auto' or 'bf16'"
             )
         self._timeout = timeout
         self._chunk_bytes = chunk_bytes
@@ -636,7 +703,12 @@ class TCPCollective(Collective):
 
         return Work(executor.submit(run))
 
-    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+    def allreduce(
+        self,
+        arrays: Sequence[np.ndarray],
+        op: str = "sum",
+        allow_wire_compression: bool = True,
+    ) -> Work:
         # Validate BEFORE the world-size-1 fast path: a typo'd op must fail
         # on a single-replica config too, not only after scaling up.
         if op not in _REDUCE_COMBINE:
@@ -644,7 +716,9 @@ class TCPCollective(Collective):
         arrays = [np.ascontiguousarray(a) for a in arrays]
         if self._world_size == 1:
             return Work(completed_future(list(arrays)))
-        return self._submit(lambda: self._ring_allreduce(arrays, op))
+        return self._submit(
+            lambda: self._ring_allreduce(arrays, op, allow_wire_compression)
+        )
 
     def _exchange(self, tag: int, payload) -> bytes:
         """Sends to the next neighbor while receiving from the previous one.
@@ -668,7 +742,12 @@ class TCPCollective(Collective):
             raise send_exc[0]
         return received
 
-    def _ring_allreduce(self, arrays: List[np.ndarray], op: str) -> List[np.ndarray]:
+    def _ring_allreduce(
+        self,
+        arrays: List[np.ndarray],
+        op: str,
+        allow_wire_compression: bool = True,
+    ) -> List[np.ndarray]:
         from torchft_tpu.checkpointing.serialization import as_u8
 
         n = self._world_size
@@ -688,7 +767,8 @@ class TCPCollective(Collective):
         # quantizing the integer values would corrupt them.
         wire = None
         if (
-            self._wire_dtype == "bf16"
+            allow_wire_compression
+            and self._wire_dtype == "bf16"
             and np.issubdtype(flat.dtype, np.floating)
             and all(np.issubdtype(a.dtype, np.floating) for a in arrays)
         ):
@@ -994,8 +1074,16 @@ class ErrorSwallowingCollective(Collective):
         work.future().add_done_callback(settle)
         return Work(out)
 
-    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
-        return self._guard(lambda: self._inner.allreduce(arrays, op), list(arrays))
+    def allreduce(
+        self,
+        arrays: Sequence[np.ndarray],
+        op: str = "sum",
+        allow_wire_compression: bool = True,
+    ) -> Work:
+        return self._guard(
+            lambda: self._inner.allreduce(arrays, op, allow_wire_compression),
+            list(arrays),
+        )
 
     def allgather(self, array: np.ndarray) -> Work:
         return self._guard(lambda: self._inner.allgather(array), [array])
@@ -1042,7 +1130,12 @@ class ManagedCollective(Collective):
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         self._manager._collective.configure(store_addr, rank, world_size)
 
-    def allreduce(self, arrays: Sequence[np.ndarray], op: str = "sum") -> Work:
+    def allreduce(
+        self,
+        arrays: Sequence[np.ndarray],
+        op: str = "sum",
+        allow_wire_compression: bool = True,
+    ) -> Work:
         # Manager.allreduce implements exactly the fault-tolerant gradient
         # semantic: sum over participants / num_participants (an average).
         # Other reduce ops must not silently return averaged data — use the

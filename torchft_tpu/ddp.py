@@ -141,11 +141,16 @@ class PerLeafGradientAverager:
     def __init__(self, manager: Manager) -> None:
         self._manager = manager
 
-    def allreduce(self, grads: Any) -> Any:
+    def allreduce(self, grads: Any, allow_wire_compression: bool = True) -> Any:
         import jax
 
         leaves, treedef = jax.tree.flatten(grads)
-        futs = [self._manager.allreduce(l) for l in leaves]
+        futs = [
+            self._manager.allreduce(
+                l, allow_wire_compression=allow_wire_compression
+            )
+            for l in leaves
+        ]
         return jax.tree.unflatten(treedef, [f.result() for f in futs])
 
 

@@ -248,6 +248,7 @@ def completed_future(value: T = None) -> Future:
 
 
 def failed_future(exc: Exception) -> Future:
+    """A Future already resolved to the given exception."""
     fut: Future = Future()
     fut.set_exception(exc)
     return fut

@@ -290,6 +290,7 @@ class Launcher:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry: ``python -m torchft_tpu.launch --groups N -- <cmd>``."""
     parser = argparse.ArgumentParser(
         prog="python -m torchft_tpu.launch",
         description="Launch N fault-tolerant replica groups with a restart "

@@ -15,6 +15,8 @@ import threading
 
 
 def main(argv=None) -> None:
+    """CLI entry: standalone Lighthouse server with the HTML dashboard
+    (reference: torchft_lighthouse, src/bin/lighthouse.rs:11-23)."""
     parser = argparse.ArgumentParser(description="torchft_tpu lighthouse server")
     parser.add_argument("--bind", default="[::]:29510", help="RPC bind address")
     parser.add_argument("--http_bind", default="[::]:29511", help="dashboard bind address")

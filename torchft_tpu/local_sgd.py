@@ -94,7 +94,12 @@ class LocalSGD:
     def _average(self, params: Any) -> Any:
         from torchft_tpu.ddp import PerLeafGradientAverager
 
-        return PerLeafGradientAverager(self._manager).allreduce(params)
+        # PARAMETERS, not gradients: opt out of lossy wire encodings —
+        # bf16-per-hop rounding of the weights themselves would accumulate
+        # across syncs (gradient noise does not excuse it here).
+        return PerLeafGradientAverager(self._manager).allreduce(
+            params, allow_wire_compression=False
+        )
 
 
 class DiLoCo:

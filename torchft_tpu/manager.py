@@ -456,13 +456,22 @@ class Manager:
 
     # -- allreduce ----------------------------------------------------------
 
-    def allreduce(self, tensor, should_average: bool = True) -> Future:
+    def allreduce(
+        self,
+        tensor,
+        should_average: bool = True,
+        allow_wire_compression: bool = True,
+    ) -> Future:
         """Fault-tolerant gradient allreduce across replica groups.
 
         Accepts a jax.Array or numpy array; returns a Future resolving to the
         averaged array of the same type/sharding.  Never raises — failures
         resolve to the unmodified input and latch the step error
         (reference: torchft/manager.py:262-323).
+
+        allow_wire_compression=False exempts this call from lossy wire
+        encodings (TCPCollective wire_dtype="bf16") — required when the
+        payload is parameters rather than gradients (LocalSGD sync).
         """
         if self.errored() is not None:
             return completed_future(tensor)
@@ -492,7 +501,9 @@ class Manager:
             host = np.zeros_like(host)
 
         try:
-            work = self._collective.allreduce([host], op="sum")
+            work = self._collective.allreduce(
+                [host], op="sum", allow_wire_compression=allow_wire_compression
+            )
 
             def normalize(results: List[np.ndarray]):
                 out = results[0]

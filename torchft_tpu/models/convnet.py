@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 
 def init_convnet_params(key: jax.Array, n_classes: int = 10) -> Dict[str, Any]:
+    """Initializes the example CIFAR-class convnet parameters."""
     k1, k2, k3 = jax.random.split(key, 3)
     return {
         "conv": jax.random.normal(k1, (3, 3, 3, 16), jnp.float32) * 0.1,
@@ -37,6 +38,7 @@ def convnet_forward(params: Dict[str, Any], x: jax.Array) -> jax.Array:
 
 
 def convnet_loss(params: Dict[str, Any], x: jax.Array, y: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy of convnet_forward logits vs labels."""
     import optax
 
     logits = convnet_forward(params, x)

@@ -74,6 +74,8 @@ class TransformerConfig:
 
 # Logical axis names for every parameter (see parallel/sharding.py).
 def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Logical axis names for every parameter, keyed like init_params'
+    tree — feed to FTMesh.shard_params to place the model on a mesh."""
     layer = {
         "attn_norm": ("layers", "embed"),
         "wq": ("layers", "embed", "heads"),
@@ -103,6 +105,8 @@ def param_axes(cfg: TransformerConfig) -> Dict[str, Any]:
 
 
 def init_params(key: jax.Array, cfg: TransformerConfig) -> Dict[str, Any]:
+    """Initializes the transformer parameter pytree (layers stacked on a
+    leading axis for the scan-over-layers; param_dtype precision)."""
     k_embed, k_layers, k_head = jax.random.split(key, 3)
     pd = cfg.param_dtype
     E, H, KV, Dh, F, L = (

@@ -66,6 +66,8 @@ class SliceConfig:
 
 
 def slice_config_from_env(env: Optional[dict] = None) -> SliceConfig:
+    """Builds a SliceConfig from the TPUFT_HOST_RANK/TPUFT_NUM_HOSTS/
+    TPUFT_STORE/TPUFT_COORD_PORT/TPUFT_SLICE_GEN environment contract."""
     e = os.environ if env is None else env
     return SliceConfig(
         host_rank=int(e.get("TPUFT_HOST_RANK", 0)),
