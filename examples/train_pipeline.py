@@ -40,6 +40,12 @@ def main() -> None:
     parser.add_argument("--microbatches", type=int, default=2)
     parser.add_argument("--lr", type=float, default=3e-3)
     parser.add_argument(
+        "--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+        help="gpipe: forward pipeline + autodiff reverse; 1f1b: loss and "
+        "backward inside the pipeline, activation memory bounded by the "
+        "pipe depth",
+    )
+    parser.add_argument(
         "--pipe", type=int, default=2, help="pipeline stages per group"
     )
     parser.add_argument(
@@ -73,7 +79,10 @@ def main() -> None:
     from torchft_tpu.models import TransformerConfig, init_params
     from torchft_tpu.models.transformer import param_axes
     from torchft_tpu.parallel import TrainStep, ft_init_mesh
-    from torchft_tpu.parallel.pipeline import pipeline_loss_fn
+    from torchft_tpu.parallel.pipeline import (
+        pipeline_1f1b_value_and_grad,
+        pipeline_loss_fn,
+    )
 
     replica_group, num_groups = replica_env()
 
@@ -91,12 +100,20 @@ def main() -> None:
     seq = 64
 
     ftmesh = ft_init_mesh({"pipeline": args.pipe, "data": data})
-    step_fn = TrainStep(
-        ftmesh, optax.sgd(args.lr),
-        lambda p, b: pipeline_loss_fn(
-            p, b, cfg, ftmesh.mesh, num_microbatches=args.microbatches
-        ),
+    schedule_kwargs = (
+        {
+            "value_and_grad_fn": lambda p, b: pipeline_1f1b_value_and_grad(
+                p, b, cfg, ftmesh.mesh, num_microbatches=args.microbatches
+            )
+        }
+        if args.schedule == "1f1b"
+        else {
+            "loss_fn": lambda p, b: pipeline_loss_fn(
+                p, b, cfg, ftmesh.mesh, num_microbatches=args.microbatches
+            )
+        }
     )
+    step_fn = TrainStep(ftmesh, optax.sgd(args.lr), **schedule_kwargs)
 
     # Synthetic token stream, identical in every process (seeded).
     rng = np.random.default_rng(0)

@@ -117,8 +117,10 @@ def _ce_lse_kernel(
     l_new = alpha * l_scr[:, :1] + jnp.sum(
         jnp.exp(s - m_cur), axis=-1, keepdims=True
     )
-    m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
-    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+    # Partial column stores: broadcasting across the (rows, 128) scratch
+    # measured ~19% of the attention kernel's time; same pattern here.
+    m_scr[:, 0:1] = m_cur
+    l_scr[:, 0:1] = l_new
 
     @pl.when(j == num_v - 1)
     def _emit():

@@ -101,16 +101,20 @@ class TrainStep:
 
     ftmesh: FTMesh
     tx: Any
-    loss_fn: Callable[[Any, Any], jax.Array]
+    # Exactly one of loss_fn / value_and_grad_fn must be provided:
+    # value_and_grad_fn replaces jax.value_and_grad(loss_fn) for losses
+    # that compute their own backward, e.g. the 1F1B pipeline schedule
+    # (parallel.pipeline.pipeline_1f1b_value_and_grad).
+    loss_fn: Optional[Callable[[Any, Any], jax.Array]] = None
     bucket_bytes: int = 25 << 20
     overlap_commit: Optional[bool] = None
-    # Optional (params, batch) -> (loss, grads) override replacing
-    # jax.value_and_grad(loss_fn) — for losses that compute their own
-    # backward, e.g. the 1F1B pipeline schedule
-    # (parallel.pipeline.pipeline_1f1b_value_and_grad).
     value_and_grad_fn: Optional[Callable[[Any, Any], Any]] = None
 
     def __post_init__(self) -> None:
+        if (self.loss_fn is None) == (self.value_and_grad_fn is None):
+            raise ValueError(
+                "TrainStep needs exactly one of loss_fn / value_and_grad_fn"
+            )
         mesh = self.ftmesh.mesh
 
         def value_and_grad(params, batch):
