@@ -361,6 +361,8 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
             "heals": heals,
             "heal_ms": [],
             "victim_downtime_s": None,
+            "victim_restart_s": None,
+            "victim_ft_resume_s": None,
             "goodput_self_fraction": None,
             "metrics_stream": False,
         }
@@ -372,12 +374,37 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
     }
 
     victim_downtime = None
+    victim_restart = None
+    victim_ft_resume = None
     self_fraction = None
     if kill_ts is not None and "1" in commits:
         before = [ts for ts in commits["1"] if ts <= kill_ts]
         after = [ts for ts in commits["1"] if ts > kill_ts]
         if before and after:
             victim_downtime = min(after) - max(before)
+        # Decompose the dead window: replica ids are "<group>:<uuid>" with a
+        # fresh uuid per incarnation, so the restarted process's FIRST event
+        # of any kind marks "process up + JAX initialized".  Everything
+        # before that is environment cost (the scripted 3 s respawn delay +
+        # process spawn + JAX/XLA init); everything from there to the first
+        # commit is the FT system's own resume path (rejoin + heal + vote).
+        pre_ids = {
+            str(ev.get("replica_id"))
+            for ev in events
+            if str(ev.get("replica_id", "")).split(":", 1)[0] == "1"
+            and float(ev["ts"]) <= kill_ts
+        }
+        new_ev_ts = [
+            float(ev["ts"])
+            for ev in events
+            if str(ev.get("replica_id", "")).split(":", 1)[0] == "1"
+            and str(ev.get("replica_id")) not in pre_ids
+            and float(ev["ts"]) > kill_ts
+        ]
+        if new_ev_ts and after:
+            t_up = min(new_ev_ts)
+            victim_restart = t_up - kill_ts
+            victim_ft_resume = min(after) - t_up
         # Self-normalized goodput: the victim's total committed count vs
         # its own pre-kill rate extrapolated over the whole measurement
         # span.  Normalizing within one run makes the fraction immune to
@@ -400,9 +427,16 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
         "heals": heals,
         "heal_ms": heal_ms,
         "victim_downtime_s": victim_downtime,
+        "victim_restart_s": victim_restart,
+        "victim_ft_resume_s": victim_ft_resume,
         "goodput_self_fraction": self_fraction,
         "metrics_stream": True,
     }
+
+
+def _mean(values) -> float | None:
+    vals = [v for v in values if v is not None]
+    return round(sum(vals) / len(vals), 2) if vals else None
 
 
 def kill_benchmark() -> dict:
@@ -492,10 +526,15 @@ def kill_benchmark() -> dict:
         "baseline_relative_spread": (
             round(base_spread, 4) if base_spread is not None else None
         ),
-        "victim_downtime_s": (
-            round(sum(downtimes) / len(downtimes), 2) if downtimes else None
-        ),
+        "victim_downtime_s": _mean(downtimes),
         "victim_downtime_s_trials": [round(d, 2) for d in downtimes],
+        # Downtime decomposition (means over trials): restart = scripted 3 s
+        # respawn delay + process spawn + JAX/XLA init (environment floor —
+        # any per-step-FT system pays it, including the reference's
+        # torchelastic restart); ft_resume = quorum rejoin + live heal +
+        # first commit (the part THIS system is responsible for).
+        "victim_restart_s": _mean([k["victim_restart_s"] for k in kills]),
+        "victim_ft_resume_s": _mean([k["victim_ft_resume_s"] for k in kills]),
         "heal_ms_median": heal_ms[len(heal_ms) // 2] if heal_ms else None,
         "committed_batches_undisturbed": sum(b["committed_batches"] for b in bases),
         "committed_batches_with_kill": sum(k["committed_batches"] for k in kills),
@@ -514,9 +553,7 @@ def kill_benchmark() -> dict:
         # it for hourly failures, which is already far beyond BASELINE.md's
         # <5% target.
         "goodput_fraction_at_hourly_failures": (
-            round(1 - (sum(downtimes) / len(downtimes)) / 3600.0, 5)
-            if downtimes
-            else None
+            round(1 - _mean(downtimes) / 3600.0, 5) if downtimes else None
         ),
     }
 
@@ -541,11 +578,17 @@ def main() -> None:
             "construction).  Victim-only, within-run normalization: on a "
             "1-core host the survivor speeds up when its peer dies and "
             "run-to-run load variance exceeds the effect, which made the "
-            "round-3 total-vs-paired-run fraction land above 1.  The "
-            "fraction charges one kill per window (~100x any realistic "
-            "failure rate); see goodput_fraction_at_hourly_failures for "
-            "the steady-state number vs BASELINE.md's <5% target.  The "
-            "reference publishes no absolute numbers.",
+            "round-3 total-vs-paired-run fraction land above 1.  Context "
+            "for the absolute value: the fraction charges one kill per "
+            "window (a failure every ~45 s, ~100x any realistic rate), and "
+            "victim_restart_s shows most of the dead window is the "
+            "environment's process-respawn + JAX-init floor that ANY "
+            "per-step-FT system pays — the FT resume itself "
+            "(victim_ft_resume_s: rejoin + live heal + commit) is "
+            "sub-second.  goodput_fraction_at_hourly_failures restates the "
+            "measured downtime against BASELINE.md's <5% target at a "
+            "realistic failure rate.  The reference publishes no absolute "
+            "numbers.",
         },
     }
     try:
