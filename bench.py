@@ -361,6 +361,7 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
             "heals": heals,
             "heal_ms": [],
             "victim_downtime_s": None,
+            "victim_partial_step_s": None,
             "victim_restart_s": None,
             "victim_ft_resume_s": None,
             "goodput_self_fraction": None,
@@ -374,6 +375,7 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
     }
 
     victim_downtime = None
+    victim_partial_step = None
     victim_restart = None
     victim_ft_resume = None
     self_fraction = None
@@ -382,29 +384,42 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
         after = [ts for ts in commits["1"] if ts > kill_ts]
         if before and after:
             victim_downtime = min(after) - max(before)
-        # Decompose the dead window: replica ids are "<group>:<uuid>" with a
-        # fresh uuid per incarnation, so the restarted process's FIRST event
-        # of any kind marks "process up + JAX initialized".  Everything
-        # before that is environment cost (the scripted 3 s respawn delay +
-        # process spawn + JAX/XLA init); everything from there to the first
-        # commit is the FT system's own resume path (rejoin + heal + vote).
+            victim_partial_step = kill_ts - max(before)
+        # Decompose the dead window so the parts SUM to victim_downtime_s:
+        #   downtime = partial_step (last pre-kill commit -> kill)
+        #            + restart     (kill -> restarted process's first event)
+        #            + ft_resume   (first event -> first post-kill commit).
+        # Replica ids are "<group>:<uuid>" with a fresh uuid per
+        # incarnation, so the restarted process's first event of any kind
+        # marks "process up + JAX initialized"; restart is environment cost
+        # (scripted respawn delay + spawn + init), ft_resume is the FT
+        # system's own path (rejoin + heal + vote).  Only single-restart
+        # trials decompose — if the respawned process died again before its
+        # first commit (>1 new incarnation by then), attributing the extra
+        # dead window to "FT resume" would be false, so the trial reports
+        # None and is counted in multi_restart.
         pre_ids = {
             str(ev.get("replica_id"))
             for ev in events
             if str(ev.get("replica_id", "")).split(":", 1)[0] == "1"
             and float(ev["ts"]) <= kill_ts
         }
-        new_ev_ts = [
-            float(ev["ts"])
+        new_events = [
+            (float(ev["ts"]), str(ev.get("replica_id")))
             for ev in events
             if str(ev.get("replica_id", "")).split(":", 1)[0] == "1"
             and str(ev.get("replica_id")) not in pre_ids
             and float(ev["ts"]) > kill_ts
         ]
-        if new_ev_ts and after:
-            t_up = min(new_ev_ts)
-            victim_restart = t_up - kill_ts
-            victim_ft_resume = min(after) - t_up
+        if new_events and after:
+            t_commit = min(after)
+            incarnations_by_commit = {
+                rid for ts, rid in new_events if ts <= t_commit
+            }
+            if len(incarnations_by_commit) == 1:
+                t_up = min(ts for ts, _ in new_events)
+                victim_restart = t_up - kill_ts
+                victim_ft_resume = t_commit - t_up
         # Self-normalized goodput: the victim's total committed count vs
         # its own pre-kill rate extrapolated over the whole measurement
         # span.  Normalizing within one run makes the fraction immune to
@@ -427,6 +442,7 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
         "heals": heals,
         "heal_ms": heal_ms,
         "victim_downtime_s": victim_downtime,
+        "victim_partial_step_s": victim_partial_step,
         "victim_restart_s": victim_restart,
         "victim_ft_resume_s": victim_ft_resume,
         "goodput_self_fraction": self_fraction,
@@ -507,6 +523,7 @@ def kill_benchmark() -> dict:
         else None
     )
     downtimes = [k["victim_downtime_s"] for k in kills if k["victim_downtime_s"]]
+    decomposed = [k for k in kills if k["victim_restart_s"] is not None]
     heal_ms = sorted(ms for k in kills for ms in k["heal_ms"])
     heals = sum(k["heals"] for k in kills)
     return {
@@ -528,13 +545,25 @@ def kill_benchmark() -> dict:
         ),
         "victim_downtime_s": _mean(downtimes),
         "victim_downtime_s_trials": [round(d, 2) for d in downtimes],
-        # Downtime decomposition (means over trials): restart = scripted 3 s
-        # respawn delay + process spawn + JAX/XLA init (environment floor —
-        # any per-step-FT system pays it, including the reference's
-        # torchelastic restart); ft_resume = quorum rejoin + live heal +
-        # first commit (the part THIS system is responsible for).
-        "victim_restart_s": _mean([k["victim_restart_s"] for k in kills]),
-        "victim_ft_resume_s": _mean([k["victim_ft_resume_s"] for k in kills]),
+        # Downtime decomposition — partial_step + restart + ft_resume sums
+        # to victim_downtime_s per trial.  Means are taken over the SAME
+        # trial subset (those with a complete single-restart decomposition;
+        # multi-restart trials report None and are counted below).
+        # restart = scripted 3 s respawn delay + process spawn + JAX/XLA
+        # init (environment floor — any per-step-FT system pays it,
+        # including the reference's torchelastic restart); ft_resume =
+        # quorum rejoin + live heal + first commit (the part THIS system
+        # is responsible for).
+        "victim_partial_step_s": _mean(
+            [k["victim_partial_step_s"] for k in decomposed]
+        ),
+        "victim_restart_s": _mean([k["victim_restart_s"] for k in decomposed]),
+        "victim_ft_resume_s": _mean([k["victim_ft_resume_s"] for k in decomposed]),
+        "multi_restart_trials": sum(
+            1
+            for k in kills
+            if k["victim_downtime_s"] is not None and k["victim_restart_s"] is None
+        ),
         "heal_ms_median": heal_ms[len(heal_ms) // 2] if heal_ms else None,
         "committed_batches_undisturbed": sum(b["committed_batches"] for b in bases),
         "committed_batches_with_kill": sum(k["committed_batches"] for k in kills),

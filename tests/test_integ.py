@@ -312,6 +312,21 @@ def test_ddp_recovery_multiple_failures(lighthouse) -> None:
     _assert_params_equal(results)
 
 
+def test_ddp_simultaneous_failure_both_groups(lighthouse) -> None:
+    """TOTAL failure: both groups die at the same step, so no live peer
+    holds newer state and no heal is possible.  The restarts must re-form
+    a quorum from scratch without deadlocking on stale rendezvous state
+    (uuid-suffixed replica ids keep the restarted incarnations distinct),
+    whichever group restarts first trains ahead alone, the second heals
+    from it, and the job converges bitwise again."""
+    inj0 = FailureInjector().fail_at(0, 3)
+    inj1 = FailureInjector().fail_at(1, 3)
+    runners = _make_runners(lighthouse, [inj0, inj1], total_steps=8)
+    results = run_replicas(runners)
+    assert inj0.count == 1 and inj1.count == 1
+    _assert_params_equal(results)
+
+
 def _make_multi_rank_runners(lighthouse, injectors, world_size=2, total_steps=6):
     barrier = _DoneBarrier(len(injectors) * world_size)
     return [
