@@ -78,8 +78,10 @@ def flagship_config():
         n_layers=12,
         # head_dim 128 = TPU lane width: the pallas flash-attention kernel
         # engages (d_head 64 falls back to XLA S^2 attention) and MXU tiles
-        # are full.  Measured on v5e: 12 heads x 64 -> 18.3% MFU, 6 x 128 ->
-        # 23.4% at identical param count.
+        # are full.  Measured on v5e: 12 heads x 64 -> 273 ms/step, 6 x 128
+        # -> 213 ms at identical param count (rounds 1-3; MFU percentages
+        # from those rounds were computed against the wrong 394 TF/s peak —
+        # see _PEAKS — the wall times stand).
         n_heads=6,
         n_kv_heads=6,
         d_ff=2048,
@@ -92,9 +94,10 @@ def flagship_config():
         # boundaries, and >= n_layers takes the static-Python-loop path
         # (constant-folded layer indexing — kills ~17 ms/step of
         # dynamic-update-slice grad writes the scan form leaves behind).
-        # Measured on v5e at this config: scan 158 ms/step (22.7% MFU) ->
-        # scan-unroll 141 ms (25.4%) -> static loop 131 ms (27.3%).
-        # Partial unroll (4) was slower than any of these.
+        # Measured on v5e at this config: scan 158 ms/step -> scan-unroll
+        # 141 ms -> static loop 131 ms (round 3; now 108 ms with the
+        # round-4 pallas backward + fused CE).  Partial unroll (4) was
+        # slower than any of these.
         scan_unroll=12,
     )
     return cfg, 16, 1024
@@ -546,14 +549,19 @@ def kill_benchmark() -> dict:
         "victim_downtime_s": _mean(downtimes),
         "victim_downtime_s_trials": [round(d, 2) for d in downtimes],
         # Downtime decomposition — partial_step + restart + ft_resume sums
-        # to victim_downtime_s per trial.  Means are taken over the SAME
-        # trial subset (those with a complete single-restart decomposition;
-        # multi-restart trials report None and are counted below).
+        # to victim_decomposed_downtime_s: all four means are taken over
+        # the SAME trial subset (those with a complete single-restart
+        # decomposition; multi-restart trials report None and are counted
+        # below — victim_downtime_s above averages ALL trials and can
+        # differ when a multi-restart trial is present).
         # restart = scripted 3 s respawn delay + process spawn + JAX/XLA
         # init (environment floor — any per-step-FT system pays it,
         # including the reference's torchelastic restart); ft_resume =
         # quorum rejoin + live heal + first commit (the part THIS system
         # is responsible for).
+        "victim_decomposed_downtime_s": _mean(
+            [k["victim_downtime_s"] for k in decomposed]
+        ),
         "victim_partial_step_s": _mean(
             [k["victim_partial_step_s"] for k in decomposed]
         ),

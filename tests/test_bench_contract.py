@@ -94,3 +94,66 @@ def test_example_emits_committed_line(tmp_path) -> None:
         lighthouse.shutdown()
     assert out.returncode == 0, out.stdout + out.stderr
     assert "committed=True" in out.stdout
+
+
+def test_scenario_stats_accounting(tmp_path) -> None:
+    """Pins _scenario_stats' per-group counting, self-normalized fraction,
+    and the downtime decomposition (partial_step + restart + ft_resume ==
+    downtime; multi-restart trials refuse to decompose)."""
+    import json as _json
+    import sys
+
+    sys.path.insert(0, REPO)
+    from bench import _scenario_stats
+
+    def write(path, events):
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(_json.dumps(ev) + "\n")
+
+    # Group 0 commits at 1..40; group 1 commits at 1..10 (id A), killed at
+    # 10.5, new incarnation B's first event (quorum) at 17.5, first commit
+    # at 18, then 18..40.
+    events = []
+    for t in range(1, 41):
+        events.append({"ts": float(t), "replica_id": "0:a", "event": "commit", "committed": True})
+    for t in range(1, 11):
+        events.append({"ts": float(t), "replica_id": "1:A", "event": "commit", "committed": True})
+    events.append({"ts": 17.5, "replica_id": "1:B", "event": "quorum"})
+    events.append({"ts": 17.9, "replica_id": "1:B", "event": "heal_fetched", "heal_ms": 150.0})
+    for t in range(18, 41):
+        events.append({"ts": float(t), "replica_id": "1:B", "event": "commit", "committed": True})
+    path = tmp_path / "metrics.jsonl"
+    write(path, events)
+
+    stats = _scenario_stats(str(tmp_path), str(path), kill_ts=10.5)
+    assert stats["per_group"] == {"0": 40, "1": 33}
+    assert stats["heals"] == 1
+    # downtime 18-10=8; decomposition: partial 0.5 + restart 7.0 + resume 0.5
+    assert abs(stats["victim_downtime_s"] - 8.0) < 1e-6
+    assert abs(stats["victim_partial_step_s"] - 0.5) < 1e-6
+    assert abs(stats["victim_restart_s"] - 7.0) < 1e-6
+    assert abs(stats["victim_ft_resume_s"] - 0.5) < 1e-6
+    assert abs(
+        stats["victim_partial_step_s"]
+        + stats["victim_restart_s"]
+        + stats["victim_ft_resume_s"]
+        - stats["victim_downtime_s"]
+    ) < 1e-6
+    # Self-normalized fraction: pre-kill rate 10 commits / 9.5 s from t0=1,
+    # expected = rate * (40 - 1), actual 33.
+    rate = 10 / 9.5
+    assert abs(stats["goodput_self_fraction"] - 33 / (rate * 39)) < 1e-6
+
+    # Multi-restart: incarnation B dies too (one event, no commit), C heals.
+    events2 = [ev for ev in events if ev["replica_id"] != "1:B"]
+    events2.append({"ts": 14.0, "replica_id": "1:B", "event": "quorum"})
+    events2.append({"ts": 24.0, "replica_id": "1:C", "event": "quorum"})
+    for t in range(25, 41):
+        events2.append({"ts": float(t), "replica_id": "1:C", "event": "commit", "committed": True})
+    path2 = tmp_path / "metrics2.jsonl"
+    write(path2, events2)
+    stats2 = _scenario_stats(str(tmp_path), str(path2), kill_ts=10.5)
+    assert stats2["victim_downtime_s"] is not None
+    assert stats2["victim_restart_s"] is None  # refuses to decompose
+    assert stats2["victim_ft_resume_s"] is None
