@@ -29,8 +29,9 @@ _NEG_INF = -1e30
 def _block_attn(q, k, v, scale, row0, col0, causal):
     """One [Sq_local x Sk_local] attention block with global causal masking.
 
-    Returns unnormalized out, running max m and sum l — all f32.
-    q/k/v: [BH, S, D]; row0/col0: global offsets of the blocks.
+    Returns unnormalized out, running max m and sum l (stats f32).
+    q/k/v: [BH, S, D] in the INPUT dtype — matmuls run at bf16 MXU rate on
+    the model path with f32 accumulation; row0/col0: global block offsets.
     """
     s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32) * scale
     if causal:
@@ -42,7 +43,9 @@ def _block_attn(q, k, v, scale, row0, col0, causal):
     m_safe = jnp.maximum(m, -1e29)
     p = jnp.exp(s - m_safe)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    o = jnp.einsum(
+        "bqk,bkd->bqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
     return o, m_safe, l
 
 
@@ -64,12 +67,12 @@ def ring_attention(
     scale = scale if scale is not None else d ** -0.5
     idx = jax.lax.axis_index(axis_name)
 
-    qf = q.reshape(b * h, s_local, d).astype(jnp.float32)
-    kf = k.reshape(b * h, s_local, d).astype(jnp.float32)
-    vf = v.reshape(b * h, s_local, d).astype(jnp.float32)
+    qf = q.reshape(b * h, s_local, d)
+    kf = k.reshape(b * h, s_local, d)
+    vf = v.reshape(b * h, s_local, d)
 
     row0 = idx * s_local
-    acc = jnp.zeros_like(qf)
+    acc = jnp.zeros((b * h, s_local, d), jnp.float32)
     m = jnp.full((b * h, s_local, 1), _NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((b * h, s_local, 1), dtype=jnp.float32)
 
@@ -78,9 +81,32 @@ def ring_attention(
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     for t in range(axis_size):
         col_block = (idx - t) % axis_size
-        o_t, m_t, l_t = _block_attn(
-            qf, kf, vf, scale, row0, col_block * s_local, causal
-        )
+
+        def do_block(kf=kf, vf=vf, col_block=col_block):
+            return _block_attn(
+                qf, kf, vf, scale, row0, col_block * s_local, causal
+            )
+
+        if causal:
+            # A K/V block strictly above this Q shard's diagonal is fully
+            # masked — skip its two matmuls entirely (the contiguous layout
+            # gives some devices more skips than others; zigzag balancing
+            # is the known future fix, see module docstring).
+            # Skip-branch outputs are derived from the (mesh-varying) q
+            # shard so both cond branches have the same varying-axes type
+            # under shard_map.
+            zero_col = (0.0 * qf[..., :1]).astype(jnp.float32)
+            o_t, m_t, l_t = jax.lax.cond(
+                col_block > idx,
+                lambda: (
+                    (0.0 * qf).astype(jnp.float32),
+                    zero_col + _NEG_INF / 10,
+                    zero_col,
+                ),
+                do_block,
+            )
+        else:
+            o_t, m_t, l_t = do_block()
         m_new = jnp.maximum(m, m_t)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(m_t - m_new)
