@@ -258,6 +258,17 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
           return Status::kOk;
         }
       }
+      // A quorum formed WITHOUT this requester (e.g. a shrink_only round
+      // excluded a fresh joiner).  Formation cleared `participants`, so
+      // re-register for the next round or this caller would never be
+      // considered again (reference: the pending request stays queued,
+      // src/lighthouse.rs:494-530 / test at src/lighthouse.rs:1078-1181).
+      // Re-joining is an implicit heartbeat like the initial join above:
+      // a raw wire client (docs/wire.md) with no heartbeat loop must not
+      // age out of the healthy filter while it blocks here.
+      state_.heartbeats[id] = Clock::now();
+      state_.participants.emplace(id,
+                                  QuorumState::Joined{req.requester(), Clock::now()});
     }
     int64_t gen = quorum_gen_;
     bool woke = quorum_cv_.wait_until(lk, deadline.at, [&] {

@@ -20,21 +20,7 @@ namespace tpuft {
 
 namespace {
 
-constexpr uint32_t kMagic = 0x7f7a55aa;
-
-#pragma pack(push, 1)
-struct FrameHeader {
-  uint32_t magic;
-  uint16_t method;
-  uint16_t status;
-  uint64_t req_id;
-  // Relative deadline budget in ms chosen by the client; 0 = none.
-  uint64_t deadline_ms;
-  uint32_t len;
-  uint32_t reserved;
-};
-#pragma pack(pop)
-static_assert(sizeof(FrameHeader) == 32, "frame header must be 32 bytes");
+constexpr uint32_t kMagic = kFrameMagic;
 
 // Read exactly n bytes; honors an absolute poll deadline. Returns false on
 // EOF/error/timeout (timed_out set on timeout).
@@ -90,6 +76,8 @@ bool WriteFrame(int fd, uint16_t method, Status status, uint64_t req_id,
   h.req_id = req_id;
   h.deadline_ms = deadline_ms;
   h.len = static_cast<uint32_t>(payload.size());
+  h.version = kWireVersion;
+  h.flags = 0;
   h.reserved = 0;
   std::string buf;
   buf.reserve(sizeof(h) + payload.size());
@@ -103,6 +91,22 @@ bool ReadFrame(int fd, FrameHeader* h, std::string* payload, TimePoint deadline,
   if (!ReadFull(fd, reinterpret_cast<char*>(h), sizeof(*h), deadline, timed_out)) return false;
   if (h->magic != kMagic) return false;
   if (h->len > (1u << 30)) return false;  // 1 GiB sanity cap
+  // Version mismatch: the header itself parsed (the 32-byte layout is
+  // frozen across versions), but the payload encoding may not have —
+  // DRAIN the payload without interpreting it (leaving it unread would
+  // make close() send RST and destroy the rejection reply in flight),
+  // then hand the caller an empty payload to reject loudly.
+  if (h->version != kWireVersion) {
+    char scratch[4096];
+    uint64_t left = h->len;
+    while (left > 0) {
+      size_t chunk = left < sizeof(scratch) ? static_cast<size_t>(left) : sizeof(scratch);
+      if (!ReadFull(fd, scratch, chunk, deadline, timed_out)) return false;
+      left -= chunk;
+    }
+    payload->clear();
+    return true;
+  }
   payload->resize(h->len);
   if (h->len > 0 &&
       !ReadFull(fd, payload->empty() ? nullptr : &(*payload)[0], h->len, deadline, timed_out))
@@ -261,6 +265,12 @@ void RpcServer::Serve(int fd) {
     std::string payload;
     bool timed_out = false;
     if (!ReadFrame(fd, &h, &payload, TimePoint::max(), &timed_out)) break;
+    if (h.version != kWireVersion) {
+      std::string msg = "wire version mismatch: client v" + std::to_string(h.version) +
+                        ", server v" + std::to_string(kWireVersion) + " (see docs/wire.md)";
+      WriteFrame(fd, h.method, Status::kFailedPrecondition, h.req_id, 0, msg);
+      break;  // close: the payload encoding cannot be trusted
+    }
     Deadline dl = Deadline::FromMillis(h.deadline_ms);
     std::string resp;
     Status st;
@@ -416,6 +426,15 @@ Status RpcClient::CallLocked(uint16_t method, const std::string& req, uint64_t t
     }
     if (err) *err = "connection to " + addr_ + " lost";
     return Status::kUnavailable;
+  }
+  if (h.version != kWireVersion) {
+    close(fd_);
+    fd_ = -1;
+    if (err)
+      *err = "wire version mismatch: server " + addr_ + " speaks v" +
+             std::to_string(h.version) + ", client v" + std::to_string(kWireVersion) +
+             " (see docs/wire.md)";
+    return Status::kFailedPrecondition;
   }
   Status st = static_cast<Status>(h.status);
   if (st != Status::kOk && err) *err = *resp;

@@ -23,6 +23,31 @@ namespace tpuft {
 using Clock = std::chrono::steady_clock;
 using TimePoint = Clock::time_point;
 
+// Wire protocol version (see docs/wire.md).  Carried in every frame
+// header; a peer speaking a different version is rejected loudly with
+// FAILED_PRECONDITION rather than misparsed.  Pre-versioning builds
+// wrote 0 in this slot, so they are rejected too.
+constexpr uint8_t kWireVersion = 1;
+
+// The on-the-wire frame header (32 bytes, little-endian, packed).  This
+// IS the wire contract — see docs/wire.md for the field semantics.
+#pragma pack(push, 1)
+struct FrameHeader {
+  uint32_t magic;        // kFrameMagic
+  uint16_t method;       // Method enum below (requests); echoed in responses
+  uint16_t status;       // Status enum below; 0 (OK) in requests
+  uint64_t req_id;       // client-chosen, echoed in the response
+  uint64_t deadline_ms;  // relative deadline budget chosen by the client; 0 = none
+  uint32_t len;          // payload byte length (protobuf-serialized message)
+  uint8_t version;       // kWireVersion
+  uint8_t flags;         // reserved, must be 0
+  uint16_t reserved;     // reserved, must be 0
+};
+#pragma pack(pop)
+static_assert(sizeof(FrameHeader) == 32, "frame header must be 32 bytes");
+
+constexpr uint32_t kFrameMagic = 0x7f7a55aa;
+
 // gRPC-compatible status codes so the Python layer can map
 // CANCELLED/DEADLINE_EXCEEDED -> TimeoutError like the reference
 // (src/lib.rs:644-668).

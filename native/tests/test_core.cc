@@ -4,15 +4,24 @@
 // the spec for quorum math (src/lighthouse.rs:606-1038), recovery assignment
 // (src/manager.rs:752-934), and the in-process Lighthouse+Manager end-to-end
 // paths (src/lighthouse.rs:946-988, src/manager.rs:534-578).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
 #include <cassert>
 #include <chrono>
 #include <cstdio>
+#include <random>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
 
 #include "lighthouse.h"
 #include "manager.h"
+#include "retry.h"
 #include "store.h"
 #include "wire.h"
 
@@ -472,6 +481,353 @@ void TestStoreE2E() {
   store.Shutdown();
 }
 
+// --- Retry backoff (reference semantics: src/retry.rs:49-99) -----------------
+
+void TestRetryBackoff() {
+  // Deterministic progression with jitter disabled: 1 -> 2 -> 4 -> 8 -> cap.
+  ExponentialBackoff b(/*initial_ms=*/1, /*multiplier=*/2.0, /*max_ms=*/8,
+                       /*jitter_ms=*/0);
+  Deadline far = Deadline::FromMillis(60000);
+  CHECK(b.next_ms() == 1);
+  CHECK(b.Sleep(far));
+  CHECK(b.next_ms() == 2);
+  CHECK(b.Sleep(far));
+  CHECK(b.next_ms() == 4);
+  CHECK(b.Sleep(far));
+  CHECK(b.next_ms() == 8);
+  CHECK(b.Sleep(far));
+  CHECK(b.next_ms() == 8);  // capped
+
+  // An operation that fails twice then succeeds is attempted exactly 3 times
+  // (the reference's retry_backoff contract).
+  ExponentialBackoff b2(1, 2.0, 8, 1);
+  Deadline dl = Deadline::FromMillis(60000);
+  int attempts = 0;
+  bool ok = false;
+  do {
+    attempts += 1;
+    if (attempts >= 3) {
+      ok = true;
+      break;
+    }
+  } while (b2.Sleep(dl));
+  CHECK(ok && attempts == 3);
+
+  // A deadline with less time left than the next sleep stops retrying.
+  ExponentialBackoff b3(50, 2.0, 100, 0);
+  Deadline tight = Deadline::FromMillis(1);
+  std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  CHECK(!b3.Sleep(tight));
+
+  // Deadline accessors: 0 means "none" (never expires).
+  Deadline none = Deadline::FromMillis(0);
+  CHECK(!none.expired());
+  CHECK(none.remaining_ms() == INT64_MAX);
+  Deadline soon = Deadline::FromMillis(5);
+  CHECK(soon.remaining_ms() <= 5);
+  std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  CHECK(soon.expired());
+  CHECK(soon.remaining_ms() == 0);
+}
+
+// --- Raw-frame helpers for wire-contract tests -------------------------------
+
+bool RawCall(int fd, const FrameHeader& h, const std::string& payload) {
+  std::string buf(reinterpret_cast<const char*>(&h), sizeof(h));
+  buf += payload;
+  size_t sent = 0;
+  while (sent < buf.size()) {
+    ssize_t r = send(fd, buf.data() + sent, buf.size() - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool RawRead(int fd, FrameHeader* h, std::string* payload) {
+  char* p = reinterpret_cast<char*>(h);
+  size_t got = 0;
+  while (got < sizeof(*h)) {
+    ssize_t r = recv(fd, p + got, sizeof(*h) - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  if (h->magic != kFrameMagic || h->len > (1u << 20)) return false;
+  payload->resize(h->len);
+  got = 0;
+  while (got < h->len) {
+    ssize_t r = recv(fd, &(*payload)[got], h->len - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// The frame deadline is honored SERVER-side (the analogue of the reference's
+// grpc-timeout header parsing, src/timeout.rs:18-61): a hand-written frame
+// with deadline_ms=150 against a blocking store wait comes back
+// DEADLINE_EXCEEDED from the server even though the client never times out.
+void TestFrameDeadlinePropagation() {
+  StoreServer store("127.0.0.1:0");
+  std::string err;
+  CHECK(store.Start(&err));
+  int fd = DialTcp(store.address(), 2000, &err);
+  CHECK(fd >= 0);
+
+  StoreGetRequest get;
+  get.set_key("never-set");
+  get.set_wait(true);
+  std::string payload;
+  get.SerializeToString(&payload);
+
+  FrameHeader h = {};
+  h.magic = kFrameMagic;
+  h.method = kStoreGet;
+  h.status = 0;
+  h.req_id = 7;
+  h.deadline_ms = 150;
+  h.len = static_cast<uint32_t>(payload.size());
+  h.version = kWireVersion;
+  auto t0 = Clock::now();
+  CHECK(RawCall(fd, h, payload));
+  FrameHeader rh;
+  std::string rpayload;
+  CHECK(RawRead(fd, &rh, &rpayload));  // no client-side deadline at all
+  auto elapsed =
+      std::chrono::duration_cast<std::chrono::milliseconds>(Clock::now() - t0).count();
+  CHECK(static_cast<Status>(rh.status) == Status::kDeadlineExceeded);
+  CHECK(rh.req_id == 7);
+  CHECK(elapsed >= 100 && elapsed < 5000);
+  close(fd);
+  store.Shutdown();
+}
+
+// A mismatched wire version must fail loudly in both directions
+// (docs/wire.md): servers answer FAILED_PRECONDITION and close; clients map a
+// mismatched response the same way.
+void TestWireVersionMismatch() {
+  StoreServer store("127.0.0.1:0");
+  std::string err;
+  CHECK(store.Start(&err));
+
+  // Client speaking version 0 (a pre-versioning build wrote 0 in this slot).
+  int fd = DialTcp(store.address(), 2000, &err);
+  CHECK(fd >= 0);
+  StoreGetRequest get;
+  get.set_key("k");
+  std::string payload;
+  get.SerializeToString(&payload);
+  FrameHeader h = {};
+  h.magic = kFrameMagic;
+  h.method = kStoreGet;
+  h.req_id = 1;
+  h.len = static_cast<uint32_t>(payload.size());
+  h.version = 0;
+  CHECK(RawCall(fd, h, payload));
+  FrameHeader rh;
+  std::string rpayload;
+  CHECK(RawRead(fd, &rh, &rpayload));
+  CHECK(static_cast<Status>(rh.status) == Status::kFailedPrecondition);
+  CHECK(rpayload.find("wire version mismatch") != std::string::npos);
+  // ...and the server closes the connection afterwards (EOF or reset).
+  char onebyte;
+  CHECK(recv(fd, &onebyte, 1, 0) <= 0);
+  close(fd);
+  store.Shutdown();
+
+  // Server speaking a FUTURE version: a raw listener echoes version 2; the
+  // real client must reject it as FAILED_PRECONDITION, not misparse it.
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  CHECK(lfd >= 0);
+  struct sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = 0;
+  CHECK(bind(lfd, reinterpret_cast<struct sockaddr*>(&sa), sizeof(sa)) == 0);
+  CHECK(listen(lfd, 1) == 0);
+  socklen_t salen = sizeof(sa);
+  CHECK(getsockname(lfd, reinterpret_cast<struct sockaddr*>(&sa), &salen) == 0);
+  uint16_t port = ntohs(sa.sin_port);
+
+  std::thread fake_server([&] {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) return;
+    FrameHeader req;
+    std::string rq;
+    if (RawRead(cfd, &req, &rq)) {
+      FrameHeader resp = {};
+      resp.magic = kFrameMagic;
+      resp.method = req.method;
+      resp.status = 0;
+      resp.req_id = req.req_id;
+      resp.len = 0;
+      resp.version = 2;  // future
+      RawCall(cfd, resp, "");
+    }
+    close(cfd);
+  });
+
+  RpcClient c("127.0.0.1:" + std::to_string(port));
+  std::string cerr;
+  CHECK(c.Connect(2000, &cerr) == Status::kOk);
+  std::string resp;
+  Status st = c.Call(kStoreGet, payload, 2000, &resp, &cerr);
+  CHECK(st == Status::kFailedPrecondition);
+  CHECK(cerr.find("wire version mismatch") != std::string::npos);
+  fake_server.join();
+  close(lfd);
+}
+
+// --- Join during shrink, end to end ------------------------------------------
+// Ports the semantics of the reference's test_lighthouse_join_during_shrink
+// (src/lighthouse.rs:1078-1181): a joiner whose quorum call lands during a
+// shrink_only round is excluded from THAT quorum but stays queued and is
+// admitted by the next normal round — its blocked RPC resolves with the
+// 3-member quorum.
+void TestJoinDuringShrink() {
+  LighthouseOpt opt;
+  opt.bind = "127.0.0.1:0";
+  opt.http_bind = "";
+  opt.min_replicas = 2;
+  opt.join_timeout_ms = 1000;
+  opt.quorum_tick_ms = 10;
+  Lighthouse lh(opt);
+  std::string err;
+  CHECK(lh.Start(&err));
+
+  auto join = [&](const std::string& id, int64_t step, bool shrink_only,
+                  LighthouseQuorumResponse* out) {
+    RpcClient c(lh.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = MakeMember(id, step, 1, shrink_only);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    Status st = c.Call(kLighthouseQuorum, payload, 20000, &resp, &cerr);
+    if (st != Status::kOk) fprintf(stderr, "join(%s) failed: %s\n", id.c_str(), cerr.c_str());
+    CHECK(st == Status::kOk);
+    CHECK(out->ParseFromString(resp));
+  };
+
+  // 1. First quorum: {a, b}.
+  LighthouseQuorumResponse qa, qb, qjoin;
+  std::thread t1a([&] { join("a", 1, false, &qa); });
+  std::thread t1b([&] { join("b", 1, false, &qb); });
+  t1a.join();
+  t1b.join();
+  CHECK(qa.quorum().participants_size() == 2);
+
+  // 2. A fresh joiner's call lands first, then a shrink_only round runs.
+  std::thread tj([&] { join("joiner", 1, false, &qjoin); });
+  // Give the joiner time to register so the shrink round actually sees it.
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  std::thread t2a([&] { join("a", 2, true, &qa); });
+  std::thread t2b([&] { join("b", 2, false, &qb); });
+  t2a.join();
+  t2b.join();
+  CHECK(qa.quorum().participants_size() == 2);
+  for (const auto& m : qa.quorum().participants()) CHECK(m.replica_id() != "joiner");
+
+  // 3. Next normal round admits the queued joiner: quorum of 3, and the
+  // joiner's original blocked call resolves with it.
+  std::thread t3a([&] { join("a", 3, false, &qa); });
+  std::thread t3b([&] { join("b", 3, false, &qb); });
+  t3a.join();
+  t3b.join();
+  tj.join();
+  CHECK(qa.quorum().participants_size() == 3);
+  bool joiner_in = false;
+  for (const auto& m : qa.quorum().participants())
+    if (m.replica_id() == "joiner") joiner_in = true;
+  CHECK(joiner_in);
+  CHECK(qjoin.quorum().participants_size() == 3);
+  CHECK(qjoin.quorum().quorum_id() == qa.quorum().quorum_id());
+
+  lh.Shutdown();
+}
+
+// --- QuorumCompute property fuzz ---------------------------------------------
+// Randomized join/leave/heartbeat/round sequences; the invariants the
+// reference effectively specs with ~590 test lines (src/lighthouse.rs:606-1038):
+//   1. a formed quorum is never below min_replicas;
+//   2. every member is healthy (heartbeat younger than the timeout);
+//   3. every member joined this round (is a participant);
+//   4. a shrink_only round never admits anyone outside the previous quorum;
+//   5. unless every previous member is present (fast quorum), membership is
+//      a strict majority of everything healthy (split-brain guard).
+void TestQuorumComputeFuzz() {
+  std::mt19937 rng(0xf7);  // fixed seed: reproducible
+  const std::vector<std::string> ids = {"r0", "r1", "r2", "r3", "r4", "r5"};
+  auto hb = std::chrono::milliseconds(5000);
+
+  for (int trial = 0; trial < 200; ++trial) {
+    LighthouseOpt opt;
+    opt.min_replicas = 1 + rng() % 3;
+    opt.join_timeout_ms = rng() % 2 ? 0 : 60000;
+    opt.heartbeat_timeout_ms = 5000;
+    QuorumState s;
+    auto now = Clock::now();
+
+    for (int op = 0; op < 60; ++op) {
+      const std::string& id = ids[rng() % ids.size()];
+      switch (rng() % 5) {
+        case 0:  // join (fresh heartbeat implied, like HandleQuorum)
+          Join(&s, MakeMember(id, rng() % 10, 1, rng() % 4 == 0), now);
+          break;
+        case 1:  // heartbeat only
+          s.heartbeats[id] = now;
+          break;
+        case 2:  // heartbeat expiry
+          s.heartbeats[id] = now - hb * 2;
+          break;
+        case 3:  // participant withdraws (connection drop)
+          s.participants.erase(id);
+          break;
+        case 4: {  // tick: try to form a quorum
+          std::string reason;
+          auto members = QuorumCompute(now, s, opt, &reason);
+          if (!members) break;
+
+          CHECK(members->size() >= opt.min_replicas);  // (1)
+
+          std::set<std::string> healthy;
+          for (const auto& [hid, last] : s.heartbeats)
+            if (now - last < hb) healthy.insert(hid);
+          std::set<std::string> prev_ids;
+          if (s.prev_quorum)
+            for (const auto& m : s.prev_quorum->participants())
+              prev_ids.insert(m.replica_id());
+          bool shrink = false;
+          for (const auto& [pid, j] : s.participants)
+            if (healthy.count(pid) && j.member.shrink_only()) shrink = true;
+
+          std::set<std::string> member_ids;
+          for (const auto& m : *members) {
+            member_ids.insert(m.replica_id());
+            CHECK(healthy.count(m.replica_id()) == 1);          // (2)
+            CHECK(s.participants.count(m.replica_id()) == 1);   // (3)
+            if (shrink && s.prev_quorum)
+              CHECK(prev_ids.count(m.replica_id()) == 1);       // (4)
+          }
+          bool fast = s.prev_quorum && !prev_ids.empty() &&
+                      std::all_of(prev_ids.begin(), prev_ids.end(),
+                                  [&](const std::string& p) { return member_ids.count(p); });
+          if (!fast) CHECK(members->size() * 2 > healthy.size());  // (5)
+
+          // Round rollover, as TickLocked does.
+          Quorum q;
+          q.set_quorum_id(++s.quorum_id);
+          for (const auto& m : *members) *q.add_participants() = m;
+          s.prev_quorum = q;
+          s.participants.clear();
+          break;
+        }
+      }
+    }
+  }
+}
+
 }  // namespace
 
 int main() {
@@ -489,6 +845,11 @@ int main() {
   TestLighthouseE2E();
   TestManagerE2E();
   TestStoreE2E();
+  TestRetryBackoff();
+  TestFrameDeadlinePropagation();
+  TestWireVersionMismatch();
+  TestJoinDuringShrink();
+  TestQuorumComputeFuzz();
   printf("all native tests passed\n");
   return 0;
 }

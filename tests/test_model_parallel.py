@@ -194,6 +194,11 @@ def test_speculation_fits_budget_arithmetic() -> None:
     stats = {"bytes_limit": 16 << 30, "bytes_in_use": 6 << 30}
     assert speculation_fits(8 << 30, FakeDevice(stats)) is True
     assert speculation_fits(10 << 30, FakeDevice(stats)) is False
+    # The allocator peak (post-step: includes activations/workspace)
+    # governs when reported: 16-12=4 GB budget despite 10 GB "free" now.
+    peaky = dict(stats, peak_bytes_in_use=12 << 30)
+    assert speculation_fits(3 << 30, FakeDevice(peaky)) is True
+    assert speculation_fits(8 << 30, FakeDevice(peaky)) is False
     # No statistics (CPU devices, some TPU tunnels): undecidable.
     assert speculation_fits(1, FakeDevice(None)) is None
     assert speculation_fits(1, FakeDevice({})) is None

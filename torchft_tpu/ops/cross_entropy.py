@@ -83,7 +83,23 @@ def fused_ce_applicable(n: int, e: int, v: int, mesh=None) -> bool:
     """
     if not on_tpu():
         return False
-    if mesh is not None and getattr(mesh, "size", 1) > 1:
+    if mesh is None:
+        # Callers that omit mesh (e.g. single-arg loss_fn closures) may
+        # still be tracing under a multi-device GSPMD jit; fall back to
+        # the ambient abstract mesh, then the process device count.  The
+        # device-count check also turns the kernel off for a genuinely
+        # single-device jit on a multi-chip host, which is a deliberate
+        # asymmetric trade: the unfused XLA path is wall-neutral there
+        # (docs/architecture.md — the fusion's win is HBM residency),
+        # while running the pallas custom call replicated under a
+        # sharded jit is a large silent cliff.  Multi-chip callers that
+        # want the kernel single-device pass mesh explicitly.
+        amesh = jax.sharding.get_abstract_mesh()
+        if amesh is not None and not amesh.empty and amesh.size > 1:
+            return False
+        if jax.device_count() > 1:
+            return False
+    elif getattr(mesh, "size", 1) > 1:
         return False
     # Blocks are solved against explicit per-operand VMEM budgets, so the
     # gate is simply "a valid tiling exists" — no separate size check that

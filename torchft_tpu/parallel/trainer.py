@@ -63,8 +63,12 @@ def tree_device_bytes(tree: Any) -> int:
 def speculation_fits(extra_bytes: int, device: Any) -> Optional[bool]:
     """Whether an extra `extra_bytes` fits the device's free HBM.
 
-    Returns None when the runtime exposes no memory statistics (CPU
-    devices; some TPU tunnels) — the caller decides the default."""
+    Budgets against the allocator's PEAK (when reported), not the
+    current bytes_in_use: callers decide after a full step has executed,
+    and the peak is what proves the step's activation/workspace
+    footprint coexisted with the resident state.  Returns None when the
+    runtime exposes no memory statistics (CPU devices; some TPU
+    tunnels) — the caller decides the default."""
     try:
         stats = device.memory_stats()
     except Exception:  # noqa: BLE001
@@ -75,7 +79,9 @@ def speculation_fits(extra_bytes: int, device: Any) -> Optional[bool]:
     in_use = stats.get("bytes_in_use")
     if limit is None or in_use is None:
         return None
-    return extra_bytes <= (limit - in_use) * _SPECULATION_HEADROOM
+    peak = stats.get("peak_bytes_in_use")
+    high_water = max(in_use, peak) if peak is not None else in_use
+    return extra_bytes <= (limit - high_water) * _SPECULATION_HEADROOM
 
 
 @dataclasses.dataclass
@@ -90,10 +96,12 @@ class TrainStep:
         overlap_commit: hide the commit-vote RPC behind a speculatively
             dispatched update (see ft_step).  MEMORY TRADE: the speculative
             apply cannot donate its inputs, so params+opt_state residency
-            transiently doubles during the update.  Default None = decide
-            automatically on the first ft_step: overlap iff an extra
-            params+opt_state copy fits the device's free HBM (with 10%
-            headroom for XLA temporaries); when the runtime exposes no
+            transiently doubles during the update.  Default None = run the
+            FIRST ft_step non-overlapped, then decide from the device's
+            post-step memory stats (allocator peak, so the measurement
+            includes the step's activation/workspace footprint): overlap
+            iff an extra params+opt_state copy fits above the observed
+            peak with 10% headroom; when the runtime exposes no
             memory statistics the overlap is kept (its failure mode — an
             allocator OOM — is loud, while silently serializing the vote
             would be an invisible perf cliff).  Pass True/False to force.
@@ -162,6 +170,27 @@ class TrainStep:
 
     # -- fault-tolerant step -------------------------------------------------
 
+    def _resolve_overlap(self, params: Any, opt_state: Any) -> None:
+        """Decide overlap_commit from post-step device memory stats."""
+        extra = tree_device_bytes(params) + tree_device_bytes(opt_state)
+        device = None
+        for leaf in jax.tree.leaves(params):
+            devs = getattr(leaf, "devices", None)
+            if callable(devs):
+                ds = devs()
+                if ds:
+                    device = next(iter(ds))
+                    break
+        fits = speculation_fits(extra, device) if device is not None else None
+        self._overlap_resolved = True if fits is None else fits
+        logger.info(
+            "overlap_commit auto: %s (extra %.2f GB for the speculative "
+            "apply, post-step device stats %s)",
+            self._overlap_resolved,
+            extra / 1e9,
+            "unavailable" if fits is None else "available",
+        )
+
     def ft_step(self, params, opt_state, batch):
         """One FT step: local grads -> Manager DCN allreduce -> commit-gated
         update.  Returns (params, opt_state, loss, committed).
@@ -194,25 +223,13 @@ class TrainStep:
         if self._averager is None or self._averager.manager is not manager:
             self._averager = GradientAverager(manager, self.bucket_bytes)
 
-        if self._overlap_resolved is None:
-            extra = tree_device_bytes(params) + tree_device_bytes(opt_state)
-            device = None
-            for leaf in jax.tree.leaves(params):
-                devs = getattr(leaf, "devices", None)
-                if callable(devs):
-                    ds = devs()
-                    if ds:
-                        device = next(iter(ds))
-                        break
-            fits = speculation_fits(extra, device) if device is not None else None
-            self._overlap_resolved = True if fits is None else fits
-            logger.info(
-                "overlap_commit auto: %s (extra %.2f GB for the speculative "
-                "apply, device stats %s)",
-                self._overlap_resolved,
-                extra / 1e9,
-                "unavailable" if fits is None else "available",
-            )
+        # overlap_commit=None: the FIRST step runs non-overlapped, and the
+        # decision is made from the device's memory stats AFTER it — deciding
+        # before any step executed would read a bytes_in_use that excludes
+        # the step's activation/workspace footprint and could green-light a
+        # speculative apply that OOMs; after one full step the allocator's
+        # peak covers compute + resident state.
+        resolve_after = self._overlap_resolved is None
 
         loss, grads = self._grads_fn(params, batch)
         grads = self._averager.allreduce(grads)
@@ -221,7 +238,13 @@ class TrainStep:
             if manager.should_commit():
                 return new_params, new_opt, loss, True
             return params, opt_state, loss, False
-        if manager.should_commit():
+        committed = manager.should_commit()
+        if committed:
             params, opt_state = self._apply_fn(params, opt_state, grads)
-            return params, opt_state, loss, True
-        return params, opt_state, loss, False
+        # Only a COMMITTED step resolves the decision: an aborted vote means
+        # _apply_fn never ran, so the allocator peak would exclude the
+        # optimizer-apply footprint the budget must cover.
+        if resolve_after and committed:
+            jax.block_until_ready(jax.tree.leaves(params))
+            self._resolve_overlap(params, opt_state)
+        return params, opt_state, loss, committed
