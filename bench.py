@@ -159,30 +159,6 @@ def chip_benchmark() -> dict:
     est = max(1e-3, time.perf_counter() - t0)
     steps = max(20, min(200, int(6.0 / est)))
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = raw_step()
-    fetch(loss)  # loss depends on params_{k-1}: forces the whole chain
-    raw_dt = time.perf_counter() - t0
-    raw_tps = tokens_per_step * steps / raw_dt
-    raw_mfu = (flops_per_step * steps / raw_dt / peak) if peak else None
-
-    if raw_mfu is not None and raw_mfu > 1.0:
-        print(
-            json.dumps(
-                {
-                    "metric": "ft_train_goodput",
-                    "value": 0,
-                    "unit": "tokens/sec",
-                    "vs_baseline": 0,
-                    "error": f"implausible measurement: raw MFU {raw_mfu:.2f} "
-                    f"exceeds 100% of {device.device_kind} peak — timing is "
-                    "not capturing real device execution",
-                }
-            )
-        )
-        sys.exit(1)
-
     # -- ft (one replica group, full stack) -------------------------------
     from torchft_tpu._native import LighthouseServer
     from torchft_tpu.checkpointing.http_transport import HTTPTransport
@@ -215,34 +191,201 @@ def chip_benchmark() -> dict:
         assert committed, "bench step failed to commit"
         return loss
 
+    # INTERLEAVED measurement: raw and FT blocks alternate (R,F,R,F,...) so
+    # slow host-load drift hits both paths equally; the FT overhead is then
+    # judged against the raw blocks' own spread rather than stated as a
+    # point estimate (round-4 lesson: ft measured *faster* than raw — the
+    # difference is below run variance, and the honest claim is exactly
+    # that).
+    reps = 3
+    block = max(7, steps // reps)
+    raw_block_tps: list[float] = []
+    ft_block_tps: list[float] = []
     try:
-        for _ in range(3):
+        for _ in range(3):  # FT warmup (compile path is shared with raw)
             loss = ft_one_step()
         fetch(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = ft_one_step()
-        fetch(loss)
-        ft_dt = time.perf_counter() - t0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(block):
+                loss = raw_step()
+            fetch(loss)  # loss depends on params_{k-1}: forces the chain
+            raw_block_tps.append(tokens_per_step * block / (time.perf_counter() - t0))
+
+            t0 = time.perf_counter()
+            for _ in range(block):
+                loss = ft_one_step()
+            fetch(loss)
+            ft_block_tps.append(tokens_per_step * block / (time.perf_counter() - t0))
     finally:
         manager.shutdown()
         lighthouse.shutdown()
 
-    ft_tps = tokens_per_step * steps / ft_dt
+    raw_tps = sum(raw_block_tps) / reps
+    ft_tps = sum(ft_block_tps) / reps
+    raw_dt = tokens_per_step * block * reps / raw_tps
+    ft_dt = tokens_per_step * block * reps / ft_tps
+    steps = block * reps
+    raw_mfu = (flops_per_step * steps / raw_dt / peak) if peak else None
     ft_mfu = (flops_per_step * steps / ft_dt / peak) if peak else None
+
+    if raw_mfu is not None and raw_mfu > 1.0:
+        print(
+            json.dumps(
+                {
+                    "metric": "ft_train_goodput",
+                    "value": 0,
+                    "unit": "tokens/sec",
+                    "vs_baseline": 0,
+                    "error": f"implausible measurement: raw MFU {raw_mfu:.2f} "
+                    f"exceeds 100% of {device.device_kind} peak — timing is "
+                    "not capturing real device execution",
+                }
+            )
+        )
+        sys.exit(1)
+
+    # Run-to-run noise floor: the raw path's own block-to-block spread.
+    raw_noise = (max(raw_block_tps) - min(raw_block_tps)) / raw_tps
+    overhead = 1 - ft_tps / raw_tps
 
     return {
         "device": str(device.device_kind),
         "model": f"transformer-lm 12L d768 bf16 seq{seq} batch{batch_size} "
         f"({n_params/1e6:.0f}M params)",
         "steps_timed": steps,
+        "interleaved_blocks": reps,
         "raw_tokens_per_sec": round(raw_tps, 1),
         "ft_tokens_per_sec": round(ft_tps, 1),
+        "raw_block_tokens_per_sec": [round(x, 1) for x in raw_block_tps],
+        "ft_block_tokens_per_sec": [round(x, 1) for x in ft_block_tps],
         "ft_step_ms": round(ft_dt / steps * 1000, 2),
         "raw_step_ms": round(raw_dt / steps * 1000, 2),
-        "ft_overhead_fraction": round(1 - ft_tps / raw_tps, 4),
+        "ft_overhead_fraction": round(overhead, 4),
+        "raw_noise_fraction": round(raw_noise, 4),
+        # The claim the README is allowed to make: overhead resolved, or
+        # below the measurement's own noise floor.
+        "ft_overhead_below_noise": bool(abs(overhead) <= raw_noise),
         "raw_mfu": round(raw_mfu, 4) if raw_mfu is not None else None,
         "ft_mfu": round(ft_mfu, 4) if ft_mfu is not None else None,
+    }
+
+
+def large_config():
+    """The scale-proof model: ~1B params, the largest round shape that fits
+    one v5e chip (16 GB HBM) with f32 params + a memory-lean factored
+    optimizer + per-layer rematerialization.  VERDICT r4 #2: show the MFU
+    and heal story survive a ~10x model (reference capability chased:
+    'train models such as Llama 3 70B', reference README)."""
+    from torchft_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=32000,
+        d_model=2048,
+        n_layers=12,
+        n_heads=16,
+        n_kv_heads=16,
+        d_ff=8192,
+        max_seq=1024,
+        remat=True,  # per-layer rematerialization: activations stay ~flat in L
+        scan_unroll=12,  # static layer loop, same as the flagship
+    )
+    return cfg, 8, 1024
+
+
+def large_chip_benchmark() -> dict | None:
+    """Step time / MFU for the ~1B model on the real chip, plus the live
+    heal cost at that size (the full state dict through HTTPTransport on
+    localhost — the same bytes a healing replica must ingest)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu.models import init_params, loss_fn
+    from torchft_tpu.parallel import TrainStep, ft_init_mesh
+
+    device = jax.devices()[0]
+    if "tpu" not in device.platform.lower() or os.environ.get(
+        "TPUFT_BENCH_LARGE", "1"
+    ) == "0":
+        return None
+
+    cfg, batch_size, seq = large_config()
+    tokens_per_step = batch_size * seq
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(batch_size, seq)), dtype=jnp.int32
+    )
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    flops_per_step = (
+        6 * n_params + 6 * cfg.n_layers * seq * cfg.d_model
+    ) * tokens_per_step
+    # Remat recomputes the layer stack in backward (~+2N per token of the
+    # layer FLOPs); MFU is still stated against the USEFUL flops above —
+    # that is the number that compares across configs.
+    peak = _peak_flops(device)
+
+    ftmesh = ft_init_mesh({"data": 1}, devices=[device])
+    tx = optax.adafactor(3e-4)  # factored second moments: O(d) state, not O(d^2)
+    step = TrainStep(ftmesh, tx, lambda p, b: loss_fn(p, b, cfg))
+    state = {"params": params, "opt": step.init_opt_state(params)}
+
+    def fetch(x) -> float:
+        return float(np.asarray(x))
+
+    def raw_step():
+        state["params"], state["opt"], loss = step.full_step(
+            state["params"], state["opt"], batch
+        )
+        return loss
+
+    for _ in range(2):
+        loss = raw_step()
+    fetch(loss)
+    t0 = time.perf_counter()
+    fetch(raw_step())
+    est = max(1e-3, time.perf_counter() - t0)
+    steps = max(10, min(60, int(8.0 / est)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = raw_step()
+    fetch(loss)
+    dt = time.perf_counter() - t0
+    tps = tokens_per_step * steps / dt
+    mfu = (flops_per_step * steps / dt / peak) if peak else None
+
+    # Heal cost at this size: stream the full live state dict through the
+    # HTTP checkpoint transport (send + chunked recv) on localhost.  This
+    # is the byte path a healed replica pays on top of restart.
+    flat = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state["params"]):
+        flat["p" + jax.tree_util.keystr(path)] = np.asarray(leaf)
+    state_bytes = sum(a.nbytes for a in flat.values())
+    # Both live transports; on this 1-core host both endpoints share one
+    # core, so these are FLOORS — real multi-host hardware has a NIC and
+    # cores per endpoint (TRANSFER_BENCH.json records the same floor for
+    # the 2 GB synthetic state).
+    heal = {"state_gb": round(state_bytes / 1e9, 2)}
+    try:
+        from bench_transfer import bench_collective, bench_http
+
+        heal["http"] = bench_http(flat, state_bytes, num_chunks=4)
+        heal["collective"] = bench_collective(flat, state_bytes)
+    except Exception as e:  # noqa: BLE001
+        heal["error"] = repr(e)
+
+    return {
+        "model": f"transformer-lm {cfg.n_layers}L d{cfg.d_model} bf16 seq{seq} "
+        f"batch{batch_size} ({n_params/1e6:.0f}M params, remat, adafactor)",
+        "steps_timed": steps,
+        "step_ms": round(dt / steps * 1000, 2),
+        "tokens_per_sec": round(tps, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "heal_transfer": heal,
     }
 
 
@@ -251,12 +394,89 @@ def chip_benchmark() -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _read_events(metrics_path: str) -> list:
+    events = []
+    try:
+        with open(metrics_path, "rb") as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return events
+
+
+class _MetricsTail:
+    """Incremental reader of the shared metrics.jsonl.
+
+    The churn watcher polls every 250 ms on the same single core being
+    measured; re-parsing the whole (growing) file each tick would steal
+    CPU from the heal interval whose duration is the headline number.
+    Appends are line-atomic (O_APPEND), so tailing from the last consumed
+    newline is safe."""
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._pos = 0
+        self.events: list = []
+
+    def poll(self) -> list:
+        try:
+            with open(self._path, "rb") as f:
+                f.seek(self._pos)
+                chunk = f.read()
+        except OSError:
+            return self.events
+        if not chunk:
+            return self.events
+        # Only consume up to the last complete line.
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return self.events
+        self._pos += end + 1
+        for line in chunk[: end + 1].splitlines():
+            try:
+                self.events.append(json.loads(line))
+            except ValueError:
+                continue
+        return self.events
+
+
+def _victim_incarnations(events, group: str) -> dict:
+    """{replica_id: (first_event_ts, first_commit_ts|None)} for one group."""
+    out: dict = {}
+    for ev in events:
+        rid = str(ev.get("replica_id", ""))
+        if rid.split(":", 1)[0] != group:
+            continue
+        ts = float(ev.get("ts", 0.0))
+        first, commit = out.get(rid, (ts, None))
+        first = min(first, ts)
+        if ev.get("event") == "commit" and ev.get("committed") and (
+            commit is None or ts < commit
+        ):
+            commit = ts
+        out[rid] = (first, commit)
+    return out
+
+
 def _run_scenario(
-    workdir: str, window_s: float, kill_at_s: float | None, cache_dir: str
+    workdir: str, window_s: float, plan: dict | None, cache_dir: str
 ) -> dict:
-    """Two supervised replica-group processes; optionally SIGKILL group 1 at
-    kill_at_s into the measurement window (supervisor restarts it, it heals
-    live from group 0).  Returns committed-batch counts parsed from the logs.
+    """Two supervised replica-group processes; `plan` scripts the fault:
+
+      None                          — undisturbed baseline window.
+      {"type": "single", "victim"}  — one SIGKILL at window/3.
+      {"type": "double", "victim"}  — SIGKILL at window/4; once the
+          restarted incarnation COMMITS, kill it again (back-to-back
+          failures, the churn the reference's integ tests repeat,
+          torchft/manager_integ_test.py:304-352).
+      {"type": "during_heal", "victim"} — SIGKILL at window/4; the moment
+          the restarted incarnation shows its FIRST event (it is
+          rejoining/healing, has not committed), kill it again — a failure
+          landing inside recovery.
 
     The measurement window only starts once BOTH groups have committed a
     step: startup JIT compilation is excluded from both scenarios, and a
@@ -266,7 +486,7 @@ def _run_scenario(
 
     Process management is the framework's own Launcher (torchft_tpu/launch.py)
     — the same supervisor a user gets from ``python -m torchft_tpu.launch``;
-    the bench only adds the scripted SIGKILL.
+    the bench only adds the scripted SIGKILLs.
 
     Counting is primarily from the Manager's structured metrics stream
     (metrics.jsonl "commit"/"heal_fetched" events — O_APPEND lines are
@@ -292,42 +512,80 @@ def _run_scenario(
         },
         cwd=repo,
     )
-    kill_ts = None
+    kill_events: list[tuple[float, str]] = []
+    victim = str(plan["victim"]) if plan else None
+    kind = plan["type"] if plan else None
+    # Churn windows get extra tail so the LAST heal still has room to
+    # complete and commit inside the measured window.
+    total_window = window_s + (20.0 if kind in ("double", "during_heal") else 0.0)
+
+    def kill_victim():
+        kill_events.append((time.time(), victim))
+        launcher.kill(int(victim))  # SIGKILL, the real thing
+        time.sleep(3.0)  # restart delay: the dead window is real
+        launcher.spawn(int(victim))
+
     with launcher:
         start = time.monotonic()
-        killed = kill_at_s is None
-        while time.monotonic() - start < window_s:
+        first_kill_at = None if plan is None else (
+            total_window / 3 if kind == "single" else total_window / 4
+        )
+        pre_kill_ids: set = set()
+        second_done = kind == "single"
+        second_deadline = None
+        tail = _MetricsTail(metrics_path)
+        while time.monotonic() - start < total_window:
             time.sleep(0.25)
-            if not killed and time.monotonic() - start >= kill_at_s:
-                kill_ts = time.time()  # metrics events use time.time()
-                launcher.kill(1)  # SIGKILL, the real thing
-                killed = True
-                time.sleep(3.0)  # restart delay: the dead window is real
-                launcher.spawn(1)
+            if first_kill_at is not None and time.monotonic() - start >= first_kill_at:
+                pre_kill_ids = set(
+                    _victim_incarnations(tail.poll(), victim)
+                )
+                kill_victim()
+                first_kill_at = None
+                second_deadline = time.monotonic() + 25.0
+            elif not second_done and kill_events:
+                # Watch for the respawned incarnation to reach the trigger
+                # state, with a deadline fallback so a stuck restart can't
+                # hang the bench.
+                inc = _victim_incarnations(tail.poll(), victim)
+                fresh = {k: v for k, v in inc.items() if k not in pre_kill_ids}
+                fire = False
+                if kind == "double":
+                    fire = any(commit is not None for _, commit in fresh.values())
+                elif kind == "during_heal":
+                    fire = bool(fresh)
+                if fire or (second_deadline and time.monotonic() > second_deadline):
+                    kill_victim()
+                    second_done = True
             # Supervisor: restart any group that died for other reasons.
             launcher.supervise_once()
 
-    return _scenario_stats(workdir, metrics_path, kill_ts)
+    return _scenario_stats(workdir, metrics_path, kill_events)
 
 
-def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> dict:
-    """Parses the metrics stream into per-group committed counts and (for
-    kill runs) the victim's measured downtime.
+def _scenario_stats(
+    workdir: str, metrics_path: str, kill_events: list | None
+) -> dict:
+    """Parses the metrics stream into per-group committed counts, the
+    dead-window goodput fraction, and (single-kill runs) the victim's
+    downtime decomposition.
 
     Counting starts at t0 = the first moment BOTH groups have committed a
     step, so startup JIT compilation is excluded from the counts (not just
     from the wall window).  Group identity is the prefix of replica_id
-    ("<group>:<uuid>")."""
-    events = []
-    try:
-        with open(metrics_path, "rb") as f:
-            for line in f:
-                try:
-                    events.append(json.loads(line))
-                except ValueError:
-                    continue
-    except OSError:
-        pass
+    ("<group>:<uuid>").
+
+    The PRIMARY goodput number is dead-window based: for every killed
+    group, each commit gap that contains >= 1 kill is charged as downtime
+    (minus one median step interval — the step it would have taken
+    anyway), and goodput = 1 - total_dead / span.  This accounting is
+    robust to host-load rate drift (a slow second half of the window does
+    not read as FT loss, which is what made the round-4 rate-extrapolated
+    fraction spread 0.23 over 3 trials) and it handles single, double, and
+    during-heal kill plans identically: overlapping kills simply land in
+    one longer gap."""
+    kill_events = kill_events or []
+    events = _read_events(metrics_path)
 
     commits: dict[str, list[float]] = {}
     heals = 0
@@ -363,28 +621,56 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
             "per_group": {},
             "heals": heals,
             "heal_ms": [],
+            "kills": len(kill_events),
+            "dead_time_s": None,
+            "goodput_deadwindow_fraction": None,
             "victim_downtime_s": None,
             "victim_partial_step_s": None,
             "victim_restart_s": None,
             "victim_ft_resume_s": None,
             "goodput_self_fraction": None,
+            "victims_recovered": False,
             "metrics_stream": False,
         }
 
     t0 = max(min(ts_list) for ts_list in commits.values())
+    t_end = max(max(ts_list) for ts_list in commits.values())
     per_group = {
         g: sum(1 for ts in ts_list if ts >= t0)
         for g, ts_list in sorted(commits.items())
     }
 
+    # --- dead-window accounting (all kill plans) -------------------------
+    dead_total = None
+    deadwindow_fraction = None
+    victims_recovered = True
+    if kill_events:
+        dead_total = 0.0
+        span = t_end - t0
+        for g in {grp for _, grp in kill_events}:
+            g_kills = sorted(ts for ts, grp in kill_events if grp == g)
+            cs = sorted(commits.get(g, []))
+            if not cs or max(cs) < max(g_kills):
+                victims_recovered = False  # never committed after its kill
+                continue
+            steps_iv = [b - a for a, b in zip(cs, cs[1:])]
+            med = sorted(steps_iv)[len(steps_iv) // 2] if steps_iv else 0.0
+            for a, b in zip(cs, cs[1:]):
+                if any(a <= k < b for k in g_kills):
+                    dead_total += max(0.0, (b - a) - med)
+        if span > 0 and victims_recovered:
+            deadwindow_fraction = max(0.0, 1.0 - dead_total / span)
+
+    # --- single-kill decomposition + self-normalized secondary -----------
     victim_downtime = None
     victim_partial_step = None
     victim_restart = None
     victim_ft_resume = None
     self_fraction = None
-    if kill_ts is not None and "1" in commits:
-        before = [ts for ts in commits["1"] if ts <= kill_ts]
-        after = [ts for ts in commits["1"] if ts > kill_ts]
+    if len(kill_events) == 1:
+        kill_ts, victim = kill_events[0]
+        before = [ts for ts in commits.get(victim, []) if ts <= kill_ts]
+        after = [ts for ts in commits.get(victim, []) if ts > kill_ts]
         if before and after:
             victim_downtime = min(after) - max(before)
             victim_partial_step = kill_ts - max(before)
@@ -400,17 +686,17 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
         # trials decompose — if the respawned process died again before its
         # first commit (>1 new incarnation by then), attributing the extra
         # dead window to "FT resume" would be false, so the trial reports
-        # None and is counted in multi_restart.
+        # None and is counted separately.
         pre_ids = {
             str(ev.get("replica_id"))
             for ev in events
-            if str(ev.get("replica_id", "")).split(":", 1)[0] == "1"
+            if str(ev.get("replica_id", "")).split(":", 1)[0] == victim
             and float(ev["ts"]) <= kill_ts
         }
         new_events = [
             (float(ev["ts"]), str(ev.get("replica_id")))
             for ev in events
-            if str(ev.get("replica_id", "")).split(":", 1)[0] == "1"
+            if str(ev.get("replica_id", "")).split(":", 1)[0] == victim
             and str(ev.get("replica_id")) not in pre_ids
             and float(ev["ts"]) > kill_ts
         ]
@@ -423,32 +709,34 @@ def _scenario_stats(workdir: str, metrics_path: str, kill_ts: float | None) -> d
                 t_up = min(ts for ts, _ in new_events)
                 victim_restart = t_up - kill_ts
                 victim_ft_resume = t_commit - t_up
-        # Self-normalized goodput: the victim's total committed count vs
-        # its own pre-kill rate extrapolated over the whole measurement
-        # span.  Normalizing within one run makes the fraction immune to
-        # run-to-run host-load variance (which dwarfed the effect when
-        # comparing across runs) and <= 1 by construction up to rate
-        # noise: the victim runs at the merged-quorum rate whenever it is
-        # alive and simply loses its dead window.
+        # Self-normalized goodput (SECONDARY; see docstring): the victim's
+        # committed count vs its own pre-kill rate extrapolated over the
+        # span.  Sensitive to host-load rate drift, which is why the
+        # dead-window fraction above is the headline.
         pre = [ts for ts in before if ts >= t0]
         span_pre = kill_ts - t0
-        t_end = max(max(ts_list) for ts_list in commits.values())
         if len(pre) >= 10 and span_pre > 5.0 and t_end > kill_ts:
             rate_pre = len(pre) / span_pre
             expected = rate_pre * (t_end - t0)
             if expected > 0:
-                self_fraction = per_group.get("1", 0) / expected
+                self_fraction = per_group.get(victim, 0) / expected
 
     return {
         "committed_batches": sum(per_group.values()),
         "per_group": per_group,
         "heals": heals,
         "heal_ms": heal_ms,
+        "kills": len(kill_events),
+        "dead_time_s": round(dead_total, 2) if dead_total is not None else None,
+        "goodput_deadwindow_fraction": (
+            round(deadwindow_fraction, 4) if deadwindow_fraction is not None else None
+        ),
         "victim_downtime_s": victim_downtime,
         "victim_partial_step_s": victim_partial_step,
         "victim_restart_s": victim_restart,
         "victim_ft_resume_s": victim_ft_resume,
         "goodput_self_fraction": self_fraction,
+        "victims_recovered": victims_recovered,
         "metrics_stream": True,
     }
 
@@ -458,90 +746,122 @@ def _mean(values) -> float | None:
     return round(sum(vals) / len(vals), 2) if vals else None
 
 
+def _trial_plans(trials: int) -> list:
+    """The churn mix: alternating-victim single kills, plus back-to-back
+    double kills and kill-during-heal trials (the repeated-failure
+    scenarios of torchft/manager_integ_test.py:304-352).  >= 4 trials
+    always includes at least one double and one during_heal."""
+    plans: list[dict] = []
+    churn = min(4, max(2, trials // 3)) if trials >= 4 else 0
+    singles = trials - churn
+    for i in range(singles):
+        plans.append({"type": "single", "victim": i % 2})
+    for i in range(churn):
+        plans.append(
+            {"type": "double" if i % 2 == 0 else "during_heal", "victim": (i + 1) % 2}
+        )
+    return plans
+
+
 def kill_benchmark() -> dict:
-    """Goodput under SIGKILL, measured per replica group over paired trials.
+    """Goodput under SIGKILL churn, measured over many scripted-fault trials.
 
     Round-3 lesson: on this single-core host, TOTAL committed batches is
-    the wrong unit — when group 1 dies, the surviving group's steps get
-    FASTER (it stops sharing the CPU and the quorum shrinks), so the
-    killed run committed 8% MORE total batches than the undisturbed run
-    and the fraction could not resolve the <5% target.  The headline
-    fraction is therefore computed on the VICTIM group only: the victim
-    runs at the merged-quorum rate in both scenarios and simply loses its
-    dead window, so victim_kill/victim_base <= 1 up to run-to-run noise,
-    and the survivor speed-up cannot inflate it.  Totals are still
-    reported (explained) as a secondary, and the baseline's own
-    run-to-run spread is reported so the effect size can be judged
-    against measurement noise."""
+    the wrong unit — when a group dies, the surviving group's steps get
+    FASTER (it stops sharing the CPU and the quorum shrinks).  Round-4
+    lesson: even victim-only rate extrapolation is noisy (spread 0.23 over
+    3 trials) because host-load drift changes the commit rate within a
+    window.  The headline is therefore the DEAD-WINDOW fraction: the
+    victim's commit timeline is charged only for the gaps that contain a
+    kill, which is exactly the work the fault cost and is insensitive to
+    rate drift.  Trials vary the victim and include double-kill and
+    kill-during-heal churn; the mean carries a 95% CI."""
     window = float(os.environ.get("TPUFT_BENCH_KILL_WINDOW_S", "45"))
-    trials = max(1, int(os.environ.get("TPUFT_BENCH_KILL_TRIALS", "3")))
+    trials = max(1, int(os.environ.get("TPUFT_BENCH_KILL_TRIALS", "10")))
+    base_trials = max(1, int(os.environ.get("TPUFT_BENCH_BASE_TRIALS", "2")))
+    plans = _trial_plans(trials)
     # One compile cache shared by every process of all scenarios: restarts
     # must not pay JIT compilation again (on a single-core host a recompile
     # starves every process and would swamp the FT cost being measured).
     bases, kills = [], []
     with tempfile.TemporaryDirectory(prefix="tpuft_bench_cache_") as cache_dir:
-        for t in range(trials):
+        for _ in range(base_trials):
             with tempfile.TemporaryDirectory(prefix="tpuft_bench_nokill_") as d:
                 bases.append(
-                    _run_scenario(d, window_s=window, kill_at_s=None, cache_dir=cache_dir)
+                    _run_scenario(d, window_s=window, plan=None, cache_dir=cache_dir)
                 )
+        for plan in plans:
             with tempfile.TemporaryDirectory(prefix="tpuft_bench_kill_") as d:
                 kills.append(
-                    _run_scenario(
-                        d, window_s=window, kill_at_s=window / 3, cache_dir=cache_dir
-                    )
+                    (plan, _run_scenario(d, window_s=window, plan=plan, cache_dir=cache_dir))
                 )
 
-    def _victim(stats: dict) -> int:
-        return stats["per_group"].get("1", 0)
-
-    per_group_ok = all(b["per_group"] and k["per_group"] for b, k in zip(bases, kills))
-    self_fracs = [k["goodput_self_fraction"] for k in kills]
-    if all(f is not None for f in self_fracs):
-        # Primary: within-run self-normalized victim goodput (see
-        # _scenario_stats) — immune to run-to-run host-load variance.
-        fractions = self_fracs
-        unit = "victim_self_normalized"
-    elif per_group_ok and all(_victim(b) > 0 for b in bases):
-        fractions = [_victim(k) / _victim(b) for b, k in zip(bases, kills)]
-        unit = "victim_group_paired"
+    fractions = [
+        k["goodput_deadwindow_fraction"]
+        for _, k in kills
+        if k["goodput_deadwindow_fraction"] is not None
+    ]
+    if fractions:
+        unit = "deadwindow"
+        mean = sum(fractions) / len(fractions)
+        if len(fractions) > 1:
+            var = sum((f - mean) ** 2 for f in fractions) / (len(fractions) - 1)
+            half = 1.96 * (var ** 0.5) / (len(fractions) ** 0.5)
+        else:
+            half = 0.0
+        ci95 = [round(mean - half, 4), round(min(1.0, mean + half), 4)]
     else:
         # Metrics stream unavailable: legacy total-count fraction (noisy).
-        fractions = [
-            k["committed_batches"] / max(1, b["committed_batches"])
-            for b, k in zip(bases, kills)
-        ]
         unit = "total(legacy)"
+        totals_b = sum(b["committed_batches"] for b in bases) / max(1, len(bases))
+        fractions = [
+            k["committed_batches"] / max(1.0, totals_b) for _, k in kills
+        ]
+        mean = sum(fractions) / len(fractions)
+        ci95 = None
 
-    mean = sum(fractions) / len(fractions)
-    paired = (
-        [round(_victim(k) / _victim(b), 4) for b, k in zip(bases, kills)]
-        if per_group_ok and all(_victim(b) > 0 for b in bases)
-        else None
-    )
-    base_victims = [_victim(b) for b in bases] if per_group_ok else []
+    singles = [k for p, k in kills if p["type"] == "single"]
+    churny = [k for p, k in kills if p["type"] != "single"]
+    base_victims = [b["per_group"].get("1", 0) for b in bases if b["per_group"]]
     base_spread = (
         (max(base_victims) - min(base_victims)) / max(1, min(base_victims))
         if base_victims
         else None
     )
-    downtimes = [k["victim_downtime_s"] for k in kills if k["victim_downtime_s"]]
-    decomposed = [k for k in kills if k["victim_restart_s"] is not None]
-    heal_ms = sorted(ms for k in kills for ms in k["heal_ms"])
-    heals = sum(k["heals"] for k in kills)
+    downtimes = [k["victim_downtime_s"] for k in singles if k["victim_downtime_s"]]
+    decomposed = [k for k in singles if k["victim_restart_s"] is not None]
+    heal_ms = sorted(ms for _, k in kills for ms in k["heal_ms"])
+    heals = sum(k["heals"] for _, k in kills)
+    self_fracs = [
+        k["goodput_self_fraction"]
+        for k in singles
+        if k["goodput_self_fraction"] is not None
+    ]
     return {
         "window_s": window,
-        "trials": trials,
+        "trials": len(kills),
+        "trial_plans": [
+            {"type": p["type"], "victim": p["victim"]} for p, _ in kills
+        ],
         "goodput_unit": unit,
         "goodput_under_kill_fraction": round(mean, 4),
+        "goodput_fraction_ci95": ci95,
         "goodput_fraction_trials": [round(f, 4) for f in fractions],
         "goodput_fraction_spread": round(max(fractions) - min(fractions), 4),
-        # Secondary: victim count vs the PAIRED undisturbed run — across-run
-        # comparison, so host-load variance between trials shows up here.
-        "goodput_paired_fraction_trials": paired,
+        # Churn evidence: trials that killed the victim AGAIN during or
+        # right after recovery, and whether every victim still recovered.
+        "multi_restart_trials": len(churny),
+        "churn_fractions": [
+            round(k["goodput_deadwindow_fraction"], 4)
+            for k in churny
+            if k["goodput_deadwindow_fraction"] is not None
+        ],
+        "kills_total": sum(k["kills"] for _, k in kills),
+        # Secondary: the round-4 self-normalized victim fraction (rate
+        # extrapolation; sensitive to load drift — kept for comparability).
+        "goodput_self_fraction_trials": [round(f, 4) for f in self_fracs],
         # Baseline noise floor: the undisturbed victim count's own
-        # run-to-run spread.  The fraction is only meaningful if the
-        # effect being measured exceeds this.
+        # run-to-run spread.
         "baseline_victim_committed": base_victims,
         "baseline_relative_spread": (
             round(base_spread, 4) if base_spread is not None else None
@@ -549,11 +869,8 @@ def kill_benchmark() -> dict:
         "victim_downtime_s": _mean(downtimes),
         "victim_downtime_s_trials": [round(d, 2) for d in downtimes],
         # Downtime decomposition — partial_step + restart + ft_resume sums
-        # to victim_decomposed_downtime_s: all four means are taken over
-        # the SAME trial subset (those with a complete single-restart
-        # decomposition; multi-restart trials report None and are counted
-        # below — victim_downtime_s above averages ALL trials and can
-        # differ when a multi-restart trial is present).
+        # to victim_decomposed_downtime_s over the SAME single-kill trial
+        # subset (multi-incarnation trials refuse to decompose).
         # restart = scripted 3 s respawn delay + process spawn + JAX/XLA
         # init (environment floor — any per-step-FT system pays it,
         # including the reference's torchelastic restart); ft_resume =
@@ -567,28 +884,28 @@ def kill_benchmark() -> dict:
         ),
         "victim_restart_s": _mean([k["victim_restart_s"] for k in decomposed]),
         "victim_ft_resume_s": _mean([k["victim_ft_resume_s"] for k in decomposed]),
-        "multi_restart_trials": sum(
+        "decomposition_skipped": sum(
             1
-            for k in kills
+            for k in singles
             if k["victim_downtime_s"] is not None and k["victim_restart_s"] is None
         ),
         "heal_ms_median": heal_ms[len(heal_ms) // 2] if heal_ms else None,
         "committed_batches_undisturbed": sum(b["committed_batches"] for b in bases),
-        "committed_batches_with_kill": sum(k["committed_batches"] for k in kills),
+        "committed_batches_with_kill": sum(k["committed_batches"] for _, k in kills),
         "per_group_undisturbed": [b["per_group"] for b in bases],
-        "per_group_with_kill": [k["per_group"] for k in kills],
+        "per_group_with_kill": [k["per_group"] for _, k in kills],
         # A kill run where the victim never healed is NOT a valid goodput
         # measurement — surface it rather than presenting fraction as if the
         # north-star heal path had been exercised.
         "heals_with_kill": heals,
-        "heal_verified": all(k["heals"] >= 1 for k in kills),
-        # The per-window fraction charges ONE kill against a window_s-sized
-        # window — a failure every 45 s, ~100x any realistic rate.  The
-        # victim's downtime is a fixed per-failure cost (dominated by
-        # process restart + JAX init on this host), so the steady-state
-        # goodput loss at a given MTBF is downtime/MTBF; this field states
-        # it for hourly failures, which is already far beyond BASELINE.md's
-        # <5% target.
+        "heal_verified": all(
+            k["heals"] >= 1 and k["victims_recovered"] for _, k in kills
+        ),
+        # The per-window fraction charges 1-2 kills against a ~45-60 s
+        # window — a failure rate ~100x anything realistic.  The victim's
+        # downtime is a fixed per-failure cost, so the steady-state goodput
+        # loss at a given MTBF is downtime/MTBF; this field states it for
+        # hourly failures against BASELINE.md's <5% target.
         "goodput_fraction_at_hourly_failures": (
             round(1 - _mean(downtimes) / 3600.0, 5) if downtimes else None
         ),
@@ -608,19 +925,20 @@ def main() -> None:
         "vs_baseline": None,
         "detail": {
             **chip,
-            "baseline_semantics": "vs_baseline = the KILLED group's "
-            "committed batches over a window with one SIGKILL + live heal, "
-            "relative to its own pre-kill commit rate extrapolated over "
-            "the same window (self-normalized; mean of trials; <= 1 by "
-            "construction).  Victim-only, within-run normalization: on a "
-            "1-core host the survivor speeds up when its peer dies and "
-            "run-to-run load variance exceeds the effect, which made the "
-            "round-3 total-vs-paired-run fraction land above 1.  Context "
-            "for the absolute value: the fraction charges one kill per "
-            "window (a failure every ~45 s, ~100x any realistic rate), and "
-            "victim_restart_s shows most of the dead window is the "
-            "environment's process-respawn + JAX-init floor that ANY "
-            "per-step-FT system pays — the FT resume itself "
+            "baseline_semantics": "vs_baseline = dead-window goodput under "
+            "SIGKILL churn: over each trial window, every commit gap of a "
+            "killed group that contains a kill is charged as downtime "
+            "(minus one median step interval) and goodput = 1 - dead/span; "
+            "the mean over trials carries a 95% CI.  Trials alternate the "
+            "victim and include back-to-back double kills and "
+            "kill-during-heal (multi_restart_trials).  Dead-window "
+            "accounting is insensitive to host-load rate drift, which made "
+            "earlier rate-extrapolated fractions spread 0.23 over 3 trials "
+            "on this 1-core host.  Context for the absolute value: each "
+            "window charges 1-2 kills per ~minute (~100x any realistic "
+            "failure rate), and victim_restart_s shows most of the dead "
+            "window is the environment's process-respawn + JAX-init floor "
+            "that ANY per-step-FT system pays — the FT resume itself "
             "(victim_ft_resume_s: rejoin + live heal + commit) is "
             "sub-second.  goodput_fraction_at_hourly_failures restates the "
             "measured downtime against BASELINE.md's <5% target at a "
@@ -628,6 +946,12 @@ def main() -> None:
             "numbers.",
         },
     }
+    try:
+        large = large_chip_benchmark()
+        if large is not None:
+            result["detail"]["large_model"] = large
+    except Exception as e:  # noqa: BLE001
+        result["detail"]["large_model_error"] = repr(e)
     try:
         kill = kill_benchmark()
     except Exception as e:  # noqa: BLE001
@@ -646,9 +970,13 @@ def selftest() -> None:
     import inspect
 
     sig = inspect.signature(_run_scenario)
-    assert list(sig.parameters) == ["workdir", "window_s", "kill_at_s", "cache_dir"]
+    assert list(sig.parameters) == ["workdir", "window_s", "plan", "cache_dir"]
     inspect.signature(kill_benchmark).bind()
     inspect.signature(chip_benchmark).bind()
+    plans = _trial_plans(10)
+    assert len(plans) == 10
+    assert {p["type"] for p in plans} == {"single", "double", "during_heal"}
+    assert {p["victim"] for p in plans} == {0, 1}
     print("bench selftest ok")
 
 

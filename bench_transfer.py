@@ -55,7 +55,9 @@ def bench_http(state: Dict[str, np.ndarray], nbytes: int, num_chunks: int) -> Di
         t0 = time.perf_counter()
         out = dst.recv_checkpoint(1, src.metadata(), step=0, timeout=120.0)
         fetch_s = time.perf_counter() - t0
-        assert set(out) == set(state) and out["layer_1.weight"][0] == 1.0
+        assert set(out) == set(state)
+        if "layer_1.weight" in out:
+            assert out["layer_1.weight"][0] == 1.0
         return {
             "transport": "http",
             "num_chunks": num_chunks,
@@ -104,7 +106,9 @@ def bench_collective(state: Dict[str, np.ndarray], nbytes: int) -> Dict[str, Any
         )
         recv_s = time.perf_counter() - t0
         sender.join()
-        assert set(out) == set(state) and out["layer_1.weight"][0] == 1.0
+        assert set(out) == set(state)
+        if "layer_1.weight" in out:
+            assert out["layer_1.weight"][0] == 1.0
         return {
             "transport": "collective",
             "send_s": round(send_done[0], 3),

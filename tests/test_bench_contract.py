@@ -126,7 +126,7 @@ def test_scenario_stats_accounting(tmp_path) -> None:
     path = tmp_path / "metrics.jsonl"
     write(path, events)
 
-    stats = _scenario_stats(str(tmp_path), str(path), kill_ts=10.5)
+    stats = _scenario_stats(str(tmp_path), str(path), [(10.5, "1")])
     assert stats["per_group"] == {"0": 40, "1": 33}
     assert stats["heals"] == 1
     # downtime 18-10=8; decomposition: partial 0.5 + restart 7.0 + resume 0.5
@@ -144,6 +144,11 @@ def test_scenario_stats_accounting(tmp_path) -> None:
     # expected = rate * (40 - 1), actual 33.
     rate = 10 / 9.5
     assert abs(stats["goodput_self_fraction"] - 33 / (rate * 39)) < 1e-6
+    # PRIMARY dead-window fraction: the victim's only kill-containing gap is
+    # (10, 18) = 8 s, charged minus one median step (1 s) over span 39 s.
+    assert stats["victims_recovered"] is True
+    assert abs(stats["dead_time_s"] - 7.0) < 1e-6
+    assert abs(stats["goodput_deadwindow_fraction"] - (1 - 7.0 / 39.0)) < 1e-3
 
     # Multi-restart: incarnation B dies too (one event, no commit), C heals.
     events2 = [ev for ev in events if ev["replica_id"] != "1:B"]
@@ -153,7 +158,58 @@ def test_scenario_stats_accounting(tmp_path) -> None:
         events2.append({"ts": float(t), "replica_id": "1:C", "event": "commit", "committed": True})
     path2 = tmp_path / "metrics2.jsonl"
     write(path2, events2)
-    stats2 = _scenario_stats(str(tmp_path), str(path2), kill_ts=10.5)
+    stats2 = _scenario_stats(str(tmp_path), str(path2), [(10.5, "1")])
     assert stats2["victim_downtime_s"] is not None
     assert stats2["victim_restart_s"] is None  # refuses to decompose
     assert stats2["victim_ft_resume_s"] is None
+
+
+def test_scenario_stats_double_kill_and_unrecovered(tmp_path) -> None:
+    """Dead-window accounting under churn: two kills of the same victim
+    charge two gaps; a victim that never recommits invalidates the trial
+    (victims_recovered False, no fraction)."""
+    import json as _json
+    import sys
+
+    sys.path.insert(0, REPO)
+    from bench import _scenario_stats
+
+    def write(path, events):
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(_json.dumps(ev) + "\n")
+
+    events = []
+    for t in range(1, 41):
+        events.append({"ts": float(t), "replica_id": "0:a", "event": "commit", "committed": True})
+    # Victim commits 1..10 (A), killed at 10.5; B commits 18..22, killed at
+    # 22.5; C commits 30..40.  Gaps charged: (10,18)=8 and (22,30)=8, each
+    # minus the 1 s median step -> dead 14 over span 39.
+    for t in range(1, 11):
+        events.append({"ts": float(t), "replica_id": "1:A", "event": "commit", "committed": True})
+    for t in range(18, 23):
+        events.append({"ts": float(t), "replica_id": "1:B", "event": "commit", "committed": True})
+    for t in range(30, 41):
+        events.append({"ts": float(t), "replica_id": "1:C", "event": "commit", "committed": True})
+    path = tmp_path / "metrics.jsonl"
+    write(path, events)
+
+    stats = _scenario_stats(str(tmp_path), str(path), [(10.5, "1"), (22.5, "1")])
+    assert stats["kills"] == 2
+    assert stats["victims_recovered"] is True
+    assert abs(stats["dead_time_s"] - 14.0) < 1e-6
+    assert abs(stats["goodput_deadwindow_fraction"] - (1 - 14.0 / 39.0)) < 1e-3
+    # Two-kill trials don't pretend to decompose a single dead window.
+    assert stats["victim_restart_s"] is None
+
+    # Unrecovered victim: killed at 10.5, never commits again.
+    events3 = [
+        ev
+        for ev in events
+        if not str(ev["replica_id"]).startswith("1:") or ev["ts"] <= 10.0
+    ]
+    path3 = tmp_path / "metrics3.jsonl"
+    write(path3, events3)
+    stats3 = _scenario_stats(str(tmp_path), str(path3), [(10.5, "1")])
+    assert stats3["victims_recovered"] is False
+    assert stats3["goodput_deadwindow_fraction"] is None

@@ -1,0 +1,121 @@
+"""Process-level SIGKILL recovery through the 'exceeds-reference'
+parallelism paths.
+
+The DDP kill/heal story is covered by the bench and the verify drive; these
+tests put the same fault through the paths the reference does not have
+(SURVEY.md §2.3): the 1F1B pipeline schedule and the zigzag ring-attention
+model.  Real OS processes under the restart supervisor, a real `kill -9`
+mid-run, and the reference's convergence criterion
+(torchft/manager_integ_test.py:281): the healed group and the survivor
+finish with bitwise-identical parameters.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+
+import pytest
+
+from torchft_tpu.launch import Launcher
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_STEPS = 150
+
+
+def _wait(predicate, timeout: float, launcher=None) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if launcher is not None:
+            launcher.supervise_once()
+        if predicate():
+            return
+        time.sleep(0.2)
+    raise AssertionError("condition not reached in time")
+
+
+def _log(tmp_path, g: int) -> str:
+    p = tmp_path / f"g{g}.log"
+    return p.read_text() if p.exists() else ""
+
+
+def _digests(tmp_path):
+    out = {}
+    for g in (0, 1):
+        m = re.search(r"FINAL step=(\d+) params_sha256=([0-9a-f]+)", _log(tmp_path, g))
+        out[g] = m.groups() if m else None
+    return out
+
+
+def _drive_kill_and_converge(tmp_path, command, monkeypatch) -> None:
+    monkeypatch.setenv("TPUFT_JAX_PLATFORM", "cpu")
+    with Launcher(
+        command,
+        num_groups=2,
+        lighthouse="embed",
+        max_restarts=3,
+        log_dir=str(tmp_path),
+    ) as launcher:
+        # Let both groups compile and take some merged steps first, so the
+        # victim has state worth losing.
+        _wait(
+            lambda: all(
+                _log(tmp_path, g).count("committed=True") >= 5 for g in (0, 1)
+            ),
+            timeout=420,  # two JIT compiles on a loaded 1-core host
+            launcher=launcher,
+        )
+        launcher.kill(1, hold=False)  # the supervisor respawns it
+        _wait(lambda: launcher.restarts(1) >= 1, timeout=120, launcher=launcher)
+        # The respawned group must HEAL from the survivor, not cold-start.
+        _wait(
+            lambda: "healing from replica" in _log(tmp_path, 1),
+            timeout=420,
+            launcher=launcher,
+        )
+        _wait(
+            lambda: all(_digests(tmp_path)[g] is not None for g in (0, 1)),
+            timeout=600,
+            launcher=launcher,
+        )
+
+    digests = _digests(tmp_path)
+    step0, sha0 = digests[0]
+    step1, sha1 = digests[1]
+    assert step0 == step1 == str(_STEPS)
+    assert sha0 == sha1, f"groups diverged after heal: {digests}"
+
+
+@pytest.mark.slow
+def test_pipeline_1f1b_killed_group_heals(tmp_path, monkeypatch) -> None:
+    """SIGKILL a replica group running the 1F1B pipeline schedule; the
+    restarted group heals its PIPELINE-SHARDED state from the survivor and
+    both converge to identical parameters."""
+    _drive_kill_and_converge(
+        tmp_path,
+        [
+            sys.executable,
+            os.path.join(_REPO, "examples", "train_pipeline.py"),
+            "--steps", str(_STEPS),
+            "--schedule", "1f1b",
+        ],
+        monkeypatch,
+    )
+
+
+@pytest.mark.slow
+def test_ring_zigzag_killed_group_heals(tmp_path, monkeypatch) -> None:
+    """SIGKILL a replica group training with zigzag ring attention over a
+    (data x sequence) mesh; heal + convergence as above."""
+    _drive_kill_and_converge(
+        tmp_path,
+        [
+            sys.executable,
+            os.path.join(_REPO, "examples", "train_ring.py"),
+            "--steps", str(_STEPS),
+            "--layout", "zigzag",
+        ],
+        monkeypatch,
+    )

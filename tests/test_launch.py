@@ -113,6 +113,71 @@ def test_launch_cli_requires_command() -> None:
         main(["--groups", "1", "--"])
 
 
+def test_dump_spec_renders_env_contract(capsys) -> None:
+    """--dump-spec emits a JobSet manifest carrying the exact launch +
+    multihost env contract (reference analogue: the torchx component's
+    roles/env, torchft/torchx.py:47-80)."""
+    import yaml
+
+    rc = main(
+        [
+            "--groups", "3",
+            "--max-restarts", "7",
+            "--dump-spec",
+            "--name", "myjob",
+            "--hosts-per-group", "4",
+            "--image", "gcr.io/proj/img:1",
+            "--tpu-topology", "4x4",
+            "--",
+            "python", "train.py", "--steps", "100",
+        ]
+    )
+    assert rc == 0
+    spec = yaml.safe_load(capsys.readouterr().out)
+
+    assert spec["kind"] == "JobSet"
+    assert spec["metadata"]["name"] == "myjob"
+    assert spec["spec"]["failurePolicy"]["maxRestarts"] == 7
+    jobs = {j["name"]: j for j in spec["spec"]["replicatedJobs"]}
+    assert set(jobs) == {"lighthouse", "group"}
+
+    group = jobs["group"]
+    assert group["replicas"] == 3
+    jspec = group["template"]["spec"]
+    # Indexed completion IS the host rank; one pod per host.
+    assert jspec["completionMode"] == "Indexed"
+    assert jspec["completions"] == jspec["parallelism"] == 4
+    container = jspec["template"]["spec"]["containers"][0]
+    env = {e["name"]: e for e in container["env"]}
+    assert env["NUM_REPLICA_GROUPS"]["value"] == "3"
+    assert env["TPUFT_NUM_HOSTS"]["value"] == "4"
+    assert "myjob-lighthouse-0-0.myjob" in env["TPUFT_LIGHTHOUSE"]["value"]
+    assert "job-index" in str(env["TPUFT_GROUP_INDEX"]["valueFrom"])
+    script = container["args"][0]
+    # The shell prologue derives the rest of the contract per pod.  The
+    # store DNS name must be the 4-component JobSet pod name of the group's
+    # host-rank-0 pod (<jobset>-<job>-<jobindex>-<podindex>.<jobset>), and
+    # rank 0 must actually SERVE the store (initialize_slice is a client).
+    for line in (
+        'REPLICA_GROUP_ID="${TPUFT_GROUP_INDEX}"',
+        'TPUFT_HOST_RANK="${JOB_COMPLETION_INDEX}"',
+        'TPUFT_STORE="myjob-group-${REPLICA_GROUP_ID}-0.myjob:29500"',
+        "python -m torchft_tpu.store_cli",
+        'MASTER_ADDR="myjob-group-${REPLICA_GROUP_ID}-0.myjob"',
+        "TPUFT_SLICE_GEN=",
+        "exec python train.py --steps 100",
+    ):
+        assert line in script, script
+    # TPU slice placement.
+    pod = jspec["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+    assert container["resources"]["limits"]["google.com/tpu"] == 4
+
+    lighthouse = jobs["lighthouse"]
+    lcmd = lighthouse["template"]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "torchft_tpu.lighthouse_cli" in lcmd
+
+
 def test_crash_loop_backoff(tmp_path) -> None:
     """A group that exits nonzero almost immediately is restarted with
     exponential backoff, not at the supervisor's poll rate (ADVICE r3:

@@ -103,6 +103,34 @@ def test_ring_attention_model_matches_flash() -> None:
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_zigzag_ring_model_matches_flash() -> None:
+    """Full model with ring_layout='zigzag': feeding zigzag-permuted
+    tokens/targets yields the same loss as the unsharded flash model on the
+    original order (mean CE is permutation-invariant; rope positions follow
+    the permutation internally)."""
+    from torchft_tpu.ops.ring_attention import to_zigzag
+
+    cfg_z = TransformerConfig(
+        **{**CFG.__dict__, "attention": "ring", "ring_layout": "zigzag"}
+    )
+    params = init_params(jax.random.PRNGKey(1), CFG)
+    batch = _batch(b=2, s=32)
+    ref = np.asarray(loss_fn(params, batch, CFG))
+
+    ftmesh = ft_init_mesh({"data": 2, "sequence": 4})
+    sharded = ftmesh.shard_params(params, param_axes(CFG))
+    zbatch = {
+        "tokens": to_zigzag(batch["tokens"], 4, axis=1),
+        "targets": to_zigzag(batch["targets"], 4, axis=1),
+    }
+    got = np.asarray(
+        jax.jit(lambda p, b: loss_fn(p, b, cfg_z, ftmesh.mesh, ftmesh.rules))(
+            sharded, zbatch
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_ftmesh_dynamic_replica_size() -> None:
     manager = create_autospec(Manager, instance=True)
     manager.num_participants.return_value = 3

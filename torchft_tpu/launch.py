@@ -312,6 +312,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--cache-dir", default=None, help="shared persistent XLA compile cache"
     )
+    spec = parser.add_argument_group(
+        "scheduler spec generation",
+        "--dump-spec renders the same env contract as a GKE JobSet manifest "
+        "(one TPU-slice Job per replica group + a lighthouse) instead of "
+        "launching locally — the torchx-component analogue "
+        "(torchft/torchx.py:11-80).",
+    )
+    spec.add_argument(
+        "--dump-spec", action="store_true",
+        help="print a JobSet YAML manifest for this job and exit",
+    )
+    spec.add_argument("--name", default="tpuft", help="JobSet name")
+    spec.add_argument(
+        "--hosts-per-group", type=int, default=1,
+        help="hosts per replica-group slice (TPUFT_NUM_HOSTS)",
+    )
+    spec.add_argument("--image", default="REPLACE_ME_IMAGE")
+    spec.add_argument("--tpu-accelerator", default="tpu-v5-lite-podslice")
+    spec.add_argument("--tpu-topology", default="2x4")
+    spec.add_argument("--chips-per-host", type=int, default=4)
     parser.add_argument(
         "cmd", nargs=argparse.REMAINDER, help="-- <command for one replica group>"
     )
@@ -321,6 +341,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd = cmd[1:]
     if not cmd:
         parser.error("missing replica-group command (after --)")
+
+    if args.dump_spec:
+        from torchft_tpu.spec import dump_yaml, jobset_spec
+
+        print(
+            dump_yaml(
+                jobset_spec(
+                    cmd,
+                    name=args.name,
+                    num_groups=args.groups,
+                    hosts_per_group=args.hosts_per_group,
+                    image=args.image,
+                    tpu_accelerator=args.tpu_accelerator,
+                    tpu_topology=args.tpu_topology,
+                    chips_per_host=args.chips_per_host,
+                    max_restarts=args.max_restarts if args.max_restarts is not None else 10,
+                    min_replicas=args.min_replicas,
+                )
+            ),
+            end="",
+        )
+        return 0
 
     launcher = Launcher(
         cmd,

@@ -47,6 +47,14 @@ class TransformerConfig:
     # (sequence-parallel K/V rotation), or "ulysses" (all-to-all head<->seq
     # resharding) — the latter two engage over the mesh "sequence" axis.
     attention: str = "flash"
+    # Sequence layout for attention="ring": "contiguous" or "zigzag"
+    # (balanced causal work, ops/ring_attention.py).  With "zigzag" the
+    # CALLER feeds tokens/targets already permuted by
+    # ops.ring_attention.to_zigzag(..., n_shards=mesh sequence size); the
+    # model ropes with the matching original positions internally, and the
+    # mean CE loss is permutation-invariant so training needs no other
+    # change.
+    ring_layout: str = "contiguous"
     # Unroll factor for the scan-over-layers (1 = pure scan).  Unrolling
     # lets XLA fuse/pipeline across layer boundaries at the cost of compile
     # time; worthwhile on the perf path, keep 1 for fast test iteration.
@@ -64,6 +72,9 @@ class TransformerConfig:
         assert self.attention in ("flash", "ring", "ulysses"), (
             f"unknown attention backend {self.attention!r}; "
             "expected 'flash', 'ring', or 'ulysses'"
+        )
+        assert self.ring_layout in ("contiguous", "zigzag"), (
+            f"unknown ring_layout {self.ring_layout!r}"
         )
 
     @property
@@ -205,11 +216,15 @@ def _attention(cfg: TransformerConfig, mesh, q, k, v):
             rep = cfg.n_heads // cfg.n_kv_heads
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
+        kwargs = {}
+        if cfg.attention == "ring":
+            kwargs["layout"] = cfg.ring_layout
         return fn(
             mesh, q, k, v, causal=True,
             batch_axis="data" if "data" in mesh.axis_names else None,
             head_axis="tensor" if "tensor" in mesh.axis_names else None,
             seq_axis="sequence",
+            **kwargs,
         )
     return flash_attention(q, k, v, causal=True)
 
@@ -270,7 +285,22 @@ def _decoder(
     MoE load-balance loss; zero for dense models)."""
     rules = rules or ShardingRules()
     B, S = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    pos = jnp.arange(S, dtype=jnp.int32)
+    if (
+        cfg.attention == "ring"
+        and cfg.ring_layout == "zigzag"
+        and mesh is not None
+        and "sequence" in mesh.axis_names
+        and mesh.shape["sequence"] > 1
+    ):
+        # Tokens arrive zigzag-permuted (see TransformerConfig.ring_layout);
+        # rope must see each slot's ORIGINAL position.
+        from torchft_tpu.ops.ring_attention import zigzag_permutation
+
+        pos = jnp.asarray(
+            zigzag_permutation(S, mesh.shape["sequence"]), dtype=jnp.int32
+        )
+    positions = jnp.broadcast_to(pos, (B, S))
 
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
