@@ -274,8 +274,9 @@ def chip_benchmark() -> dict:
 def large_config():
     """The scale-proof model: ~1B params, the largest round shape that fits
     one v5e chip (16 GB HBM) with f32 params + a memory-lean factored
-    optimizer + per-layer rematerialization.  VERDICT r4 #2: show the MFU
-    and heal story survive a ~10x model (reference capability chased:
+    optimizer — withOUT rematerialization, which measured as a pure loss
+    at this size (see the remat field comment).  VERDICT r4 #2: show the
+    MFU and heal story survive a ~10x model (reference capability chased:
     'train models such as Llama 3 70B', reference README)."""
     from torchft_tpu.models import TransformerConfig
 
@@ -287,7 +288,12 @@ def large_config():
         n_kv_heads=16,
         d_ff=8192,
         max_seq=1024,
-        remat=True,  # per-layer rematerialization: activations stay ~flat in L
+        # Measured on v5e at batch 8: remat 410 ms/step (58.6% MFU) vs
+        # NO remat 334 ms (71.9%) — the flash-attention kernels' O(S*D)
+        # residuals and the fused CE's never-materialized logits leave
+        # enough HBM at this size that paying the recompute tax is a pure
+        # loss.  Larger-than-HBM configs flip remat back on.
+        remat=False,
         scan_unroll=12,  # static layer loop, same as the flagship
     )
     return cfg, 8, 1024
@@ -380,7 +386,8 @@ def large_chip_benchmark() -> dict | None:
 
     return {
         "model": f"transformer-lm {cfg.n_layers}L d{cfg.d_model} bf16 seq{seq} "
-        f"batch{batch_size} ({n_params/1e6:.0f}M params, remat, adafactor)",
+        f"batch{batch_size} ({n_params/1e6:.0f}M params, "
+        f"{'remat' if cfg.remat else 'no-remat'}, adafactor)",
         "steps_timed": steps,
         "step_ms": round(dt / steps * 1000, 2),
         "tokens_per_sec": round(tps, 1),

@@ -116,7 +116,11 @@ def test_http_transport_multi_recovery(store) -> None:
     run_multi_recovery_test(lambda rank, colls: HTTPTransport(timeout=10.0), store)
 
 
-def test_http_transport_chunked_multi_recovery(store) -> None:
+def test_http_transport_chunked_multi_recovery(store, monkeypatch) -> None:
+    # Force the parallel-chunk receive path: the receiver's cpu-count
+    # heuristic would otherwise (correctly) fall back to the single /full
+    # stream on this 1-core host and leave chunk assembly uncovered.
+    monkeypatch.setenv("TPUFT_HTTP_CHUNK_WORKERS", "3")
     run_multi_recovery_test(
         lambda rank, colls: HTTPTransport(timeout=10.0, num_chunks=3), store
     )
