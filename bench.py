@@ -476,6 +476,10 @@ def _run_scenario(
 
       None                          — undisturbed baseline window.
       {"type": "single", "victim"}  — one SIGKILL at window/3.
+      {"type": "single_spare", "victim"} — one SIGKILL, but the launcher
+          runs a hot-spare pool: the dead group's id is handed to a
+          pre-initialized spare immediately (no scripted respawn delay —
+          adoption IS the respawn), measuring the spare-pool downtime.
       {"type": "double", "victim"}  — SIGKILL at window/4; once the
           restarted incarnation COMMITS, kill it again (back-to-back
           failures, the churn the reference's integ tests repeat,
@@ -503,6 +507,9 @@ def _run_scenario(
     from torchft_tpu.launch import Launcher
 
     metrics_path = os.path.join(workdir, "metrics.jsonl")
+    victim = str(plan["victim"]) if plan else None
+    kind = plan["type"] if plan else None
+    spares = 1 if kind == "single_spare" else 0
     launcher = Launcher(
         [sys.executable, os.path.join(repo, "examples", "train_ddp.py"),
          "--steps", "1000000"],
@@ -518,10 +525,9 @@ def _run_scenario(
             "TPUFT_METRICS_PATH": metrics_path,
         },
         cwd=repo,
+        spares=spares,
     )
     kill_events: list[tuple[float, str]] = []
-    victim = str(plan["victim"]) if plan else None
-    kind = plan["type"] if plan else None
     # Churn windows get extra tail so the LAST heal still has room to
     # complete and commit inside the measured window.
     total_window = window_s + (20.0 if kind in ("double", "during_heal") else 0.0)
@@ -529,16 +535,20 @@ def _run_scenario(
     def kill_victim():
         kill_events.append((time.time(), victim))
         launcher.kill(int(victim))  # SIGKILL, the real thing
-        time.sleep(3.0)  # restart delay: the dead window is real
-        launcher.spawn(int(victim))
+        if spares:
+            # Hot adoption IS the respawn: no scripted environment delay.
+            launcher.spawn(int(victim))
+        else:
+            time.sleep(3.0)  # restart delay: the dead window is real
+            launcher.spawn(int(victim))
 
     with launcher:
         start = time.monotonic()
         first_kill_at = None if plan is None else (
-            total_window / 3 if kind == "single" else total_window / 4
+            total_window / 3 if kind in ("single", "single_spare") else total_window / 4
         )
         pre_kill_ids: set = set()
-        second_done = kind == "single"
+        second_done = kind in ("single", "single_spare")
         second_deadline = None
         tail = _MetricsTail(metrics_path)
         while time.monotonic() - start < total_window:
@@ -612,10 +622,15 @@ def _scenario_stats(
         # per-group timing.
         committed = 0
         heals = 0
-        for g in (0, 1):
-            path = os.path.join(workdir, f"g{g}.log")
+        # Every process log in the workdir: g<i>.log plus spare_<sid>.log —
+        # an adopted hot spare keeps writing to its spare log.
+        try:
+            logs = [n for n in os.listdir(workdir) if n.endswith(".log")]
+        except OSError:
+            logs = []
+        for name in logs:
             try:
-                with open(path, "rb") as f:
+                with open(os.path.join(workdir, name), "rb") as f:
                     for line in f:
                         if b"committed=True" in line:
                             committed += 1
@@ -754,15 +769,19 @@ def _mean(values) -> float | None:
 
 
 def _trial_plans(trials: int) -> list:
-    """The churn mix: alternating-victim single kills, plus back-to-back
-    double kills and kill-during-heal trials (the repeated-failure
-    scenarios of torchft/manager_integ_test.py:304-352).  >= 4 trials
-    always includes at least one double and one during_heal."""
+    """The churn mix: alternating-victim single kills, hot-spare single
+    kills (the launcher's spare pool adopts the dead group), plus
+    back-to-back double kills and kill-during-heal trials (the
+    repeated-failure scenarios of torchft/manager_integ_test.py:304-352).
+    >= 9 trials carries 3 churn trials and 2 spare trials."""
     plans: list[dict] = []
-    churn = min(4, max(2, trials // 3)) if trials >= 4 else 0
-    singles = trials - churn
+    churn = 3 if trials >= 9 else (2 if trials >= 4 else 0)
+    spare = 2 if trials >= 8 else 0
+    singles = trials - churn - spare
     for i in range(singles):
         plans.append({"type": "single", "victim": i % 2})
+    for i in range(spare):
+        plans.append({"type": "single_spare", "victim": (i + 1) % 2})
     for i in range(churn):
         plans.append(
             {"type": "double" if i % 2 == 0 else "during_heal", "victim": (i + 1) % 2}
@@ -804,7 +823,8 @@ def kill_benchmark() -> dict:
                 )
 
     singles = [k for p, k in kills if p["type"] == "single"]
-    churny = [k for p, k in kills if p["type"] != "single"]
+    spare_trials = [k for p, k in kills if p["type"] == "single_spare"]
+    churny = [k for p, k in kills if p["type"] in ("double", "during_heal")]
 
     # The headline fraction is computed over the SINGLE-kill trials only:
     # churn trials run a longer window and charge two kills, so mixing the
@@ -838,11 +858,18 @@ def kill_benchmark() -> dict:
 
     per_kill = [
         k["dead_time_s"] / k["kills"]
-        for _, k in kills
+        for p, k in kills
         # victims_recovered guards the same case the fraction guards: an
         # unrecovered victim's gaps were never charged, so its dead time
         # would read ~0 and drag the per-kill mean down spuriously.
-        if k.get("dead_time_s") is not None and k["kills"] and k["victims_recovered"]
+        # single_spare trials are excluded too: their per-kill cost is
+        # ~2.8 s BY DESIGN, and mixing them in would break the
+        # "churn costs the same per kill as singles" comparison this
+        # number exists for (they get spare_victim_downtime_s instead).
+        if k.get("dead_time_s") is not None
+        and k["kills"]
+        and k["victims_recovered"]
+        and p["type"] != "single_spare"
     ]
     base_victims = [b["per_group"].get("1", 0) for b in bases if b["per_group"]]
     base_spread = (
@@ -885,6 +912,24 @@ def kill_benchmark() -> dict:
         # cost no more per failure than isolated ones.
         "dead_time_per_kill_s": _mean(per_kill),
         "dead_time_per_kill_s_trials": [round(x, 2) for x in per_kill],
+        # Hot-spare pool (launch --spares): the dead group's id is handed
+        # to a pre-initialized process, removing the respawn + runtime-init
+        # floor from the dead window.  Compare spare_victim_downtime_s with
+        # victim_downtime_s (cold restart) below.
+        "spare_fractions": [
+            round(k["goodput_deadwindow_fraction"], 4)
+            for k in spare_trials
+            if k["goodput_deadwindow_fraction"] is not None
+        ],
+        "spare_victim_downtime_s": _mean(
+            [k["victim_downtime_s"] for k in spare_trials]
+        ),
+        "spare_victim_restart_s": _mean(
+            [k["victim_restart_s"] for k in spare_trials]
+        ),
+        "spare_victim_ft_resume_s": _mean(
+            [k["victim_ft_resume_s"] for k in spare_trials]
+        ),
         "kills_total": sum(k["kills"] for _, k in kills),
         # Secondary: the round-4 self-normalized victim fraction (rate
         # extrapolation; sensitive to load drift — kept for comparability).
@@ -1008,8 +1053,11 @@ def selftest() -> None:
     inspect.signature(chip_benchmark).bind()
     plans = _trial_plans(10)
     assert len(plans) == 10
-    assert {p["type"] for p in plans} == {"single", "double", "during_heal"}
+    assert {p["type"] for p in plans} == {
+        "single", "single_spare", "double", "during_heal"
+    }
     assert {p["victim"] for p in plans} == {0, 1}
+    assert sum(p["type"] in ("double", "during_heal") for p in plans) >= 3
     print("bench selftest ok")
 
 

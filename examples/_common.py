@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 from typing import Any, Callable, Optional
 
 
@@ -43,9 +44,30 @@ def pin_platform_and_cache(virtual_devices: Optional[int] = None) -> None:
 
 
 def replica_env() -> tuple:
-    """(replica_group, num_replica_groups) from the launcher's env."""
+    """(replica_group, num_replica_groups) from the launcher's env.
+
+    Hot-spare mode: when the supervisor started this process as a SPARE
+    (``TPUFT_SPARE_FILE`` set, no ``REPLICA_GROUP_ID``), finish the
+    expensive initialization NOW — force the JAX backend up — and block
+    until the supervisor assigns a replica group by writing the go-file.
+    Adoption then skips the process-spawn + runtime-init floor that
+    dominates a cold restart's dead window (measured ~7 s of the ~7.5 s
+    downtime on the kill bench)."""
+    gid = os.environ.get("REPLICA_GROUP_ID")
+    spare = os.environ.get("TPUFT_SPARE_FILE")
+    if gid is None and spare:
+        import jax
+
+        jax.devices()  # backend init happens while idling, not after a death
+        print(f"[spare] ready (backend up), waiting at {spare}", flush=True)
+        while not os.path.exists(spare):
+            time.sleep(0.05)
+        with open(spare) as f:
+            gid = f.read().strip()
+        os.environ["REPLICA_GROUP_ID"] = gid
+        print(f"[spare] adopted replica group {gid}", flush=True)
     return (
-        int(os.environ.get("REPLICA_GROUP_ID", 0)),
+        int(gid or 0),
         int(os.environ.get("NUM_REPLICA_GROUPS", 2)),
     )
 

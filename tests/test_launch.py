@@ -113,6 +113,48 @@ def test_launch_cli_requires_command() -> None:
         main(["--groups", "1", "--"])
 
 
+_SPARE_AWARE = (
+    "import os,time;"
+    "gid = os.environ.get('REPLICA_GROUP_ID');"
+    "sf = os.environ.get('TPUFT_SPARE_FILE');\n"
+    "if gid is None and sf:\n"
+    "    print('spare ready', flush=True)\n"
+    "    while not os.path.exists(sf): time.sleep(0.02)\n"
+    "    gid = open(sf).read().strip()\n"
+    "print('gid', gid, flush=True); time.sleep(60)"
+)
+
+
+def test_hot_spare_adoption(tmp_path) -> None:
+    """A killed group is restarted by handing its id to a ready spare (same
+    pid as the former spare — adoption, not a cold fork) and the pool is
+    refilled; without the pool the group would pay the full spawn cost."""
+    with Launcher(
+        [sys.executable, "-c", _SPARE_AWARE],
+        num_groups=1,
+        lighthouse=None,
+        max_restarts=3,
+        log_dir=str(tmp_path),
+        spares=1,
+    ) as launcher:
+        _wait(lambda: b"gid 0" in (tmp_path / "g0.log").read_bytes())
+        _wait(lambda: launcher.spare_count() == 1)
+        spare_pid = launcher._spares[0].proc.pid
+        spare_sid = launcher._spares[0].sid
+
+        launcher.kill(0, hold=False)
+        assert launcher.supervise_once() == [0]
+        # Adoption: the group's process IS the former spare.
+        assert launcher._groups[0].proc.pid == spare_pid
+        _wait(
+            lambda: b"gid 0"
+            in (tmp_path / f"spare_{spare_sid}.log").read_bytes()
+        )
+        # The pool was refilled with a fresh spare.
+        _wait(lambda: launcher.spare_count() == 1)
+        assert launcher._spares[0].sid != spare_sid
+
+
 def test_dump_spec_renders_env_contract(capsys) -> None:
     """--dump-spec emits a JobSet manifest carrying the exact launch +
     multihost env contract (reference analogue: the torchx component's

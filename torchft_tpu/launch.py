@@ -46,6 +46,19 @@ __all__ = ["Launcher", "main"]
 
 
 @dataclass
+class _Spare:
+    """A hot-spare process: fully spawned (imports + JAX backend init done
+    while idle), blocked in the example harness's ``replica_env`` until the
+    supervisor writes its go-file with a replica-group id."""
+
+    proc: subprocess.Popen
+    log: Optional[object]
+    go_path: str
+    sid: int
+    spawned_at: float = 0.0
+
+
+@dataclass
 class _Group:
     proc: Optional[subprocess.Popen] = None
     log: Optional[object] = None
@@ -84,6 +97,15 @@ class Launcher:
         env: extra environment for every group (overrides inherited; a None
             value unsets the variable).
         cwd: working directory for the groups.
+        spares: hot-spare pool size.  Spares are spawned WITHOUT a
+            ``REPLICA_GROUP_ID`` and idle fully initialized (imports + JAX
+            backend up) behind ``TPUFT_SPARE_FILE``; when a group dies,
+            ``spawn`` hands the dead group's id to a ready spare by writing
+            that file — adoption skips the process-spawn + runtime-init
+            floor that dominates cold-restart downtime (kill-bench
+            ``victim_restart_s``), and the pool is refilled in the
+            background.  Requires the command to resolve its group id via
+            the ``replica_env`` contract (``examples/_common.py``).
     """
 
     def __init__(
@@ -99,6 +121,7 @@ class Launcher:
         cache_dir: Optional[str] = None,
         env: Optional[Dict[str, Optional[str]]] = None,
         cwd: Optional[str] = None,
+        spares: int = 0,
     ) -> None:
         self._cmd = list(cmd)
         self._num_groups = num_groups
@@ -107,6 +130,13 @@ class Launcher:
         self._cwd = cwd
         self._groups: Dict[int, _Group] = {i: _Group() for i in range(num_groups)}
         self._embedded = None
+        self._spares_target = max(0, spares)
+        self._spares: List[_Spare] = []
+        self._spare_seq = 0
+        self._spare_fast_deaths = 0
+        self._spare_pool_disabled = False
+        self._spare_dir: Optional[str] = None
+        self._spare_dir_created = False
 
         if lighthouse == "embed":
             from torchft_tpu._native import LighthouseServer
@@ -146,7 +176,82 @@ class Launcher:
     def start(self) -> "Launcher":
         for i in range(self._num_groups):
             self.spawn(i)
+        for _ in range(self._spares_target):
+            self._spawn_spare()
         return self
+
+    # -- hot spares ----------------------------------------------------------
+
+    def _spawn_spare(self) -> None:
+        if self._spare_pool_disabled:
+            return
+        if self._spare_dir is None:
+            import tempfile
+
+            if self._log_dir is not None:
+                self._spare_dir = self._log_dir
+                os.makedirs(self._spare_dir, exist_ok=True)
+            else:
+                self._spare_dir = tempfile.mkdtemp(prefix="tpuft_spares_")
+                self._spare_dir_created = True
+        sid = self._spare_seq
+        self._spare_seq += 1
+        go_path = os.path.join(self._spare_dir, f"spare_{sid}.go")
+        env = dict(self._base_env)
+        env.pop("REPLICA_GROUP_ID", None)
+        env["TPUFT_SPARE_FILE"] = go_path
+        stdout = stderr = None
+        log = None
+        if self._log_dir is not None:
+            log = open(os.path.join(self._log_dir, f"spare_{sid}.log"), "ab")
+            stdout, stderr = log, subprocess.STDOUT
+        proc = subprocess.Popen(
+            self._cmd, env=env, stdout=stdout, stderr=stderr, cwd=self._cwd
+        )
+        self._spares.append(
+            _Spare(
+                proc=proc, log=log, go_path=go_path, sid=sid,
+                spawned_at=time.monotonic(),
+            )
+        )
+
+    def _note_spare_death(self, spare: _Spare, refill: bool = True) -> None:
+        """Bookkeeping for a dead spare: close its log, apply the
+        crash-loop brake (same discipline as groups: only FAST deaths
+        count, a healthy-uptime death resets the streak), refill."""
+        if spare.log is not None:
+            spare.log.close()
+        if time.monotonic() - spare.spawned_at < _MIN_UPTIME_S:
+            self._spare_fast_deaths += 1
+        else:
+            self._spare_fast_deaths = 0
+        if self._spare_fast_deaths > 3:
+            self._spare_pool_disabled = True
+            logger.error(
+                "spare %d died fast (exit %s); pool disabled after repeated "
+                "immediate deaths", spare.sid, spare.proc.poll(),
+            )
+            return
+        logger.warning(
+            "spare %d died (exit %s); respawning", spare.sid, spare.proc.poll()
+        )
+        if refill:
+            self._spawn_spare()
+
+    def _take_ready_spare(self) -> Optional[_Spare]:
+        while self._spares:
+            spare = self._spares.pop(0)
+            if spare.proc.poll() is None:
+                return spare
+            # A dead spare found here must still be replaced, or the pool
+            # silently shrinks to zero and every later "hot" restart pays
+            # full cold cost.
+            self._note_spare_death(spare)
+        return None
+
+    def spare_count(self) -> int:
+        """Live spares currently in the pool."""
+        return sum(1 for s in self._spares if s.proc.poll() is None)
 
     def __enter__(self) -> "Launcher":
         return self.start()
@@ -155,7 +260,11 @@ class Launcher:
         self.stop()
 
     def spawn(self, group: int) -> None:
-        """(Re)starts one replica group; clears any kill-hold on it."""
+        """(Re)starts one replica group; clears any kill-hold on it.
+
+        With a hot-spare pool, a respawn ADOPTS a ready spare instead of
+        forking a cold process: the spare already paid imports + JAX
+        backend init and is blocked waiting for its group id."""
         g = self._groups[group]
         if g.proc is not None and g.proc.poll() is None:
             raise RuntimeError(f"group {group} is already running")
@@ -163,6 +272,23 @@ class Launcher:
         g.exited_clean = False
         g.backoff_until = 0.0  # explicit spawn overrides a pending backoff
         g.killed_by_us = False  # the new process's exits are its own
+        spare = self._take_ready_spare() if self._spares_target else None
+        if spare is not None:
+            tmp = spare.go_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(group))
+            os.replace(tmp, spare.go_path)  # atomic: the spare reads whole ids
+            if g.log is not None:
+                g.log.close()
+            g.proc = spare.proc
+            g.log = spare.log  # the adopted process keeps its spare log file
+            g.spawned_at = time.monotonic()
+            logger.info(
+                "group %d adopted hot spare %d (pid %d)", group, spare.sid,
+                spare.proc.pid,
+            )
+            self._spawn_spare()  # refill the pool in the background
+            return
         env = dict(self._base_env)
         env["REPLICA_GROUP_ID"] = str(group)
         env.update(g.env)
@@ -237,6 +363,14 @@ class Launcher:
             g.restarts += 1
             self.spawn(i)
             restarted.append(i)
+        # Spare pool upkeep: replace dead spares (repeated IMMEDIATE deaths
+        # mean the command itself is broken — _note_spare_death's brake
+        # disables the pool instead of crash-looping).
+        for spare in list(self._spares):
+            if spare.proc.poll() is None:
+                continue
+            self._spares.remove(spare)
+            self._note_spare_death(spare)
         return restarted
 
     def running(self) -> bool:
@@ -268,11 +402,14 @@ class Launcher:
         return self._groups[group].restarts
 
     def stop(self) -> None:
-        """SIGTERM every group, escalate to SIGKILL, close logs and the
-        embedded Lighthouse."""
+        """SIGTERM every group (and spare), escalate to SIGKILL, close logs
+        and the embedded Lighthouse."""
         for g in self._groups.values():
             if g.proc is not None and g.proc.poll() is None:
                 g.proc.send_signal(signal.SIGTERM)
+        for spare in self._spares:
+            if spare.proc.poll() is None:
+                spare.proc.kill()  # spares hold no state worth a grace period
         for g in self._groups.values():
             if g.proc is not None:
                 try:
@@ -280,6 +417,29 @@ class Launcher:
                 except subprocess.TimeoutExpired:
                     g.proc.kill()
                     g.proc.wait(timeout=5)
+        for spare in self._spares:
+            try:
+                spare.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+            if spare.log is not None:
+                spare.log.close()
+        self._spares.clear()
+        # Go-file cleanup: remove the mkdtemp directory outright, or the
+        # stray .go files when they lived in the caller's log_dir.
+        if self._spare_dir is not None:
+            import glob
+            import shutil
+
+            if self._spare_dir_created:
+                shutil.rmtree(self._spare_dir, ignore_errors=True)
+            else:
+                for path in glob.glob(os.path.join(self._spare_dir, "spare_*.go")):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+            self._spare_dir = None
         for g in self._groups.values():
             if g.log is not None:
                 g.log.close()
@@ -308,6 +468,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument("--min-replicas", type=int, default=1)
     parser.add_argument("--join-timeout-ms", type=int, default=2000)
+    parser.add_argument(
+        "--spares", type=int, default=0,
+        help="hot-spare pool: pre-initialized processes that adopt a dead "
+        "group's id instantly (skips the respawn + runtime-init floor)",
+    )
     parser.add_argument("--log-dir", default=None)
     parser.add_argument(
         "--cache-dir", default=None, help="shared persistent XLA compile cache"
@@ -373,6 +538,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         join_timeout_ms=args.join_timeout_ms,
         log_dir=args.log_dir,
         cache_dir=args.cache_dir,
+        spares=args.spares,
     )
     with launcher:
         print(
