@@ -69,18 +69,26 @@ def main() -> None:
     from torchft_tpu import GradientAverager, Optimizer
     from torchft_tpu.data import DistributedSampler
 
-    replica_group, num_groups = replica_env()
-
     # -- model: tiny convnet on 32x32x3 inputs (CIFAR shaped) ----------------
+    # Everything here is GROUP-INDEPENDENT, so it runs before the group id
+    # resolves: a hot spare (launch --spares) pays params init + the JIT
+    # compile while idling, and adoption costs only Manager setup + rejoin.
     from torchft_tpu.models import convnet_loss, init_convnet_params
 
     init_params = init_convnet_params
     grad_fn = jax.jit(jax.value_and_grad(convnet_loss))
+    params0 = init_params(jax.random.PRNGKey(42))
 
     # Synthetic dataset, identical in every process (seeded).
     rng = np.random.default_rng(0)
     dataset_x = rng.standard_normal((2048, 32, 32, 3)).astype(np.float32)
     dataset_y = rng.integers(0, 10, size=(2048,)).astype(np.int32)
+    # Warm the compiled step (from the shared cache when available).
+    jax.block_until_ready(
+        grad_fn(params0, dataset_x[: args.batch], dataset_y[: args.batch])[0]
+    )
+
+    replica_group, num_groups = replica_env()
 
     # -- manager wiring ------------------------------------------------------
     state = {}
@@ -96,9 +104,7 @@ def main() -> None:
         save, load, replica_group, min_replicas=args.min_replicas
     )
 
-    state["opt"] = Optimizer(
-        manager, optax.sgd(args.lr), init_params(jax.random.PRNGKey(42))
-    )
+    state["opt"] = Optimizer(manager, optax.sgd(args.lr), params0)
     averager = GradientAverager(manager)
 
     # Durable disk checkpoints: peer transports heal a restarted group from

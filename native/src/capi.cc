@@ -65,6 +65,10 @@ char* tf_lighthouse_http_address(void* p) {
   return CopyString(static_cast<Lighthouse*>(p)->http_address());
 }
 
+int tf_lighthouse_evict(void* p, const char* prefix) {
+  return static_cast<Lighthouse*>(p)->EvictReplica(prefix ? prefix : "");
+}
+
 void tf_lighthouse_shutdown(void* p) { static_cast<Lighthouse*>(p)->Shutdown(); }
 
 void tf_lighthouse_free(void* p) { delete static_cast<Lighthouse*>(p); }

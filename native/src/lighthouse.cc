@@ -159,6 +159,12 @@ bool Lighthouse::Start(std::string* err) {
               r.body = kerr;
               r.content_type = "text/plain";
             }
+          } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
+                     path.size() > 15 && path.substr(path.size() - 6) == "/evict") {
+            std::string prefix = path.substr(9, path.size() - 9 - 6);
+            int n = EvictReplica(prefix);
+            r.body = "evicted " + std::to_string(n) + " id(s) for " + prefix;
+            r.content_type = "text/plain";
           } else {
             r.code = 404;
             r.body = "not found";
@@ -219,6 +225,14 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
       r.SerializeToString(resp);
       return Status::kOk;
     }
+    case kLighthouseEvict: {
+      LighthouseEvictRequest q;
+      if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      LighthouseEvictResponse r;
+      r.set_evicted(EvictReplica(q.replica_prefix()));
+      r.SerializeToString(resp);
+      return Status::kOk;
+    }
     default:
       *resp = "unknown lighthouse method " + std::to_string(method);
       return Status::kUnknown;
@@ -227,6 +241,9 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
 
 Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
   std::lock_guard<std::mutex> lk(mu_);
+  if (evicted_.count(req.replica_id())) {
+    return Status::kAborted;  // a zombie's in-flight heartbeat
+  }
   state_.heartbeats[req.replica_id()] = Clock::now();
   return Status::kOk;
 }
@@ -239,6 +256,13 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     return Status::kInvalidArgument;
   }
   std::unique_lock<std::mutex> lk(mu_);
+  if (evicted_.count(id)) {
+    // The supervisor declared this exact incarnation dead; a late join
+    // from it is a zombie (e.g. a request already in flight when the
+    // process was reaped) and must not resurrect the corpse.
+    *err = "replica " + id + " was evicted by its supervisor";
+    return Status::kAborted;
+  }
   // Joining is an implicit heartbeat (reference: src/lighthouse.rs:480-491).
   state_.heartbeats[id] = Clock::now();
   state_.participants[id] = QuorumState::Joined{req.requester(), Clock::now()};
@@ -251,6 +275,13 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
   // excluded from the quorum its own join triggered (e.g. shrink_only), in
   // which case it keeps waiting for a later round (src/lighthouse.rs:494-530).
   while (true) {
+    if (evicted_.count(id)) {
+      // Evicted while blocked here: abort instead of re-registering (the
+      // re-register below would resurrect a corpse the supervisor already
+      // replaced with a fresh incarnation).
+      *err = "replica " + id + " was evicted by its supervisor";
+      return Status::kAborted;
+    }
     if (latest_quorum_ && quorum_gen_ > start_gen) {
       for (const auto& m : latest_quorum_->participants()) {
         if (m.replica_id() == id) {
@@ -272,7 +303,7 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     }
     int64_t gen = quorum_gen_;
     bool woke = quorum_cv_.wait_until(lk, deadline.at, [&] {
-      return quorum_gen_ != gen || shutdown_;
+      return quorum_gen_ != gen || shutdown_ || evicted_.count(id) > 0;
     });
     if (shutdown_) {
       *err = "lighthouse shutting down";
@@ -331,6 +362,15 @@ void Lighthouse::TickLocked() {
     if (tick_now - it->second > hb_timeout * 10 &&
         state_.participants.find(it->first) == state_.participants.end()) {
       it = state_.heartbeats.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  // Tombstones outlive any in-flight zombie RPC by far at 10x the
+  // heartbeat timeout; prune so id churn cannot grow the map unboundedly.
+  for (auto it = evicted_.begin(); it != evicted_.end();) {
+    if (tick_now - it->second > hb_timeout * 10) {
+      it = evicted_.erase(it);
     } else {
       ++it;
     }
@@ -409,6 +449,56 @@ void Lighthouse::FillStatus(LighthouseStatusResponse* resp) {
         std::chrono::duration_cast<std::chrono::milliseconds>(now - last).count();
   }
   resp->set_quorum_id(state_.quorum_id);
+}
+
+int Lighthouse::EvictReplica(const std::string& prefix) {
+  // Tombstones cover IDS SEEN at evict time.  A first-contact join that
+  // was serialized by the dying process but not yet dispatched here can
+  // still register afterwards — that zombie self-heals within
+  // heartbeat_timeout (it never commits or heartbeats again), which is the
+  // pre-eviction behavior for a bounded, microsecond-scale window.
+  // Tombstoning the whole "<prefix>:" FAMILY instead would be wrong: the
+  // replacement incarnation shares the prefix and joins milliseconds
+  // later (hot-spare adoption), so it must not be blocked.
+  std::lock_guard<std::mutex> lk(mu_);
+  int dropped = 0;
+  auto now = Clock::now();
+  auto matches = [&](const std::string& id) {
+    return id == prefix || id.rfind(prefix + ":", 0) == 0;
+  };
+  for (auto it = state_.heartbeats.begin(); it != state_.heartbeats.end();) {
+    if (matches(it->first)) {
+      evicted_[it->first] = now;  // tombstone: no zombie re-registration
+      it = state_.heartbeats.erase(it);
+      ++dropped;
+    } else {
+      ++it;
+    }
+  }
+  for (auto it = state_.participants.begin(); it != state_.participants.end();) {
+    if (matches(it->first)) {
+      evicted_[it->first] = now;
+      it = state_.participants.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  for (auto it = last_fresh_.begin(); it != last_fresh_.end();) {
+    if (matches(it->first)) {
+      it = last_fresh_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  // Wake blocked quorum handlers: an evicted id's own handler must notice
+  // its tombstone and abort instead of waiting out its deadline.
+  quorum_cv_.notify_all();
+  if (dropped > 0) {
+    LOGI("lighthouse: evicted %d replica id(s) matching '%s' (supervisor "
+         "reported dead)", dropped, prefix.c_str());
+    TickLocked();  // a waiting quorum can now form without the straggler wait
+  }
+  return dropped;
 }
 
 bool Lighthouse::KillReplica(const std::string& replica_id, std::string* err) {

@@ -76,6 +76,14 @@ class Lighthouse {
   Status HandleHeartbeat(const LighthouseHeartbeatRequest& req);
   void FillStatus(LighthouseStatusResponse* resp);
 
+  // Supervisor-assisted failure notification: drop a replica's heartbeat
+  // and pending join immediately so the next quorum round does not spend
+  // join_timeout waiting for a process the SUPERVISOR already knows is
+  // dead (the heartbeat would otherwise look fresh for up to
+  // heartbeat_timeout_ms).  `prefix` matches a full replica id or a
+  // "<group>:" uuid-suffixed family.  Returns how many ids were dropped.
+  int EvictReplica(const std::string& prefix);
+
   // Asks the replica's manager to exit. Used by the dashboard kill button.
   // Reference parity: src/lighthouse.rs:433-458.
   bool KillReplica(const std::string& replica_id, std::string* err);
@@ -110,6 +118,13 @@ class Lighthouse {
   // Replicas observed heartbeat-fresh on the previous tick, for logging
   // healthy<->stale transitions (failure-detection visibility).
   std::map<std::string, bool> last_fresh_;
+  // Tombstones for supervisor-evicted incarnations (id -> evict time): a
+  // dead incarnation's still-blocked quorum handler or in-flight heartbeat
+  // must not re-register the corpse after EvictReplica dropped it.  Pruned
+  // on the tick after 10x the heartbeat timeout (same horizon as the
+  // heartbeat graveyard) — fresh incarnations carry new uuids, so exact-id
+  // tombstones cannot block a legitimate rejoin.
+  std::map<std::string, TimePoint> evicted_;
 
   std::thread tick_thread_;
   bool shutdown_ = false;

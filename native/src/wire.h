@@ -69,6 +69,7 @@ enum Method : uint16_t {
   kLighthouseQuorum = 1,
   kLighthouseHeartbeat = 2,
   kLighthouseStatus = 3,
+  kLighthouseEvict = 4,
   kManagerQuorum = 10,
   kManagerCheckpointMetadata = 11,
   kManagerShouldCommit = 12,

@@ -747,6 +747,101 @@ void TestJoinDuringShrink() {
   lh.Shutdown();
 }
 
+// --- Supervisor-assisted eviction --------------------------------------------
+// A dead replica whose heartbeat is still fresh blocks the next quorum (the
+// healthy-majority guard counts the corpse) until heartbeat_timeout ages it
+// out; EvictReplica (the launcher's failure notification) drops it so the
+// round forms in tick time.  Also covers "<group>:" uuid-family prefix
+// matching and idempotency.
+void TestEvictSkipsStragglerWait() {
+  LighthouseOpt opt;
+  opt.bind = "127.0.0.1:0";
+  opt.http_bind = "";
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 10;
+  opt.heartbeat_timeout_ms = 5000;  // the wait evict must beat
+  Lighthouse lh(opt);
+  std::string err;
+  CHECK(lh.Start(&err));
+
+  auto join = [&](const std::string& id, int64_t step, LighthouseQuorumResponse* out) {
+    RpcClient c(lh.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = MakeMember(id, step);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    CHECK(c.Call(kLighthouseQuorum, payload, 20000, &resp, &cerr) == Status::kOk);
+    CHECK(out->ParseFromString(resp));
+  };
+
+  // Round 1: group 1 alone (uuid-suffixed id, like real managers).
+  LighthouseQuorumResponse q1;
+  join("1:bbbb", 1, &q1);
+  CHECK(q1.quorum().participants_size() == 1);
+
+  // Group 1's process dies; its heartbeat is still fresh, so a NEW group's
+  // join would be held by the healthy-majority guard (1 of 2 healthy
+  // joined) until the corpse's heartbeat ages out at 5 s.  The
+  // supervisor's evict removes it; prefix "1" matches the "1:bbbb" family.
+  CHECK(lh.EvictReplica("1") == 1);
+  CHECK(lh.EvictReplica("1") == 0);  // idempotent
+
+  auto t0 = Clock::now();
+  LighthouseQuorumResponse q2;
+  join("0:aaaa", 2, &q2);
+  auto elapsed =
+      std::chrono::duration_cast<std::chrono::milliseconds>(Clock::now() - t0).count();
+  CHECK(q2.quorum().participants_size() == 1);
+  CHECK(q2.quorum().participants(0).replica_id() == "0:aaaa");
+  CHECK(elapsed < 2000);  // far below the 5 s heartbeat staleness wait
+
+  // The evicted family rejoins later as a fresh incarnation.
+  CHECK(lh.EvictReplica("0") == 1);
+  LighthouseQuorumResponse q3;
+  join("1:cccc", 3, &q3);
+  CHECK(q3.quorum().participants_size() == 1);
+  CHECK(q3.quorum().participants(0).replica_id() == "1:cccc");
+
+  // Tombstones: a ZOMBIE of an evicted incarnation (a join already in
+  // flight when its process was reaped) must be rejected, not resurrect
+  // the corpse into the healthy set.
+  {
+    RpcClient c(lh.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = MakeMember("0:aaaa", 4);  // evicted id
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    CHECK(c.Call(kLighthouseQuorum, payload, 5000, &resp, &cerr) == Status::kAborted);
+    LighthouseHeartbeatRequest hb;
+    hb.set_replica_id("0:aaaa");
+    hb.SerializeToString(&payload);
+    CHECK(c.Call(kLighthouseHeartbeat, payload, 2000, &resp, &cerr) == Status::kAborted);
+  }
+
+  // The Evict RPC itself (wire method 4 — what an external supervisor
+  // uses): evicting the live "1:cccc" family over the wire.
+  {
+    RpcClient c(lh.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    LighthouseEvictRequest req;
+    req.set_replica_prefix("1");
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    CHECK(c.Call(kLighthouseEvict, payload, 2000, &resp, &cerr) == Status::kOk);
+    LighthouseEvictResponse out;
+    CHECK(out.ParseFromString(resp));
+    CHECK(out.evicted() == 1);
+  }
+
+  lh.Shutdown();
+}
+
 // --- QuorumCompute property fuzz ---------------------------------------------
 // Randomized join/leave/heartbeat/round sequences; the invariants the
 // reference effectively specs with ~590 test lines (src/lighthouse.rs:606-1038):
@@ -849,6 +944,7 @@ int main() {
   TestFrameDeadlinePropagation();
   TestWireVersionMismatch();
   TestJoinDuringShrink();
+  TestEvictSkipsStragglerWait();
   TestQuorumComputeFuzz();
   printf("all native tests passed\n");
   return 0;

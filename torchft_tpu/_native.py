@@ -35,6 +35,7 @@ _DEADLINE_EXCEEDED = 4
 LIGHTHOUSE_QUORUM = 1
 LIGHTHOUSE_HEARTBEAT = 2
 LIGHTHOUSE_STATUS = 3
+LIGHTHOUSE_EVICT = 4
 MANAGER_QUORUM = 10
 MANAGER_CHECKPOINT_METADATA = 11
 MANAGER_SHOULD_COMMIT = 12
@@ -92,6 +93,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.tf_lighthouse_address.argtypes = [ctypes.c_void_p]
     lib.tf_lighthouse_http_address.restype = ctypes.c_void_p
     lib.tf_lighthouse_http_address.argtypes = [ctypes.c_void_p]
+    lib.tf_lighthouse_evict.restype = ctypes.c_int
+    lib.tf_lighthouse_evict.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.tf_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_lighthouse_free.argtypes = [ctypes.c_void_p]
     lib.tf_manager_new.restype = ctypes.c_void_p
@@ -253,6 +256,15 @@ class LighthouseServer:
     def http_address(self) -> str:
         return _take_string(_lib.tf_lighthouse_http_address(self._ptr))
 
+    def evict(self, replica_prefix: str) -> int:
+        """Supervisor-assisted failure notification: drop the heartbeat and
+        pending join of every replica id matching ``replica_prefix`` (a full
+        id or a "<group>" family whose ids are "<group>:<uuid>").  The next
+        quorum round then forms without spending join_timeout waiting for a
+        process the supervisor already knows is dead.  Returns the number of
+        ids dropped."""
+        return int(_lib.tf_lighthouse_evict(self._ptr, replica_prefix.encode()))
+
     def shutdown(self) -> None:
         if self._ptr:
             _lib.tf_lighthouse_shutdown(self._ptr)
@@ -306,6 +318,18 @@ class LighthouseClient:
     def heartbeat(self, replica_id: str, timeout_ms: int = 5000) -> None:
         req = pb.LighthouseHeartbeatRequest(replica_id=replica_id)
         self._client.call(LIGHTHOUSE_HEARTBEAT, req.SerializeToString(), timeout_ms)
+
+    def evict(self, replica_prefix: str, timeout_ms: int = 5000) -> int:
+        """Supervisor-assisted failure notification over the wire (method 4,
+        docs/wire.md): drop + tombstone every replica id matching
+        ``replica_prefix`` (full id or "<group>" uuid family) so the next
+        quorum forms without waiting on a process the supervisor reaped."""
+        req = pb.LighthouseEvictRequest(replica_prefix=replica_prefix)
+        resp = pb.LighthouseEvictResponse()
+        resp.ParseFromString(
+            self._client.call(LIGHTHOUSE_EVICT, req.SerializeToString(), timeout_ms)
+        )
+        return int(resp.evicted)
 
     def status(self, timeout_ms: int = 5000) -> "pb.LighthouseStatusResponse":
         resp = pb.LighthouseStatusResponse()

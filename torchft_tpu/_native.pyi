@@ -53,6 +53,7 @@ class LighthouseServer:
     ) -> None: ...
     def address(self) -> str: ...
     def http_address(self) -> str: ...
+    def evict(self, replica_prefix: str) -> int: ...
     def shutdown(self) -> None: ...
 
 class LighthouseClient:
@@ -69,6 +70,7 @@ class LighthouseClient:
         data: Optional[Dict[str, Any]] = ...,
     ) -> Any: ...  # pb.Quorum
     def heartbeat(self, replica_id: str, timeout_ms: int = ...) -> None: ...
+    def evict(self, replica_prefix: str, timeout_ms: int = ...) -> int: ...
     def close(self) -> None: ...
 
 class ManagerServer:

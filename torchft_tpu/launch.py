@@ -75,6 +75,11 @@ class _Group:
     # Set when the death was OUR kill() (fault injection): exempt from the
     # brake — the uptime check targets spontaneous fast-exits only.
     killed_by_us: bool = False
+    # This incarnation's death was already reported to the lighthouse; the
+    # supervisor polls dead groups every pass (backoff / exhausted budget)
+    # and must not repeat the (possibly blocking, for external
+    # lighthouses) evict RPC each tick.
+    evicted: bool = False
 
 
 class Launcher:
@@ -137,6 +142,7 @@ class Launcher:
         self._spare_pool_disabled = False
         self._spare_dir: Optional[str] = None
         self._spare_dir_created = False
+        self._evict_client = None  # lazy wire client for external lighthouses
 
         if lighthouse == "embed":
             from torchft_tpu._native import LighthouseServer
@@ -272,6 +278,7 @@ class Launcher:
         g.exited_clean = False
         g.backoff_until = 0.0  # explicit spawn overrides a pending backoff
         g.killed_by_us = False  # the new process's exits are its own
+        g.evicted = False  # fresh incarnation: its death is unreported
         spare = self._take_ready_spare() if self._spares_target else None
         if spare is not None:
             tmp = spare.go_path + ".tmp"
@@ -304,6 +311,29 @@ class Launcher:
         )
         g.spawned_at = time.monotonic()
 
+    def _evict_from_lighthouse(self, group: int) -> None:
+        """Supervisor-assisted failure notification: the lighthouse drops
+        (and tombstones) the dead group's incarnations immediately, so the
+        next quorum forms without spending join/heartbeat timeouts on a
+        corpse whose heartbeat still looks fresh.  This is what makes
+        hot-spare adoption fast — the spare rejoins within the old
+        incarnation's heartbeat window.  Embedded lighthouses are called
+        in-process; external ones over the wire (method 4, docs/wire.md)."""
+        try:
+            if self._embedded is not None:
+                self._embedded.evict(str(group))
+            elif self.lighthouse_address:
+                from torchft_tpu._native import LighthouseClient
+
+                if self._evict_client is None:
+                    self._evict_client = LighthouseClient(self.lighthouse_address)
+                self._evict_client.evict(str(group))
+        except Exception:  # noqa: BLE001
+            # Drop a possibly-broken cached connection so the next death
+            # redials instead of failing forever on a stale client.
+            self._evict_client = None
+            logger.warning("lighthouse evict of group %d failed", group, exc_info=True)
+
     def kill(self, group: int, sig: int = signal.SIGKILL, hold: bool = True) -> None:
         """Kills one group (default SIGKILL — the fault-injection path).  With
         ``hold``, the supervisor won't restart it until ``spawn`` is called,
@@ -317,6 +347,8 @@ class Launcher:
             # doubled delay too — the next incarnation's exits start fresh.
             g.killed_by_us = True
             g.backoff_s = 0.0
+            g.evicted = True
+            self._evict_from_lighthouse(group)
         g.held = hold
 
     def supervise_once(self) -> List[int]:
@@ -332,7 +364,17 @@ class Launcher:
                 continue
             if code == 0:
                 g.exited_clean = True
+                if not g.evicted:
+                    g.evicted = True
+                    self._evict_from_lighthouse(i)
                 continue
+            # Evict BEFORE the budget check: a group that exhausted
+            # max_restarts is the most permanently dead of all — leaving
+            # its heartbeat fresh would stall the survivors' quorum on it.
+            # Once per incarnation: dead groups are re-polled every pass.
+            if not g.evicted:
+                g.evicted = True
+                self._evict_from_lighthouse(i)
             if self._max_restarts is not None and g.restarts >= self._max_restarts:
                 continue
             now = time.monotonic()
