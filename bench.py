@@ -488,6 +488,14 @@ def _run_scenario(
           the restarted incarnation shows its FIRST event (it is
           rejoining/healing, has not committed), kill it again — a failure
           landing inside recovery.
+      {"type": "drain", "victim"}   — cooperative drain at window/3: the
+          launcher (spare pool enabled) writes the drain notice and hands
+          the group id to a pre-warmed spare; the donor finishes its
+          in-flight step, votes commit, tells the lighthouse, and exits.
+          Measures the PLANNED-departure path (GCE maintenance /
+          preemption notices, SIGTERM grace periods) next to the crash
+          numbers: dead time is the donor-to-replacement commit gap, and
+          the survivors must see ZERO failed should_commit rounds.
 
     The measurement window only starts once BOTH groups have committed a
     step: startup JIT compilation is excluded from both scenarios, and a
@@ -509,7 +517,7 @@ def _run_scenario(
     metrics_path = os.path.join(workdir, "metrics.jsonl")
     victim = str(plan["victim"]) if plan else None
     kind = plan["type"] if plan else None
-    spares = 1 if kind == "single_spare" else 0
+    spares = 1 if kind in ("single_spare", "drain") else 0
     launcher = Launcher(
         [sys.executable, os.path.join(repo, "examples", "train_ddp.py"),
          "--steps", "1000000"],
@@ -534,6 +542,18 @@ def _run_scenario(
 
     def kill_victim():
         kill_events.append((time.time(), victim))
+        if kind == "drain":
+            # Planned departure: the launcher hands the id to a pre-warmed
+            # spare and notifies the donor; no kill at all.  A victim that
+            # crashed in the poll gap makes drain() raise — record the
+            # trial as unrecovered instead of aborting the whole bench
+            # (kill() tolerates the same race silently).
+            try:
+                launcher.drain(int(victim), deadline_s=20.0)
+            except RuntimeError as e:
+                print(f"drain trial lost its victim before the notice: {e}",
+                      file=sys.stderr)
+            return
         launcher.kill(int(victim))  # SIGKILL, the real thing
         if spares:
             # Hot adoption IS the respawn: no scripted environment delay.
@@ -545,21 +565,35 @@ def _run_scenario(
     with launcher:
         start = time.monotonic()
         first_kill_at = None if plan is None else (
-            total_window / 3 if kind in ("single", "single_spare") else total_window / 4
+            total_window / 3
+            if kind in ("single", "single_spare", "drain")
+            else total_window / 4
         )
         pre_kill_ids: set = set()
-        second_done = kind in ("single", "single_spare")
+        second_done = kind in ("single", "single_spare", "drain")
         second_deadline = None
         tail = _MetricsTail(metrics_path)
         while time.monotonic() - start < total_window:
             time.sleep(0.25)
             if first_kill_at is not None and time.monotonic() - start >= first_kill_at:
-                pre_kill_ids = set(
-                    _victim_incarnations(tail.poll(), victim)
+                # Draining a group that never committed (still in its first
+                # JIT) measures nothing: the handoff gap needs a donor
+                # commit timeline on both sides.  Hold the drain until the
+                # first commit — WITHOUT skipping the supervision below
+                # (the window clock keeps running either way).
+                fire_ok = kind != "drain" or any(
+                    commit is not None
+                    for _, commit in _victim_incarnations(
+                        tail.poll(), victim
+                    ).values()
                 )
-                kill_victim()
-                first_kill_at = None
-                second_deadline = time.monotonic() + 25.0
+                if fire_ok:
+                    pre_kill_ids = set(
+                        _victim_incarnations(tail.poll(), victim)
+                    )
+                    kill_victim()
+                    first_kill_at = None
+                    second_deadline = time.monotonic() + 25.0
             elif not second_done and kill_events:
                 # Watch for the respawned incarnation to reach the trigger
                 # state, with a deadline fallback so a stuck restart can't
@@ -577,11 +611,11 @@ def _run_scenario(
             # Supervisor: restart any group that died for other reasons.
             launcher.supervise_once()
 
-    return _scenario_stats(workdir, metrics_path, kill_events)
+    return _scenario_stats(workdir, metrics_path, kill_events, plan)
 
 
 def _scenario_stats(
-    workdir: str, metrics_path: str, kill_events: list | None
+    workdir: str, metrics_path: str, kill_events: list | None, plan: dict | None = None
 ) -> dict:
     """Parses the metrics stream into per-group committed counts, the
     dead-window goodput fraction, and (single-kill runs) the victim's
@@ -605,12 +639,16 @@ def _scenario_stats(
     events = _read_events(metrics_path)
 
     commits: dict[str, list[float]] = {}
+    failed: dict[str, list[float]] = {}
     heals = 0
     heal_ms: list[float] = []
     for ev in events:
-        if ev.get("event") == "commit" and ev.get("committed"):
+        if ev.get("event") == "commit":
             group = str(ev.get("replica_id", "")).split(":", 1)[0]
-            commits.setdefault(group, []).append(float(ev["ts"]))
+            if ev.get("committed"):
+                commits.setdefault(group, []).append(float(ev["ts"]))
+            else:
+                failed.setdefault(group, []).append(float(ev["ts"]))
         elif ev.get("event") == "heal_fetched":
             heals += 1
             if ev.get("heal_ms") is not None:
@@ -652,6 +690,8 @@ def _scenario_stats(
             "victim_ft_resume_s": None,
             "goodput_self_fraction": None,
             "victims_recovered": False,
+            "drain_handoff_gap_s": None,
+            "failed_commits_after_kill": {},
             "metrics_stream": False,
         }
 
@@ -682,6 +722,53 @@ def _scenario_stats(
                     dead_total += max(0.0, (b - a) - med)
         if span > 0 and victims_recovered:
             deadwindow_fraction = max(0.0, 1.0 - dead_total / span)
+
+    # --- cooperative drain: incarnation-aware accounting -----------------
+    # The donor keeps COMMITTING after the notice (that is the point), so
+    # the gap containing the notice is a normal step gap and the real
+    # handoff cost is the incarnation boundary: last donor commit -> first
+    # replacement commit.  A negative gap means the replacement overlapped
+    # the donor's tail — genuine zero dead time.
+    drain_handoff_gap = None
+    failed_after_kill: dict[str, int] = {}
+    if kill_events:
+        first_kill = min(ts for ts, _ in kill_events)
+        failed_after_kill = {
+            g: sum(1 for ts in ts_list if ts >= first_kill)
+            for g, ts_list in sorted(failed.items())
+        }
+    if plan is not None and plan.get("type") == "drain" and len(kill_events) == 1:
+        notice_ts, victim = kill_events[0]
+        pre_ids = {
+            str(ev.get("replica_id"))
+            for ev in events
+            if str(ev.get("replica_id", "")).split(":", 1)[0] == victim
+            and float(ev["ts"]) <= notice_ts
+        }
+        old = sorted(
+            float(ev["ts"]) for ev in events
+            if ev.get("event") == "commit" and ev.get("committed")
+            and str(ev.get("replica_id", "")).split(":", 1)[0] == victim
+            and str(ev.get("replica_id")) in pre_ids
+        )
+        new = sorted(
+            float(ev["ts"]) for ev in events
+            if ev.get("event") == "commit" and ev.get("committed")
+            and str(ev.get("replica_id", "")).split(":", 1)[0] == victim
+            and str(ev.get("replica_id")) not in pre_ids
+        )
+        if old and new:
+            drain_handoff_gap = min(new) - max(old)
+            steps_iv = [b - a for a, b in zip(old, old[1:])]
+            med = sorted(steps_iv)[len(steps_iv) // 2] if steps_iv else 0.0
+            dead_total = max(0.0, drain_handoff_gap - med)
+            victims_recovered = True
+            span = t_end - t0
+            if span > 0:
+                deadwindow_fraction = max(0.0, 1.0 - dead_total / span)
+        else:
+            victims_recovered = False
+            deadwindow_fraction = None
 
     # --- single-kill decomposition + self-normalized secondary -----------
     victim_downtime = None
@@ -742,6 +829,15 @@ def _scenario_stats(
             expected = rate_pre * (t_end - t0)
             if expected > 0:
                 self_fraction = per_group.get(victim, 0) / expected
+        if plan is not None and plan.get("type") == "drain":
+            # before/after split by the NOTICE time mixes the donor's
+            # post-notice commits into "after"; the honest downtime is the
+            # incarnation boundary computed above (clamped: an overlapped
+            # handoff costs zero, not negative).
+            victim_downtime = (
+                max(0.0, drain_handoff_gap) if drain_handoff_gap is not None else None
+            )
+            victim_partial_step = None
 
     return {
         "committed_batches": sum(per_group.values()),
@@ -759,6 +855,10 @@ def _scenario_stats(
         "victim_ft_resume_s": victim_ft_resume,
         "goodput_self_fraction": self_fraction,
         "victims_recovered": victims_recovered,
+        "drain_handoff_gap_s": (
+            round(drain_handoff_gap, 3) if drain_handoff_gap is not None else None
+        ),
+        "failed_commits_after_kill": failed_after_kill,
         "metrics_stream": True,
     }
 
@@ -770,18 +870,23 @@ def _mean(values) -> float | None:
 
 def _trial_plans(trials: int) -> list:
     """The churn mix: alternating-victim single kills, hot-spare single
-    kills (the launcher's spare pool adopts the dead group), plus
-    back-to-back double kills and kill-during-heal trials (the
-    repeated-failure scenarios of torchft/manager_integ_test.py:304-352).
-    >= 9 trials carries 3 churn trials and 2 spare trials."""
+    kills (the launcher's spare pool adopts the dead group), back-to-back
+    double kills and kill-during-heal trials (the repeated-failure
+    scenarios of torchft/manager_integ_test.py:304-352), plus cooperative
+    DRAIN trials — the planned-departure path (maintenance/preemption
+    notices) measured next to the crash numbers.  >= 10 trials carries
+    3 churn, 2 spare, and 2 drain trials."""
     plans: list[dict] = []
     churn = 3 if trials >= 9 else (2 if trials >= 4 else 0)
     spare = 2 if trials >= 8 else 0
-    singles = trials - churn - spare
+    drain = 2 if trials >= 10 else (1 if trials >= 6 else 0)
+    singles = max(0, trials - churn - spare - drain)
     for i in range(singles):
         plans.append({"type": "single", "victim": i % 2})
     for i in range(spare):
         plans.append({"type": "single_spare", "victim": (i + 1) % 2})
+    for i in range(drain):
+        plans.append({"type": "drain", "victim": i % 2})
     for i in range(churn):
         plans.append(
             {"type": "double" if i % 2 == 0 else "during_heal", "victim": (i + 1) % 2}
@@ -825,6 +930,8 @@ def kill_benchmark() -> dict:
     singles = [k for p, k in kills if p["type"] == "single"]
     spare_trials = [k for p, k in kills if p["type"] == "single_spare"]
     churny = [k for p, k in kills if p["type"] in ("double", "during_heal")]
+    drain_pairs = [(p, k) for p, k in kills if p["type"] == "drain"]
+    drains = [k for _, k in drain_pairs]
 
     # The headline fraction is computed over the SINGLE-kill trials only:
     # churn trials run a longer window and charge two kills, so mixing the
@@ -869,7 +976,7 @@ def kill_benchmark() -> dict:
         if k.get("dead_time_s") is not None
         and k["kills"]
         and k["victims_recovered"]
-        and p["type"] != "single_spare"
+        and p["type"] not in ("single_spare", "drain")
     ]
     base_victims = [b["per_group"].get("1", 0) for b in bases if b["per_group"]]
     base_spread = (
@@ -930,6 +1037,34 @@ def kill_benchmark() -> dict:
         "spare_victim_ft_resume_s": _mean(
             [k["victim_ft_resume_s"] for k in spare_trials]
         ),
+        # Cooperative drain (the planned-departure path): the replacement
+        # is pre-warmed at notice time, so the handoff gap — last donor
+        # commit to first replacement commit — is the whole cost; a
+        # negative gap means the replacement overlapped the donor's tail.
+        # drain_survivor_failed_commits MUST be 0: nobody crashed, so no
+        # collective ever failed mid-step.
+        "drain_fractions": [
+            round(k["goodput_deadwindow_fraction"], 4)
+            for k in drains
+            if k["goodput_deadwindow_fraction"] is not None
+        ],
+        "drain_victim_downtime_s": _mean(
+            [k["victim_downtime_s"] for k in drains]
+        ),
+        "drain_handoff_gap_s_trials": [
+            k["drain_handoff_gap_s"] for k in drains
+            if k.get("drain_handoff_gap_s") is not None
+        ],
+        "drain_dead_time_s": _mean(
+            [k["dead_time_s"] for k in drains if k.get("dead_time_s") is not None]
+        ),
+        "drain_survivor_failed_commits": sum(
+            n
+            for p, k in drain_pairs
+            for g, n in k.get("failed_commits_after_kill", {}).items()
+            if g != str(p["victim"])
+        ),
+        "drains_recovered": all(k["victims_recovered"] for k in drains),
         "kills_total": sum(k["kills"] for _, k in kills),
         # Secondary: the round-4 self-normalized victim fraction (rate
         # extrapolation; sensitive to load drift — kept for comparability).
@@ -986,6 +1121,58 @@ def kill_benchmark() -> dict:
     }
 
 
+def drain_benchmark(trials: int | None = None) -> dict:
+    """Standalone cooperative-drain benchmark (``--scenario drain``): N
+    drain trials, no kill baseline needed — the criterion is absolute
+    (zero survivor commit failures, handoff gap ~one step interval), and
+    the numbers land next to the SIGKILL figures in the BENCH_* artifact."""
+    window = float(os.environ.get("TPUFT_BENCH_KILL_WINDOW_S", "45"))
+    trials = trials if trials is not None else max(
+        1, int(os.environ.get("TPUFT_BENCH_DRAIN_TRIALS", "3"))
+    )
+    results = []
+    with tempfile.TemporaryDirectory(prefix="tpuft_bench_cache_") as cache_dir:
+        for i in range(trials):
+            plan = {"type": "drain", "victim": i % 2}
+            with tempfile.TemporaryDirectory(prefix="tpuft_bench_drain_") as d:
+                results.append(
+                    (plan, _run_scenario(d, window_s=window, plan=plan, cache_dir=cache_dir))
+                )
+    fractions = [
+        k["goodput_deadwindow_fraction"]
+        for _, k in results
+        if k["goodput_deadwindow_fraction"] is not None
+    ]
+    return {
+        "window_s": window,
+        "trials": trials,
+        "drain_fractions": [round(f, 4) for f in fractions],
+        "drain_goodput_fraction": (
+            round(sum(fractions) / len(fractions), 4) if fractions else None
+        ),
+        "drain_victim_downtime_s": _mean([k["victim_downtime_s"] for _, k in results]),
+        "drain_handoff_gap_s_trials": [
+            k["drain_handoff_gap_s"] for _, k in results
+            if k.get("drain_handoff_gap_s") is not None
+        ],
+        "drain_dead_time_s": _mean(
+            [k["dead_time_s"] for _, k in results if k.get("dead_time_s") is not None]
+        ),
+        "drain_victim_restart_s": _mean([k["victim_restart_s"] for _, k in results]),
+        "drain_victim_ft_resume_s": _mean(
+            [k["victim_ft_resume_s"] for _, k in results]
+        ),
+        "drain_survivor_failed_commits": sum(
+            n
+            for p, k in results
+            for g, n in k.get("failed_commits_after_kill", {}).items()
+            if g != str(p["victim"])
+        ),
+        "drains_recovered": all(k["victims_recovered"] for _, k in results),
+        "heals": sum(k["heals"] for _, k in results),
+    }
+
+
 def main() -> None:
     # The chip result is computed, assembled, and (on any kill-scenario
     # failure) still printed first: a failure on the subprocess-heavy kill
@@ -1020,8 +1207,14 @@ def main() -> None:
             "(victim_ft_resume_s: rejoin + live heal + commit) is "
             "sub-second.  goodput_fraction_at_hourly_failures restates the "
             "measured downtime against BASELINE.md's <5% target at a "
-            "realistic failure rate.  The reference publishes no absolute "
-            "numbers.",
+            "realistic failure rate.  Drain trials (drain_fractions) "
+            "measure the PLANNED-departure path: the launcher pre-warms a "
+            "replacement at notice time and the donor finishes its step "
+            "and exits, so the cost is the donor-to-replacement commit "
+            "gap (drain_handoff_gap_s_trials; negative = overlapped) and "
+            "survivors must log zero failed commits "
+            "(drain_survivor_failed_commits).  The reference publishes no "
+            "absolute numbers.",
         },
     }
     try:
@@ -1051,18 +1244,36 @@ def selftest() -> None:
     assert list(sig.parameters) == ["workdir", "window_s", "plan", "cache_dir"]
     inspect.signature(kill_benchmark).bind()
     inspect.signature(chip_benchmark).bind()
+    inspect.signature(drain_benchmark).bind()
     plans = _trial_plans(10)
     assert len(plans) == 10
     assert {p["type"] for p in plans} == {
-        "single", "single_spare", "double", "during_heal"
+        "single", "single_spare", "drain", "double", "during_heal"
     }
     assert {p["victim"] for p in plans} == {0, 1}
     assert sum(p["type"] in ("double", "during_heal") for p in plans) >= 3
+    assert sum(p["type"] == "drain" for p in plans) >= 2
     print("bench selftest ok")
 
 
 if __name__ == "__main__":
     if "--selftest" in sys.argv:
         selftest()
+    elif "--scenario" in sys.argv:
+        which = sys.argv[sys.argv.index("--scenario") + 1:]
+        if not which or which[0] != "drain":
+            print(f"unknown --scenario {which[:1] or '(missing)'}", file=sys.stderr)
+            sys.exit(2)
+        drain = drain_benchmark()
+        print(
+            json.dumps(
+                {
+                    "metric": "drain_goodput",
+                    "value": drain["drain_goodput_fraction"],
+                    "unit": "deadwindow_drain_fraction",
+                    "detail": drain,
+                }
+            )
+        )
     else:
         main()

@@ -83,13 +83,15 @@ def make_manager(
 ) -> Any:
     """One-replica-group Manager with the examples' standard wiring:
     TCPCollective data plane + HTTP checkpoint transport (optionally with a
-    sharding restorer for sharded-state healing)."""
+    sharding restorer for sharded-state healing), plus the cooperative-drain
+    watcher (SIGTERM / supervisor notice file / opt-in GCE metadata poll) so
+    a planned departure hands off instead of dying."""
     from datetime import timedelta
 
     from torchft_tpu import Manager, TCPCollective
     from torchft_tpu.checkpointing.http_transport import HTTPTransport
 
-    return Manager(
+    manager = Manager(
         collective=TCPCollective(timeout=timeout_s),
         load_state_dict=load,
         state_dict=save,
@@ -102,6 +104,69 @@ def make_manager(
             timeout=timeout_s, restore_sharding=restore_sharding
         ),
     )
+    manager.attach_drain_watcher()
+    return manager
+
+
+class TrainGate:
+    """Decides when an example train loop is done.
+
+    Three exits, in priority order:
+
+    - **drain** — a cooperative-departure notice arrived (the Manager's
+      DrainWatcher fired): finish the in-flight step and leave NOW; the
+      supervisor already pre-warmed a replacement.
+    - **merged final** (``require_merged`` > 0) — don't stop at the step
+      budget until a committed step at-or-after it ran with at least that
+      many participating groups.  This replaces the fixed-step-budget race
+      in the kill tests with a deterministic criterion: a survivor keeps
+      stepping (solo) until the healed replacement merges back, so both
+      groups provably finish the same merged step with identical state.
+    - **step budget** — plain ``current_step() >= steps`` otherwise, with
+      ``steps_cap`` as a runaway bound when the merged criterion can never
+      be met (e.g. the peer is gone for good).
+    """
+
+    def __init__(
+        self, manager: Any, steps: int, *, require_merged: int = 0, steps_cap: int = 0
+    ) -> None:
+        self._manager = manager
+        self._steps = steps
+        self._require_merged = require_merged
+        self._steps_cap = steps_cap
+        self._last_merged = 0
+
+    def should_continue(self) -> bool:
+        if self._manager.drain_requested():
+            return False
+        step = self._manager.current_step()
+        if self._steps_cap and step >= self._steps_cap:
+            return False
+        if step < self._steps:
+            return True
+        return self._require_merged > 0 and self._last_merged < self._require_merged
+
+    def note_commit(self, committed: bool) -> None:
+        """Record the last commit's participation (call once per step)."""
+        self._last_merged = self._manager.num_participants() if committed else 0
+
+    def drained(self) -> bool:
+        return self._manager.drain_requested()
+
+    def finish(self, replica_group: int) -> bool:
+        """Drain epilogue: completes a requested drain and prints the exit
+        marker.  Returns True when this was a drain exit (the caller skips
+        its FINAL print — the departing params are donor state, not the
+        run's converged result)."""
+        if not self.drained():
+            return False
+        self._manager.complete_drain()
+        print(
+            f"[group {replica_group}] DRAIN exit step="
+            f"{self._manager.current_step()}",
+            flush=True,
+        )
+        return True
 
 
 def params_digest(params: Any) -> str:

@@ -34,7 +34,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from _common import make_manager, params_digest, pin_platform_and_cache, replica_env
+from _common import (
+    TrainGate,
+    make_manager,
+    params_digest,
+    pin_platform_and_cache,
+    replica_env,
+)
 
 
 def main() -> None:
@@ -58,6 +64,16 @@ def main() -> None:
         help="durable checkpoint directory; empty disables disk checkpoints",
     )
     parser.add_argument("--ckpt_every", type=int, default=10)
+    parser.add_argument(
+        "--require-merged-final", type=int, default=0,
+        help="keep stepping past --steps until a committed step ran with "
+        "at least this many participating groups (deterministic merged "
+        "finish for the kill/heal tests)",
+    )
+    parser.add_argument(
+        "--steps-cap", type=int, default=0,
+        help="hard step bound when --require-merged-final can never be met",
+    )
     args = parser.parse_args()
 
     pin_platform_and_cache()
@@ -127,8 +143,12 @@ def main() -> None:
                 flush=True,
             )
 
+    gate = TrainGate(
+        manager, args.steps,
+        require_merged=args.require_merged_final, steps_cap=args.steps_cap,
+    )
     try:
-        while manager.current_step() < args.steps:
+        while gate.should_continue():
             state["opt"].step_begin()
             step = manager.current_step()
 
@@ -149,6 +169,7 @@ def main() -> None:
             loss, grads = grad_fn(state["opt"].params, x, y)
             grads = averager.allreduce(grads)
             committed = state["opt"].step(grads)
+            gate.note_commit(committed)
             if ckpt is not None:
                 ckpt.maybe_save(committed)
             print(
@@ -157,8 +178,9 @@ def main() -> None:
                 flush=True,
             )
 
-        print(f"[group {replica_group}] FINAL step={manager.current_step()} "
-              f"params_sha256={params_digest(state['opt'].params)}", flush=True)
+        if not gate.finish(replica_group):
+            print(f"[group {replica_group}] FINAL step={manager.current_step()} "
+                  f"params_sha256={params_digest(state['opt'].params)}", flush=True)
     finally:
         if ckpt is not None:
             ckpt.shutdown()

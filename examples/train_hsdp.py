@@ -28,7 +28,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from _common import make_manager, params_digest, pin_platform_and_cache, replica_env
+from _common import (
+    TrainGate,
+    make_manager,
+    params_digest,
+    pin_platform_and_cache,
+    replica_env,
+)
 
 
 def main() -> None:
@@ -47,6 +53,16 @@ def main() -> None:
         help="durable checkpoint directory; empty disables disk checkpoints",
     )
     parser.add_argument("--ckpt_every", type=int, default=20)
+    parser.add_argument(
+        "--require-merged-final", type=int, default=0,
+        help="keep stepping past --steps until a committed step ran with "
+        "at least this many participating groups (deterministic merged "
+        "finish for the kill/heal tests)",
+    )
+    parser.add_argument(
+        "--steps-cap", type=int, default=0,
+        help="hard step bound when --require-merged-final can never be met",
+    )
     args = parser.parse_args()
 
     pin_platform_and_cache(virtual_devices=args.devices)
@@ -137,8 +153,12 @@ def main() -> None:
         shuffle=True,
     )
 
+    gate = TrainGate(
+        manager, args.steps,
+        require_merged=args.require_merged_final, steps_cap=args.steps_cap,
+    )
     try:
-        while manager.current_step() < args.steps:
+        while gate.should_continue():
             state["opt"].step_begin()
             step = manager.current_step()
             # One sampler, re-seeded per step: a restarted group resumes the
@@ -155,6 +175,7 @@ def main() -> None:
             loss, grads = step_fn.grads(state["opt"].params, batch)
             grads = averager.allreduce(grads)
             committed = state["opt"].step(grads)
+            gate.note_commit(committed)
             if ckpt is not None:
                 ckpt.maybe_save(committed)
             print(
@@ -163,18 +184,20 @@ def main() -> None:
                 flush=True,
             )
 
-        shardings = {
-            path[-1].key if hasattr(path[-1], "key") else str(path[-1]): str(leaf.sharding.spec)
-            for path, leaf in jax.tree_util.tree_leaves_with_path(
-                state["opt"].params["layers"]
-            )[:2]
-        }
-        print(
-            f"[group {replica_group}] FINAL step={manager.current_step()} "
-            f"params_sha256={params_digest(state['opt'].params)} "
-            f"sample_shardings={shardings}",
-            flush=True,
-        )
+        if not gate.finish(replica_group):
+            shardings = {
+                path[-1].key if hasattr(path[-1], "key") else str(path[-1]):
+                    str(leaf.sharding.spec)
+                for path, leaf in jax.tree_util.tree_leaves_with_path(
+                    state["opt"].params["layers"]
+                )[:2]
+            }
+            print(
+                f"[group {replica_group}] FINAL step={manager.current_step()} "
+                f"params_sha256={params_digest(state['opt'].params)} "
+                f"sample_shardings={shardings}",
+                flush=True,
+            )
     finally:
         if ckpt is not None:
             ckpt.shutdown()

@@ -28,7 +28,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from _common import make_manager, params_digest, pin_platform_and_cache, replica_env
+from _common import (
+    TrainGate,
+    make_manager,
+    params_digest,
+    pin_platform_and_cache,
+    replica_env,
+)
 
 
 def main() -> None:
@@ -48,6 +54,16 @@ def main() -> None:
     parser.add_argument(
         "--devices", type=int, default=4,
         help="virtual devices forming this group's (data x sequence) mesh",
+    )
+    parser.add_argument(
+        "--require-merged-final", type=int, default=0,
+        help="keep stepping past --steps until a committed step ran with "
+        "at least this many participating groups (deterministic merged "
+        "finish for the kill/heal tests)",
+    )
+    parser.add_argument(
+        "--steps-cap", type=int, default=0,
+        help="hard step bound when --require-merged-final can never be met",
     )
     args = parser.parse_args()
 
@@ -124,8 +140,12 @@ def main() -> None:
         shuffle=True,
     )
 
+    gate = TrainGate(
+        manager, args.steps,
+        require_merged=args.require_merged_final, steps_cap=args.steps_cap,
+    )
     try:
-        while manager.current_step() < args.steps:
+        while gate.should_continue():
             state["opt"].step_begin()
             step = manager.current_step()
             sampler.set_epoch(step)
@@ -144,20 +164,24 @@ def main() -> None:
             loss, grads = step_fn.grads(state["opt"].params, batch)
             grads = averager.allreduce(grads)
             committed = state["opt"].step(grads)
+            gate.note_commit(committed)
             print(
                 f"[group {replica_group}] step={step} loss={float(loss):.4f} "
                 f"participants={manager.num_participants()} committed={committed}",
                 flush=True,
             )
 
-        sample = jax.tree_util.tree_leaves_with_path(state["opt"].params["layers"])[0]
-        print(
-            f"[group {replica_group}] FINAL step={manager.current_step()} "
-            f"params_sha256={params_digest(state['opt'].params)} "
-            f"ring_layout={args.layout} "
-            f"sample_sharding={sample[1].sharding.spec}",
-            flush=True,
-        )
+        if not gate.finish(replica_group):
+            sample = jax.tree_util.tree_leaves_with_path(
+                state["opt"].params["layers"]
+            )[0]
+            print(
+                f"[group {replica_group}] FINAL step={manager.current_step()} "
+                f"params_sha256={params_digest(state['opt'].params)} "
+                f"ring_layout={args.layout} "
+                f"sample_sharding={sample[1].sharding.spec}",
+                flush=True,
+            )
     finally:
         manager.shutdown()
 

@@ -69,6 +69,10 @@ int tf_lighthouse_evict(void* p, const char* prefix) {
   return static_cast<Lighthouse*>(p)->EvictReplica(prefix ? prefix : "");
 }
 
+int tf_lighthouse_drain(void* p, const char* prefix, int64_t deadline_ms) {
+  return static_cast<Lighthouse*>(p)->DrainReplica(prefix ? prefix : "", deadline_ms);
+}
+
 void tf_lighthouse_shutdown(void* p) { static_cast<Lighthouse*>(p)->Shutdown(); }
 
 void tf_lighthouse_free(void* p) { delete static_cast<Lighthouse*>(p); }

@@ -14,8 +14,16 @@ namespace tpuft {
 
 namespace {
 
+// Trims ASCII whitespace from both ends.
+std::string Trim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
 // Reads until "\r\n\r\n" plus Content-Length body. Very small requests only.
-bool ReadRequest(int fd, std::string* method, std::string* path, std::string* body) {
+bool ReadRequest(int fd, HttpRequestInfo* req) {
   std::string buf;
   char tmp[4096];
   size_t header_end = std::string::npos;
@@ -33,16 +41,24 @@ bool ReadRequest(int fd, std::string* method, std::string* path, std::string* bo
   auto sp1 = request_line.find(' ');
   auto sp2 = request_line.rfind(' ');
   if (sp1 == std::string::npos || sp2 == std::string::npos || sp2 <= sp1) return false;
-  *method = request_line.substr(0, sp1);
-  *path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+  req->method = request_line.substr(0, sp1);
+  req->path = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
 
   size_t content_length = 0;
-  std::string headers = buf.substr(0, header_end);
+  std::string raw_headers = buf.substr(0, header_end);
+  std::string headers = raw_headers;  // lowercased copy for name lookups
   for (char& c : headers) c = static_cast<char>(tolower(c));
   auto cl = headers.find("content-length:");
   if (cl != std::string::npos) {
     content_length = static_cast<size_t>(atoll(headers.c_str() + cl + 15));
     if (content_length > (1u << 20)) return false;
+  }
+  auto tok = headers.find("x-tpuft-token:");
+  if (tok != std::string::npos) {
+    auto eol = headers.find("\r\n", tok);
+    // Value sliced from the ORIGINAL bytes (same offsets): header NAMES
+    // are case-insensitive, but the shared secret's case must survive.
+    req->token = Trim(raw_headers.substr(tok + 14, eol - tok - 14));
   }
   std::string have = buf.substr(header_end + 4);
   while (have.size() < content_length) {
@@ -52,8 +68,27 @@ bool ReadRequest(int fd, std::string* method, std::string* path, std::string* bo
     if (r <= 0) return false;
     have.append(tmp, static_cast<size_t>(r));
   }
-  *body = have.substr(0, content_length);
+  req->body = have.substr(0, content_length);
   return true;
+}
+
+bool PeerIsLoopback(int fd) {
+  struct sockaddr_storage peer = {};
+  socklen_t plen = sizeof(peer);
+  if (getpeername(fd, reinterpret_cast<struct sockaddr*>(&peer), &plen) != 0) return false;
+  if (peer.ss_family == AF_INET) {
+    auto* a = reinterpret_cast<struct sockaddr_in*>(&peer);
+    return (ntohl(a->sin_addr.s_addr) >> 24) == 127;
+  }
+  if (peer.ss_family == AF_INET6) {
+    auto* a = reinterpret_cast<struct sockaddr_in6*>(&peer);
+    if (IN6_IS_ADDR_LOOPBACK(&a->sin6_addr)) return true;
+    if (IN6_IS_ADDR_V4MAPPED(&a->sin6_addr)) {
+      const uint8_t* b = a->sin6_addr.s6_addr;
+      return b[12] == 127;
+    }
+  }
+  return false;
 }
 
 void WriteResponse(int fd, const HttpResponse& resp) {
@@ -131,8 +166,22 @@ bool HttpServer::Start(std::string* err) {
   return true;
 }
 
+void HttpServer::ReapFinishedLocked(std::vector<FinishedConn>* out) {
+  out->insert(out->end(), finished_.begin(), finished_.end());
+  finished_.clear();
+}
+
 void HttpServer::AcceptLoop() {
   while (!shutdown_.load()) {
+    std::vector<FinishedConn> done;
+    {
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      ReapFinishedLocked(&done);
+    }
+    for (auto& [fd, th] : done) {
+      if (th->joinable()) th->join();
+      close(fd);
+    }
     struct pollfd pfd = {listen_fd_, POLLIN, 0};
     if (poll(&pfd, 1, 100) <= 0) continue;
     int cfd = accept(listen_fd_, nullptr, nullptr);
@@ -147,11 +196,12 @@ void HttpServer::AcceptLoop() {
 }
 
 void HttpServer::Serve(int fd) {
-  std::string method, path, body;
-  if (ReadRequest(fd, &method, &path, &body)) {
+  HttpRequestInfo req;
+  req.peer_loopback = PeerIsLoopback(fd);
+  if (ReadRequest(fd, &req)) {
     HttpResponse resp;
     try {
-      resp = handler_(method, path, body);
+      resp = handler_(req);
     } catch (const std::exception& e) {
       resp.code = 500;
       resp.body = e.what();
@@ -159,30 +209,43 @@ void HttpServer::Serve(int fd) {
     }
     WriteResponse(fd, resp);
   }
-  close(fd);
+  // See RpcServer::Serve: the reaper that joins this thread closes the
+  // fd afterwards — never the serving thread itself.
   std::lock_guard<std::mutex> lk(conns_mu_);
+  if (shutdown_.load()) return;
   auto it = conns_.find(fd);
   if (it != conns_.end()) {
-    it->second->detach();
+    finished_.emplace_back(fd, it->second);
     conns_.erase(it);
   }
 }
 
 void HttpServer::Shutdown() {
-  if (shutdown_.exchange(true)) return;
+  {
+    std::lock_guard<std::mutex> lk(conns_mu_);
+    if (shutdown_.exchange(true)) return;
+  }
   if (listen_fd_ >= 0) {
     ::shutdown(listen_fd_, SHUT_RDWR);
     close(listen_fd_);
   }
   if (accept_thread_.joinable()) accept_thread_.join();
   std::map<int, std::shared_ptr<std::thread>> conns;
+  std::vector<FinishedConn> done;
   {
     std::lock_guard<std::mutex> lk(conns_mu_);
     conns.swap(conns_);
+    ReapFinishedLocked(&done);
   }
   for (auto& [fd, th] : conns) ::shutdown(fd, SHUT_RDWR);
-  for (auto& [fd, th] : conns)
+  for (auto& [fd, th] : conns) {
     if (th->joinable()) th->join();
+    close(fd);
+  }
+  for (auto& [fd, th] : done) {
+    if (th->joinable()) th->join();
+    close(fd);
+  }
 }
 
 }  // namespace tpuft

@@ -9,6 +9,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <vector>
 
 namespace tpuft {
 
@@ -18,9 +19,20 @@ struct HttpResponse {
   std::string body;
 };
 
-// (method, path, body) -> response.
-using HttpHandler = std::function<HttpResponse(const std::string& method, const std::string& path,
-                                               const std::string& body)>;
+// One parsed request plus the connection facts the ops-endpoint trust
+// model needs (docs/wire.md "Trust model"): the shared-secret header and
+// whether the peer is loopback.
+struct HttpRequestInfo {
+  std::string method;
+  std::string path;
+  std::string body;
+  // Value of the "x-tpuft-token" header, empty when absent.
+  std::string token;
+  // True when the TCP peer is 127.0.0.0/8, ::1, or a v4-mapped loopback.
+  bool peer_loopback = false;
+};
+
+using HttpHandler = std::function<HttpResponse(const HttpRequestInfo& req)>;
 
 class HttpServer {
  public:
@@ -33,6 +45,8 @@ class HttpServer {
  private:
   void AcceptLoop();
   void Serve(int fd);
+  using FinishedConn = std::pair<int, std::shared_ptr<std::thread>>;
+  void ReapFinishedLocked(std::vector<FinishedConn>* out);
 
   std::string bind_;
   HttpHandler handler_;
@@ -42,6 +56,10 @@ class HttpServer {
   std::thread accept_thread_;
   std::mutex conns_mu_;
   std::map<int, std::shared_ptr<std::thread>> conns_;
+  // Finished connection threads awaiting join-then-close (see
+  // RpcServer::finished_: detaching raced static destruction at process
+  // exit, and closing before the join raced fd-number reuse).
+  std::vector<FinishedConn> finished_;
 };
 
 }  // namespace tpuft

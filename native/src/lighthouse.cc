@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <set>
 #include <sstream>
 
@@ -20,6 +21,9 @@ int64_t NowEpochMs() {
 // ---------------------------------------------------------------------------
 // Pure quorum math.  Reference parity: quorum_compute, src/lighthouse.rs:133-261.
 // Semantics (in evaluation order):
+//   0. draining replicas (cooperative departure announced) are invisible:
+//      neither candidates nor counted healthy — the quorum forms without
+//      them instantly instead of waiting out join/heartbeat timeouts;
 //   1. only replicas with a fresh heartbeat are candidates;
 //   2. if any candidate requests shrink_only, membership may not grow beyond
 //      the previous quorum;
@@ -37,6 +41,7 @@ std::optional<std::vector<QuorumMember>> QuorumCompute(TimePoint now, const Quor
 
   std::set<std::string> healthy;
   for (const auto& [id, last] : state.heartbeats) {
+    if (state.draining.count(id)) continue;
     if (now - last < hb_timeout) healthy.insert(id);
   }
 
@@ -131,7 +136,13 @@ Lighthouse::Lighthouse(LighthouseOpt opt) : opt_(std::move(opt)) {}
 
 Lighthouse::~Lighthouse() { Shutdown(); }
 
+bool Lighthouse::AdminAllowed(const std::string& token, bool peer_loopback) const {
+  if (!admin_token_.empty()) return token == admin_token_;
+  return peer_loopback;
+}
+
 bool Lighthouse::Start(std::string* err) {
+  if (const char* tok = std::getenv("TPUFT_ADMIN_TOKEN")) admin_token_ = tok;
   server_ = std::make_unique<RpcServer>(
       opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl, std::string* resp) {
         return Dispatch(method, req, dl, resp);
@@ -140,8 +151,24 @@ bool Lighthouse::Start(std::string* err) {
   if (!opt_.http_bind.empty()) {
     http_ = std::make_unique<HttpServer>(
         opt_.http_bind,
-        [this](const std::string& method, const std::string& path, const std::string&) {
+        [this](const HttpRequestInfo& req) {
+          const std::string& method = req.method;
+          const std::string& path = req.path;
           HttpResponse r;
+          bool is_mutation = method == "POST" && path.rfind("/replica/", 0) == 0;
+          if (is_mutation && !AdminAllowed(req.token, req.peer_loopback)) {
+            // Ops endpoints mutate cluster membership; see docs/wire.md
+            // "Trust model" — remote callers must present the shared
+            // secret when one is configured, and are refused outright
+            // otherwise.
+            r.code = 403;
+            r.body = admin_token_.empty()
+                         ? "forbidden: mutating endpoints are loopback-only "
+                           "(set TPUFT_ADMIN_TOKEN to allow remote ops calls)"
+                         : "forbidden: missing or wrong x-tpuft-token header";
+            r.content_type = "text/plain";
+            return r;
+          }
           if (method == "GET" && (path == "/" || path == "/status")) {
             r.body = StatusHtml();
           } else if (method == "GET" && path == "/status.json") {
@@ -164,6 +191,12 @@ bool Lighthouse::Start(std::string* err) {
             std::string prefix = path.substr(9, path.size() - 9 - 6);
             int n = EvictReplica(prefix);
             r.body = "evicted " + std::to_string(n) + " id(s) for " + prefix;
+            r.content_type = "text/plain";
+          } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
+                     path.size() > 15 && path.substr(path.size() - 6) == "/drain") {
+            std::string prefix = path.substr(9, path.size() - 9 - 6);
+            int n = DrainReplica(prefix, 0);
+            r.body = "draining " + std::to_string(n) + " id(s) for " + prefix;
             r.content_type = "text/plain";
           } else {
             r.code = 404;
@@ -233,6 +266,14 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
       r.SerializeToString(resp);
       return Status::kOk;
     }
+    case kLighthouseDrain: {
+      LighthouseDrainRequest q;
+      if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      LighthouseDrainResponse r;
+      r.set_drained(DrainReplica(q.replica_prefix(), q.deadline_ms()));
+      r.SerializeToString(resp);
+      return Status::kOk;
+    }
     default:
       *resp = "unknown lighthouse method " + std::to_string(method);
       return Status::kUnknown;
@@ -263,6 +304,14 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     *err = "replica " + id + " was evicted by its supervisor";
     return Status::kAborted;
   }
+  if (state_.draining.count(id)) {
+    // The incarnation announced a cooperative departure: it finishes its
+    // in-flight step and exits — it must not start a NEW round.  (The
+    // drain controller stops the train loop before the next quorum; this
+    // guards the race where the notice lands mid-call.)
+    *err = "replica " + id + " is draining; rejoin as a new incarnation";
+    return Status::kAborted;
+  }
   // Joining is an implicit heartbeat (reference: src/lighthouse.rs:480-491).
   state_.heartbeats[id] = Clock::now();
   state_.participants[id] = QuorumState::Joined{req.requester(), Clock::now()};
@@ -280,6 +329,13 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
       // re-register below would resurrect a corpse the supervisor already
       // replaced with a fresh incarnation).
       *err = "replica " + id + " was evicted by its supervisor";
+      return Status::kAborted;
+    }
+    if (state_.draining.count(id)) {
+      // Drain notice landed while this join was blocked: the quorum it is
+      // waiting for will exclude it forever — unblock the caller so the
+      // departing process can proceed to its drain exit.
+      *err = "replica " + id + " is draining; rejoin as a new incarnation";
       return Status::kAborted;
     }
     if (latest_quorum_ && quorum_gen_ > start_gen) {
@@ -303,7 +359,8 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     }
     int64_t gen = quorum_gen_;
     bool woke = quorum_cv_.wait_until(lk, deadline.at, [&] {
-      return quorum_gen_ != gen || shutdown_ || evicted_.count(id) > 0;
+      return quorum_gen_ != gen || shutdown_ || evicted_.count(id) > 0 ||
+             state_.draining.count(id) > 0;
     });
     if (shutdown_) {
       *err = "lighthouse shutting down";
@@ -333,6 +390,8 @@ void Lighthouse::TickLocked() {
   auto tick_now = Clock::now();
   auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
   for (const auto& [id, last] : state_.heartbeats) {
+    if (state_.draining.count(id)) continue;  // a drained donor's clean
+    // exit makes its heartbeat stale by design — not a death to announce.
     bool fresh = tick_now - last < hb_timeout;
     auto it = last_fresh_.find(id);
     if (it == last_fresh_.end()) {
@@ -371,6 +430,24 @@ void Lighthouse::TickLocked() {
   for (auto it = evicted_.begin(); it != evicted_.end();) {
     if (tick_now - it->second > hb_timeout * 10) {
       it = evicted_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  // Drain marks age out on the same horizon — but never before the
+  // ANNOUNCED deadline passes: a 5-minute Kubernetes grace period must
+  // keep the donor excluded for all 5 minutes (it may legitimately keep
+  // heartbeating while it serves a long final checkpoint), while
+  // replacement incarnations carry fresh uuids so exact-id marks cannot
+  // block a legitimate member either way.
+  for (auto it = state_.draining.begin(); it != state_.draining.end();) {
+    bool horizon_passed = tick_now - it->second > hb_timeout * 10;
+    auto dl = drain_deadline_ms_.find(it->first);
+    bool deadline_passed =
+        dl == drain_deadline_ms_.end() || NowEpochMs() > dl->second;
+    if (horizon_passed && deadline_passed) {
+      drain_deadline_ms_.erase(it->first);
+      it = state_.draining.erase(it);
     } else {
       ++it;
     }
@@ -449,6 +526,7 @@ void Lighthouse::FillStatus(LighthouseStatusResponse* resp) {
         std::chrono::duration_cast<std::chrono::milliseconds>(now - last).count();
   }
   resp->set_quorum_id(state_.quorum_id);
+  for (const auto& [id, _] : state_.draining) resp->add_draining(id);
 }
 
 int Lighthouse::EvictReplica(const std::string& prefix) {
@@ -499,6 +577,51 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
     TickLocked();  // a waiting quorum can now form without the straggler wait
   }
   return dropped;
+}
+
+int Lighthouse::DrainReplica(const std::string& prefix, int64_t deadline_ms) {
+  // Unlike EvictReplica, the heartbeat entries stay: the departing process
+  // is ALIVE and finishing its step — the dashboard should keep showing it
+  // (as draining) until it actually exits.  Exclusion from quorum comes
+  // from QuorumCompute skipping draining ids entirely.  Ids are collected
+  // from everything the lighthouse currently knows: heartbeats, pending
+  // joins, and the previous quorum's membership (a member between rounds
+  // has neither a heartbeat-map-only presence nor a pending join).
+  std::lock_guard<std::mutex> lk(mu_);
+  auto matches = [&](const std::string& id) {
+    return id == prefix || id.rfind(prefix + ":", 0) == 0;
+  };
+  std::set<std::string> ids;
+  for (const auto& [id, _] : state_.heartbeats) {
+    if (matches(id)) ids.insert(id);
+  }
+  for (const auto& [id, _] : state_.participants) {
+    if (matches(id)) ids.insert(id);
+  }
+  if (state_.prev_quorum) {
+    for (const auto& m : state_.prev_quorum->participants()) {
+      if (matches(m.replica_id())) ids.insert(m.replica_id());
+    }
+  }
+  auto now = Clock::now();
+  int marked = 0;
+  for (const auto& id : ids) {
+    if (state_.draining.emplace(id, now).second) ++marked;
+    if (deadline_ms > 0) drain_deadline_ms_[id] = NowEpochMs() + deadline_ms;
+  }
+  // Wake blocked joins: a draining id's own pending handler must abort
+  // (it will never be included again), and waiting survivors can form
+  // their next quorum without the straggler wait right now.
+  quorum_cv_.notify_all();
+  if (marked > 0) {
+    LOGI("lighthouse: draining %d replica id(s) matching '%s' (cooperative "
+         "departure%s)", marked, prefix.c_str(),
+         deadline_ms > 0
+             ? (", deadline " + std::to_string(deadline_ms) + " ms").c_str()
+             : "");
+    TickLocked();
+  }
+  return marked;
 }
 
 bool Lighthouse::KillReplica(const std::string& replica_id, std::string* err) {
@@ -571,7 +694,14 @@ std::string Lighthouse::StatusJson() {
     first = false;
     o << "\"" << JsonEscape(id) << "\":" << age;
   }
-  o << "}}";
+  o << "},\"draining\":[";
+  first = true;
+  for (const auto& id : s.draining()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\"";
+  }
+  o << "]}";
   return o.str();
 }
 
@@ -587,22 +717,28 @@ std::string Lighthouse::StatusHtml() {
        ".card{border:1px solid #444;border-radius:6px;padding:1em;margin:.5em;display:inline-block;"
        "min-width:18em;vertical-align:top}"
        ".recovering{border-color:orange}.stale{color:#f66}"
+       ".draining{border-color:#6af}"
        "button{background:#a33;color:#fff;border:0;padding:.3em .8em;border-radius:4px;"
        "cursor:pointer}</style></head><body>"
        "<h1>tpu-ft lighthouse</h1>";
   o << "<p>quorum_id: " << s.quorum_id() << " &mdash; " << s.prev_quorum().participants_size()
     << " participants, " << s.pending_participants_size() << " pending</p>";
+  std::set<std::string> draining(s.draining().begin(), s.draining().end());
   for (const auto& m : s.prev_quorum().participants()) {
     bool recovering = m.step() != max_step;
+    bool is_draining = draining.count(m.replica_id()) > 0;
     int64_t age = -1;
     auto it = s.heartbeat_age_ms().find(m.replica_id());
     if (it != s.heartbeat_age_ms().end()) age = it->second;
-    o << "<div class=\"card" << (recovering ? " recovering" : "") << "\"><b>" << m.replica_id()
-      << "</b><br>step: " << m.step() << (recovering ? " (recovering)" : "")
+    o << "<div class=\"card" << (is_draining ? " draining" : recovering ? " recovering" : "")
+      << "\"><b>" << m.replica_id() << "</b><br>step: " << m.step()
+      << (is_draining ? " (draining)" : recovering ? " (recovering)" : "")
       << "<br>world_size: " << m.world_size() << "<br>manager: " << m.address()
       << "<br><span class=\"" << (age > 2500 ? "stale" : "") << "\">heartbeat: " << age
       << " ms ago</span><br><form method=\"post\" action=\"/replica/" << m.replica_id()
-      << "/kill\"><button>Kill</button></form></div>";
+      << "/kill\"><button>Kill</button></form>"
+      << "<form method=\"post\" action=\"/replica/" << m.replica_id()
+      << "/drain\"><button style=\"background:#36a\">Drain</button></form></div>";
   }
   o << "</body></html>";
   return o.str();

@@ -50,6 +50,11 @@ struct QuorumState {
   std::map<std::string, Joined> participants;
   // Last heartbeat seen per replica id (includes non-participants).
   std::map<std::string, TimePoint> heartbeats;
+  // Replica ids departing cooperatively (drain notice received): excluded
+  // from candidates AND from the healthy-set arithmetic (majority guard,
+  // straggler wait), so the next quorum forms without them immediately.
+  // Value: when the drain was announced (for pruning/status).
+  std::map<std::string, TimePoint> draining;
   std::optional<Quorum> prev_quorum;
   int64_t quorum_id = 0;
 };
@@ -84,12 +89,29 @@ class Lighthouse {
   // "<group>:" uuid-suffixed family.  Returns how many ids were dropped.
   int EvictReplica(const std::string& prefix);
 
+  // Cooperative drain: a PLANNED departure announced before the process is
+  // gone (maintenance events, preemption notices, SIGTERM grace periods).
+  // Marks every id matching `prefix` (full id or "<group>:" family) as
+  // draining: excluded from the NEXT quorum round immediately — no
+  // join-timeout straggler wait, no heartbeat-timeout wait — and
+  // tombstoned against late re-joins, while the id's in-flight step and
+  // blocked handlers are left alone (unlike EvictReplica, which declares
+  // the process already dead and aborts them).  The replacement
+  // incarnation has a fresh ":<uuid>" suffix and joins normally.
+  // `deadline_ms` is advisory (recorded for observability).  Returns how
+  // many ids were marked.
+  int DrainReplica(const std::string& prefix, int64_t deadline_ms);
+
   // Asks the replica's manager to exit. Used by the dashboard kill button.
   // Reference parity: src/lighthouse.rs:433-458.
   bool KillReplica(const std::string& replica_id, std::string* err);
 
  private:
   Status Dispatch(uint16_t method, const std::string& req, Deadline deadline, std::string* resp);
+  // True when an ops-endpoint request may mutate state (docs/wire.md
+  // "Trust model"): the shared-secret header matches TPUFT_ADMIN_TOKEN, or
+  // no token is configured and the peer is loopback.
+  bool AdminAllowed(const std::string& token, bool peer_loopback) const;
   void TickLoop();
   // Runs one quorum attempt; on success installs + broadcasts it.
   // Caller must hold mu_.
@@ -125,6 +147,13 @@ class Lighthouse {
   // heartbeat graveyard) — fresh incarnations carry new uuids, so exact-id
   // tombstones cannot block a legitimate rejoin.
   std::map<std::string, TimePoint> evicted_;
+  // Announced drain deadlines (id -> epoch ms when the process will be
+  // forcibly gone): a drain mark is never pruned before its deadline
+  // passes, so a long grace period keeps its exclusion for the duration.
+  std::map<std::string, int64_t> drain_deadline_ms_;
+  // Shared secret for the mutating HTTP ops endpoints, from
+  // TPUFT_ADMIN_TOKEN at Start; empty = loopback-only access.
+  std::string admin_token_;
 
   std::thread tick_thread_;
   bool shutdown_ = false;

@@ -241,8 +241,25 @@ bool RpcServer::Start(std::string* err) {
   return true;
 }
 
+void RpcServer::ReapFinishedLocked(std::vector<FinishedConn>* out) {
+  out->insert(out->end(), finished_.begin(), finished_.end());
+  finished_.clear();
+}
+
 void RpcServer::AcceptLoop() {
   while (!shutdown_.load()) {
+    // Join connection threads that finished on their own so the set of
+    // unjoined threads stays bounded by the live connections.  The fd is
+    // closed only AFTER the join — the thread is the fd's user.
+    std::vector<FinishedConn> done;
+    {
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      ReapFinishedLocked(&done);
+    }
+    for (auto& [fd, th] : done) {
+      if (th->joinable()) th->join();
+      close(fd);
+    }
     struct pollfd pfd = {listen_fd_, POLLIN, 0};
     int pr = poll(&pfd, 1, 100);
     if (pr <= 0) continue;
@@ -282,32 +299,52 @@ void RpcServer::Serve(int fd) {
     }
     if (!WriteFrame(fd, h.method, st, h.req_id, 0, resp)) break;
   }
-  close(fd);
+  // The serving thread does NOT close its fd: the reaper that joins this
+  // thread (accept loop or Shutdown) closes it afterwards, so no fd
+  // number can be recycled while another thread still holds it for a
+  // ::shutdown().  Handing the handle over (instead of detaching) is
+  // what makes process exit race-free: a detached thread still running
+  // this epilogue during static destruction is a crash.  Under shutdown
+  // the entry stays in conns_ — Shutdown's snapshot joins and closes it.
   std::lock_guard<std::mutex> lk(conns_mu_);
+  if (shutdown_.load()) return;
   auto it = conns_.find(fd);
   if (it != conns_.end()) {
-    it->second->detach();
+    finished_.emplace_back(fd, it->second);
     conns_.erase(it);
   }
 }
 
 void RpcServer::Shutdown() {
-  if (shutdown_.exchange(true)) return;
+  {
+    // The flag flip and the map snapshot are one atomic step relative to
+    // Serve's epilogue, so every connection thread ends up in exactly one
+    // of {conns snapshot, finished_} and gets joined + closed once.
+    std::lock_guard<std::mutex> lk(conns_mu_);
+    if (shutdown_.exchange(true)) return;
+  }
   if (listen_fd_ >= 0) {
     ::shutdown(listen_fd_, SHUT_RDWR);
     close(listen_fd_);
   }
   if (accept_thread_.joinable()) accept_thread_.join();
   std::map<int, std::shared_ptr<std::thread>> conns;
+  std::vector<FinishedConn> done;
   {
     std::lock_guard<std::mutex> lk(conns_mu_);
     conns.swap(conns_);
+    ReapFinishedLocked(&done);
   }
   for (auto& [fd, th] : conns) {
-    ::shutdown(fd, SHUT_RDWR);
+    ::shutdown(fd, SHUT_RDWR);  // wakes the thread; fd is still open
   }
   for (auto& [fd, th] : conns) {
     if (th->joinable()) th->join();
+    close(fd);
+  }
+  for (auto& [fd, th] : done) {
+    if (th->joinable()) th->join();
+    close(fd);
   }
 }
 

@@ -70,6 +70,7 @@ enum Method : uint16_t {
   kLighthouseHeartbeat = 2,
   kLighthouseStatus = 3,
   kLighthouseEvict = 4,
+  kLighthouseDrain = 5,
   kManagerQuorum = 10,
   kManagerCheckpointMetadata = 11,
   kManagerShouldCommit = 12,
@@ -132,6 +133,9 @@ class RpcServer {
   void AcceptLoop();
   void Serve(int fd);
 
+  using FinishedConn = std::pair<int, std::shared_ptr<std::thread>>;
+  void ReapFinishedLocked(std::vector<FinishedConn>* out);
+
   std::string bind_;
   RpcHandler handler_;
   int listen_fd_ = -1;
@@ -141,6 +145,14 @@ class RpcServer {
   std::thread accept_thread_;
   std::mutex conns_mu_;
   std::map<int, std::shared_ptr<std::thread>> conns_;
+  // Connection threads that finished serving move their own handle (and
+  // fd) here — a thread cannot join itself; the accept loop and Shutdown
+  // join them and only THEN close the fd, so no fd is ever closed while
+  // another thread could still ::shutdown() it (a closed number can be
+  // reused by an unrelated descriptor).  Detaching instead raced process
+  // exit: a detached thread's epilogue during static destruction aborted
+  // ~1/30 runs.
+  std::vector<FinishedConn> finished_;
 };
 
 // ---------------------------------------------------------------------------

@@ -13,6 +13,7 @@
 #include <cassert>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <random>
 #include <set>
 #include <string>
@@ -842,6 +843,244 @@ void TestEvictSkipsStragglerWait() {
   lh.Shutdown();
 }
 
+// --- Cooperative drain -------------------------------------------------------
+// A draining replica (planned departure announced) is invisible to quorum
+// math: not a candidate AND not counted healthy, so the next round forms
+// without any join-timeout or heartbeat-timeout wait while the departing
+// process finishes its in-flight step undisturbed.
+void TestQuorumComputeDraining() {
+  LighthouseOpt opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 60000;  // the straggler wait drain must bypass
+  QuorumState s;
+  auto now = Clock::now();
+  Quorum prev;
+  prev.set_quorum_id(1);
+  *prev.add_participants() = MakeMember("a", 5);
+  *prev.add_participants() = MakeMember("b", 5);
+  s.prev_quorum = prev;
+  // Survivor a re-joins; b is draining with a FRESH heartbeat and a
+  // pending join from the round the notice interrupted.
+  Join(&s, MakeMember("a", 6), now);
+  Join(&s, MakeMember("b", 6), now);
+  s.draining["b"] = now;
+  std::string reason;
+  auto q = QuorumCompute(now, s, opt, &reason);
+  // Without the draining mark this would block on the straggler wait
+  // (b healthy, both joined -> fast quorum would need b... here b joined,
+  // so contrast: mark makes b invisible even though it joined).
+  CHECK(q.has_value());
+  CHECK(q->size() == 1);
+  CHECK((*q)[0].replica_id() == "a");
+
+  // And when b has NOT re-joined (the common case: its train loop exited):
+  s.participants.erase("b");
+  q = QuorumCompute(now, s, opt, &reason);
+  CHECK(q.has_value());
+  CHECK(q->size() == 1);
+
+  // Split-brain arithmetic ignores draining ids too: one survivor out of
+  // two heartbeating ids would otherwise NOT be a strict majority.
+  QuorumState s2;
+  Join(&s2, MakeMember("x", 3), now);
+  s2.heartbeats["y"] = now;  // healthy, never joined
+  s2.draining["y"] = now;
+  q = QuorumCompute(now, s2, opt, &reason);
+  CHECK(q.has_value());
+  CHECK(q->size() == 1);
+  CHECK((*q)[0].replica_id() == "x");
+}
+
+// End-to-end drain through the server: the draining family is excluded
+// from the next quorum immediately, its own late join is aborted, and the
+// replacement incarnation (fresh uuid) is admitted normally.
+void TestDrainCooperativeHandoff() {
+  LighthouseOpt opt;
+  opt.bind = "127.0.0.1:0";
+  opt.http_bind = "";
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 100;
+  opt.quorum_tick_ms = 10;
+  opt.heartbeat_timeout_ms = 5000;  // the wait drain must beat
+  Lighthouse lh(opt);
+  std::string err;
+  CHECK(lh.Start(&err));
+
+  auto join = [&](const std::string& id, int64_t step, LighthouseQuorumResponse* out) {
+    RpcClient c(lh.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = MakeMember(id, step);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    CHECK(c.Call(kLighthouseQuorum, payload, 20000, &resp, &cerr) == Status::kOk);
+    CHECK(out->ParseFromString(resp));
+  };
+
+  // Round 1: the departing group alone.
+  LighthouseQuorumResponse q1;
+  join("1:dddd", 7, &q1);
+  CHECK(q1.quorum().participants_size() == 1);
+
+  // Drain notice over the wire (method 5) — what the departing Manager
+  // sends the moment its DrainWatcher fires.
+  {
+    RpcClient c(lh.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    LighthouseDrainRequest req;
+    req.set_replica_prefix("1:dddd");
+    req.set_deadline_ms(30000);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    CHECK(c.Call(kLighthouseDrain, payload, 2000, &resp, &cerr) == Status::kOk);
+    LighthouseDrainResponse out;
+    CHECK(out.ParseFromString(resp));
+    CHECK(out.drained() == 1);
+    // Idempotent: already marked.
+    CHECK(c.Call(kLighthouseDrain, payload, 2000, &resp, &cerr) == Status::kOk);
+    CHECK(out.ParseFromString(resp));
+    CHECK(out.drained() == 0);
+  }
+
+  // A survivor's next quorum forms in tick time, NOT after the 5 s
+  // heartbeat staleness wait the drainer's fresh heartbeat would force.
+  auto t0 = Clock::now();
+  LighthouseQuorumResponse q2;
+  join("0:eeee", 8, &q2);
+  auto elapsed =
+      std::chrono::duration_cast<std::chrono::milliseconds>(Clock::now() - t0).count();
+  CHECK(q2.quorum().participants_size() == 1);
+  CHECK(q2.quorum().participants(0).replica_id() == "0:eeee");
+  CHECK(elapsed < 2000);
+
+  // The draining incarnation itself must not start a new round.
+  {
+    RpcClient c(lh.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    LighthouseQuorumRequest req;
+    *req.mutable_requester() = MakeMember("1:dddd", 8);
+    std::string payload, resp;
+    req.SerializeToString(&payload);
+    CHECK(c.Call(kLighthouseQuorum, payload, 5000, &resp, &cerr) == Status::kAborted);
+    // Unlike eviction, its heartbeat stays accepted while it finishes the
+    // in-flight step (the dashboard keeps showing it as draining).
+    LighthouseHeartbeatRequest hb;
+    hb.set_replica_id("1:dddd");
+    hb.SerializeToString(&payload);
+    CHECK(c.Call(kLighthouseHeartbeat, payload, 2000, &resp, &cerr) == Status::kOk);
+  }
+
+  // Status surfaces the drain.
+  {
+    RpcClient c(lh.address());
+    std::string cerr;
+    CHECK(c.Connect(2000, &cerr) == Status::kOk);
+    std::string resp;
+    CHECK(c.Call(kLighthouseStatus, "", 2000, &resp, &cerr) == Status::kOk);
+    LighthouseStatusResponse st;
+    CHECK(st.ParseFromString(resp));
+    CHECK(st.draining_size() == 1);
+    CHECK(st.draining(0) == "1:dddd");
+  }
+
+  // The replacement incarnation (same group prefix, fresh uuid) joins the
+  // survivor normally — exact-id drain marks cannot block it.  The
+  // replacement's join is registered FIRST (it alone is held by the
+  // split-brain guard: 1 of 2 healthy), so the survivor's re-join
+  // deterministically completes the round with both members.
+  LighthouseQuorumResponse q3;
+  std::thread replacement([&] { join("1:ffff", 0, &q3); });
+  for (int i = 0; i < 200; ++i) {
+    LighthouseStatusResponse st;
+    lh.FillStatus(&st);
+    bool pending = false;
+    for (const auto& m : st.pending_participants())
+      if (m.replica_id() == "1:ffff") pending = true;
+    if (pending) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  LighthouseQuorumResponse q4;
+  join("0:eeee", 9, &q4);
+  replacement.join();
+  CHECK(q3.quorum().participants_size() == 2);
+  CHECK(q4.quorum().participants_size() == 2);
+
+  // Family-prefix drain ("1" matches "1:ffff") for the supervisor-side
+  // fallback path.
+  CHECK(lh.DrainReplica("1", 0) == 1);
+
+  lh.Shutdown();
+}
+
+// --- HTTP ops-endpoint trust model -------------------------------------------
+// Mutating endpoints (kill/evict/drain) honor the shared-secret header;
+// without a configured token they are loopback-only (docs/wire.md).
+std::string HttpPost(const std::string& http_addr, const std::string& path,
+                     const std::string& token) {
+  // http_addr is "http://host:port".
+  std::string hostport = http_addr.substr(7);
+  std::string err;
+  int fd = DialTcp(hostport, 2000, &err);
+  CHECK(fd >= 0);
+  // Mixed-case header NAME on purpose: names are case-insensitive (RFC
+  // 9110) and clients capitalize them; the VALUE's case must be preserved.
+  std::string req = "POST " + path + " HTTP/1.1\r\nHost: x\r\n" +
+                    (token.empty() ? "" : "X-Tpuft-Token: " + token + "\r\n") +
+                    "Content-Length: 0\r\n\r\n";
+  CHECK(send(fd, req.data(), req.size(), 0) == static_cast<ssize_t>(req.size()));
+  std::string out;
+  char buf[4096];
+  for (;;) {
+    ssize_t r = recv(fd, buf, sizeof(buf), 0);
+    if (r <= 0) break;
+    out.append(buf, static_cast<size_t>(r));
+  }
+  close(fd);
+  return out;
+}
+
+void TestHttpAdminGate() {
+  // Token configured (mixed case: the value's case must survive header
+  // parsing): remote AND loopback callers must present it.
+  setenv("TPUFT_ADMIN_TOKEN", "SeKr1t", 1);
+  {
+    LighthouseOpt opt;
+    opt.bind = "127.0.0.1:0";
+    opt.http_bind = "127.0.0.1:0";
+    opt.min_replicas = 1;
+    Lighthouse lh(opt);
+    std::string err;
+    CHECK(lh.Start(&err));
+    std::string denied = HttpPost(lh.http_address(), "/replica/1/evict", "");
+    CHECK(denied.find("403") != std::string::npos);
+    std::string wrong = HttpPost(lh.http_address(), "/replica/1/evict", "sekr1t");
+    CHECK(wrong.find("403") != std::string::npos);
+    std::string ok = HttpPost(lh.http_address(), "/replica/1/evict", "SeKr1t");
+    CHECK(ok.find("200") != std::string::npos);
+    std::string drain = HttpPost(lh.http_address(), "/replica/1/drain", "SeKr1t");
+    CHECK(drain.find("200") != std::string::npos);
+    lh.Shutdown();
+  }
+  unsetenv("TPUFT_ADMIN_TOKEN");
+  // No token: loopback callers pass (the dashboard's own buttons), and
+  // the evict/drain endpoints answer 200.
+  {
+    LighthouseOpt opt;
+    opt.bind = "127.0.0.1:0";
+    opt.http_bind = "127.0.0.1:0";
+    opt.min_replicas = 1;
+    Lighthouse lh(opt);
+    std::string err;
+    CHECK(lh.Start(&err));
+    std::string ok = HttpPost(lh.http_address(), "/replica/1/drain", "");
+    CHECK(ok.find("200") != std::string::npos);
+    lh.Shutdown();
+  }
+}
+
 // --- QuorumCompute property fuzz ---------------------------------------------
 // Randomized join/leave/heartbeat/round sequences; the invariants the
 // reference effectively specs with ~590 test lines (src/lighthouse.rs:606-1038):
@@ -945,6 +1184,9 @@ int main() {
   TestWireVersionMismatch();
   TestJoinDuringShrink();
   TestEvictSkipsStragglerWait();
+  TestQuorumComputeDraining();
+  TestDrainCooperativeHandoff();
+  TestHttpAdminGate();
   TestQuorumComputeFuzz();
   printf("all native tests passed\n");
   return 0;

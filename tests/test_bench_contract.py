@@ -164,6 +164,68 @@ def test_scenario_stats_accounting(tmp_path) -> None:
     assert stats2["victim_ft_resume_s"] is None
 
 
+def test_scenario_stats_drain_accounting(tmp_path) -> None:
+    """Drain trials use incarnation-aware accounting: the donor keeps
+    committing AFTER the notice (that is the point of a drain), so the
+    handoff cost is the donor-to-replacement commit gap — which may be
+    negative when the pre-warmed replacement overlapped the donor's tail —
+    and survivor commit failures after the notice are surfaced."""
+    import json as _json
+    import sys
+
+    sys.path.insert(0, REPO)
+    from bench import _scenario_stats
+
+    def write(path, events):
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(_json.dumps(ev) + "\n")
+
+    # Survivor commits 1..40.  Donor (1:A) receives the notice at 10.5 but
+    # COMMITS THROUGH 13 (finishing its in-flight steps); replacement 1:B
+    # first commits at 15, i.e. a 2 s handoff gap charged minus the 1 s
+    # median step.  One survivor failed commit BEFORE the notice must not
+    # count against the drain.
+    events = [
+        {"ts": 5.5, "replica_id": "0:a", "event": "commit", "committed": False},
+    ]
+    for t in range(1, 41):
+        events.append({"ts": float(t), "replica_id": "0:a", "event": "commit", "committed": True})
+    for t in range(1, 14):
+        events.append({"ts": float(t), "replica_id": "1:A", "event": "commit", "committed": True})
+    for t in range(15, 41):
+        events.append({"ts": float(t), "replica_id": "1:B", "event": "commit", "committed": True})
+    path = tmp_path / "metrics.jsonl"
+    write(path, events)
+
+    plan = {"type": "drain", "victim": 1}
+    stats = _scenario_stats(str(tmp_path), str(path), [(10.5, "1")], plan)
+    assert abs(stats["drain_handoff_gap_s"] - 2.0) < 1e-6
+    assert abs(stats["dead_time_s"] - 1.0) < 1e-6  # gap minus median step
+    assert abs(stats["victim_downtime_s"] - 2.0) < 1e-6
+    assert stats["victims_recovered"] is True
+    # Pre-notice failure excluded from the post-notice count.
+    assert stats["failed_commits_after_kill"] == {"0": 0}
+    assert abs(stats["goodput_deadwindow_fraction"] - (1 - 1.0 / 39.0)) < 1e-3
+
+    # Overlapped handoff: replacement's first commit BEFORE the donor's
+    # last -> negative gap, zero dead time, downtime clamped to 0.
+    events2 = []
+    for t in range(1, 41):
+        events2.append({"ts": float(t), "replica_id": "0:a", "event": "commit", "committed": True})
+    for t in range(1, 14):
+        events2.append({"ts": float(t), "replica_id": "1:A", "event": "commit", "committed": True})
+    for t in range(12, 41):
+        events2.append({"ts": t + 0.5, "replica_id": "1:B", "event": "commit", "committed": True})
+    path2 = tmp_path / "metrics2.jsonl"
+    write(path2, events2)
+    stats2 = _scenario_stats(str(tmp_path), str(path2), [(10.5, "1")], plan)
+    assert stats2["drain_handoff_gap_s"] == -0.5
+    assert stats2["dead_time_s"] == 0.0
+    assert stats2["victim_downtime_s"] == 0.0
+    assert stats2["goodput_deadwindow_fraction"] == 1.0
+
+
 def test_scenario_stats_double_kill_and_unrecovered(tmp_path) -> None:
     """Dead-window accounting under churn: two kills of the same victim
     charge two gaps; a victim that never recommits invalidates the trial

@@ -195,6 +195,10 @@ def test_dump_spec_renders_env_contract(capsys) -> None:
     assert env["TPUFT_NUM_HOSTS"]["value"] == "4"
     assert "myjob-lighthouse-0-0.myjob" in env["TPUFT_LIGHTHOUSE"]["value"]
     assert "job-index" in str(env["TPUFT_GROUP_INDEX"]["valueFrom"])
+    # TPUFT_SLICE_GEN's source: the JobSet restart-attempt annotation via
+    # the downward API — nothing injects a JOBSET_RESTART_ATTEMPT env var,
+    # so without this fieldRef the generation would always read 0.
+    assert "restart-attempt" in str(env["JOBSET_RESTART_ATTEMPT"]["valueFrom"])
     script = container["args"][0]
     # The shell prologue derives the rest of the contract per pod.  The
     # store DNS name must be the 4-component JobSet pod name of the group's

@@ -23,12 +23,10 @@ def test_native_core_suite() -> None:
         # no-op; build the full default target set explicitly.
         subprocess.run(["ninja", "-C", build_dir], check=True, capture_output=True)
     out = subprocess.run(
-        # --repeat until-pass:2 absorbs a rare at-exit teardown flake
-        # (detached connection thread vs static destruction, observed ~1/30
-        # runs as SIGABRT AFTER "all native tests passed" printed); a real
-        # test failure still fails both attempts.
-        ["ctest", "--test-dir", build_dir, "--output-on-failure",
-         "--repeat", "until-pass:2"],
+        # No retry: RpcServer/HttpServer now JOIN their connection threads
+        # on shutdown (they used to detach, and a detached thread's epilogue
+        # racing static destruction SIGABRTed ~1/30 runs at exit).
+        ["ctest", "--test-dir", build_dir, "--output-on-failure"],
         capture_output=True,
         text=True,
         timeout=300,

@@ -36,6 +36,7 @@ LIGHTHOUSE_QUORUM = 1
 LIGHTHOUSE_HEARTBEAT = 2
 LIGHTHOUSE_STATUS = 3
 LIGHTHOUSE_EVICT = 4
+LIGHTHOUSE_DRAIN = 5
 MANAGER_QUORUM = 10
 MANAGER_CHECKPOINT_METADATA = 11
 MANAGER_SHOULD_COMMIT = 12
@@ -95,6 +96,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.tf_lighthouse_http_address.argtypes = [ctypes.c_void_p]
     lib.tf_lighthouse_evict.restype = ctypes.c_int
     lib.tf_lighthouse_evict.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tf_lighthouse_drain.restype = ctypes.c_int
+    lib.tf_lighthouse_drain.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
     lib.tf_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_lighthouse_free.argtypes = [ctypes.c_void_p]
     lib.tf_manager_new.restype = ctypes.c_void_p
@@ -265,6 +268,19 @@ class LighthouseServer:
         ids dropped."""
         return int(_lib.tf_lighthouse_evict(self._ptr, replica_prefix.encode()))
 
+    def drain(self, replica_prefix: str, deadline_ms: int = 0) -> int:
+        """Cooperative drain: mark every replica id matching
+        ``replica_prefix`` (full id or "<group>" uuid family) as a PLANNED
+        departure — excluded from the next quorum immediately (no
+        join/heartbeat-timeout wait) while its in-flight step finishes
+        undisturbed, and tombstoned against late re-joins.  The replacement
+        incarnation (fresh ":uuid" suffix) is admitted normally.
+        ``deadline_ms`` is the advisory preemption deadline.  Returns the
+        number of ids marked."""
+        return int(
+            _lib.tf_lighthouse_drain(self._ptr, replica_prefix.encode(), int(deadline_ms))
+        )
+
     def shutdown(self) -> None:
         if self._ptr:
             _lib.tf_lighthouse_shutdown(self._ptr)
@@ -330,6 +346,23 @@ class LighthouseClient:
             self._client.call(LIGHTHOUSE_EVICT, req.SerializeToString(), timeout_ms)
         )
         return int(resp.evicted)
+
+    def drain(
+        self, replica_prefix: str, deadline_ms: int = 0, timeout_ms: int = 5000
+    ) -> int:
+        """Cooperative-drain notice over the wire (method 5, docs/wire.md):
+        mark the matching replica ids as departing so the next quorum forms
+        without them, while their in-flight step finishes undisturbed.
+        This is what a departing Manager sends the moment its DrainWatcher
+        fires (SIGTERM / GCE preemption notice / explicit trigger)."""
+        req = pb.LighthouseDrainRequest(
+            replica_prefix=replica_prefix, deadline_ms=int(deadline_ms)
+        )
+        resp = pb.LighthouseDrainResponse()
+        resp.ParseFromString(
+            self._client.call(LIGHTHOUSE_DRAIN, req.SerializeToString(), timeout_ms)
+        )
+        return int(resp.drained)
 
     def status(self, timeout_ms: int = 5000) -> "pb.LighthouseStatusResponse":
         resp = pb.LighthouseStatusResponse()

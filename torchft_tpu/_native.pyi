@@ -54,6 +54,7 @@ class LighthouseServer:
     def address(self) -> str: ...
     def http_address(self) -> str: ...
     def evict(self, replica_prefix: str) -> int: ...
+    def drain(self, replica_prefix: str, deadline_ms: int = ...) -> int: ...
     def shutdown(self) -> None: ...
 
 class LighthouseClient:
@@ -71,6 +72,9 @@ class LighthouseClient:
     ) -> Any: ...  # pb.Quorum
     def heartbeat(self, replica_id: str, timeout_ms: int = ...) -> None: ...
     def evict(self, replica_prefix: str, timeout_ms: int = ...) -> int: ...
+    def drain(
+        self, replica_prefix: str, deadline_ms: int = ..., timeout_ms: int = ...
+    ) -> int: ...
     def close(self) -> None: ...
 
 class ManagerServer:

@@ -59,6 +59,21 @@ class _Spare:
 
 
 @dataclass
+class _Draining:
+    """A donor process finishing its cooperative departure: detached from
+    its group slot (the replacement already owns it), reaped separately,
+    escalated to SIGTERM/SIGKILL past its deadline."""
+
+    proc: subprocess.Popen
+    log: Optional[object]
+    group: int
+    deadline: float  # monotonic; escalate past this
+    notice_path: str
+    started: float = 0.0
+    term_sent: bool = False
+
+
+@dataclass
 class _Group:
     proc: Optional[subprocess.Popen] = None
     log: Optional[object] = None
@@ -143,6 +158,9 @@ class Launcher:
         self._spare_dir: Optional[str] = None
         self._spare_dir_created = False
         self._evict_client = None  # lazy wire client for external lighthouses
+        self._draining: List[_Draining] = []
+        self._drain_dir: Optional[str] = None
+        self._drain_dir_created = False
 
         if lighthouse == "embed":
             from torchft_tpu._native import LighthouseServer
@@ -174,8 +192,29 @@ class Launcher:
             base["TPUFT_LIGHTHOUSE"] = lighthouse_addr
         if cache_dir:
             base["TPUFT_COMPILE_CACHE"] = cache_dir
+        # Cooperative-drain channel: every child (groups AND spares, whose
+        # group id resolves at adoption) watches <drain_dir>/drain_<gid>.json
+        # through its DrainWatcher; the supervisor's drain() writes it.
+        if log_dir is not None:
+            os.makedirs(log_dir, exist_ok=True)
+            self._drain_dir = log_dir
+        else:
+            import tempfile
+
+            self._drain_dir = tempfile.mkdtemp(prefix="tpuft_drain_")
+            self._drain_dir_created = True
+        base["TPUFT_DRAIN_DIR"] = self._drain_dir
+        # Children only honor PID-PINNED notices (written by drain()); a
+        # pid-less file is an OPERATOR request addressed to this
+        # supervisor, which re-issues it through drain() so the departing
+        # group gets a replacement (a child consuming it directly would
+        # exit clean with nobody taking over).
+        base["TPUFT_DRAIN_SUPERVISED"] = "1"
         self._base_env = base
         self.lighthouse_address = lighthouse_addr
+        from torchft_tpu.metrics import MetricsLogger
+
+        self._metrics = MetricsLogger(base.get("TPUFT_METRICS_PATH"), "launcher")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -279,7 +318,13 @@ class Launcher:
         g.backoff_until = 0.0  # explicit spawn overrides a pending backoff
         g.killed_by_us = False  # the new process's exits are its own
         g.evicted = False  # fresh incarnation: its death is unreported
-        spare = self._take_ready_spare() if self._spares_target else None
+        # Spares are spawned with the BASE env only — a group carrying
+        # per-group overrides cannot adopt one (the drain handoff path
+        # relies on the replacement seeing the same env as the donor), so
+        # it falls through to a cold spawn that applies g.env.
+        spare = (
+            self._take_ready_spare() if self._spares_target and not g.env else None
+        )
         if spare is not None:
             tmp = spare.go_path + ".tmp"
             with open(tmp, "w") as f:
@@ -333,6 +378,101 @@ class Launcher:
             # redials instead of failing forever on a stale client.
             self._evict_client = None
             logger.warning("lighthouse evict of group %d failed", group, exc_info=True)
+
+    def _drain_at_lighthouse(self, group: int, deadline_ms: int) -> None:
+        """Marks the group's EXISTING incarnations draining at the
+        lighthouse, by family prefix.  Called from drain() BEFORE the
+        replacement spawns (its fresh uuid must not be caught by the
+        prefix), so quorum exclusion holds even when the child never
+        integrated the drain contract (the cooperating Manager's own
+        exact-id notice is then a harmless duplicate)."""
+        try:
+            if self._embedded is not None:
+                self._embedded.drain(str(group), deadline_ms)
+            elif self.lighthouse_address:
+                from torchft_tpu._native import LighthouseClient
+
+                if self._evict_client is None:
+                    self._evict_client = LighthouseClient(self.lighthouse_address)
+                self._evict_client.drain(str(group), deadline_ms)
+        except Exception:  # noqa: BLE001
+            self._evict_client = None
+            logger.warning(
+                "lighthouse drain of group %d failed", group, exc_info=True
+            )
+
+    def drain(self, group: int, deadline_s: float = 30.0) -> None:
+        """Cooperative drain of one group: graceful handoff instead of a
+        kill.  The moment the notice lands, a replacement is pre-warmed —
+        a ready hot spare adopts the group id instantly, otherwise a cold
+        replacement is spawned — so its initialization OVERLAPS the donor's
+        final step; the donor (notified through its drain file) finishes
+        the in-flight step, votes commit, tells the lighthouse it is
+        leaving, and exits.  Past ``deadline_s`` a non-cooperative donor is
+        escalated to SIGTERM, then SIGKILL (supervise_once drives the
+        escalation and the reaping)."""
+        g = self._groups[group]
+        if g.proc is None or g.proc.poll() is not None:
+            raise RuntimeError(f"group {group} is not running; nothing to drain")
+        donor = g.proc
+        donor_log = g.log
+        # 1. The notice file the donor's DrainWatcher polls.  Pinned to the
+        # donor's PID so the replacement (same group id, same file name)
+        # cannot mistake the stale notice for its own.
+        notice_path = os.path.join(self._drain_dir, f"drain_{group}.json")
+        tmp = notice_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            import json
+
+            json.dump(
+                {
+                    "deadline_ms": int(deadline_s * 1000),
+                    "source": "supervisor",
+                    "pid": donor.pid,
+                },
+                f,
+            )
+        os.replace(tmp, notice_path)  # atomic: the watcher reads whole notices
+        # 1b. Lighthouse exclusion from the supervisor side too, BEFORE the
+        # replacement exists: a donor that never wired a DrainWatcher would
+        # otherwise keep joining quorums until the deadline escalation
+        # kills it, stalling survivors on its stale heartbeat afterwards.
+        self._drain_at_lighthouse(group, int(deadline_s * 1000))
+        # 2. Detach the donor from the group slot and hand the id to a
+        # replacement NOW — adoption overlaps the donor's last step.  The
+        # lighthouse admits both briefly: the donor's incarnation is
+        # marked draining (by its own Manager), the replacement's fresh
+        # uuid joins normally.
+        self._draining.append(
+            _Draining(
+                proc=donor,
+                log=donor_log,
+                group=group,
+                deadline=time.monotonic() + deadline_s,
+                notice_path=notice_path,
+                started=time.monotonic(),
+            )
+        )
+        g.proc = None
+        g.log = None
+        had_spare = self._spares_target > 0 and self.spare_count() > 0 and not g.env
+        self.spawn(group)
+        logger.info(
+            "group %d draining (pid %d, deadline %.1fs); replacement %s",
+            group, donor.pid, deadline_s,
+            "adopted a hot spare" if had_spare else "cold-spawned",
+        )
+        self._metrics.emit(
+            "drain_handoff",
+            group=str(group),
+            donor_pid=donor.pid,
+            hot_spare=had_spare,
+            deadline_ms=int(deadline_s * 1000),
+        )
+
+    def draining(self) -> List[int]:
+        """Groups with a donor still finishing a cooperative departure."""
+        return sorted({d.group for d in self._draining if d.proc.poll() is None})
 
     def kill(self, group: int, sig: int = signal.SIGKILL, hold: bool = True) -> None:
         """Kills one group (default SIGKILL — the fault-injection path).  With
@@ -405,6 +545,77 @@ class Launcher:
             g.restarts += 1
             self.spawn(i)
             restarted.append(i)
+        # Operator drain requests: a pid-less drain_<g>.json in the drain
+        # dir (e.g. `echo '{}' > <log-dir>/drain_1.json` against the CLI
+        # launcher) is addressed to the SUPERVISOR — re-issue it through
+        # drain(), which pre-warms the replacement and rewrites the file
+        # pid-pinned for the donor.  Children skip pid-less files in
+        # supervised mode, so there is no consume race.
+        if self._drain_dir is not None:
+            for i, g in self._groups.items():
+                if g.proc is None or g.proc.poll() is not None:
+                    continue
+                path = os.path.join(self._drain_dir, f"drain_{i}.json")
+                import json
+
+                try:
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                except OSError:
+                    # Absent — or consumed by its donor between any
+                    # existence check and the open; draining the
+                    # replacement over that race would be a spurious
+                    # second handoff.
+                    continue
+                deadline_s = 30.0
+                try:
+                    data = json.loads(raw)
+                    if data.get("pid") is not None:
+                        continue  # already pid-pinned: in flight to its donor
+                    deadline_s = float(data.get("deadline_ms", 30000)) / 1000.0
+                except (ValueError, AttributeError):
+                    pass  # a bare `touch` is a valid operator request
+                logger.info("group %d: operator drain request via %s", i, path)
+                self.drain(i, deadline_s=deadline_s)
+        # Draining donors: reap the ones that finished their cooperative
+        # exit; escalate SIGTERM -> SIGKILL past the drain deadline for a
+        # child that never integrated the drain contract.
+        for d in list(self._draining):
+            code = d.proc.poll()
+            now = time.monotonic()
+            if code is not None:
+                self._draining.remove(d)
+                if d.log is not None:
+                    d.log.close()
+                try:
+                    os.remove(d.notice_path)
+                except OSError:
+                    pass
+                logger.info(
+                    "group %d donor (pid %d) exited %s after %.2fs of drain",
+                    d.group, d.proc.pid, code, now - d.started,
+                )
+                self._metrics.emit(
+                    "drain_donor_exit",
+                    group=str(d.group),
+                    exit_code=code,
+                    drain_s=round(now - d.started, 3),
+                )
+            elif now > d.deadline:
+                if not d.term_sent:
+                    logger.warning(
+                        "group %d donor (pid %d) still alive past its drain "
+                        "deadline; sending SIGTERM", d.group, d.proc.pid,
+                    )
+                    d.proc.send_signal(signal.SIGTERM)
+                    d.term_sent = True
+                    d.deadline = now + 5.0
+                else:
+                    logger.warning(
+                        "group %d donor (pid %d) ignored SIGTERM; SIGKILL",
+                        d.group, d.proc.pid,
+                    )
+                    d.proc.kill()
         # Spare pool upkeep: replace dead spares (repeated IMMEDIATE deaths
         # mean the command itself is broken — _note_spare_death's brake
         # disables the pool instead of crash-looping).
@@ -449,6 +660,9 @@ class Launcher:
         for g in self._groups.values():
             if g.proc is not None and g.proc.poll() is None:
                 g.proc.send_signal(signal.SIGTERM)
+        for d in self._draining:
+            if d.proc.poll() is None:
+                d.proc.kill()  # a donor mid-drain at stop() gets no grace
         for spare in self._spares:
             if spare.proc.poll() is None:
                 spare.proc.kill()  # spares hold no state worth a grace period
@@ -482,10 +696,36 @@ class Launcher:
                     except OSError:
                         pass
             self._spare_dir = None
+        for d in self._draining:
+            try:
+                d.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+            if d.log is not None:
+                d.log.close()
+            try:
+                os.remove(d.notice_path)
+            except OSError:
+                pass
+        self._draining.clear()
+        if self._drain_dir is not None:
+            import glob
+            import shutil
+
+            if self._drain_dir_created:
+                shutil.rmtree(self._drain_dir, ignore_errors=True)
+            else:
+                for path in glob.glob(os.path.join(self._drain_dir, "drain_*.json")):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+            self._drain_dir = None
         for g in self._groups.values():
             if g.log is not None:
                 g.log.close()
                 g.log = None
+        self._metrics.close()
         if self._embedded is not None:
             self._embedded.shutdown()
             self._embedded = None

@@ -140,6 +140,9 @@ class Manager:
         self._fixed_world_size = fixed_world_size
 
         lighthouse_addr = lighthouse_addr or os.environ.get(TPUFT_LIGHTHOUSE_ENV, "")
+        # Kept for the cooperative-drain notice (begin_drain dials the
+        # lighthouse directly with this group's exact incarnation id).
+        self._lighthouse_addr = lighthouse_addr
 
         self._store_server: Optional[StoreServer] = None
         self._manager_server: Optional[ManagerServer] = None
@@ -222,6 +225,12 @@ class Manager:
 
         self._participating_replica_rank: Optional[int] = None
         self._participating_replica_world_size: int = 0
+
+        # Cooperative-drain state (torchft_tpu/drain): set once by
+        # begin_drain, observed by the train loop between steps.
+        self._drain_notice = None
+        self._drain_watcher = None
+        self._drain_lock = threading.Lock()
 
         self._logger = _ManagerLogger(self, self._replica_id, self._rank)
         # JSONL event stream when TPUFT_METRICS_PATH is set (no-op otherwise).
@@ -622,6 +631,100 @@ class Manager:
                 )
         return should_commit
 
+    # -- cooperative drain --------------------------------------------------
+
+    def attach_drain_watcher(self, watcher=None) -> "object":
+        """Wires a :class:`~torchft_tpu.drain.DrainWatcher` to this manager
+        and starts it.  With no argument, builds one from the environment
+        contract (SIGTERM + ``TPUFT_DRAIN_DIR`` notice file + optional GCE
+        metadata poll).  The watcher is stopped by :meth:`shutdown`."""
+        if watcher is None:
+            from torchft_tpu.drain import DrainWatcher
+
+            watcher = DrainWatcher(on_notice=self.begin_drain)
+        else:
+            watcher._on_notice = self.begin_drain
+        self._drain_watcher = watcher
+        watcher.start()
+        return watcher
+
+    def begin_drain(self, notice=None) -> None:
+        """Handles a drain notice: records it for the train loop and tells
+        the lighthouse IMMEDIATELY (wire method 5) so the next quorum
+        excludes this group with zero join/heartbeat-timeout wait, while
+        the in-flight step finishes undisturbed.  Idempotent; callable from
+        any thread (the DrainWatcher invokes it from a signal handler or a
+        poller thread)."""
+        from torchft_tpu.drain import DrainNotice
+
+        if notice is None:
+            notice = DrainNotice(source="manual", deadline=time.time() + 30.0)
+        with self._drain_lock:
+            if self._drain_notice is not None:
+                return
+            self._drain_notice = notice
+        self._logger.warn(
+            f"drain notice ({notice.source}): finishing in-flight step, "
+            f"deadline in {notice.remaining_s():.1f}s"
+        )
+        self._metrics.emit(
+            "drain_notice",
+            step=self._step,
+            source=notice.source,
+            deadline_ms=notice.deadline_ms_from_now(),
+        )
+        # Rank 0 owns the group's lighthouse relationship; other local
+        # ranks observe the same notice via their own watcher/launcher
+        # channel and simply stop stepping.  The RPC runs on its own
+        # thread: begin_drain may be called from a SIGTERM handler on the
+        # main thread, and the final step must not stall behind a dial.
+        if self._rank == 0 and self._lighthouse_addr:
+            def _notify() -> None:
+                try:
+                    from torchft_tpu._native import LighthouseClient
+
+                    client = LighthouseClient(
+                        self._lighthouse_addr, connect_timeout_ms=2000
+                    )
+                    client.drain(
+                        self._replica_id,
+                        deadline_ms=notice.deadline_ms_from_now(),
+                        timeout_ms=2000,
+                    )
+                    client.close()
+                except Exception as e:  # noqa: BLE001 — a failed notice
+                    # degrades to the crash path (heartbeat timeout),
+                    # never kills the final step.
+                    self._logger.warn(f"lighthouse drain notice failed: {e}")
+
+            threading.Thread(
+                target=_notify, name="tpuft_drain_notify", daemon=True
+            ).start()
+
+    def drain_requested(self) -> bool:
+        """True once a drain notice arrived: the train loop must finish the
+        current step, then exit via :meth:`complete_drain`."""
+        return self._drain_notice is not None
+
+    def drain_notice(self):
+        return self._drain_notice
+
+    def complete_drain(self) -> None:
+        """Marks the cooperative departure finished (call after the final
+        committed step, before :meth:`shutdown`).  The checkpoint transport
+        keeps serving until shutdown so an already-assigned heal against
+        this donor can still complete."""
+        notice = self._drain_notice
+        self._metrics.emit(
+            "drain_complete",
+            step=self._step,
+            batches_committed=self._batches_committed,
+            source=notice.source if notice is not None else None,
+        )
+        self._logger.info(
+            f"drain complete at step {self._step}; exiting cleanly"
+        )
+
     # -- state --------------------------------------------------------------
 
     def load_state_dict(self, state_dict: Dict[str, int]) -> None:
@@ -671,6 +774,12 @@ class Manager:
         return self._collective
 
     def shutdown(self) -> None:
+        if self._drain_watcher is not None:
+            try:
+                self._drain_watcher.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._drain_watcher = None
         self._metrics.close()
         self._executor.shutdown(wait=True)
         if self._checkpoint_transport is not None:

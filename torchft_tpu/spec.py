@@ -108,6 +108,19 @@ def jobset_spec(
                 }
             },
         },
+        # The JobSet controller stamps its restart counter on every pod as
+        # the restart-attempt annotation (there is no JOBSET_RESTART_ATTEMPT
+        # env var injected by anything); surfacing it through the downward
+        # API is what makes the worker script's TPUFT_SLICE_GEN a real
+        # generation instead of a constant 0.
+        {
+            "name": "JOBSET_RESTART_ATTEMPT",
+            "valueFrom": {
+                "fieldRef": {
+                    "fieldPath": "metadata.annotations['jobset.sigs.k8s.io/restart-attempt']"
+                }
+            },
+        },
     ] + [{"name": k, "value": v} for k, v in (env or {}).items()]
 
     worker_job = {
@@ -187,9 +200,18 @@ def jobset_spec(
         "kind": "JobSet",
         "metadata": {"name": name},
         "spec": {
-            # Kill/recreate only the failed child Job (the failed replica
-            # group), never the whole set — the healthy groups keep
-            # training and the restarted one heals from them live.
+            # JobSet restart semantics are TWO-LEVEL, and this policy is
+            # the outer level: a pod that dies is first retried inside its
+            # own child Job up to that Job's backoffLimit (set above to
+            # max_restarts) — during those retries the other groups keep
+            # training and the restarted group heals live, which is the
+            # common path.  Only when a child Job FAILS outright (pod
+            # retries exhausted) does this failurePolicy act, and its
+            # default action recreates the WHOLE JobSet (all groups, the
+            # lighthouse included) up to maxRestarts times, bumping the
+            # restart-attempt annotation that becomes TPUFT_SLICE_GEN —
+            # a full cold start recovered via disk checkpoints, not live
+            # healing.
             "failurePolicy": {"maxRestarts": max_restarts},
             "network": {"enableDNSHostnames": True},
             "replicatedJobs": [lighthouse_job, worker_job],
