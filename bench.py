@@ -513,8 +513,14 @@ def _run_scenario(
     a cross-checked fallback."""
     repo = os.path.dirname(os.path.abspath(__file__))
     from torchft_tpu.launch import Launcher
+    from torchft_tpu.metrics import MetricsLogger
 
     metrics_path = os.path.join(workdir, "metrics.jsonl")
+    # The bench driver writes its fault schedule INTO the shared metrics
+    # stream ("fault" records), so obs/report.py sees the exact timeline
+    # the goodput accounting below charges — the report reproduces the
+    # benchmark number from the JSONL alone.
+    fault_log = MetricsLogger(metrics_path, replica_id="bench-driver")
     victim = str(plan["victim"]) if plan else None
     kind = plan["type"] if plan else None
     spares = 1 if kind in ("single_spare", "drain") else 0
@@ -541,7 +547,18 @@ def _run_scenario(
     total_window = window_s + (20.0 if kind in ("double", "during_heal") else 0.0)
 
     def kill_victim():
-        kill_events.append((time.time(), victim))
+        now = time.time()
+        kill_events.append((now, victim))
+        # Same ts as the in-memory kill list (the explicit ts field
+        # overrides the logger's own clock) so the recorded stream yields
+        # bit-identical goodput arithmetic.
+        fault_log.emit(
+            "fault",
+            ts=now,
+            kind="drain" if kind == "drain" else "kill",
+            group=victim,
+            plan=kind,
+        )
         if kind == "drain":
             # Planned departure: the launcher hands the id to a pre-warmed
             # spare and notifies the donor; no kill at all.  A victim that
@@ -611,6 +628,7 @@ def _run_scenario(
             # Supervisor: restart any group that died for other reasons.
             launcher.supervise_once()
 
+    fault_log.close()
     return _scenario_stats(workdir, metrics_path, kill_events, plan)
 
 
@@ -703,25 +721,21 @@ def _scenario_stats(
     }
 
     # --- dead-window accounting (all kill plans) -------------------------
+    # Shared with the attribution tool: obs/report.py::deadwindow is the
+    # single implementation of this arithmetic, so `python -m
+    # torchft_tpu.obs.report metrics.jsonl` reproduces the headline
+    # fraction from the recorded stream (tests/test_bench_contract.py pins
+    # the equality).
+    from torchft_tpu.obs import report as obs_report
+
     dead_total = None
     deadwindow_fraction = None
     victims_recovered = True
     if kill_events:
-        dead_total = 0.0
-        span = t_end - t0
-        for g in {grp for _, grp in kill_events}:
-            g_kills = sorted(ts for ts, grp in kill_events if grp == g)
-            cs = sorted(commits.get(g, []))
-            if not cs or max(cs) < max(g_kills):
-                victims_recovered = False  # never committed after its kill
-                continue
-            steps_iv = [b - a for a, b in zip(cs, cs[1:])]
-            med = sorted(steps_iv)[len(steps_iv) // 2] if steps_iv else 0.0
-            for a, b in zip(cs, cs[1:]):
-                if any(a <= k < b for k in g_kills):
-                    dead_total += max(0.0, (b - a) - med)
-        if span > 0 and victims_recovered:
-            deadwindow_fraction = max(0.0, 1.0 - dead_total / span)
+        dw = obs_report.deadwindow(commits, kill_events)
+        dead_total = dw["dead_time_s"] if dw["dead_time_s"] is not None else 0.0
+        deadwindow_fraction = dw["fraction"]
+        victims_recovered = dw["victims_recovered"]
 
     # --- cooperative drain: incarnation-aware accounting -----------------
     # The donor keeps COMMITTING after the notice (that is the point), so
@@ -1121,6 +1135,58 @@ def kill_benchmark() -> dict:
     }
 
 
+def kill_scenario_benchmark(trials: int | None = None) -> dict:
+    """Standalone SIGKILL scenario (``--scenario kill``): N single-kill
+    trials whose workdirs — including the per-trial ``metrics.jsonl`` — are
+    KEPT, so the attribution tool can replay the exact streams the numbers
+    came from::
+
+        python bench.py --scenario kill
+        python -m torchft_tpu.obs.report <workdir>/kill_0/metrics.jsonl
+
+    The printed goodput fraction and the report's dead-window fraction are
+    the same function over the same data (obs/report.py::deadwindow; the
+    fault schedule rides in the stream as ``fault`` records), pinned by
+    tests/test_bench_contract.py."""
+    window = float(os.environ.get("TPUFT_BENCH_KILL_WINDOW_S", "45"))
+    trials = trials if trials is not None else max(
+        1, int(os.environ.get("TPUFT_BENCH_KILL_TRIALS", "2"))
+    )
+    out_root = os.environ.get("TPUFT_BENCH_WORKDIR") or tempfile.mkdtemp(
+        prefix="tpuft_bench_kill_"
+    )
+    results = []
+    with tempfile.TemporaryDirectory(prefix="tpuft_bench_cache_") as cache_dir:
+        for i in range(trials):
+            d = os.path.join(out_root, f"kill_{i}")
+            os.makedirs(d, exist_ok=True)
+            plan = {"type": "single", "victim": i % 2}
+            results.append(
+                _run_scenario(d, window_s=window, plan=plan, cache_dir=cache_dir)
+            )
+    fractions = [
+        k["goodput_deadwindow_fraction"]
+        for k in results
+        if k["goodput_deadwindow_fraction"] is not None
+    ]
+    return {
+        "window_s": window,
+        "trials": trials,
+        "workdir": out_root,
+        "metrics_jsonl": [
+            os.path.join(out_root, f"kill_{i}", "metrics.jsonl")
+            for i in range(trials)
+        ],
+        "kill_fractions": [round(f, 4) for f in fractions],
+        "kill_goodput_fraction": (
+            round(sum(fractions) / len(fractions), 4) if fractions else None
+        ),
+        "victim_downtime_s": _mean([k["victim_downtime_s"] for k in results]),
+        "heals": sum(k["heals"] for k in results),
+        "victims_recovered": all(k["victims_recovered"] for k in results),
+    }
+
+
 def drain_benchmark(trials: int | None = None) -> dict:
     """Standalone cooperative-drain benchmark (``--scenario drain``): N
     drain trials, no kill baseline needed — the criterion is absolute
@@ -1245,6 +1311,7 @@ def selftest() -> None:
     inspect.signature(kill_benchmark).bind()
     inspect.signature(chip_benchmark).bind()
     inspect.signature(drain_benchmark).bind()
+    inspect.signature(kill_scenario_benchmark).bind()
     plans = _trial_plans(10)
     assert len(plans) == 10
     assert {p["type"] for p in plans} == {
@@ -1261,19 +1328,32 @@ if __name__ == "__main__":
         selftest()
     elif "--scenario" in sys.argv:
         which = sys.argv[sys.argv.index("--scenario") + 1:]
-        if not which or which[0] != "drain":
+        if not which or which[0] not in ("drain", "kill"):
             print(f"unknown --scenario {which[:1] or '(missing)'}", file=sys.stderr)
             sys.exit(2)
-        drain = drain_benchmark()
-        print(
-            json.dumps(
-                {
-                    "metric": "drain_goodput",
-                    "value": drain["drain_goodput_fraction"],
-                    "unit": "deadwindow_drain_fraction",
-                    "detail": drain,
-                }
+        if which[0] == "drain":
+            drain = drain_benchmark()
+            print(
+                json.dumps(
+                    {
+                        "metric": "drain_goodput",
+                        "value": drain["drain_goodput_fraction"],
+                        "unit": "deadwindow_drain_fraction",
+                        "detail": drain,
+                    }
+                )
             )
-        )
+        else:
+            kill = kill_scenario_benchmark()
+            print(
+                json.dumps(
+                    {
+                        "metric": "kill_goodput",
+                        "value": kill["kill_goodput_fraction"],
+                        "unit": "deadwindow_single_kill_fraction",
+                        "detail": kill,
+                    }
+                )
+            )
     else:
         main()

@@ -104,6 +104,10 @@ void* tf_manager_new(const char* replica_id, const char* lighthouse_addr, const 
 
 char* tf_manager_address(void* p) { return CopyString(static_cast<ManagerServer*>(p)->address()); }
 
+void tf_manager_set_status(void* p, int64_t step, const char* state) {
+  static_cast<ManagerServer*>(p)->SetStatus(step, state ? state : "");
+}
+
 void tf_manager_shutdown(void* p) { static_cast<ManagerServer*>(p)->Shutdown(); }
 
 void tf_manager_free(void* p) { delete static_cast<ManagerServer*>(p); }

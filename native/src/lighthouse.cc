@@ -174,6 +174,11 @@ bool Lighthouse::Start(std::string* err) {
           } else if (method == "GET" && path == "/status.json") {
             r.content_type = "application/json";
             r.body = StatusJson();
+          } else if (method == "GET" && path == "/metrics") {
+            // Prometheus text exposition (read-only, ungated like
+            // /status.json): cluster-level gauges a scraper can alert on.
+            r.content_type = "text/plain; version=0.0.4; charset=utf-8";
+            r.body = MetricsText();
           } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
                      path.size() > 14 && path.substr(path.size() - 5) == "/kill") {
             std::string replica_id = path.substr(9, path.size() - 9 - 5);
@@ -286,6 +291,17 @@ Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
     return Status::kAborted;  // a zombie's in-flight heartbeat
   }
   state_.heartbeats[req.replica_id()] = Clock::now();
+  // Live step/state (wire method 2 fields 2-3; 0/"" from pre-observability
+  // peers).  A step ADVANCE is a commit: steps increment exactly when a
+  // step commits (or a heal fast-forwards, which is progress too), so the
+  // advance time is the lighthouse's last-commit timestamp for /metrics
+  // and /status.json.
+  auto it = hb_step_.find(req.replica_id());
+  if (it == hb_step_.end() || req.step() > it->second) {
+    if (it != hb_step_.end()) last_commit_ms_[req.replica_id()] = NowEpochMs();
+    hb_step_[req.replica_id()] = req.step();
+  }
+  if (!req.state().empty()) hb_state_[req.replica_id()] = req.state();
   return Status::kOk;
 }
 
@@ -314,6 +330,15 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
   }
   // Joining is an implicit heartbeat (reference: src/lighthouse.rs:480-491).
   state_.heartbeats[id] = Clock::now();
+  // ...and carries the requester's step: keep the live view fresh for
+  // clients whose heartbeat loop lags the join (raw wire clients).
+  {
+    auto step_it = hb_step_.find(id);
+    if (step_it == hb_step_.end() || req.requester().step() > step_it->second) {
+      if (step_it != hb_step_.end()) last_commit_ms_[id] = NowEpochMs();
+      hb_step_[id] = req.requester().step();
+    }
+  }
   state_.participants[id] = QuorumState::Joined{req.requester(), Clock::now()};
   // Only quorums broadcast after this join count — a stale quorum from a
   // previous round must not satisfy this request.
@@ -459,6 +484,20 @@ void Lighthouse::TickLocked() {
       ++it;
     }
   }
+  // Live-status maps follow the heartbeat graveyard: under uuid-suffixed
+  // id churn they would otherwise grow without bound.
+  auto prune_with_heartbeats = [&](auto& m) {
+    for (auto it = m.begin(); it != m.end();) {
+      if (state_.heartbeats.find(it->first) == state_.heartbeats.end()) {
+        it = m.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  };
+  prune_with_heartbeats(hb_step_);
+  prune_with_heartbeats(hb_state_);
+  prune_with_heartbeats(last_commit_ms_);
 
   std::string reason;
   auto members = QuorumCompute(Clock::now(), state_, opt_, &reason);
@@ -527,6 +566,9 @@ void Lighthouse::FillStatus(LighthouseStatusResponse* resp) {
   }
   resp->set_quorum_id(state_.quorum_id);
   for (const auto& [id, _] : state_.draining) resp->add_draining(id);
+  for (const auto& [id, step] : hb_step_) (*resp->mutable_replica_step())[id] = step;
+  for (const auto& [id, ms] : last_commit_ms_) (*resp->mutable_last_commit_ts_ms())[id] = ms;
+  for (const auto& [id, st] : hb_state_) (*resp->mutable_replica_state())[id] = st;
 }
 
 int Lighthouse::EvictReplica(const std::string& prefix) {
@@ -568,6 +610,18 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
       ++it;
     }
   }
+  auto erase_matching = [&](auto& m) {
+    for (auto it = m.begin(); it != m.end();) {
+      if (matches(it->first)) {
+        it = m.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  };
+  erase_matching(hb_step_);
+  erase_matching(hb_state_);
+  erase_matching(last_commit_ms_);
   // Wake blocked quorum handlers: an evicted id's own handler must notice
   // its tombstone and abort instead of waiting out its deadline.
   quorum_cv_.notify_all();
@@ -665,7 +719,80 @@ std::string JsonEscape(const std::string& s) {
   }
   return out;
 }
+
+// Prometheus label-value escaping (same rules as JSON's subset: backslash,
+// double quote, newline).
+std::string PromEscape(const std::string& s) { return JsonEscape(s); }
 }  // namespace
+
+std::string Lighthouse::MetricsText() {
+  std::ostringstream o;
+  std::lock_guard<std::mutex> lk(mu_);
+  auto now = Clock::now();
+  auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
+
+  int64_t max_step = 0;
+  for (const auto& [id, step] : hb_step_) max_step = std::max(max_step, step);
+
+  int64_t healing = 0;
+  for (const auto& [id, st] : hb_state_) {
+    if (st == "heal") ++healing;
+  }
+  int64_t healthy = 0;
+  for (const auto& [id, last] : state_.heartbeats) {
+    if (!state_.draining.count(id) && now - last < hb_timeout) ++healthy;
+  }
+
+  auto gauge = [&](const char* name, const char* help) {
+    o << "# HELP " << name << " " << help << "\n# TYPE " << name << " gauge\n";
+  };
+  gauge("tpuft_quorum_size", "participants in the current quorum");
+  o << "tpuft_quorum_size "
+    << (state_.prev_quorum ? state_.prev_quorum->participants_size() : 0) << "\n";
+  gauge("tpuft_quorum_id", "monotonically increasing quorum id (bumps on membership change)");
+  o << "tpuft_quorum_id " << state_.quorum_id << "\n";
+  gauge("tpuft_quorum_age_seconds", "seconds since the current quorum formed");
+  if (state_.prev_quorum) {
+    o << "tpuft_quorum_age_seconds "
+      << (NowEpochMs() - state_.prev_quorum->created_ms()) / 1000.0 << "\n";
+  } else {
+    o << "tpuft_quorum_age_seconds -1\n";
+  }
+  gauge("tpuft_replicas_healthy", "replicas with a fresh heartbeat (draining excluded)");
+  o << "tpuft_replicas_healthy " << healthy << "\n";
+  gauge("tpuft_pending_joins", "replicas blocked in a quorum join this round");
+  o << "tpuft_pending_joins " << state_.participants.size() << "\n";
+  gauge("tpuft_replicas_draining", "replicas marked for cooperative departure");
+  o << "tpuft_replicas_draining " << state_.draining.size() << "\n";
+  gauge("tpuft_replicas_tombstoned", "evicted incarnations still tombstoned against zombies");
+  o << "tpuft_replicas_tombstoned " << evicted_.size() << "\n";
+  gauge("tpuft_heal_in_progress", "replicas currently fetching weights from a peer");
+  o << "tpuft_heal_in_progress " << healing << "\n";
+
+  gauge("tpuft_replica_step", "live training step per replica (from heartbeats)");
+  for (const auto& [id, step] : hb_step_) {
+    o << "tpuft_replica_step{replica=\"" << PromEscape(id) << "\"} " << step << "\n";
+  }
+  gauge("tpuft_replica_step_lag", "steps behind the most advanced replica");
+  for (const auto& [id, step] : hb_step_) {
+    o << "tpuft_replica_step_lag{replica=\"" << PromEscape(id) << "\"} "
+      << (max_step - step) << "\n";
+  }
+  gauge("tpuft_replica_heartbeat_age_seconds", "seconds since the last heartbeat");
+  for (const auto& [id, last] : state_.heartbeats) {
+    auto age_ms =
+        std::chrono::duration_cast<std::chrono::milliseconds>(now - last).count();
+    o << "tpuft_replica_heartbeat_age_seconds{replica=\"" << PromEscape(id)
+      << "\"} " << age_ms / 1000.0 << "\n";
+  }
+  gauge("tpuft_replica_last_commit_age_seconds",
+        "seconds since the replica's reported step last advanced");
+  for (const auto& [id, ms] : last_commit_ms_) {
+    o << "tpuft_replica_last_commit_age_seconds{replica=\"" << PromEscape(id)
+      << "\"} " << (NowEpochMs() - ms) / 1000.0 << "\n";
+  }
+  return o.str();
+}
 
 std::string Lighthouse::StatusJson() {
   LighthouseStatusResponse s;
@@ -701,7 +828,31 @@ std::string Lighthouse::StatusJson() {
     first = false;
     o << "\"" << JsonEscape(id) << "\"";
   }
-  o << "]}";
+  // Live per-replica observability (heartbeat step/state fields): the
+  // participants[].step above is the QUORUM-SNAPSHOT step; replica_step is
+  // real-time, and last_commit_ts_ms is when it last advanced.
+  o << "],\"replica_step\":{";
+  first = true;
+  for (const auto& [id, step] : s.replica_step()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\":" << step;
+  }
+  o << "},\"last_commit_ts_ms\":{";
+  first = true;
+  for (const auto& [id, ms] : s.last_commit_ts_ms()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\":" << ms;
+  }
+  o << "},\"replica_state\":{";
+  first = true;
+  for (const auto& [id, st] : s.replica_state()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\":\"" << JsonEscape(st) << "\"";
+  }
+  o << "}}";
   return o.str();
 }
 
@@ -724,14 +875,27 @@ std::string Lighthouse::StatusHtml() {
   o << "<p>quorum_id: " << s.quorum_id() << " &mdash; " << s.prev_quorum().participants_size()
     << " participants, " << s.pending_participants_size() << " pending</p>";
   std::set<std::string> draining(s.draining().begin(), s.draining().end());
+  int64_t max_live = 0;
+  for (const auto& [id, st] : s.replica_step()) max_live = std::max(max_live, st);
   for (const auto& m : s.prev_quorum().participants()) {
     bool recovering = m.step() != max_step;
     bool is_draining = draining.count(m.replica_id()) > 0;
     int64_t age = -1;
     auto it = s.heartbeat_age_ms().find(m.replica_id());
     if (it != s.heartbeat_age_ms().end()) age = it->second;
+    // Live step/lag from heartbeats (the quorum-snapshot step can be a
+    // whole round stale); lag > 0 is the step-lag alarm /metrics exposes.
+    int64_t live = m.step();
+    auto ls = s.replica_step().find(m.replica_id());
+    if (ls != s.replica_step().end()) live = ls->second;
+    int64_t lag = max_live - live;
+    std::string state;
+    auto st_it = s.replica_state().find(m.replica_id());
+    if (st_it != s.replica_state().end()) state = st_it->second;
     o << "<div class=\"card" << (is_draining ? " draining" : recovering ? " recovering" : "")
-      << "\"><b>" << m.replica_id() << "</b><br>step: " << m.step()
+      << "\"><b>" << m.replica_id() << "</b><br>step: " << live
+      << " <span class=\"" << (lag > 0 ? "stale" : "") << "\">(lag " << lag << ")</span>"
+      << (state.empty() ? "" : " [" + state + "]")
       << (is_draining ? " (draining)" : recovering ? " (recovering)" : "")
       << "<br>world_size: " << m.world_size() << "<br>manager: " << m.address()
       << "<br><span class=\"" << (age > 2500 ? "stale" : "") << "\">heartbeat: " << age

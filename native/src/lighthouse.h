@@ -118,6 +118,10 @@ class Lighthouse {
   void TickLocked();
   std::string StatusJson();
   std::string StatusHtml();
+  // Prometheus text exposition for GET /metrics: quorum size/id/age,
+  // per-replica step + step lag + heartbeat age, draining/tombstoned
+  // counts, heal-in-progress and pending-join gauges (docs/wire.md).
+  std::string MetricsText();
 
   LighthouseOpt opt_;
   std::unique_ptr<RpcServer> server_;
@@ -140,6 +144,14 @@ class Lighthouse {
   // Replicas observed heartbeat-fresh on the previous tick, for logging
   // healthy<->stale transitions (failure-detection visibility).
   std::map<std::string, bool> last_fresh_;
+  // Live per-replica training status carried on heartbeats (step/state
+  // fields, wire method 2): feeds /metrics and /status.json.  Pruned with
+  // the heartbeat graveyard so replica-id churn cannot grow them.
+  std::map<std::string, int64_t> hb_step_;
+  std::map<std::string, std::string> hb_state_;
+  // Epoch ms when a replica's reported step last ADVANCED — the lighthouse's
+  // view of its last commit (steps advance exactly on committed steps).
+  std::map<std::string, int64_t> last_commit_ms_;
   // Tombstones for supervisor-evicted incarnations (id -> evict time): a
   // dead incarnation's still-blocked quorum handler or in-flight heartbeat
   // must not re-register the corpse after EvictReplica dropped it.  Pruned

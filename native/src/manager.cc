@@ -139,11 +139,14 @@ void ManagerServer::Shutdown() {
 
 std::string ManagerServer::address() const { return server_ ? server_->address() : ""; }
 
+void ManagerServer::SetStatus(int64_t step, const std::string& state) {
+  std::lock_guard<std::mutex> lk(mu_);
+  status_step_ = step;
+  status_state_ = state;
+}
+
 void ManagerServer::HeartbeatLoop() {
-  LighthouseHeartbeatRequest req;
-  req.set_replica_id(opt_.replica_id);
   std::string payload, resp, err;
-  req.SerializeToString(&payload);
   // A single heartbeat RPC must never be allowed to eat a whole
   // heartbeat_timeout window: the lighthouse keeps a replica alive as long
   // as one heartbeat lands within each heartbeat_timeout window, so a
@@ -169,6 +172,17 @@ void ManagerServer::HeartbeatLoop() {
            static_cast<long long>(gap_ms));
     }
     last_iter = now;
+    // Rebuilt every tick: the payload carries the LIVE step/state pushed by
+    // SetStatus, which is what makes the lighthouse's /metrics step-lag and
+    // last-commit gauges real-time rather than quorum-snapshot stale.
+    {
+      LighthouseHeartbeatRequest req;
+      req.set_replica_id(opt_.replica_id);
+      std::lock_guard<std::mutex> lk(mu_);
+      req.set_step(status_step_);
+      req.set_state(status_state_);
+      req.SerializeToString(&payload);
+    }
     Status st = heartbeat_client_->Call(kLighthouseHeartbeat, payload, call_timeout_ms,
                                         &resp, &err);
     if (st != Status::kOk) {

@@ -56,6 +56,12 @@ class ManagerServer {
   void Shutdown();
   std::string address() const;
 
+  // Live training status pushed by the Python Manager (rank 0) at phase
+  // transitions; carried on every subsequent lighthouse heartbeat so the
+  // cluster's GET /metrics exposition and dashboard show per-replica step
+  // and state without waiting for the next quorum snapshot.
+  void SetStatus(int64_t step, const std::string& state);
+
   // RPC handlers (public for in-process tests).
   Status HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
                       ManagerQuorumResponse* resp, std::string* err);
@@ -89,6 +95,10 @@ class ManagerServer {
 
   // Latest checkpoint metadata per local rank (served to healing peers).
   std::map<int64_t, std::string> checkpoint_metadata_;
+
+  // Live status for heartbeat enrichment (SetStatus).
+  int64_t status_step_ = 0;
+  std::string status_state_ = "init";
 
   // should_commit barrier per (step) round (reference: src/manager.rs:313-371).
   struct CommitRound {

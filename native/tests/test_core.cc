@@ -1042,6 +1042,119 @@ std::string HttpPost(const std::string& http_addr, const std::string& path,
   return out;
 }
 
+std::string HttpGet(const std::string& http_addr, const std::string& path) {
+  std::string hostport = http_addr.substr(7);
+  std::string err;
+  int fd = DialTcp(hostport, 2000, &err);
+  CHECK(fd >= 0);
+  std::string req = "GET " + path + " HTTP/1.1\r\nHost: x\r\n\r\n";
+  CHECK(send(fd, req.data(), req.size(), 0) == static_cast<ssize_t>(req.size()));
+  std::string out;
+  char buf[4096];
+  for (;;) {
+    ssize_t r = recv(fd, buf, sizeof(buf), 0);
+    if (r <= 0) break;
+    out.append(buf, static_cast<size_t>(r));
+  }
+  close(fd);
+  return out;
+}
+
+// --- GET /metrics Prometheus exposition + heartbeat step/state fields --------
+void TestMetricsExposition() {
+  LighthouseOpt opt;
+  opt.bind = "127.0.0.1:0";
+  opt.http_bind = "127.0.0.1:0";
+  opt.min_replicas = 1;
+  opt.quorum_tick_ms = 20;
+  Lighthouse lh(opt);
+  std::string err;
+  CHECK(lh.Start(&err));
+
+  LighthouseHeartbeatRequest hb;
+  hb.set_replica_id("0:aaaa");
+  hb.set_step(5);
+  hb.set_state("step");
+  CHECK(lh.HandleHeartbeat(hb) == Status::kOk);
+  hb.set_replica_id("1:bbbb");
+  hb.set_step(2);
+  hb.set_state("heal");
+  CHECK(lh.HandleHeartbeat(hb) == Status::kOk);
+
+  std::string m = HttpGet(lh.http_address(), "/metrics");
+  CHECK(m.find("text/plain") != std::string::npos);
+  CHECK(m.find("tpuft_replica_step{replica=\"0:aaaa\"} 5") != std::string::npos);
+  CHECK(m.find("tpuft_replica_step_lag{replica=\"1:bbbb\"} 3") != std::string::npos);
+  CHECK(m.find("tpuft_heal_in_progress 1") != std::string::npos);
+  CHECK(m.find("tpuft_replicas_healthy 2") != std::string::npos);
+
+  // Step advance = commit: lag closes, heal gauge clears, the last-commit
+  // stamp appears for the healed replica.
+  hb.set_replica_id("1:bbbb");
+  hb.set_step(5);
+  hb.set_state("step");
+  CHECK(lh.HandleHeartbeat(hb) == Status::kOk);
+  m = HttpGet(lh.http_address(), "/metrics");
+  CHECK(m.find("tpuft_replica_step_lag{replica=\"1:bbbb\"} 0") != std::string::npos);
+  CHECK(m.find("tpuft_heal_in_progress 0") != std::string::npos);
+  CHECK(m.find("tpuft_replica_last_commit_age_seconds{replica=\"1:bbbb\"}") !=
+        std::string::npos);
+
+  // /status.json mirrors the live maps.
+  std::string js = HttpGet(lh.http_address(), "/status.json");
+  CHECK(js.find("\"replica_step\"") != std::string::npos);
+  CHECK(js.find("\"1:bbbb\":5") != std::string::npos);
+  CHECK(js.find("\"replica_state\"") != std::string::npos);
+  CHECK(js.find("\"last_commit_ts_ms\"") != std::string::npos);
+
+  // Eviction tombstones show, and the evicted id's series disappears.
+  CHECK(lh.EvictReplica("1") == 1);
+  m = HttpGet(lh.http_address(), "/metrics");
+  CHECK(m.find("tpuft_replicas_tombstoned 1") != std::string::npos);
+  CHECK(m.find("tpuft_replica_step{replica=\"1:bbbb\"}") == std::string::npos);
+
+  lh.Shutdown();
+}
+
+// SetStatus rides the next heartbeat: the Python Manager's phase pushes
+// reach the lighthouse within one heartbeat interval.
+void TestManagerHeartbeatCarriesStatus() {
+  LighthouseOpt lopt;
+  lopt.bind = "127.0.0.1:0";
+  lopt.http_bind = "";
+  lopt.min_replicas = 1;
+  Lighthouse lh(lopt);
+  std::string err;
+  CHECK(lh.Start(&err));
+
+  ManagerOpt mopt;
+  mopt.replica_id = "g0:x";
+  mopt.lighthouse_addr = lh.address();
+  mopt.bind = "127.0.0.1:0";
+  mopt.heartbeat_interval_ms = 20;
+  ManagerServer ms(mopt);
+  CHECK(ms.Start(&err));
+  ms.SetStatus(7, "step");
+
+  auto deadline = Clock::now() + std::chrono::seconds(5);
+  bool seen = false;
+  while (Clock::now() < deadline && !seen) {
+    LighthouseStatusResponse s;
+    lh.FillStatus(&s);
+    auto it = s.replica_step().find("g0:x");
+    if (it != s.replica_step().end() && it->second == 7) {
+      seen = true;
+      auto st = s.replica_state().find("g0:x");
+      CHECK(st != s.replica_state().end() && st->second == "step");
+    } else {
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+  }
+  CHECK(seen);
+  ms.Shutdown();
+  lh.Shutdown();
+}
+
 void TestHttpAdminGate() {
   // Token configured (mixed case: the value's case must survive header
   // parsing): remote AND loopback callers must present it.
@@ -1187,6 +1300,8 @@ int main() {
   TestQuorumComputeDraining();
   TestDrainCooperativeHandoff();
   TestHttpAdminGate();
+  TestMetricsExposition();
+  TestManagerHeartbeatCarriesStatus();
   TestQuorumComputeFuzz();
   printf("all native tests passed\n");
   return 0;

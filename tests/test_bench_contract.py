@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -224,6 +226,51 @@ def test_scenario_stats_drain_accounting(tmp_path) -> None:
     assert stats2["dead_time_s"] == 0.0
     assert stats2["victim_downtime_s"] == 0.0
     assert stats2["goodput_deadwindow_fraction"] == 1.0
+
+
+def test_bench_headline_equals_obs_report(tmp_path) -> None:
+    """The benchmark's dead-window goodput and `python -m
+    torchft_tpu.obs.report` must agree EXACTLY on the same recorded stream
+    — they now share one implementation (obs/report.py::deadwindow), and
+    the fault schedule rides in the stream as `fault` records, so the
+    report needs nothing but the JSONL."""
+    import json as _json
+    import sys
+
+    sys.path.insert(0, REPO)
+    from bench import _scenario_stats
+    from torchft_tpu.obs import report
+
+    kill_ts = 10.5
+    events = []
+    for t in range(1, 41):
+        events.append({"ts": float(t), "replica_id": "0:a", "event": "commit", "committed": True})
+    for t in range(1, 11):
+        events.append({"ts": float(t), "replica_id": "1:A", "event": "commit", "committed": True})
+    for t in range(18, 41):
+        events.append({"ts": float(t), "replica_id": "1:B", "event": "commit", "committed": True})
+    # The record bench's fault logger writes at kill time (explicit ts).
+    events.append(
+        {"ts": kill_ts, "replica_id": "bench-driver", "event": "fault",
+         "kind": "kill", "group": "1", "plan": "single"}
+    )
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(_json.dumps(ev) + "\n")
+
+    bench_stats = _scenario_stats(str(tmp_path), str(path), [(kill_ts, "1")])
+    report_result = report.attribute(report.read_events([str(path)]))
+    assert bench_stats["goodput_deadwindow_fraction"] is not None
+    assert report_result["goodput"]["deadwindow_fraction"] == pytest.approx(
+        bench_stats["goodput_deadwindow_fraction"], abs=5e-5
+    )
+    assert report_result["goodput"]["dead_time_s"] == pytest.approx(
+        bench_stats["dead_time_s"], abs=5e-3
+    )
+    assert report_result["goodput"]["victims_recovered"] is True
+    # The report also yields a per-step table over the same stream.
+    assert report_result["steps"], "attribution table empty"
 
 
 def test_scenario_stats_double_kill_and_unrecovered(tmp_path) -> None:

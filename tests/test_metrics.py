@@ -26,6 +26,17 @@ def test_metrics_logger_roundtrip(tmp_path) -> None:
     assert [e["event"] for e in events] == ["commit", "error"]
     assert events[0]["replica_id"] == "r0" and events[0]["step"] == 3
     assert events[0]["committed"] is True and "ts" in events[0]
+    # Schema versioning + the monotonic clock report.py duration math uses
+    # (wall-clock ts is NTP-steppable mid-run; t_mono is not).
+    assert all(e["schema"] == 1 for e in events)
+    assert all("t_mono" in e for e in events)
+    # Registered names carry no flag; unknown names are flagged, not dropped.
+    assert "unregistered" not in events[0]
+    m2 = MetricsLogger(str(path), replica_id="r0")
+    m2.emit("totally_new_event", x=1)
+    m2.close()
+    last = json.loads(path.read_text().splitlines()[-1])
+    assert last["event"] == "totally_new_event" and last["unregistered"] is True
 
 
 def test_metrics_disabled_is_noop(tmp_path) -> None:
@@ -61,3 +72,89 @@ def test_manager_emits_lifecycle_events(store, tmp_path, monkeypatch) -> None:  
     # carries how long its phase took.
     assert quorum["quorum_ms"] >= 0
     assert commit["vote_ms"] >= 0
+    # The same measurements also ride as first-class span records plus a
+    # per-step summary (obs/spans.py) — the trace the report tool merges.
+    span_phases = {e["phase"] for e in events if e["event"] == "span"}
+    assert {"quorum", "allreduce_merge", "commit_vote"} <= span_phases
+    summary = next(e for e in events if e["event"] == "step_summary")
+    assert summary["committed"] is True and summary["step"] == commit["step"]
+    assert "quorum" in summary["phases"] and "commit_vote" in summary["phases"]
+    assert summary["slice_gen"] == 0
+
+
+def test_manager_full_lifecycle_event_coverage(store, tmp_path, monkeypatch) -> None:  # noqa: F811
+    """Fake-wire walk-through of EVERY Manager lifecycle path that emits an
+    event — quorum, configure, heal, error, commit (failed + committed),
+    drain — asserting each event lands in the stream with its span."""
+    path = tmp_path / "life.jsonl"
+    monkeypatch.setenv(METRICS_PATH_ENV, str(path))
+
+    from test_manager import make_quorum as mq
+
+    client = MagicMock()
+    client._quorum.return_value = mq(
+        max_step=5, heal=True, recover_src=1, max_replica_rank=None
+    )
+    client._checkpoint_metadata.return_value = "peer-meta"
+    client.should_commit.side_effect = [False, True]
+
+    transport = MagicMock()
+    transport.metadata.return_value = "my-meta"
+    transport.recv_checkpoint.return_value = {
+        "user": {"default": {"w": np.ones(2)}},
+        "tpuft": {"step": 5, "batches_committed": 10},
+    }
+    manager, collective, _ = make_manager(
+        store,
+        client_mock=client,
+        checkpoint_transport=transport,
+        load_state_dict=lambda sd: None,
+        state_dict=lambda: {"w": np.zeros(2)},
+    )
+    try:
+        # Step with a heal + a latched error -> failed commit vote.
+        manager.start_quorum()
+        manager.wait_quorum()
+        manager.report_error(RuntimeError("boom"))
+        assert manager.should_commit() is False
+
+        # Clean committed step.
+        client._quorum.return_value = mq(max_step=6, max_world_size=2)
+        manager.start_quorum()
+        manager.allreduce(np.ones(4, dtype=np.float32)).result()
+        assert manager.should_commit() is True
+
+        # Cooperative drain notice + completion.
+        manager._lighthouse_addr = ""  # skip the real lighthouse dial
+        manager.begin_drain()
+        assert manager.drain_requested()
+        manager.complete_drain()
+    finally:
+        manager.shutdown()
+
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    kinds = [e["event"] for e in events]
+    for expected in (
+        "quorum",
+        "reconfigure",
+        "heal_start",
+        "heal_fetched",
+        "error",
+        "commit",
+        "span",
+        "step_summary",
+        "drain_notice",
+        "drain_complete",
+    ):
+        assert expected in kinds, f"missing {expected} in {sorted(set(kinds))}"
+    # Nothing a Manager emits may be unregistered (metrics.EVENTS).
+    assert not any(e.get("unregistered") for e in events)
+    # Both commit outcomes covered, each with its own step_summary.
+    commits = [e for e in events if e["event"] == "commit"]
+    assert [c["committed"] for c in commits] == [False, True]
+    summaries = [e for e in events if e["event"] == "step_summary"]
+    assert [s["committed"] for s in summaries] == [False, True]
+    # The heal span carries the phase breakdown the report attributes.
+    heal_spans = [e for e in events if e["event"] == "span" and e["phase"] == "heal"]
+    assert heal_spans and heal_spans[0]["duration_ms"] >= 0
+    assert heal_spans[0]["step"] == 5  # healed to max_step

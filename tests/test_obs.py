@@ -1,0 +1,452 @@
+"""Observability subsystem tests: span tracing (obs/spans.py), goodput
+attribution (obs/report.py), the metrics event registry, and the
+lighthouse's Prometheus ``GET /metrics`` exposition scraped during a
+kill-and-heal run.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu.metrics import EVENTS, MetricsLogger
+from torchft_tpu.obs import report
+from torchft_tpu.obs.spans import PHASES, SpanTracker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_tracker_emits_spans_and_summary(tmp_path) -> None:
+    path = tmp_path / "spans.jsonl"
+    tracker = SpanTracker(MetricsLogger(str(path), replica_id="r0"), slice_gen=3)
+    with tracker.span("quorum", step=7) as sp:
+        time.sleep(0.01)
+    assert sp.duration_ms >= 5
+    with tracker.span("commit_vote", step=7, extra="x"):
+        pass
+    tracker.step_summary(7, committed=True)
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    spans = [e for e in events if e["event"] == "span"]
+    assert [s["phase"] for s in spans] == ["quorum", "commit_vote"]
+    assert all(s["step"] == 7 and s["slice_gen"] == 3 for s in spans)
+    assert spans[1]["extra"] == "x"
+    summary = events[-1]
+    assert summary["event"] == "step_summary" and summary["committed"] is True
+    assert set(summary["phases"]) == {"quorum", "commit_vote"}
+    assert summary["accounted_ms"] == pytest.approx(
+        sum(s["duration_ms"] for s in spans), abs=0.01
+    )
+    # The accumulator reset: a second summary carries only new phases.
+    with tracker.span("heal", step=8):
+        pass
+    tracker.step_summary(8, committed=False)
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    assert set(events[-1]["phases"]) == {"heal"}
+
+
+def test_span_records_failure(tmp_path) -> None:
+    """A phase that raises still lands in the trace, marked ok: false —
+    a hung-then-failed quorum must show its real duration."""
+    path = tmp_path / "spans.jsonl"
+    tracker = SpanTracker(MetricsLogger(str(path)), slice_gen=0)
+    with pytest.raises(RuntimeError):
+        with tracker.span("quorum", step=1):
+            raise RuntimeError("boom")
+    ev = json.loads(path.read_text().splitlines()[-1])
+    assert ev["event"] == "span" and ev["ok"] is False
+    assert ev["duration_ms"] >= 0
+
+
+def test_phases_registry_is_stable() -> None:
+    """report.py buckets and the Manager call sites key off these names."""
+    assert PHASES == ("quorum", "configure", "heal", "allreduce_merge", "commit_vote")
+
+
+# ---------------------------------------------------------------------------
+# Event registry static check
+# ---------------------------------------------------------------------------
+
+
+def test_every_emit_call_site_is_registered() -> None:
+    """Greps every ``.emit("name", ...)`` call site in the package (and
+    bench.py) against metrics.EVENTS so a new event cannot ship
+    undocumented.  Registered-but-unused names are allowed (consumers may
+    predate their producers during a refactor)."""
+    roots = [os.path.join(REPO, "torchft_tpu"), os.path.join(REPO, "bench.py")]
+    pat = re.compile(r"\.emit\(\s*\n?\s*\"([a-zA-Z0-9_]+)\"")
+    emitted = {}
+    for root in roots:
+        files = []
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            for dirpath, _, names in os.walk(root):
+                files += [
+                    os.path.join(dirpath, n) for n in names if n.endswith(".py")
+                ]
+        for f in files:
+            with open(f, "r", encoding="utf-8") as fh:
+                for name in pat.findall(fh.read()):
+                    emitted.setdefault(name, []).append(os.path.relpath(f, REPO))
+    assert emitted, "grep found no emit() call sites — pattern rot?"
+    unregistered = {n: fs for n, fs in emitted.items() if n not in EVENTS}
+    assert not unregistered, (
+        f"emit() call sites using event names missing from "
+        f"torchft_tpu.metrics.EVENTS: {unregistered}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Report: attribution + CLI
+# ---------------------------------------------------------------------------
+
+
+def _write_jsonl(path, events) -> str:
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+def _synthetic_stream():
+    """Two replicas, three committed steps; replica B pays a 2 s heal on
+    step 2 and a long quorum wait on step 3 (1.0 s of compute per step)."""
+    events = []
+    for rid, start in (("0:a", 0.0), ("1:b", 0.1)):
+        mono = 100.0  # distinct per-process monotonic origin
+        ts = start
+        for step in (1, 2, 3):
+            heal_ms = 2000.0 if rid == "1:b" and step == 2 else 0.0
+            quorum_ms = 600.0 if rid == "1:b" and step == 3 else 50.0
+            wall = 1.0 + (heal_ms + quorum_ms) / 1e3
+            mono += wall
+            ts += wall
+            events.append(
+                {
+                    "ts": ts,
+                    "t_mono": mono,
+                    "replica_id": rid,
+                    "event": "commit",
+                    "step": step,
+                    "committed": True,
+                    "vote_ms": 5.0,
+                }
+            )
+            phases = {"quorum": quorum_ms, "commit_vote": 5.0}
+            if heal_ms:
+                phases["heal"] = heal_ms
+            events.append(
+                {
+                    "ts": ts + 0.001,
+                    "replica_id": rid,
+                    "event": "step_summary",
+                    "step": step,
+                    "committed": True,
+                    "phases": phases,
+                }
+            )
+    return events
+
+
+def test_attribute_builds_per_step_table(tmp_path) -> None:
+    events = _synthetic_stream()
+    result = report.attribute(events)
+    rows = {r["step"]: r for r in result["steps"]}
+    # Step 1 of each replica is the first commit — no interval yet; steps
+    # 2 and 3 attribute.
+    assert set(rows) == {2, 3}
+    # Step 2's slowest replica is 1:b (heal-dominated).
+    assert rows[2]["heal_s"] == pytest.approx(2.0, abs=0.05)
+    assert rows[2]["critical"] == "heal"
+    # Step 3's slowest replica is 1:b again, quorum-wait-dominated... but
+    # productive time (1.0 s compute) still exceeds the 0.6 s wait.
+    assert rows[3]["quorum_wait_s"] == pytest.approx(0.6, abs=0.05)
+    assert rows[3]["critical"] == "productive"
+    totals = result["totals"]
+    assert totals["heal_s"] == pytest.approx(2.0, abs=0.05)
+    assert totals["productive_s"] > 0
+    fr = result["fractions"]
+    assert fr["heal_fraction"] is not None and 0 < fr["heal_fraction"] < 1
+
+
+def test_attribute_merges_retried_step_summaries() -> None:
+    """A failed-then-retried commit vote summarizes the same step twice;
+    the committed interval spans both attempts, so their phases must ADD
+    — replacing would misattribute the first attempt's quorum wait as
+    productive time."""
+    events = [
+        {"ts": 1.0, "t_mono": 1.0, "replica_id": "0:a", "event": "commit",
+         "step": 1, "committed": True},
+        {"ts": 1.1, "replica_id": "0:a", "event": "step_summary", "step": 2,
+         "committed": False, "phases": {"quorum": 5000.0}},
+        {"ts": 8.0, "replica_id": "0:a", "event": "step_summary", "step": 2,
+         "committed": True, "phases": {"quorum": 100.0, "commit_vote": 5.0}},
+        {"ts": 8.1, "t_mono": 8.1, "replica_id": "0:a", "event": "commit",
+         "step": 2, "committed": True},
+        # A second group so t0/t_end cover the window.
+        {"ts": 1.0, "t_mono": 1.0, "replica_id": "1:b", "event": "commit",
+         "step": 1, "committed": True},
+        {"ts": 8.0, "t_mono": 8.0, "replica_id": "1:b", "event": "commit",
+         "step": 2, "committed": True},
+    ]
+    result = report.attribute(events)
+    row = next(r for r in result["steps"] if r["step"] == 2)
+    assert row["quorum_wait_s"] == pytest.approx(5.1, abs=0.01)
+
+
+def test_deadwindow_matches_bench_fixture(tmp_path) -> None:
+    """The report's goodput on a recorded stream (fault records included)
+    equals the arithmetic bench.py charges for the same timeline."""
+    events = []
+    for t in range(1, 41):
+        events.append(
+            {"ts": float(t), "replica_id": "0:a", "event": "commit", "committed": True}
+        )
+    for t in list(range(1, 11)) + list(range(18, 41)):
+        rid = "1:A" if t <= 10 else "1:B"
+        events.append(
+            {"ts": float(t), "replica_id": rid, "event": "commit", "committed": True}
+        )
+    events.append(
+        {"ts": 10.5, "replica_id": "bench-driver", "event": "fault",
+         "kind": "kill", "group": "1"}
+    )
+    path = _write_jsonl(tmp_path / "m.jsonl", events)
+    result = report.attribute(report.read_events([path]))
+    # Gap (10, 18) charged minus the 1 s median step over span 39.
+    assert result["goodput"]["dead_time_s"] == pytest.approx(7.0, abs=1e-6)
+    assert result["goodput"]["deadwindow_fraction"] == pytest.approx(
+        1 - 7.0 / 39.0, abs=1e-4
+    )
+    assert result["goodput"]["victims_recovered"] is True
+
+
+def test_report_cli_json_and_table(tmp_path) -> None:
+    path = _write_jsonl(tmp_path / "m.jsonl", _synthetic_stream())
+    out = subprocess.run(
+        [sys.executable, "-m", "torchft_tpu.obs.report", path, "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    result = json.loads(out.stdout)
+    assert {"steps", "totals", "fractions", "goodput"} <= set(result)
+    out2 = subprocess.run(
+        [sys.executable, "-m", "torchft_tpu.obs.report", path],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out2.returncode == 0, out2.stderr
+    assert "critical" in out2.stdout and "goodput (dead-window)" in out2.stdout
+
+
+# ---------------------------------------------------------------------------
+# tools/profile_step.py --json (device-side profile, machine-readable)
+# ---------------------------------------------------------------------------
+
+
+def test_profile_step_json_smoke(tmp_path) -> None:
+    """--json --trace parses a Chrome-trace fixture into the machine-readable
+    per-op report (no TPU needed), so device-side and runtime-side profiles
+    can be joined in one pipeline."""
+    import gzip
+
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "name": "thread_name", "pid": 1, "tid": 2,
+             "args": {"name": "XLA Ops"}},
+            {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.1", "dur": 4000,
+             "args": {"hlo_category": "convolution fusion",
+                      "bytes_accessed": 2_000_000_000}},
+            {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.1", "dur": 4000},
+            {"ph": "X", "pid": 1, "tid": 2, "name": "copy.7", "dur": 1000,
+             "args": {"hlo_category": "copy"}},
+        ]
+    }
+    path = tmp_path / "t.trace.json.gz"
+    with gzip.open(path, "wt") as f:
+        json.dump(trace, f)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profile_step.py"),
+         "--trace", str(path), "--steps", "2", "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["schema"] == 1 and rep["steps"] == 2
+    # (4000+4000+1000) us over 2 steps = 4.5 ms/step.
+    assert rep["device_total_ms_per_step"] == pytest.approx(4.5)
+    assert rep["ops"][0]["name"] == "fusion.1"
+    assert rep["ops"][0]["ms_per_step"] == pytest.approx(4.0)
+    assert rep["ops"][0]["gb_accessed"] == pytest.approx(2.0)
+    assert {c["op_class"] for c in rep["by_class"]} == {"fusion", "copy"}
+    # Human-readable mode still renders.
+    out2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profile_step.py"),
+         "--trace", str(path), "--steps", "2"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out2.returncode == 0, out2.stderr
+    assert "device ops total" in out2.stdout
+
+
+# ---------------------------------------------------------------------------
+# Lighthouse /metrics exposition (Prometheus text) under kill-and-heal
+# ---------------------------------------------------------------------------
+
+
+def _scrape(lighthouse) -> dict:
+    port = lighthouse.http_address().rsplit(":", 1)[1]
+    url = f"http://127.0.0.1:{port}/metrics"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    metrics = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_labels, _, value = line.rpartition(" ")
+        metrics[name_labels] = float(value)
+    assert metrics, f"no samples parsed from:\n{text}"
+    return metrics
+
+
+def test_lighthouse_metrics_during_kill_and_heal() -> None:
+    """Wire-level kill-and-heal against the real lighthouse, scraping
+    /metrics at each stage: healthy 2-group quorum -> one group SIGKILLed
+    (supervisor evict) -> replacement incarnation rejoins behind and heals
+    -> caught up.  The exposition must track quorum size, per-replica step
+    lag, tombstones, and the heal gauge through the whole arc."""
+    from torchft_tpu._native import LighthouseClient, LighthouseServer
+
+    server = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=300,
+        quorum_tick_ms=20, heartbeat_timeout_ms=5000,
+    )
+    try:
+        client = LighthouseClient(server.address())
+        client2 = LighthouseClient(server.address())
+
+        # Healthy steady state: both groups at step 5.  Heartbeat BOTH ids
+        # before joining so the split-brain guard deterministically holds
+        # the first joiner until the second arrives (2 of 2 join).
+        import threading
+
+        client.heartbeat("0:bbbb", step=5, state="step")
+        client.heartbeat("1:aaaa", step=5, state="step")
+        results = []
+        joiner = threading.Thread(
+            target=lambda: results.append(
+                client.quorum("1:aaaa", timeout_ms=10000, step=5)
+            )
+        )
+        joiner.start()
+        q = client2.quorum("0:bbbb", timeout_ms=10000, step=5)
+        joiner.join()
+        assert len(q.participants) == 2
+        m = _scrape(server)
+        assert m["tpuft_quorum_size"] == 2
+        assert m['tpuft_replica_step{replica="1:aaaa"}'] == 5
+        assert m['tpuft_replica_step_lag{replica="1:aaaa"}'] == 0
+        assert m["tpuft_replicas_tombstoned"] == 0
+        assert m["tpuft_heal_in_progress"] == 0
+
+        # Kill: the supervisor reaps 1:aaaa and evicts it.
+        assert client.evict("1") == 1
+        m = _scrape(server)
+        assert m["tpuft_replicas_tombstoned"] == 1
+        assert 'tpuft_replica_step{replica="1:aaaa"}' not in m
+
+        # Survivor advances; replacement incarnation rejoins behind, healing.
+        client.heartbeat("0:bbbb", step=8, state="step")
+        client.heartbeat("1:cccc", step=5, state="heal")
+        t0 = time.monotonic()
+        results2 = []
+        joiner2 = threading.Thread(
+            target=lambda: results2.append(
+                client.quorum("1:cccc", timeout_ms=10000, step=5)
+            )
+        )
+        joiner2.start()
+        q2 = client2.quorum("0:bbbb", timeout_ms=10000, step=8)
+        joiner2.join()
+        assert time.monotonic() - t0 < 5.0, "evict must beat heartbeat timeout"
+        assert len(q2.participants) == 2
+        m = _scrape(server)
+        assert m['tpuft_replica_step_lag{replica="1:cccc"}'] == 3
+        assert m["tpuft_heal_in_progress"] == 1
+        assert m["tpuft_quorum_size"] == 2
+
+        # Healed: caught up, lag back to zero.
+        client.heartbeat("1:cccc", step=8, state="step")
+        m = _scrape(server)
+        assert m['tpuft_replica_step_lag{replica="1:cccc"}'] == 0
+        assert m["tpuft_heal_in_progress"] == 0
+        # The step advance stamped a last-commit age for the healed group.
+        assert (
+            m['tpuft_replica_last_commit_age_seconds{replica="1:cccc"}'] < 60
+        )
+    finally:
+        server.shutdown()
+
+
+def test_manager_server_set_status_feeds_heartbeats() -> None:
+    """The Python-facing half of the pipeline: ManagerServer.set_status
+    rides the next heartbeat into the lighthouse's live view."""
+    from torchft_tpu._native import LighthouseServer, ManagerServer
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200, quorum_tick_ms=20
+    )
+    manager = None
+    try:
+        manager = ManagerServer(
+            replica_id="g0:uuid1",
+            lighthouse_addr=lighthouse.address(),
+            bind="127.0.0.1:0",
+            heartbeat_interval_ms=25,
+        )
+        manager.set_status(7, "step")
+        deadline = time.monotonic() + 5.0
+        m = {}
+        while time.monotonic() < deadline:
+            m = _scrape(lighthouse)
+            if m.get('tpuft_replica_step{replica="g0:uuid1"}') == 7:
+                break
+            time.sleep(0.05)
+        assert m.get('tpuft_replica_step{replica="g0:uuid1"}') == 7
+        # /status.json mirrors the same live view.
+        port = lighthouse.http_address().rsplit(":", 1)[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status.json", timeout=10
+        ) as resp:
+            status = json.loads(resp.read().decode())
+        assert status["replica_step"]["g0:uuid1"] == 7
+        assert status["replica_state"]["g0:uuid1"] == "step"
+        # A later advance stamps last_commit_ts_ms.
+        manager.set_status(8, "step")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status.json", timeout=10
+            ) as resp:
+                status = json.loads(resp.read().decode())
+            if status["replica_step"].get("g0:uuid1") == 8:
+                break
+            time.sleep(0.05)
+        assert status["replica_step"]["g0:uuid1"] == 8
+        assert "g0:uuid1" in status["last_commit_ts_ms"]
+    finally:
+        if manager is not None:
+            manager.shutdown()
+        lighthouse.shutdown()

@@ -54,6 +54,8 @@ MODULES = [
     "torchft_tpu.ops.ulysses",
     "torchft_tpu.coordination",
     "torchft_tpu.metrics",
+    "torchft_tpu.obs.spans",
+    "torchft_tpu.obs.report",
     "torchft_tpu.multihost",
     "torchft_tpu.launch",
     "torchft_tpu.lighthouse_cli",

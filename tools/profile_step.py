@@ -81,7 +81,13 @@ def capture(outdir: str, steps: int) -> str:
     return traces[-1]
 
 
-def report(trace_path: str, steps: int, top: int = 20) -> None:
+def build_report(trace_path: str, steps: int, top: int = 20) -> dict:
+    """Parses a Chrome-trace .json.gz into the op-time breakdown.
+
+    Machine-readable (--json prints exactly this): device-side and
+    runtime-side profiles can be joined in one report — obs/report.py
+    attributes the runtime phases, this gives the on-chip split of the
+    'productive' bucket."""
     with gzip.open(trace_path) as fh:
         trace = json.load(fh)
     events = trace["traceEvents"]
@@ -106,26 +112,67 @@ def report(trace_path: str, steps: int, top: int = 20) -> None:
                 args_of.setdefault(e["name"], e["args"])
 
     total = sum(durs.values())
-    print(f"device ops total: {total / steps / 1e3:.2f} ms/step "
-          f"({len(durs)} distinct ops, {steps} steps)")
-    print(f"\ntop {top} ops:")
+    ops = []
     for name, d in sorted(durs.items(), key=lambda kv: -kv[1])[:top]:
         a = args_of.get(name, {})
-        cat = a.get("hlo_category", "?")
-        gb = int(a.get("bytes_accessed", 0)) / 1e9
-        print(f"  {d / steps / 1e3:8.3f} ms/step  {gb:6.2f} GB  [{cat}]  {name[:50]}")
-
-    print("\nby op class:")
+        ops.append(
+            {
+                "name": name,
+                "ms_per_step": round(d / steps / 1e3, 4),
+                "gb_accessed": round(int(a.get("bytes_accessed", 0)) / 1e9, 3),
+                "category": a.get("hlo_category", "?"),
+            }
+        )
     classes: dict = collections.defaultdict(float)
     for n, d in durs.items():
         classes[re.sub(r"[.\d]+$", "", n)] += d
-    for n, d in sorted(classes.items(), key=lambda kv: -kv[1])[:12]:
-        print(f"  {d / steps / 1e3:8.3f} ms/step  {n}")
+    by_class = [
+        {"op_class": n, "ms_per_step": round(d / steps / 1e3, 4)}
+        for n, d in sorted(classes.items(), key=lambda kv: -kv[1])[:12]
+    ]
+    return {
+        "schema": 1,
+        "trace": trace_path,
+        "steps": steps,
+        "device_total_ms_per_step": round(total / steps / 1e3, 4),
+        "distinct_ops": len(durs),
+        "ops": ops,
+        "by_class": by_class,
+    }
+
+
+def print_report(rep: dict) -> None:
+    print(
+        f"device ops total: {rep['device_total_ms_per_step']:.2f} ms/step "
+        f"({rep['distinct_ops']} distinct ops, {rep['steps']} steps)"
+    )
+    print(f"\ntop {len(rep['ops'])} ops:")
+    for op in rep["ops"]:
+        print(
+            f"  {op['ms_per_step']:8.3f} ms/step  {op['gb_accessed']:6.2f} GB  "
+            f"[{op['category']}]  {op['name'][:50]}"
+        )
+    print("\nby op class:")
+    for c in rep["by_class"]:
+        print(f"  {c['ms_per_step']:8.3f} ms/step  {c['op_class']}")
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--outdir", default="/tmp/jaxprof_step")
+    ap.add_argument(
+        "--trace",
+        default=None,
+        help="parse an existing .trace.json.gz instead of capturing on-chip",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable report on stdout"
+    )
     args = ap.parse_args()
-    report(capture(args.outdir, args.steps), args.steps)
+    trace_path = args.trace or capture(args.outdir, args.steps)
+    rep = build_report(trace_path, args.steps)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print_report(rep)

@@ -113,6 +113,11 @@ def _load_lib() -> ctypes.CDLL:
     ]
     lib.tf_manager_address.restype = ctypes.c_void_p
     lib.tf_manager_address.argtypes = [ctypes.c_void_p]
+    lib.tf_manager_set_status.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+    ]
     lib.tf_manager_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_manager_free.argtypes = [ctypes.c_void_p]
     lib.tf_store_new.restype = ctypes.c_void_p
@@ -331,8 +336,18 @@ class LighthouseClient:
         )
         return resp.quorum
 
-    def heartbeat(self, replica_id: str, timeout_ms: int = 5000) -> None:
-        req = pb.LighthouseHeartbeatRequest(replica_id=replica_id)
+    def heartbeat(
+        self,
+        replica_id: str,
+        timeout_ms: int = 5000,
+        step: int = 0,
+        state: str = "",
+    ) -> None:
+        """One heartbeat; ``step``/``state`` feed the lighthouse's live
+        per-replica observability (GET /metrics step lag, /status.json)."""
+        req = pb.LighthouseHeartbeatRequest(
+            replica_id=replica_id, step=int(step), state=state
+        )
         self._client.call(LIGHTHOUSE_HEARTBEAT, req.SerializeToString(), timeout_ms)
 
     def evict(self, replica_prefix: str, timeout_ms: int = 5000) -> int:
@@ -405,6 +420,13 @@ class ManagerServer:
 
     def address(self) -> str:
         return _take_string(_lib.tf_manager_address(self._ptr))
+
+    def set_status(self, step: int, state: str) -> None:
+        """Pushes live (step, state) into the heartbeat payload so the
+        lighthouse's ``GET /metrics`` and ``/status.json`` show per-replica
+        progress in real time (see docs/wire.md, Heartbeat fields)."""
+        if self._ptr:
+            _lib.tf_manager_set_status(self._ptr, int(step), state.encode())
 
     def shutdown(self) -> None:
         if self._ptr:

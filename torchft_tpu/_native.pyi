@@ -70,7 +70,13 @@ class LighthouseClient:
         shrink_only: bool = ...,
         data: Optional[Dict[str, Any]] = ...,
     ) -> Any: ...  # pb.Quorum
-    def heartbeat(self, replica_id: str, timeout_ms: int = ...) -> None: ...
+    def heartbeat(
+        self,
+        replica_id: str,
+        timeout_ms: int = ...,
+        step: int = ...,
+        state: str = ...,
+    ) -> None: ...
     def evict(self, replica_prefix: str, timeout_ms: int = ...) -> int: ...
     def drain(
         self, replica_prefix: str, deadline_ms: int = ..., timeout_ms: int = ...
@@ -89,6 +95,7 @@ class ManagerServer:
         connect_timeout_ms: int = ...,
     ) -> None: ...
     def address(self) -> str: ...
+    def set_status(self, step: int, state: str) -> None: ...
     def shutdown(self) -> None: ...
 
 class ManagerClient:

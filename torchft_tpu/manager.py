@@ -235,8 +235,13 @@ class Manager:
         self._logger = _ManagerLogger(self, self._replica_id, self._rank)
         # JSONL event stream when TPUFT_METRICS_PATH is set (no-op otherwise).
         from torchft_tpu.metrics import MetricsLogger
+        from torchft_tpu.obs.spans import SpanTracker
 
         self._metrics = MetricsLogger.from_env(self._replica_id)
+        # Step-scoped trace spans over the same stream (obs/spans.py): each
+        # phase below runs inside a span, and the span's single monotonic
+        # measurement also feeds the legacy *_ms fields.
+        self._spans = SpanTracker(self._metrics)
 
     # -- registration -------------------------------------------------------
 
@@ -312,16 +317,17 @@ class Manager:
         metadata = (
             self._checkpoint_transport.metadata() if self._checkpoint_transport else ""
         )
-        t_quorum = time.monotonic()
-        quorum = self._client._quorum(
-            group_rank=self._rank,
-            step=self._step,
-            checkpoint_metadata=metadata,
-            shrink_only=shrink_only,
-            timeout_ms=int(quorum_timeout.total_seconds() * 1000),
-            init_sync=self._init_sync,
-            commit_failures=self._commit_failures,
-        )
+        self._set_status("quorum")
+        with self._spans.span("quorum", step=self._step) as sp_quorum:
+            quorum = self._client._quorum(
+                group_rank=self._rank,
+                step=self._step,
+                checkpoint_metadata=metadata,
+                shrink_only=shrink_only,
+                timeout_ms=int(quorum_timeout.total_seconds() * 1000),
+                init_sync=self._init_sync,
+                commit_failures=self._commit_failures,
+            )
 
         quorum_id = quorum.quorum_id
         replica_rank = quorum.replica_rank
@@ -363,9 +369,9 @@ class Manager:
             replica_world_size=replica_world_size,
             participating=self._participating_replica_world_size,
             heal=heal,
-            # Span durations make the stream a trace: where a slow step
+            # Same measurement the span record carries: where a slow step
             # went (quorum wait vs reconfigure vs heal) without a profiler.
-            quorum_ms=round((time.monotonic() - t_quorum) * 1e3, 3),
+            quorum_ms=sp_quorum.duration_ms,
         )
 
         if quorum_id != self._quorum_id:
@@ -376,17 +382,18 @@ class Manager:
                 f"reconfiguring collective for quorum {quorum_id} "
                 f"(rank {replica_rank}/{replica_world_size})"
             )
-            t_cfg = time.monotonic()
-            self._collective.configure(
-                f"{store_address}/{prefix}", replica_rank, replica_world_size
-            )
+            with self._spans.span("configure", step=self._step) as sp_cfg:
+                self._collective.configure(
+                    f"{store_address}/{prefix}", replica_rank, replica_world_size
+                )
             self._quorum_id = quorum_id
             self._metrics.emit(
                 "reconfigure",
+                step=self._step,
                 quorum_id=quorum_id,
                 replica_rank=replica_rank,
                 replica_world_size=replica_world_size,
-                configure_ms=round((time.monotonic() - t_cfg) * 1e3, 3),
+                configure_ms=sp_cfg.duration_ms,
             )
 
         if allow_heal and self._checkpoint_transport is not None:
@@ -413,32 +420,40 @@ class Manager:
                     f"({quorum.recover_src_manager_address}) at step {max_step}"
                 )
                 self._metrics.emit("heal_start", src_rank=src_rank, max_step=max_step)
-                t_heal = time.monotonic()
-                src_client = self._manager_client_factory(
-                    quorum.recover_src_manager_address,
-                    connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
-                )
-                src_metadata = src_client._checkpoint_metadata(
-                    self._rank, timeout_ms=int(self._timeout.total_seconds() * 1000)
-                )
-                src_client.close()
-                state = self._checkpoint_transport.recv_checkpoint(
-                    src_rank=src_rank,
-                    metadata=src_metadata,
-                    step=max_step,
-                    timeout=self._timeout.total_seconds(),
-                )
-                self._pending_state_dict = cast(Dict[str, object], state)
-                # Fast-forward to the healed step (torchft/manager.py:562-568).
-                self._step = max_step
+                self._set_status("heal")
+                with self._spans.span(
+                    "heal", step=max_step, src_rank=src_rank
+                ) as sp_heal:
+                    src_client = self._manager_client_factory(
+                        quorum.recover_src_manager_address,
+                        connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
+                    )
+                    src_metadata = src_client._checkpoint_metadata(
+                        self._rank, timeout_ms=int(self._timeout.total_seconds() * 1000)
+                    )
+                    src_client.close()
+                    state = self._checkpoint_transport.recv_checkpoint(
+                        src_rank=src_rank,
+                        metadata=src_metadata,
+                        step=max_step,
+                        timeout=self._timeout.total_seconds(),
+                    )
+                    self._pending_state_dict = cast(Dict[str, object], state)
+                    # Fast-forward to the healed step (torchft/manager.py:562-568).
+                    self._step = max_step
                 self._metrics.emit(
                     "heal_fetched",
                     src_rank=src_rank,
                     step=max_step,
-                    heal_ms=round((time.monotonic() - t_heal) * 1e3, 3),
+                    heal_ms=sp_heal.duration_ms,
                 )
         elif heal:
             self._healing = True
+
+        # Quorum (and any heal fetch) resolved: the group is training until
+        # the commit vote — without this the async-quorum overlap leaves the
+        # replica labeled "quorum"/"heal" for the whole compute phase.
+        self._set_status("step")
 
     def _manager_state_dict(self) -> Dict[str, object]:
         """Full transferable state: user trees + manager bookkeeping
@@ -561,6 +576,22 @@ class Manager:
         torchft/manager.py:95-97)."""
         return self._timeout
 
+    # -- status -------------------------------------------------------------
+
+    def _set_status(self, state: str) -> None:
+        """Pushes (step, state) into this group's native ManagerServer so its
+        lighthouse heartbeats carry live per-replica progress — the feed for
+        the lighthouse's ``GET /metrics`` exposition and the dashboard's
+        step-lag column.  Rank != 0 has no server; best-effort by design
+        (status must never fail a step)."""
+        srv = self._manager_server
+        if srv is None:
+            return
+        try:
+            srv.set_status(self._step, state)
+        except Exception:  # noqa: BLE001
+            pass
+
     # -- error handling -----------------------------------------------------
 
     def report_error(self, e: Exception) -> None:
@@ -577,13 +608,16 @@ class Manager:
     def should_commit(self, timeout: Optional[timedelta] = None) -> bool:
         """Two-phase commit vote across all local ranks of the group
         (reference: torchft/manager.py:587-663)."""
-        # Drain pending allreduces; their errors are already latched.
-        for work in self._pending_work:
-            try:
-                work.result()
-            except Exception:  # noqa: BLE001
-                pass
-        self._pending_work = []
+        # Drain pending allreduces; their errors are already latched.  The
+        # span is the merge wait: how long commit time blocked on gradient
+        # traffic the step's compute did not already hide.
+        with self._spans.span("allreduce_merge", step=self._step):
+            for work in self._pending_work:
+                try:
+                    work.result()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._pending_work = []
 
         if self._collective.errored() is not None:
             self.report_error(cast(Exception, self._collective.errored()))
@@ -593,26 +627,28 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
-        t_vote = time.monotonic()
-        should_commit = self._client.should_commit(
-            self._rank,
-            self._step,
-            local_should_commit,
-            timeout_ms=int((timeout or self._timeout).total_seconds() * 1000),
-        )
+        vote_step = self._step
+        with self._spans.span("commit_vote", step=vote_step) as sp_vote:
+            should_commit = self._client.should_commit(
+                self._rank,
+                vote_step,
+                local_should_commit,
+                timeout_ms=int((timeout or self._timeout).total_seconds() * 1000),
+            )
         self._logger.info(
             f"should_commit={should_commit} (local={local_should_commit}, "
             f"enough_replicas={enough_replicas}, error={self._errored})"
         )
         self._metrics.emit(
             "commit",
-            step=self._step,
+            step=vote_step,
             committed=should_commit,
             local=local_should_commit,
             participants=self.num_participants(),
             error=repr(self._errored) if self._errored else None,
-            vote_ms=round((time.monotonic() - t_vote) * 1e3, 3),
+            vote_ms=sp_vote.duration_ms,
         )
+        self._spans.step_summary(vote_step, committed=should_commit)
 
         if self._checkpoint_transport is not None:
             # Weights are about to be mutated: stop serving the stale
@@ -623,6 +659,7 @@ class Manager:
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
+            self._set_status("step")
         else:
             self._commit_failures += 1
             if self._max_retries is not None and self._commit_failures > self._max_retries:
@@ -673,6 +710,7 @@ class Manager:
             source=notice.source,
             deadline_ms=notice.deadline_ms_from_now(),
         )
+        self._set_status("draining")
         # Rank 0 owns the group's lighthouse relationship; other local
         # ranks observe the same notice via their own watcher/launcher
         # channel and simply stop stepping.  The RPC runs on its own

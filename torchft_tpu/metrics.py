@@ -8,10 +8,20 @@ commit decided, error latched — so goodput/recovery analyses read an event
 stream instead of grepping log strings (the failure mode VERDICT r2 #6
 flagged in the kill benchmark).
 
-Format: one JSON object per line, always containing ``ts`` (unix seconds),
+Format: one JSON object per line, always containing ``schema`` (record
+schema version, currently 1), ``ts`` (unix seconds), ``t_mono`` (monotonic
+seconds — duration math in tools/report must use this so it survives NTP
+steps mid-run; ``ts`` is for humans and cross-host alignment only),
 ``replica_id`` and ``event``; remaining keys are event-specific.  Writes are
 append-only, lock-serialized, and never raise into the train loop — metrics
 must not be able to fail a step.
+
+Every event name the runtime emits is declared in :data:`EVENTS`; emitting
+an unregistered name still writes the record but flags it
+``unregistered: true`` so consumers (obs/report.py) can surface schema
+drift instead of silently ignoring unknown data.  A static test
+(tests/test_obs.py) greps the ``emit(`` call sites against the registry so
+new events cannot ship undocumented.
 """
 
 from __future__ import annotations
@@ -22,9 +32,39 @@ import threading
 import time
 from typing import Any, Optional
 
-__all__ = ["MetricsLogger", "METRICS_PATH_ENV"]
+__all__ = ["MetricsLogger", "METRICS_PATH_ENV", "EVENTS", "SCHEMA_VERSION"]
 
 METRICS_PATH_ENV = "TPUFT_METRICS_PATH"
+
+# Version of the record layout (the always-present keys above).  Bump when
+# a required key changes meaning; event-specific keys may grow freely.
+SCHEMA_VERSION = 1
+
+# Registry of every event name the runtime emits: name -> one-line meaning.
+# obs/report.py keys its attribution off these; the static check in
+# tests/test_obs.py fails if an emit() call site names an event that is not
+# here.
+EVENTS = {
+    # -- Manager step lifecycle (torchft_tpu/manager.py) --------------------
+    "quorum": "quorum result for a step (membership, participation, quorum_ms)",
+    "reconfigure": "cross-group collective rebuilt for a new quorum id",
+    "heal_start": "this replica began fetching weights from a peer",
+    "heal_fetched": "healed state dict received (heal_ms = fetch duration)",
+    "error": "an error was latched for the current step",
+    "commit": "two-phase commit vote decided (committed, vote_ms)",
+    # -- spans (torchft_tpu/obs/spans.py) -----------------------------------
+    "span": "begin/end-measured phase of one step (phase, duration_ms)",
+    "step_summary": "per-step phase breakdown emitted after the commit vote",
+    # -- cooperative drain (torchft_tpu/drain, manager.py, launch.py) -------
+    "drain_notice": "drain notice received; finishing the in-flight step",
+    "drain_complete": "cooperative departure finished cleanly",
+    "drain_handoff": "launcher handed the draining group's id to a spare",
+    "drain_donor_exit": "draining donor process exited",
+    # -- fault injection (bench.py) -----------------------------------------
+    "fault": "scripted fault fired (kind=kill|drain, group=victim) — written "
+             "by the benchmark driver so obs/report.py sees the same fault "
+             "timeline the goodput accounting charges",
+}
 
 
 class MetricsLogger:
@@ -56,7 +96,15 @@ class MetricsLogger:
     def emit(self, event: str, **fields: Any) -> None:
         if self._file is None:
             return
-        record = {"ts": time.time(), "replica_id": self._replica_id, "event": event}
+        record = {
+            "schema": SCHEMA_VERSION,
+            "ts": time.time(),
+            "t_mono": time.monotonic(),
+            "replica_id": self._replica_id,
+            "event": event,
+        }
+        if event not in EVENTS:
+            record["unregistered"] = True
         record.update(fields)
         try:
             line = (json.dumps(record, default=str) + "\n").encode()

@@ -1,0 +1,28 @@
+"""Observability: step-scoped tracing and goodput attribution.
+
+Two halves:
+
+- :mod:`torchft_tpu.obs.spans` — the *producer* side.  ``SpanTracker``
+  wraps each Manager step phase (quorum, configure, heal, allreduce-merge,
+  commit vote) in begin/end spans keyed by ``(slice_gen, step,
+  replica_id)`` with monotonic-clock durations, emitted through
+  :class:`~torchft_tpu.metrics.MetricsLogger` as versioned ``span``
+  records, plus one ``step_summary`` record per step carrying the full
+  phase breakdown.
+
+- :mod:`torchft_tpu.obs.report` — the *consumer* side.  Merges every
+  replica's JSONL stream into a per-step cluster timeline, classifies wall
+  time into productive / quorum-wait / heal / drain / idle, names the
+  critical-path phase per step, and computes the dead-window goodput
+  fraction.  ``bench.py`` calls the same functions, so the benchmark
+  headline and the report tool cannot drift apart.  CLI::
+
+      python -m torchft_tpu.obs.report metrics.jsonl [...]
+
+The third leg — live cluster metrics — is served by the native lighthouse
+(``GET /metrics``, Prometheus text exposition; see docs/wire.md).
+"""
+
+from torchft_tpu.obs.spans import SpanTracker
+
+__all__ = ["SpanTracker"]

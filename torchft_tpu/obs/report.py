@@ -1,0 +1,430 @@
+"""Goodput attribution: merge per-replica JSONL streams into a per-step
+cluster timeline and say where the wall-clock went.
+
+CLI::
+
+    python -m torchft_tpu.obs.report metrics.jsonl [more.jsonl ...] [--json]
+
+Input is the event stream documented in torchft_tpu/metrics.py (all
+replicas may share one file — O_APPEND keeps lines atomic — or each may
+have its own).  Output:
+
+- a per-step phase attribution table: for every committed step, the
+  slowest replica's wall time split into productive compute vs the FT
+  phases (quorum wait, configure, heal, allreduce merge, commit vote) and
+  the critical-path phase — the bucket that dominated the slowest replica;
+- cluster totals: wall time classified productive / quorum-wait / heal /
+  drain / idle per group and summed;
+- the dead-window goodput fraction, computed by :func:`deadwindow` — the
+  SAME function ``bench.py`` calls for its headline, so the benchmark
+  number and this report cannot drift apart (pinned by
+  tests/test_bench_contract.py).
+
+Timing discipline: durations inside one replica's stream use ``t_mono``
+(NTP-step-immune); cross-replica alignment (t0, spans, gaps between
+incarnations — which never share a monotonic origin) uses ``ts``.
+
+Faults are part of the stream: bench.py writes a ``fault`` record (kind
+kill|drain, group=victim) at injection time, so this tool charges the same
+fault timeline the benchmark charged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "read_events",
+    "commit_timelines",
+    "fault_times",
+    "deadwindow",
+    "attribute",
+    "render",
+]
+
+
+def read_events(paths: Sequence[str]) -> List[dict]:
+    """Reads + merges JSONL streams, sorted by wall-clock ``ts``.
+    Unparseable lines (torn writes) are skipped."""
+    events: List[dict] = []
+    for path in paths:
+        try:
+            with open(path, "rb") as f:
+                for line in f:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+    events.sort(key=lambda ev: float(ev.get("ts", 0.0)))
+    return events
+
+
+def _group(replica_id: str) -> str:
+    """Replica ids are "<group>:<uuid>" with a fresh uuid per incarnation;
+    the group prefix is the stable identity."""
+    return str(replica_id).split(":", 1)[0]
+
+
+def commit_timelines(events: Sequence[dict]) -> Dict[str, List[float]]:
+    """{group: sorted committed-commit ts list} across all incarnations."""
+    commits: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("event") == "commit" and ev.get("committed"):
+            commits.setdefault(_group(ev.get("replica_id", "")), []).append(
+                float(ev["ts"])
+            )
+    for ts_list in commits.values():
+        ts_list.sort()
+    return commits
+
+
+def fault_times(events: Sequence[dict]) -> List[Tuple[float, str]]:
+    """[(ts, victim group)] from ``fault`` records (written by bench.py)."""
+    return [
+        (float(ev["ts"]), str(ev.get("group", "")))
+        for ev in events
+        if ev.get("event") == "fault"
+    ]
+
+
+def _fault_records(events: Sequence[dict]) -> List[dict]:
+    return [ev for ev in events if ev.get("event") == "fault"]
+
+
+def deadwindow(
+    commits: Dict[str, List[float]], kills: Sequence[Tuple[float, str]]
+) -> dict:
+    """Dead-window goodput accounting (the benchmark headline).
+
+    Over the window [t0, t_end] — t0 = the first moment EVERY group has
+    committed (startup JIT excluded), t_end = the last commit — each
+    killed group's commit gaps that contain >= 1 kill are charged as dead
+    time, minus one median step interval (the step it would have taken
+    anyway), and goodput = 1 - dead/span.  Insensitive to host-load rate
+    drift, handles single/double/during-heal kills identically
+    (overlapping kills land in one longer gap).
+
+    Returns dead_time_s/fraction None (victims_recovered False) when a
+    killed group never commits after its last kill — that trial measured
+    an unrecovered victim, not goodput.
+    """
+    if not commits:
+        return {
+            "t0": None, "t_end": None, "span_s": None, "dead_time_s": None,
+            "fraction": None, "victims_recovered": False,
+        }
+    t0 = max(min(ts_list) for ts_list in commits.values())
+    t_end = max(max(ts_list) for ts_list in commits.values())
+    span = t_end - t0
+    dead_total = 0.0
+    victims_recovered = True
+    for g in {grp for _, grp in kills}:
+        g_kills = sorted(ts for ts, grp in kills if grp == g)
+        cs = sorted(commits.get(g, []))
+        if not cs or max(cs) < max(g_kills):
+            victims_recovered = False  # never committed after its kill
+            continue
+        steps_iv = [b - a for a, b in zip(cs, cs[1:])]
+        med = sorted(steps_iv)[len(steps_iv) // 2] if steps_iv else 0.0
+        for a, b in zip(cs, cs[1:]):
+            if any(a <= k < b for k in g_kills):
+                dead_total += max(0.0, (b - a) - med)
+    fraction = None
+    if kills and span > 0 and victims_recovered:
+        fraction = max(0.0, 1.0 - dead_total / span)
+    return {
+        "t0": t0,
+        "t_end": t_end,
+        "span_s": span,
+        "dead_time_s": dead_total if kills else None,
+        "fraction": fraction,
+        "victims_recovered": victims_recovered if kills else True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Per-step attribution
+# ---------------------------------------------------------------------------
+
+# Phase ms a legacy (pre-span) stream carries on its lifecycle events,
+# mapped onto span phase names so old recordings still attribute.
+_LEGACY_MS = {
+    "quorum": ("quorum", "quorum_ms"),
+    "reconfigure": ("configure", "configure_ms"),
+    "heal_fetched": ("heal", "heal_ms"),
+    "commit": ("commit_vote", "vote_ms"),
+}
+
+
+def _phase_ms(events: Sequence[dict]) -> Dict[Tuple[str, int], Dict[str, float]]:
+    """{(replica_id, step): {phase: ms}} from span records, falling back to
+    the legacy *_ms fields when a stream predates spans.  step_summary is
+    authoritative when present (it is the flushed accumulation)."""
+    spans: Dict[Tuple[str, int], Dict[str, float]] = {}
+    summarized: set = set()
+    for ev in events:
+        rid = str(ev.get("replica_id", ""))
+        kind = ev.get("event")
+        if kind == "step_summary" and isinstance(ev.get("phases"), dict):
+            key = (rid, int(ev.get("step", -1)))
+            if key in summarized:
+                # A failed-then-retried commit vote summarizes the same step
+                # twice; the committed interval's wall spans both attempts,
+                # so their phases ADD (replacing would misattribute the
+                # first attempt's waits as productive time).
+                d = spans.setdefault(key, {})
+                for k, v in ev["phases"].items():
+                    d[k] = d.get(k, 0.0) + float(v)
+            else:
+                # First summary supersedes the raw spans already
+                # accumulated for this key — they are the same
+                # measurements, flushed.
+                spans[key] = {k: float(v) for k, v in ev["phases"].items()}
+                summarized.add(key)
+        elif kind == "span":
+            key = (rid, int(ev.get("step", -1)))
+            if key in summarized:
+                continue
+            d = spans.setdefault(key, {})
+            phase = str(ev.get("phase", "?"))
+            d[phase] = d.get(phase, 0.0) + float(ev.get("duration_ms", 0.0))
+        elif kind in _LEGACY_MS:
+            phase, field = _LEGACY_MS[kind]
+            if ev.get(field) is None:
+                continue
+            key = (rid, int(ev.get("step", ev.get("max_step", -1))))
+            if key in summarized:
+                continue
+            d = spans.setdefault(key, {})
+            # Spans supersede the legacy duplicates of the same phase: the
+            # Manager emits both (span record + legacy event) from ONE
+            # measurement, so take max instead of summing.
+            d[phase] = max(d.get(phase, 0.0), float(ev[field]))
+    return spans
+
+
+def attribute(events: Sequence[dict]) -> dict:
+    """Builds the per-step cluster attribution.
+
+    Returns ``{"steps": [row...], "totals": {...}, "goodput": {...}}``.
+    Each row: ``step``, ``replicas`` (committing that step), ``wall_s``
+    (slowest replica's commit-to-commit interval), per-phase seconds of
+    that slowest replica, ``productive_s`` (wall minus FT phases) and
+    ``critical`` — the dominating bucket.
+
+    Totals classify every group's [t0, t_end] wall time into productive /
+    quorum_wait / heal / drain / idle: step intervals split by their phase
+    breakdown; gaps between incarnations (or commit gaps containing a
+    fault) are idle, or drain when a drain fault falls inside.
+    """
+    commits = commit_timelines(events)
+    faults = fault_times(events)
+    dw = deadwindow(commits, faults)
+    phase_ms = _phase_ms(events)
+
+    # Per-incarnation commit sequences: (rid, [(ts, t_mono, step)...]).
+    per_inc: Dict[str, List[Tuple[float, float, int]]] = {}
+    for ev in events:
+        if ev.get("event") == "commit" and ev.get("committed"):
+            per_inc.setdefault(str(ev.get("replica_id", "")), []).append(
+                (
+                    float(ev["ts"]),
+                    float(ev.get("t_mono", ev["ts"])),
+                    int(ev.get("step", -1)),
+                )
+            )
+
+    steps: Dict[int, List[dict]] = {}
+    totals = {
+        "productive_s": 0.0,
+        "quorum_wait_s": 0.0,
+        "heal_s": 0.0,
+        "other_ft_s": 0.0,
+        "drain_s": 0.0,
+        "idle_s": 0.0,
+    }
+    t0 = dw["t0"]
+    for rid, seq in per_inc.items():
+        seq.sort()
+        for (ts_a, mono_a, _), (ts_b, mono_b, step) in zip(seq, seq[1:]):
+            if t0 is not None and ts_b < t0:
+                continue  # startup, outside the measured window
+            # Same process: monotonic delta is the trustworthy duration.
+            wall = max(0.0, mono_b - mono_a)
+            phases = phase_ms.get((rid, step), {})
+            q = phases.get("quorum", 0.0) / 1e3
+            heal = phases.get("heal", 0.0) / 1e3
+            other_ft = (
+                sum(v for k, v in phases.items() if k not in ("quorum", "heal"))
+                / 1e3
+            )
+            productive = max(0.0, wall - q - heal - other_ft)
+            buckets = {
+                "productive": productive,
+                "quorum_wait": q,
+                "heal": heal,
+                **{k: v / 1e3 for k, v in phases.items()
+                   if k not in ("quorum", "heal")},
+            }
+            critical = max(buckets, key=lambda k: buckets[k]) if wall > 0 else "-"
+            steps.setdefault(step, []).append(
+                {
+                    "replica_id": rid,
+                    "wall_s": wall,
+                    "quorum_wait_s": q,
+                    "heal_s": heal,
+                    "other_ft_s": other_ft,
+                    "productive_s": productive,
+                    "critical": critical,
+                }
+            )
+            totals["productive_s"] += productive
+            totals["quorum_wait_s"] += q
+            totals["heal_s"] += heal
+            totals["other_ft_s"] += other_ft
+
+    # A restarted incarnation's heal span lies BEFORE its first commit, so
+    # no commit interval covers it; credit it to the heal class (carved
+    # out of that group's gap below) instead of leaving it in idle.
+    first_commit_heal: Dict[str, float] = {}
+    for rid, seq in per_inc.items():
+        if not seq:
+            continue
+        ts_first, _, step_first = seq[0]
+        if t0 is not None and ts_first >= t0:
+            h = phase_ms.get((rid, step_first), {}).get("heal", 0.0) / 1e3
+            if h:
+                g = _group(rid)
+                first_commit_heal[g] = first_commit_heal.get(g, 0.0) + h
+
+    # Idle / drain: per group, wall time in [t0, t_end] not covered by
+    # intra-incarnation step intervals — restart windows and fault gaps.
+    # A gap belonging to a group whose only faults were drains is planned
+    # departure cost ("drain"); everything else is dead time ("idle").
+    if t0 is not None:
+        drain_groups = {
+            str(ev.get("group", ""))
+            for ev in _fault_records(events)
+            if str(ev.get("kind")) == "drain"
+        }
+        kill_groups = {
+            str(ev.get("group", ""))
+            for ev in _fault_records(events)
+            if str(ev.get("kind")) != "drain"
+        }
+        for g, ts_list in commits.items():
+            covered = 0.0
+            for rid, seq in per_inc.items():
+                if _group(rid) != g:
+                    continue
+                for (ts_a, _, _), (ts_b, _, _) in zip(seq, seq[1:]):
+                    a = max(ts_a, t0)
+                    if ts_b > a:
+                        covered += ts_b - a
+            group_span = max(0.0, dw["t_end"] - max(t0, min(ts_list)))
+            gap = max(0.0, group_span - covered)
+            heal_in_gap = min(gap, first_commit_heal.get(g, 0.0))
+            totals["heal_s"] += heal_in_gap
+            gap -= heal_in_gap
+            if g in drain_groups and g not in kill_groups:
+                totals["drain_s"] += gap
+            else:
+                totals["idle_s"] += gap
+
+    rows = []
+    for step in sorted(steps):
+        reps = steps[step]
+        slowest = max(reps, key=lambda r: r["wall_s"])
+        rows.append(
+            {
+                "step": step,
+                "replicas": len(reps),
+                "wall_s": round(slowest["wall_s"], 4),
+                "productive_s": round(slowest["productive_s"], 4),
+                "quorum_wait_s": round(slowest["quorum_wait_s"], 4),
+                "heal_s": round(slowest["heal_s"], 4),
+                "other_ft_s": round(slowest["other_ft_s"], 4),
+                "critical": slowest["critical"],
+            }
+        )
+
+    accounted = sum(
+        totals[k] for k in
+        ("productive_s", "quorum_wait_s", "heal_s", "drain_s", "idle_s",
+         "other_ft_s")
+    )
+    fractions = {
+        k.replace("_s", "_fraction"): (round(v / accounted, 4) if accounted else None)
+        for k, v in totals.items()
+    }
+    return {
+        "steps": rows,
+        "totals": {k: round(v, 3) for k, v in totals.items()},
+        "fractions": fractions,
+        "goodput": {
+            "deadwindow_fraction": (
+                round(dw["fraction"], 4) if dw["fraction"] is not None else None
+            ),
+            "dead_time_s": (
+                round(dw["dead_time_s"], 3) if dw["dead_time_s"] is not None else None
+            ),
+            "span_s": round(dw["span_s"], 3) if dw["span_s"] is not None else None,
+            "victims_recovered": dw["victims_recovered"],
+            "faults": len(faults),
+        },
+    }
+
+
+def render(result: dict, out=sys.stdout) -> None:
+    """Human-readable attribution table + goodput summary."""
+    w = out.write
+    w(
+        f"{'step':>6} {'reps':>4} {'wall_s':>8} {'product':>8} "
+        f"{'quorum':>8} {'heal':>8} {'other_ft':>8}  critical\n"
+    )
+    for r in result["steps"]:
+        w(
+            f"{r['step']:>6} {r['replicas']:>4} {r['wall_s']:>8.3f} "
+            f"{r['productive_s']:>8.3f} {r['quorum_wait_s']:>8.3f} "
+            f"{r['heal_s']:>8.3f} {r['other_ft_s']:>8.3f}  {r['critical']}\n"
+        )
+    t = result["totals"]
+    w("\ntotals (s): " + "  ".join(f"{k}={v}" for k, v in t.items()) + "\n")
+    f = result["fractions"]
+    w("fractions:  " + "  ".join(f"{k}={v}" for k, v in f.items()) + "\n")
+    g = result["goodput"]
+    w(
+        f"\ngoodput (dead-window): fraction={g['deadwindow_fraction']} "
+        f"dead_time_s={g['dead_time_s']} span_s={g['span_s']} "
+        f"faults={g['faults']} victims_recovered={g['victims_recovered']}\n"
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m torchft_tpu.obs.report",
+        description="Per-step goodput attribution from tpu-ft metrics JSONL",
+    )
+    ap.add_argument("paths", nargs="+", help="metrics.jsonl file(s)")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+    events = read_events(args.paths)
+    if not events:
+        print("no events parsed", file=sys.stderr)
+        return 1
+    result = attribute(events)
+    if args.json:
+        json.dump(result, sys.stdout)
+        print()
+    else:
+        render(result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,124 @@
+"""Step-scoped tracing: begin/end spans for Manager step phases.
+
+Turns the flat metrics event stream into a distributed trace without a
+tracing dependency: each phase of a step runs inside ``SpanTracker.span``,
+which measures a monotonic-clock duration and emits one ``span`` record
+keyed by ``(slice_gen, step, replica_id)`` — ``replica_id`` comes from the
+underlying :class:`~torchft_tpu.metrics.MetricsLogger`, ``slice_gen`` from
+``TPUFT_SLICE_GEN`` (the scheduler's restart counter, see spec.py), so
+records from every incarnation of every replica across restarts merge into
+one unambiguous timeline.  ``obs/report.py`` is the matching consumer.
+
+The known phase names are fixed in :data:`PHASES`; a span may use any name
+(the record is self-describing) but report.py's attribution buckets are
+built from these.
+
+One tracker per Manager.  Phases of the same step may run on different
+threads (the quorum thread vs the train loop), so the per-step breakdown
+is lock-guarded; ``step_summary(step, committed=...)`` flushes the
+accumulated phases as one record after the commit vote.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from torchft_tpu.metrics import MetricsLogger
+
+__all__ = ["PHASES", "Span", "SpanTracker"]
+
+# The Manager step phases report.py attributes (docs/architecture.md
+# "Observability").  quorum = blocking wait on the lighthouse round;
+# configure = collective rebuild on quorum change; heal = peer weight
+# fetch; allreduce_merge = drain of pending allreduce futures at commit
+# time; commit_vote = the two-phase commit barrier RPC.
+PHASES = ("quorum", "configure", "heal", "allreduce_merge", "commit_vote")
+
+
+class Span:
+    """One in-flight phase measurement; ``duration_ms`` is valid after the
+    ``with`` block exits (monotonic clock, NTP-immune)."""
+
+    def __init__(self, tracker: "SpanTracker", phase: str, step: int, fields: dict):
+        self._tracker = tracker
+        self.phase = phase
+        self.step = step
+        self.fields = fields
+        self.t_start = 0.0
+        self.duration_ms: float = 0.0
+
+    def __enter__(self) -> "Span":
+        self.t_start = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.duration_ms = round((time.monotonic() - self.t_start) * 1e3, 3)
+        self._tracker._finish(self, ok=exc_type is None)
+
+
+class SpanTracker:
+    """Emits ``span`` / ``step_summary`` records through a MetricsLogger.
+
+    Spans are emitted even for phases that raise (with ``ok: false``) so a
+    hung-then-failed quorum still shows up in the trace with its real
+    duration.
+    """
+
+    def __init__(
+        self, metrics: MetricsLogger, slice_gen: Optional[int] = None
+    ) -> None:
+        self._metrics = metrics
+        if slice_gen is None:
+            try:
+                slice_gen = int(os.environ.get("TPUFT_SLICE_GEN", "0"))
+            except ValueError:
+                slice_gen = 0
+        self.slice_gen = slice_gen
+        self._lock = threading.Lock()
+        # phase -> accumulated ms since the last step_summary.  Keyed by
+        # phase, NOT by step: a heal fast-forwards the step number mid-step
+        # (quorum ran at the old step, heal at max_step, the vote at
+        # max_step), yet all of it is one train-loop iteration — the
+        # summary flushes everything since the previous vote.  Individual
+        # span records still carry their own step.
+        self._acc: Dict[str, float] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self._metrics.enabled
+
+    def span(self, phase: str, step: int, **fields) -> Span:
+        """Context manager measuring one phase of one step."""
+        return Span(self, phase, step, fields)
+
+    def _finish(self, span: Span, ok: bool) -> None:
+        with self._lock:
+            self._acc[span.phase] = self._acc.get(span.phase, 0.0) + span.duration_ms
+        rec = {
+            "phase": span.phase,
+            "step": span.step,
+            "slice_gen": self.slice_gen,
+            "duration_ms": span.duration_ms,
+        }
+        if not ok:
+            rec["ok"] = False
+        rec.update(span.fields)
+        self._metrics.emit("span", **rec)
+
+    def step_summary(self, step: int, committed: bool, **fields) -> None:
+        """Emits the per-step phase breakdown and resets the accumulator.
+        Call once per step, after the commit vote."""
+        with self._lock:
+            rec = {
+                "step": step,
+                "slice_gen": self.slice_gen,
+                "committed": committed,
+                "phases": {k: round(v, 3) for k, v in self._acc.items()},
+                "accounted_ms": round(sum(self._acc.values()), 3),
+            }
+            self._acc = {}
+        rec.update(fields)
+        self._metrics.emit("step_summary", **rec)
