@@ -706,6 +706,7 @@ def _scenario_stats(
             "victim_partial_step_s": None,
             "victim_restart_s": None,
             "victim_ft_resume_s": None,
+            "victim_heal_transfer_s": None,
             "goodput_self_fraction": None,
             "victims_recovered": False,
             "drain_handoff_gap_s": None,
@@ -789,6 +790,7 @@ def _scenario_stats(
     victim_partial_step = None
     victim_restart = None
     victim_ft_resume = None
+    victim_heal_transfer = None
     self_fraction = None
     if len(kill_events) == 1:
         kill_ts, victim = kill_events[0]
@@ -832,6 +834,21 @@ def _scenario_stats(
                 t_up = min(ts for ts, _ in new_events)
                 victim_restart = t_up - kill_ts
                 victim_ft_resume = t_commit - t_up
+                # Split ft_resume further: heal TRANSFER time is the part
+                # striped multi-donor fetch buys down (it scales with donor
+                # count), vs rejoin/vote overhead which does not.  The new
+                # incarnation's heal_fetched spans before its first commit
+                # carry the measured fetch duration.
+                heal_transfer_ms = [
+                    float(ev["heal_ms"])
+                    for ev in events
+                    if ev.get("event") == "heal_fetched"
+                    and str(ev.get("replica_id")) in incarnations_by_commit
+                    and float(ev["ts"]) <= t_commit
+                    and ev.get("heal_ms") is not None
+                ]
+                if heal_transfer_ms:
+                    victim_heal_transfer = sum(heal_transfer_ms) / 1e3
         # Self-normalized goodput (SECONDARY; see docstring): the victim's
         # committed count vs its own pre-kill rate extrapolated over the
         # span.  Sensitive to host-load rate drift, which is why the
@@ -867,6 +884,7 @@ def _scenario_stats(
         "victim_partial_step_s": victim_partial_step,
         "victim_restart_s": victim_restart,
         "victim_ft_resume_s": victim_ft_resume,
+        "victim_heal_transfer_s": victim_heal_transfer,
         "goodput_self_fraction": self_fraction,
         "victims_recovered": victims_recovered,
         "drain_handoff_gap_s": (
@@ -1107,6 +1125,11 @@ def kill_benchmark() -> dict:
         ),
         "victim_restart_s": _mean([k["victim_restart_s"] for k in decomposed]),
         "victim_ft_resume_s": _mean([k["victim_ft_resume_s"] for k in decomposed]),
+        # ft_resume split: heal TRANSFER (the wire time striped multi-donor
+        # fetch scales down with donor count) vs rejoin/vote overhead.
+        "victim_heal_transfer_s": _mean(
+            [k.get("victim_heal_transfer_s") for k in decomposed]
+        ),
         "decomposition_skipped": sum(
             1
             for k in singles
@@ -1182,6 +1205,9 @@ def kill_scenario_benchmark(trials: int | None = None) -> dict:
             round(sum(fractions) / len(fractions), 4) if fractions else None
         ),
         "victim_downtime_s": _mean([k["victim_downtime_s"] for k in results]),
+        "victim_heal_transfer_s": _mean(
+            [k.get("victim_heal_transfer_s") for k in results]
+        ),
         "heals": sum(k["heals"] for k in results),
         "victims_recovered": all(k["victims_recovered"] for k in results),
     }

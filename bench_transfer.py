@@ -10,11 +10,23 @@ Measures, for a synthetic multi-buffer state dict of --gb total:
 
   http/chunks=N   — HTTPTransport snapshot + recv_checkpoint (the pull path a
                     healing replica takes), N parallel round-robin chunks;
+  http/donors=N   — striped multi-donor fetch: N donor transports each serve
+                    the full snapshot, the receiver pulls disjoint
+                    byte-balanced stripes from all of them in parallel (the
+                    heal path when the quorum lists several healthy max-step
+                    groups), plus a failover trial that kills one donor
+                    mid-fetch;
   collective      — CollectiveTransport send/recv over a 2-rank TCPCollective
                     (the in-band path that shares the manager's data plane).
 
+Snapshot timing is split: ``snapshot_enqueue_s`` is what send_checkpoint
+costs the donor's train loop (the async pipeline makes this ~0),
+``snapshot_s`` is the background flatten duration until the snapshot is
+servable.
+
 Prints one JSON line per configuration plus a trailing summary line; run as
   python bench_transfer.py [--gb 2] [--buffers 32] [--out TRANSFER_BENCH.json]
+  python bench_transfer.py --quick         # small-dict smoke (CI tier-1)
 """
 
 from __future__ import annotations
@@ -50,6 +62,8 @@ def bench_http(state: Dict[str, np.ndarray], nbytes: int, num_chunks: int) -> Di
     try:
         t0 = time.perf_counter()
         src.send_checkpoint([1], step=0, state_dict=state, timeout=120.0)
+        enqueue_s = time.perf_counter() - t0
+        assert src.wait_snapshot(120.0), "snapshot never became servable"
         snapshot_s = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -61,12 +75,91 @@ def bench_http(state: Dict[str, np.ndarray], nbytes: int, num_chunks: int) -> Di
         return {
             "transport": "http",
             "num_chunks": num_chunks,
+            "snapshot_enqueue_s": round(enqueue_s, 5),
             "snapshot_s": round(snapshot_s, 3),
             "fetch_s": round(fetch_s, 3),
             "fetch_gb_per_s": round(_gb(nbytes) / fetch_s, 3),
         }
     finally:
         src.shutdown()
+        dst.shutdown()
+
+
+def bench_http_multi_donor(
+    state: Dict[str, np.ndarray],
+    nbytes: int,
+    n_donors: int,
+    kill_donor_after_s: float = -1.0,
+    shaped_mbps: float = 0.0,
+) -> Dict[str, Any]:
+    """Striped multi-donor heal: n_donors transports each serve the full
+    snapshot, one receiver pulls disjoint byte-balanced stripes from all of
+    them.  With ``kill_donor_after_s >= 0`` donor 0 is shut down that long
+    into the fetch — the stripe-failover path must finish the heal on the
+    survivors.  ``shaped_mbps > 0`` caps EACH donor's serving bandwidth
+    (TPUFT_HTTP_SHAPED_MBPS, shared across that donor's connections): the
+    link-bound regime of a real cluster, where aggregate heal bandwidth
+    scales with the donor count — on a small loopback host the unshaped
+    numbers are CPU-bound instead and scale with cores, not donors."""
+    import os
+
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    prior = os.environ.get("TPUFT_HTTP_SHAPED_MBPS")
+    if shaped_mbps > 0:
+        os.environ["TPUFT_HTTP_SHAPED_MBPS"] = str(shaped_mbps)
+    try:
+        # The pacer is read at construction: only the donors are shaped.
+        donors = [HTTPTransport(timeout=120.0) for _ in range(n_donors)]
+    finally:
+        if shaped_mbps > 0:
+            if prior is None:
+                del os.environ["TPUFT_HTTP_SHAPED_MBPS"]
+            else:
+                os.environ["TPUFT_HTTP_SHAPED_MBPS"] = prior
+    dst = HTTPTransport(timeout=120.0)
+    killer: threading.Timer | None = None
+    try:
+        for d in donors:
+            d.send_checkpoint([1], step=0, state_dict=state, timeout=120.0)
+        for d in donors:
+            assert d.wait_snapshot(120.0)
+        metas = [d.metadata() for d in donors]
+
+        kill_fired = threading.Event()
+        if kill_donor_after_s >= 0 and n_donors > 1:
+            def _kill_donor0() -> None:
+                kill_fired.set()
+                donors[0].shutdown()
+
+            killer = threading.Timer(kill_donor_after_s, _kill_donor0)
+            killer.start()
+        t0 = time.perf_counter()
+        out = dst.recv_checkpoint(1, metas, step=0, timeout=120.0)
+        fetch_s = time.perf_counter() - t0
+        assert set(out) == set(state)
+        for k in ("layer_1.weight", "layer_0.weight"):
+            if k in out:
+                np.testing.assert_array_equal(np.asarray(out[k]), state[k])
+        return {
+            "transport": "http",
+            "donors": n_donors,
+            "donor_killed_mid_fetch": kill_donor_after_s >= 0,
+            # True only if the kill timer actually fired before the fetch
+            # finished — a kill scheduled past the fetch end exercised
+            # nothing, and the artifact must say so.
+            "donor_kill_fired": (
+                kill_fired.is_set() if kill_donor_after_s >= 0 else None
+            ),
+            "donor_link_mbps": shaped_mbps if shaped_mbps > 0 else None,
+            "fetch_s": round(fetch_s, 3),
+            "fetch_gb_per_s": round(_gb(nbytes) / fetch_s, 3),
+        }
+    finally:
+        if killer is not None:
+            killer.cancel()
+        for d in donors:
+            d.shutdown()
         dst.shutdown()
 
 
@@ -222,16 +315,57 @@ def bench_shaped_link(mbps: float = 200.0, rtt_ms: float = 20.0) -> Dict[str, An
     }
 
 
+def run_quick(gb: float = 0.064, buffers: int = 16) -> Dict[str, Any]:
+    """Smoke sweep for CI tier-1 (``--quick``): small dict, 1 vs 2 donors
+    plus a mid-fetch donor kill — transfer-path regressions (stripe
+    arithmetic, failover, async snapshot) fail fast here instead of only
+    showing up in BENCH_*.json artifacts."""
+    nbytes = int(gb * 1e9)
+    state = make_state_dict(nbytes, buffers)
+    actual = sum(a.nbytes for a in state.values())
+    one = bench_http_multi_donor(state, actual, n_donors=1)
+    two = bench_http_multi_donor(state, actual, n_donors=2)
+    failover = bench_http_multi_donor(
+        state, actual, n_donors=2, kill_donor_after_s=0.0
+    )
+    return {
+        "quick": True,
+        "state_dict_gb": round(_gb(actual), 3),
+        "results": [one, two, failover],
+        # The kill fires at t=0 (donor 0 dead before the header fetch), so a
+        # completed, correctness-asserted fetch here IS the failover proof —
+        # donor_kill_fired pins that the kill really preceded the fetch.
+        "failover_completed": bool(failover["donor_kill_fired"]),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--gb", type=float, default=2.0, help="state dict size")
     parser.add_argument("--buffers", type=int, default=32)
     parser.add_argument("--chunks", type=int, nargs="*", default=[0, 2, 4, 8])
+    parser.add_argument("--donors", type=int, nargs="*", default=[1, 2, 4])
+    parser.add_argument(
+        "--donor-link-mbps", type=float, default=100.0,
+        help="per-donor serving-link cap for the shaped multi-donor sweep",
+    )
     parser.add_argument("--shaped-mbps", type=float, default=200.0)
     parser.add_argument("--shaped-rtt-ms", type=float, default=20.0)
     parser.add_argument("--no-shaped", action="store_true")
+    parser.add_argument(
+        "--quick", action="store_true",
+        help="small-dict smoke: 1 vs 2 donors + mid-fetch donor kill",
+    )
     parser.add_argument("--out", default=None, help="also write results JSON here")
     args = parser.parse_args()
+
+    if args.quick:
+        payload = run_quick()
+        print(json.dumps(payload), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=1)
+        return
 
     nbytes = int(args.gb * 1e9)
     state = make_state_dict(nbytes, args.buffers)
@@ -242,12 +376,43 @@ def main() -> None:
         r = bench_http(state, actual, num_chunks=n)
         results.append(r)
         print(json.dumps(r), flush=True)
+
+    # Striped multi-donor sweep: the heal-bandwidth scaling headline.
+    # Unshaped = this host's CPU ceiling (loopback copies are compute-bound);
+    # shaped = each donor's serving link capped (--donor-link-mbps), the
+    # production regime where transfer time IS the heal window and adding
+    # healthy peers must buy it down.
+    donor_results: Dict[int, Dict[str, Any]] = {}
+    shaped_results: Dict[int, Dict[str, Any]] = {}
+    for n in args.donors:
+        r = bench_http_multi_donor(state, actual, n_donors=n)
+        donor_results[n] = r
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    for n in args.donors:
+        r = bench_http_multi_donor(
+            state, actual, n_donors=n, shaped_mbps=args.donor_link_mbps
+        )
+        shaped_results[n] = r
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    # Stripe failover: kill donor 0 a third of the way into the 2-donor
+    # fetch; the heal must still complete from the survivor.
+    if 2 in shaped_results:
+        kill_at = max(0.2, shaped_results[2]["fetch_s"] / 3.0)
+        r = bench_http_multi_donor(
+            state, actual, n_donors=2, kill_donor_after_s=kill_at,
+            shaped_mbps=args.donor_link_mbps,
+        )
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
     r = bench_collective(state, actual)
     results.append(r)
     print(json.dumps(r), flush=True)
 
     best_http = max(
-        (x for x in results if x["transport"] == "http"),
+        (x for x in results if x["transport"] == "http" and "num_chunks" in x),
         key=lambda x: x["fetch_gb_per_s"],
     )
     summary = {
@@ -256,7 +421,23 @@ def main() -> None:
         "best_http_gb_per_s": best_http["fetch_gb_per_s"],
         "best_http_chunks": best_http["num_chunks"],
         "collective_gb_per_s": results[-1]["recv_gb_per_s"],
+        "multi_donor_gb_per_s": {
+            str(n): donor_results[n]["fetch_gb_per_s"] for n in sorted(donor_results)
+        },
+        "shaped_multi_donor_gb_per_s": {
+            str(n): shaped_results[n]["fetch_gb_per_s"] for n in sorted(shaped_results)
+        },
+        "donor_link_mbps": args.donor_link_mbps,
     }
+    if 1 in donor_results and 2 in donor_results:
+        summary["speedup_2_donors"] = round(
+            donor_results[2]["fetch_gb_per_s"] / donor_results[1]["fetch_gb_per_s"], 2
+        )
+    if 1 in shaped_results and 2 in shaped_results:
+        summary["shaped_speedup_2_donors"] = round(
+            shaped_results[2]["fetch_gb_per_s"] / shaped_results[1]["fetch_gb_per_s"],
+            2,
+        )
     shaped = None
     if not args.no_shaped:
         shaped = bench_shaped_link(args.shaped_mbps, args.shaped_rtt_ms)

@@ -742,6 +742,27 @@ std::string Lighthouse::MetricsText() {
   for (const auto& [id, last] : state_.heartbeats) {
     if (!state_.draining.count(id) && now - last < hb_timeout) ++healthy;
   }
+  // Healthy replicas at the max live step = the donor pool striped healing
+  // can draw on; recovery bandwidth scales with this count, so it is the
+  // capacity gauge to alert on (donor_pool == 1 means heals are pinned to
+  // a single donor link again).  The reference step is the max over
+  // ELIGIBLE replicas only — a draining or heartbeat-stale replica that
+  // reported a higher step cannot serve, and counting against its step
+  // would read donor_pool=0 (a false capacity alarm) during exactly the
+  // departure scenarios the gauge exists to monitor.
+  int64_t donor_pool = 0;
+  int64_t max_eligible_step = -1;
+  auto eligible = [&](const std::string& id) {
+    auto hb = state_.heartbeats.find(id);
+    return hb != state_.heartbeats.end() && !state_.draining.count(id) &&
+           now - hb->second < hb_timeout;
+  };
+  for (const auto& [id, step] : hb_step_) {
+    if (eligible(id)) max_eligible_step = std::max(max_eligible_step, step);
+  }
+  for (const auto& [id, step] : hb_step_) {
+    if (eligible(id) && step == max_eligible_step) ++donor_pool;
+  }
 
   auto gauge = [&](const char* name, const char* help) {
     o << "# HELP " << name << " " << help << "\n# TYPE " << name << " gauge\n";
@@ -768,6 +789,9 @@ std::string Lighthouse::MetricsText() {
   o << "tpuft_replicas_tombstoned " << evicted_.size() << "\n";
   gauge("tpuft_heal_in_progress", "replicas currently fetching weights from a peer");
   o << "tpuft_heal_in_progress " << healing << "\n";
+  gauge("tpuft_donor_pool",
+        "healthy replicas at the max live step (striped-heal donor capacity)");
+  o << "tpuft_donor_pool " << donor_pool << "\n";
 
   gauge("tpuft_replica_step", "live training step per replica (from heartbeats)");
   for (const auto& [id, step] : hb_step_) {

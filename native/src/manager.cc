@@ -78,29 +78,61 @@ bool ComputeQuorumResults(const std::string& replica_id, int64_t group_rank, con
   }
   resp->set_heal(heal);
 
-  // Round-robin recovery assignment, striped by local rank so different local
-  // ranks of the same recovering group pull from different sources.
+  // Striped multi-donor recovery assignment.  The PRIMARY donor is still
+  // round-robin over (recovery position + local rank) — different recovering
+  // groups and different local ranks of one group lead with different
+  // donors — but the full ordered donor rotation now travels with the
+  // response (recover_src_replica_ranks / _manager_addresses) so the
+  // receiver can stripe its fetch across every healthy max-step group and
+  // fail a stripe over to the next donor if one dies mid-heal.  Every
+  // up-to-date member learns the complete recovering set and opens its
+  // serving window, not just the primaries.
   if (!up_to_date.empty()) {
-    for (size_t j = 0; j < recovering.size(); ++j) {
-      int64_t src =
-          up_to_date[(static_cast<int64_t>(j) + group_rank) % static_cast<int64_t>(up_to_date.size())];
-      if (recovering[j] == replica_rank) {
-        resp->set_recover_src_replica_rank(src);
-        resp->set_recover_src_manager_address(members[src].address());
+    // Donor candidates exclude the requester itself: a force-recovering
+    // group sits in up_to_date (its step LOOKS current) yet must not be
+    // told to heal from — or serve — itself.
+    std::vector<int64_t> donors;
+    for (int64_t idx : up_to_date) {
+      if (idx != replica_rank) donors.push_back(idx);
+    }
+    auto set_donor_rotation = [&](int64_t lead_pos) {
+      resp->set_recover_src_replica_rank(donors[lead_pos]);
+      resp->set_recover_src_manager_address(members[donors[lead_pos]].address());
+      for (size_t i = 0; i < donors.size(); ++i) {
+        int64_t src = donors[(lead_pos + static_cast<int64_t>(i)) %
+                             static_cast<int64_t>(donors.size())];
+        resp->add_recover_src_replica_ranks(src);
+        resp->add_recover_src_manager_addresses(members[src].address());
       }
-      if (src == replica_rank) {
+    };
+    for (size_t j = 0; j < recovering.size(); ++j) {
+      // Primary assignment: unchanged single-donor round-robin over
+      // up_to_date (a recovering member is never in up_to_date, so its
+      // donor rotation below leads with this same primary).
+      int64_t primary = up_to_date[(static_cast<int64_t>(j) + group_rank) %
+                                   static_cast<int64_t>(up_to_date.size())];
+      if (recovering[j] == replica_rank && !donors.empty()) {
+        set_donor_rotation((static_cast<int64_t>(j) + group_rank) %
+                           static_cast<int64_t>(donors.size()));
+      }
+      if (primary == replica_rank) {
+        // Field 11 keeps PRIMARY-only semantics: point-to-point transports
+        // (collective send/recv) block until matched, so a donor must only
+        // send where the healer is guaranteed to recv.
         resp->add_recover_dst_replica_ranks(recovering[j]);
       }
     }
-    if (heal && std::find(recovering.begin(), recovering.end(), replica_rank) == recovering.end()) {
-      // force_recover path: pick a striped source among the other up-to-date.
-      std::vector<int64_t> others;
-      for (int64_t idx : up_to_date) {
-        if (idx != replica_rank) others.push_back(idx);
-      }
-      int64_t src = others[group_rank % static_cast<int64_t>(others.size())];
-      resp->set_recover_src_replica_rank(src);
-      resp->set_recover_src_manager_address(members[src].address());
+    if (heal && !donors.empty() &&
+        std::find(recovering.begin(), recovering.end(), replica_rank) == recovering.end()) {
+      // force_recover path: an up-to-date replica re-fetching after repeated
+      // failed commits stripes over the same rotation, led by local rank.
+      set_donor_rotation(group_rank % static_cast<int64_t>(donors.size()));
+    }
+    if (max_replica_rank >= 0 && !heal) {
+      // Field 14: EVERY donor learns the full recovering set so pull-based
+      // transports open their serving windows for striped multi-donor
+      // fetches (serving is passive — an unused window costs nothing).
+      for (int64_t r : recovering) resp->add_recover_dst_replica_ranks_all(r);
     }
   }
   return true;

@@ -260,6 +260,42 @@ void TestResultsInitSync() {
   CHECK(!rb2.heal());
 }
 
+void TestResultsMultiDonor() {
+  // Two donors at max_step: the recovering group gets the FULL ordered
+  // donor rotation (primary first) for striped fetch + failover, and BOTH
+  // donors open their serving windows for it.
+  auto q = MakeQuorum({MakeMember("a", 10), MakeMember("b", 4), MakeMember("c", 10)});
+  ManagerQuorumResponse ra, rb, rc;
+  std::string err;
+  CHECK(ComputeQuorumResults("b", 0, q, true, false, &rb, &err));
+  CHECK(rb.heal());
+  CHECK(rb.recover_src_replica_ranks_size() == 2);
+  CHECK(rb.recover_src_manager_addresses_size() == 2);
+  // Rotation leads with the primary assignment (same as the scalar field).
+  CHECK(rb.recover_src_replica_ranks(0) == rb.recover_src_replica_rank());
+  CHECK(rb.recover_src_manager_addresses(0) == rb.recover_src_manager_address());
+  CHECK(rb.recover_src_replica_ranks(0) == 0);
+  CHECK(rb.recover_src_replica_ranks(1) == 2);
+  CHECK(rb.recover_src_manager_addresses(1) == "addr-c:1");
+  // Primary-dst (field 11) stays primary-only: a is b's assigned donor, c
+  // is not.  The _all set (field 14) makes EVERY up-to-date member open
+  // its pull-serving window for the recovering group.
+  CHECK(ComputeQuorumResults("a", 0, q, true, false, &ra, &err));
+  CHECK(ComputeQuorumResults("c", 0, q, true, false, &rc, &err));
+  CHECK(ra.recover_dst_replica_ranks_size() == 1);
+  CHECK(ra.recover_dst_replica_ranks(0) == 1);
+  CHECK(rc.recover_dst_replica_ranks_size() == 0);
+  CHECK(ra.recover_dst_replica_ranks_all_size() == 1);
+  CHECK(ra.recover_dst_replica_ranks_all(0) == 1);
+  CHECK(rc.recover_dst_replica_ranks_all_size() == 1);
+  CHECK(rc.recover_dst_replica_ranks_all(0) == 1);
+  // Local rank 1 of the healer leads with the OTHER donor but still sees both.
+  ManagerQuorumResponse rb1;
+  CHECK(ComputeQuorumResults("b", 1, q, true, false, &rb1, &err));
+  CHECK(rb1.recover_src_replica_ranks(0) == 2);
+  CHECK(rb1.recover_src_replica_ranks(1) == 0);
+}
+
 void TestResultsForceRecover() {
   // force_recover makes an up-to-date replica heal anyway.
   auto q = MakeQuorum({MakeMember("a", 10), MakeMember("b", 10)});
@@ -1288,6 +1324,7 @@ int main() {
   TestResultsRecovery();
   TestResultsRankStriping();
   TestResultsInitSync();
+  TestResultsMultiDonor();
   TestResultsForceRecover();
   TestLighthouseE2E();
   TestManagerE2E();

@@ -44,6 +44,24 @@ def test_bench_greps_match_emitters() -> None:
     assert '"healing from replica' in manager
 
 
+def test_transfer_quick_smoke() -> None:
+    """bench_transfer --quick in-process: the striped multi-donor fetch and
+    mid-fetch donor-kill failover must work on a small dict — transfer-path
+    regressions fail tier-1 here instead of only showing up in
+    BENCH_*.json artifacts."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_transfer
+    finally:
+        sys.path.pop(0)
+    payload = bench_transfer.run_quick(gb=0.008, buffers=8)
+    assert payload["failover_completed"]
+    results = {(r["donors"], r["donor_killed_mid_fetch"]): r for r in payload["results"]}
+    assert set(results) == {(1, False), (2, False), (2, True)}
+    for r in results.values():
+        assert r["fetch_s"] > 0 and r["fetch_gb_per_s"] > 0
+
+
 def test_bench_selftest() -> None:
     """bench.py --selftest verifies its own scenario-call signatures without
     touching the chip or spawning training subprocesses."""

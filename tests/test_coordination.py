@@ -213,8 +213,10 @@ def _multi_group_quorum(steps, init_sync=True, min_replicas=None):
 
 
 def test_quorum_recovery_plan_behind_group_heals() -> None:
-    """Groups at steps (5, 5, 0): the behind group gets heal=True with an
-    up-to-date source; that source's response lists it as a destination."""
+    """Groups at steps (5, 5, 0): the behind group gets heal=True with the
+    full ordered donor rotation (primary first); EVERY up-to-date group's
+    response lists it as a destination — all donors open their serving
+    windows so the receiver can stripe its fetch across them."""
     res = _multi_group_quorum([5, 5, 0])
     behind = res[2]
     assert behind.heal
@@ -222,9 +224,19 @@ def test_quorum_recovery_plan_behind_group_heals() -> None:
     up_to_date_ranks = {res[0].replica_rank, res[1].replica_rank}
     assert behind.recover_src_replica_rank in up_to_date_ranks
     assert behind.recover_src_manager_address
-    # Exactly one healthy group is assigned the behind group's rank.
+    # The donor rotation covers every up-to-date group, primary first.
+    assert list(behind.recover_src_replica_ranks)[0] == behind.recover_src_replica_rank
+    assert set(behind.recover_src_replica_ranks) == up_to_date_ranks
+    assert behind.recover_src_manager_addresses[0] == behind.recover_src_manager_address
+    assert len(behind.recover_src_manager_addresses) == len(up_to_date_ranks)
+    # Field 11 keeps primary-only semantics: exactly one healthy group owns
+    # the assignment (point-to-point transports serve only this)...
     dsts = [list(res[g].recover_dst_replica_ranks) for g in (0, 1)]
     assert sorted(d for ds in dsts for d in ds) == [behind.replica_rank]
+    # ...while the _all set makes EVERY healthy group open its pull-serving
+    # window for the striped fetch.
+    dsts_all = [list(res[g].recover_dst_replica_ranks_all) for g in (0, 1)]
+    assert all(ds == [behind.replica_rank] for ds in dsts_all)
     # Up-to-date groups do not heal and agree on max_step.
     for g in (0, 1):
         assert not res[g].heal

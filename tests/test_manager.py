@@ -91,6 +91,8 @@ def make_quorum(
     heal: bool = False,
     recover_src: Optional[int] = None,
     recover_dst: Optional[List[int]] = None,
+    donor_ranks: Optional[List[int]] = None,
+    donor_addrs: Optional[List[str]] = None,
 ) -> QuorumResult:
     return QuorumResult(
         quorum_id=quorum_id,
@@ -99,6 +101,8 @@ def make_quorum(
         recover_src_manager_address="src-mgr:0",
         recover_src_replica_rank=recover_src,
         recover_dst_replica_ranks=recover_dst or [],
+        recover_src_replica_ranks=donor_ranks or [],
+        recover_src_manager_addresses=donor_addrs or [],
         store_address="fake-store:0",
         max_step=max_step,
         max_replica_rank=max_replica_rank,
@@ -223,6 +227,93 @@ def test_async_heal(store) -> None:
         assert manager.batches_committed() == 10 + manager.num_participants()
         transport.recv_checkpoint.assert_called_once()
         assert transport.recv_checkpoint.call_args.kwargs["metadata"] == "peer-meta"
+    finally:
+        manager.shutdown()
+
+
+def test_multi_donor_heal_passes_donor_list(store) -> None:
+    """A quorum listing two donors: the manager resolves BOTH donors'
+    transport metadatas and hands the ordered list to recv_checkpoint so
+    the transport can stripe the fetch."""
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(
+        max_step=5,
+        heal=True,
+        recover_src=1,
+        max_replica_rank=None,
+        donor_ranks=[1, 2],
+        donor_addrs=["mgr-1:0", "mgr-2:0"],
+    )
+    client.should_commit.return_value = True
+    transport = MagicMock()
+    transport.metadata.return_value = "my-meta"
+    transport.recv_checkpoint.return_value = {
+        "user": {},
+        "tpuft": {"step": 5, "batches_committed": 0},
+    }
+    manager, _, _ = make_manager(
+        store, client_mock=client, checkpoint_transport=transport,
+        state_dict=lambda: {},
+    )
+    metas = {"mgr-1:0": "meta-1", "mgr-2:0": "meta-2"}
+
+    def factory(addr, connect_timeout_ms=0):
+        m = MagicMock()
+        m._checkpoint_metadata.return_value = metas[addr]
+        return m
+
+    manager._manager_client_factory = factory
+    try:
+        manager.start_quorum()
+        manager.wait_quorum()
+        kwargs = transport.recv_checkpoint.call_args.kwargs
+        assert kwargs["metadata"] == ["meta-1", "meta-2"]
+        assert kwargs["src_rank"] == 1
+        assert kwargs["step"] == 5
+    finally:
+        manager.shutdown()
+
+
+def test_multi_donor_heal_skips_unreachable_donor(store) -> None:
+    """A donor that died between the quorum and the heal is dropped from the
+    stripe list instead of failing the heal; the single survivor's metadata
+    travels as a plain string (transport back-compat)."""
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(
+        max_step=4,
+        heal=True,
+        recover_src=1,
+        max_replica_rank=None,
+        donor_ranks=[1, 2],
+        donor_addrs=["dead:0", "mgr-2:0"],
+    )
+    client.should_commit.return_value = True
+    transport = MagicMock()
+    transport.metadata.return_value = "my-meta"
+    transport.recv_checkpoint.return_value = {
+        "user": {},
+        "tpuft": {"step": 4, "batches_committed": 0},
+    }
+    manager, _, _ = make_manager(
+        store, client_mock=client, checkpoint_transport=transport,
+        state_dict=lambda: {},
+    )
+
+    def factory(addr, connect_timeout_ms=0):
+        if addr == "dead:0":
+            raise TimeoutError("connection refused")
+        m = MagicMock()
+        m._checkpoint_metadata.return_value = "meta-2"
+        return m
+
+    manager._manager_client_factory = factory
+    try:
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert manager.errored() is None
+        kwargs = transport.recv_checkpoint.call_args.kwargs
+        assert kwargs["metadata"] == "meta-2"
+        assert kwargs["src_rank"] == 2
     finally:
         manager.shutdown()
 

@@ -69,7 +69,20 @@ def test_span_records_failure(tmp_path) -> None:
 
 def test_phases_registry_is_stable() -> None:
     """report.py buckets and the Manager call sites key off these names."""
-    assert PHASES == ("quorum", "configure", "heal", "allreduce_merge", "commit_vote")
+    assert PHASES == (
+        "quorum",
+        "configure",
+        "heal",
+        "allreduce_merge",
+        "commit_vote",
+        "snapshot",
+    )
+    from torchft_tpu.obs.spans import OVERLAPPED_PHASES
+
+    # Overlapped phases must be a subset of the registry: report.py treats
+    # them as concurrent-with-compute (not charged against productive time).
+    assert set(OVERLAPPED_PHASES) <= set(PHASES)
+    assert OVERLAPPED_PHASES == ("snapshot",)
 
 
 # ---------------------------------------------------------------------------

@@ -98,13 +98,22 @@ def _sharding_fingerprint(tree: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _small_group_mesh(replica_id: int) -> Mesh:
+    """2-device (fsdp=2, tensor=1) mesh per group — lets THREE disjoint
+    groups fit on the 8 virtual devices for the multi-donor scenario."""
+    devices = jax.devices()
+    pair = np.array(devices[2 * replica_id : 2 * replica_id + 2]).reshape(2, 1)
+    return Mesh(pair, ("fsdp", "tensor"))
+
+
 def sharded_train_loop(runner: Runner, rank: int) -> Dict[str, Any]:
     import optax
 
     total_steps = runner.train_loop_args.get("total_steps", 7)
     transport_kind = runner.train_loop_args["transport"]
 
-    mesh = _group_mesh(runner.replica_id)
+    mesh_fn = runner.train_loop_args.get("mesh_fn", _group_mesh)
+    mesh = mesh_fn(runner.replica_id)
     collective = TCPCollective(timeout=20.0)
 
     state: Dict[str, Any] = {"healed": None}
@@ -232,3 +241,73 @@ def test_sharded_healing_e2e(lighthouse, transport) -> None:
         ), "healed arrays must land on the healed replica's own mesh"
     # Healed values equal the survivor's state at the handoff step: verified
     # transitively by the bitwise-equal final params after lockstep steps.
+
+
+def test_sharded_healing_multi_donor_e2e(tmp_path, monkeypatch) -> None:
+    """THREE replica groups (2-device meshes each): one is killed mid-run
+    and must heal with BOTH survivors as donors — the quorum hands the full
+    donor rotation to the healer, every survivor opens its serving window,
+    and the striped HTTP fetch reassembles sharded state bitwise-equal on
+    the healed group's own mesh.  The metrics stream is the evidence that
+    the heal actually used 2 donors (heal_fetched n_donors=2)."""
+    metrics_path = tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUFT_METRICS_PATH", str(metrics_path))
+    # min_replicas=3 keeps the groups in lockstep from step 0 (a warm-JIT
+    # pair must not run ahead before the third joins, or the scripted kill
+    # at step 3 never fires — the victim would heal straight past it); the
+    # killed group's thread restarts immediately, rejoins, and heals.
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=3, join_timeout_ms=100)
+    injector = FailureInjector().fail_at(2, 3)
+    barrier = _DoneBarrier(3)
+    try:
+        runners = [
+            Runner(
+                replica_id=i,
+                lighthouse_address=lh.address(),
+                failure_injector=inj,
+                train_loop=sharded_train_loop,
+                num_replicas=3,
+                train_loop_args={
+                    "total_steps": 7,
+                    "barrier": barrier,
+                    "transport": "http",
+                    "mesh_fn": _small_group_mesh,
+                },
+            )
+            for i, inj in enumerate(
+                [FailureInjector(), FailureInjector(), injector]
+            )
+        ]
+        results = run_replicas(runners)
+    finally:
+        lh.shutdown()
+    assert injector.count == 1
+
+    finals = [results[i][0] for i in range(3)]
+    assert all(r["step"] >= 7 for r in finals)
+    # Bitwise-identical final values across all three groups.
+    for k in finals[0]["params"]:
+        for r in finals[1:]:
+            np.testing.assert_array_equal(finals[0]["params"][k], r["params"][k])
+
+    # The restarted group healed, onto ITS own 2-device mesh.
+    healed = finals[2]["healed"]
+    assert healed is not None, "replica 2 never healed"
+    own_devices = {str(d) for d in _small_group_mesh(2).devices.flat}
+    for k in PARAM_SPECS:
+        fp = healed["shardings"][k]
+        assert fp is not None
+        assert set(fp[2]) == own_devices
+
+    # Striped multi-donor evidence: the post-kill heal fetched from BOTH
+    # survivors (init-sync heals at step 0 legitimately report 1 donor).
+    import json as _json
+
+    n_donors = [
+        rec.get("n_donors")
+        for rec in map(_json.loads, metrics_path.read_text().splitlines())
+        if rec.get("event") == "heal_fetched" and rec.get("step", 0) > 0
+    ]
+    assert any((n or 0) >= 2 for n in n_donors), (
+        f"no multi-donor heal recorded: {n_donors}"
+    )

@@ -37,7 +37,14 @@ def make_state_dict(seed: int):
             "w": jnp.asarray(rng.randn(8, 16).astype(np.float32)),
             "b": jnp.asarray(rng.randn(16), dtype=jnp.bfloat16),
         },
-        "optim": [np.arange(10, dtype=np.int64) * seed, {"lr": 0.125}],
+        # 0-d leaves ride along on purpose: optax state carries scalar
+        # arrays (e.g. adam's `count`) and they must round-trip with their
+        # () shape intact, not crash as_u8 or get promoted to (1,).
+        "optim": [
+            np.arange(10, dtype=np.int64) * seed,
+            {"lr": 0.125, "count": np.asarray(seed * 3, dtype=np.int32)},
+        ],
+        "scalar": jnp.asarray(float(seed), dtype=jnp.float32),
         "tpuft": {"step": 7, "batches_committed": 21},
     }
 
@@ -130,6 +137,156 @@ def test_collective_transport_multi_recovery(store) -> None:
     run_multi_recovery_test(
         lambda rank, colls: CollectiveTransport(colls[rank], timeout=10.0), store
     )
+
+
+def test_http_transport_multi_donor_striped(store) -> None:
+    """3 donors each serving the same snapshot: the receiver stripes the
+    fetch across all of them and reassembles bitwise-identical state."""
+    state = make_state_dict(seed=2)
+    donors = [HTTPTransport(timeout=10.0) for _ in range(3)]
+    rx = HTTPTransport(timeout=10.0)
+    try:
+        for d in donors:
+            d.send_checkpoint([3], step=11, state_dict=state, timeout=10.0)
+            assert d.wait_snapshot(10.0)
+        got = rx.recv_checkpoint(
+            0, [d.metadata() for d in donors], step=11, timeout=10.0
+        )
+        assert_state_dicts_equal(got, state)
+    finally:
+        for d in donors:
+            d.shutdown()
+        rx.shutdown()
+
+
+def test_http_transport_donor_death_mid_heal_failover(store) -> None:
+    """The serving donor dies AFTER the header is fetched (mid-heal): the
+    receiver fails its stripes over to the second donor and still
+    reassembles the full state."""
+    state = make_state_dict(seed=3)
+    a = HTTPTransport(timeout=5.0)
+    b = HTTPTransport(timeout=5.0)
+    rx = HTTPTransport(timeout=5.0)
+    try:
+        for d in (a, b):
+            d.send_checkpoint([2], step=7, state_dict=state, timeout=5.0)
+            assert d.wait_snapshot(5.0)
+        a_url = a.metadata()
+        orig = rx._urlopen
+        killed = []
+
+        def hooked(url, timeout):
+            # Deterministic mid-heal death: the moment the receiver asks
+            # donor A for its first STRIPE (header already served), A dies.
+            if url.startswith(a_url) and "chunk_" in url and not killed:
+                killed.append(url)
+                a.shutdown()
+            return orig(url, timeout)
+
+        rx._urlopen = hooked
+        got = rx.recv_checkpoint(0, [a_url, b.metadata()], step=7, timeout=5.0)
+        assert killed, "no stripe was ever routed to donor A"
+        assert_state_dicts_equal(got, state)
+    finally:
+        for t in (a, b, rx):
+            t.shutdown()
+
+
+def test_http_transport_all_donors_dead_raises(store) -> None:
+    a = HTTPTransport(timeout=2.0)
+    b = HTTPTransport(timeout=2.0)
+    dead = [a.metadata(), b.metadata()]
+    a.shutdown()
+    b.shutdown()
+    rx = HTTPTransport(timeout=2.0)
+    try:
+        with pytest.raises(Exception):
+            rx.recv_checkpoint(0, dead, step=1, timeout=2.0)
+    finally:
+        rx.shutdown()
+
+
+def test_http_transport_async_snapshot_off_critical_path(store, monkeypatch) -> None:
+    """send_checkpoint must return without waiting for the device->host
+    flatten (the background snapshotter does it); a fetch racing the flip
+    blocks until the snapshot lands instead of 404ing."""
+    import torchft_tpu.checkpointing.http_transport as ht
+
+    orig_flatten = ht.flatten_state_dict
+
+    def slow_flatten(sd, step=0):
+        time.sleep(0.5)
+        return orig_flatten(sd, step=step)
+
+    monkeypatch.setattr(ht, "flatten_state_dict", slow_flatten)
+    t = HTTPTransport(timeout=5.0)
+    try:
+        t0 = time.monotonic()
+        t.send_checkpoint([1], step=2, state_dict={"x": np.ones(4)}, timeout=5.0)
+        enqueue = time.monotonic() - t0
+        assert enqueue < 0.25, f"send_checkpoint blocked {enqueue:.3f}s on the flatten"
+        got = t.recv_checkpoint(0, t.metadata(), step=2, timeout=5.0)
+        np.testing.assert_array_equal(got["x"], np.ones(4))
+    finally:
+        t.shutdown()
+
+
+def test_http_transport_malformed_requests_4xx(store) -> None:
+    """Garbage paths, stale steps, and out-of-range/malformed stripe params
+    must come back as 4xx (never an unhandled 500 traceback) while a
+    concurrent legitimate fetch succeeds."""
+    import urllib.error
+    import urllib.request
+
+    state = {"a": np.ones(8, dtype=np.float32), "b": np.zeros(4, dtype=np.float32)}
+    t = HTTPTransport(timeout=5.0, num_chunks=2)
+    try:
+        t.send_checkpoint([1], step=5, state_dict=state, timeout=5.0)
+        assert t.wait_snapshot(5.0)
+        base = t.metadata()
+
+        def code_of(url: str) -> int:
+            try:
+                with urllib.request.urlopen(url, timeout=5.0) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        garbage = {
+            f"{base}/not/a/thing": 404,
+            f"{base}/checkpoint/abc/full": 400,       # non-integer step
+            f"{base}/checkpoint/-3/full": 404,        # negative step
+            f"{base}/checkpoint/9/full": 404,         # stale step
+            f"{base}/checkpoint/5/chunk_99": 404,     # out-of-range index
+            f"{base}/checkpoint/5/chunk_xx": 404,     # malformed index
+            f"{base}/checkpoint/5/chunk_0?n=0": 400,  # bad stripe count
+            f"{base}/checkpoint/5/chunk_0?n=zz": 400,
+            f"{base}/checkpoint/5/chunk_2?n=2": 404,  # idx >= n
+        }
+        for url, want in garbage.items():
+            got = code_of(url)
+            assert 400 <= got < 500 and got == want, f"{url}: got {got}, want {want}"
+
+        # Legitimate fetch succeeds while garbage requests hammer the server.
+        stop = threading.Event()
+
+        def hammer() -> None:
+            urls = list(garbage)
+            i = 0
+            while not stop.is_set():
+                code_of(urls[i % len(urls)])
+                i += 1
+
+        th = threading.Thread(target=hammer)
+        th.start()
+        try:
+            got = t.recv_checkpoint(0, base, step=5, timeout=5.0)
+            np.testing.assert_array_equal(got["a"], state["a"])
+        finally:
+            stop.set()
+            th.join(timeout=5)
+    finally:
+        t.shutdown()
 
 
 def test_http_transport_wrong_step_404(store) -> None:

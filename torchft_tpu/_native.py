@@ -225,7 +225,19 @@ class QuorumResult:
     replica_world_size: int = 1
     recover_src_manager_address: str = ""
     recover_src_replica_rank: Optional[int] = None
+    # PRIMARY-assignment destinations (what a point-to-point transport must
+    # serve — its sends block until matched).
     recover_dst_replica_ranks: List[int] = field(default_factory=list)
+    # Full recovering set (what a pull-based transport serves: every donor
+    # opens its window for striped fetches).  Falls back to the primary
+    # list against pre-multi-donor servers.
+    recover_dst_replica_ranks_all: List[int] = field(default_factory=list)
+    # Striped multi-donor healing (valid when heal): the full ordered donor
+    # rotation — every healthy max-step group, primary first.  Falls back to
+    # the singleton [recover_src_*] against pre-multi-donor servers so the
+    # healing path can always iterate these.
+    recover_src_replica_ranks: List[int] = field(default_factory=list)
+    recover_src_manager_addresses: List[str] = field(default_factory=list)
     store_address: str = ""
     max_step: int = 0
     max_replica_rank: Optional[int] = None
@@ -471,6 +483,12 @@ class ManagerClient:
         resp.ParseFromString(
             self._client.call(MANAGER_QUORUM, req.SerializeToString(), timeout_ms)
         )
+        donor_ranks = list(resp.recover_src_replica_ranks)
+        donor_addrs = list(resp.recover_src_manager_addresses)
+        if resp.heal and not donor_addrs and resp.recover_src_manager_address:
+            # Pre-multi-donor server: degrade to the single assigned donor.
+            donor_ranks = [resp.recover_src_replica_rank]
+            donor_addrs = [resp.recover_src_manager_address]
         return QuorumResult(
             quorum_id=resp.quorum_id,
             replica_rank=resp.replica_rank,
@@ -478,6 +496,12 @@ class ManagerClient:
             recover_src_manager_address=resp.recover_src_manager_address,
             recover_src_replica_rank=resp.recover_src_replica_rank if resp.heal else None,
             recover_dst_replica_ranks=list(resp.recover_dst_replica_ranks),
+            recover_dst_replica_ranks_all=(
+                list(resp.recover_dst_replica_ranks_all)
+                or list(resp.recover_dst_replica_ranks)
+            ),
+            recover_src_replica_ranks=donor_ranks if resp.heal else [],
+            recover_src_manager_addresses=donor_addrs if resp.heal else [],
             store_address=resp.store_address,
             max_step=resp.max_step,
             max_replica_rank=resp.max_replica_rank if resp.max_replica_rank >= 0 else None,

@@ -21,6 +21,9 @@ class QuorumResult:
     recover_src_manager_address: str
     recover_src_replica_rank: Optional[int]
     recover_dst_replica_ranks: List[int]
+    recover_dst_replica_ranks_all: List[int]
+    recover_src_replica_ranks: List[int]
+    recover_src_manager_addresses: List[str]
     store_address: str
     max_step: int
     max_replica_rank: Optional[int]
@@ -34,6 +37,9 @@ class QuorumResult:
         recover_src_manager_address: str = ...,
         recover_src_replica_rank: Optional[int] = ...,
         recover_dst_replica_ranks: List[int] = ...,
+        recover_dst_replica_ranks_all: List[int] = ...,
+        recover_src_replica_ranks: List[int] = ...,
+        recover_src_manager_addresses: List[str] = ...,
         store_address: str = ...,
         max_step: int = ...,
         max_replica_rank: Optional[int] = ...,

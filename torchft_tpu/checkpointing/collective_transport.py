@@ -90,6 +90,11 @@ class CollectiveTransport(CheckpointTransport):
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
+        if not isinstance(metadata, str):
+            # Multi-donor metadata list from the manager: collective recv is
+            # inherently single-source (one send/recv ring peer), use the
+            # primary.
+            metadata = metadata[0]
         with _timeit(f"recv_checkpoint from {src_rank}"):
             header = self._collective.recv((0,), np.uint8, src_rank, tag=1).wait(
                 timeout=timeout

@@ -4,8 +4,26 @@ Reference parity: torchft/checkpointing/http_transport.py.  A threading HTTP
 server on every replica streams the current-step state dict to recovering
 peers; an RWLock gates serving so the train loop can mutate weights safely
 (write-held while training, released while a checkpoint is being served);
-the URL scheme is /checkpoint/<step>/{full|metadata|<chunk_i>} with optional
-round-robin chunking fetched in parallel by the receiver.
+the URL scheme is /checkpoint/<step>/{full|header|metadata|<chunk_i>}.
+
+Two performance structures on top of the reference design:
+
+- **Async snapshot pipeline** (donor side): ``send_checkpoint`` only
+  enqueues the pytree and opens the serving window — a background worker
+  does the device→host flatten into the inactive buffer slot and atomically
+  flips the served ``(meta, buffers, step)``, so the donor's train loop
+  never blocks on host copies (jax leaves are immutable, making the
+  by-reference snapshot safe).  A request for the pending step blocks
+  (bounded) until the flip instead of 404ing.
+
+- **Striped multi-donor fetch** (receiver side): ``recv_checkpoint``
+  accepts a list of donor URLs, partitions the buffer index space into
+  round-robin stripes (the ``chunk_<i>?n=<total>`` framing — receiver
+  parameterized, not server config), assigns stripes to donors balanced by
+  bytes, pulls them in parallel streaming each tensor straight into its
+  preallocated buffer, and fails a stripe over to the next donor on
+  error/timeout — so heal bandwidth scales with the donor count and a donor
+  dying mid-heal degrades instead of aborting.
 """
 
 from __future__ import annotations
@@ -16,10 +34,12 @@ import os
 import pickle
 import socket
 import threading
+import time
+import urllib.parse
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -28,6 +48,8 @@ from torchft_tpu.checkpointing.serialization import (
     StateDictMeta,
     as_u8,
     flatten_state_dict,
+    read_exact,
+    read_exact_into,
     read_state_dict,
     state_dict_frames,
     unflatten_state_dict,
@@ -44,12 +66,18 @@ class HTTPTransport(CheckpointTransport):
 
     Args:
         timeout: per-request deadline.
-        num_chunks: if > 0, the buffers are split round-robin into this many
-            chunks which the receiver fetches in parallel
-            (reference: torchft/checkpointing/http_transport.py:287-298).
+        num_chunks: if > 0, single-donor receivers that ask the legacy
+            ``/metadata`` endpoint are told to split the fetch into this many
+            round-robin chunks (reference:
+            torchft/checkpointing/http_transport.py:287-298).  Striped
+            multi-donor receivers choose their own stripe count instead.
         restore_sharding: optional spec -> jax.Sharding resolver used when
             rebuilding fetched arrays on device.
     """
+
+    # Pull-based: opening the serving window for every recovering group is
+    # free, which is what lets striped receivers fetch from all donors.
+    serves_all_donors = True
 
     def __init__(
         self,
@@ -64,8 +92,25 @@ class HTTPTransport(CheckpointTransport):
         # while a consistent snapshot is being served.
         self._checkpoint_lock = RWLock(timeout=timeout)
         self._checkpoint_lock.w_acquire()
+        # Served snapshot + async-snapshotter state, all guarded by
+        # _snap_cond: _state/_step are the ACTIVE (served) buffer slot,
+        # _snap_pending the newest enqueued-but-not-flattened snapshot
+        # (double buffering: the active slot keeps serving while the worker
+        # fills the inactive one; the flip is atomic under the condvar).
+        self._snap_cond = threading.Condition()
         self._state: Optional[Tuple[StateDictMeta, List[np.ndarray]]] = None
         self._step = -1
+        self._snap_pending: Optional[Tuple[int, Any]] = None
+        self._pending_step = -1
+        self._snap_error: Optional[Exception] = None
+        self._shutdown = False
+        self._spans = None  # optional obs SpanTracker (set_span_tracker)
+        # Optional serving-side bandwidth cap shared by ALL connections of
+        # this transport (TPUFT_HTTP_SHAPED_MBPS, read at construction):
+        # emulates a donor-NIC link for benchmarking the link-bound regime
+        # where striped multi-donor healing scales (the checkpoint-path
+        # sibling of the collective layer's TPUFT_SHAPED_LINK).
+        self._pacer = _ServerPacer.from_env()
 
         transport = self
 
@@ -74,8 +119,9 @@ class HTTPTransport(CheckpointTransport):
                 logger.debug(fmt % args)
 
             def do_GET(self) -> None:
-                parts = self.path.strip("/").split("/")
-                # /checkpoint/<step>/<what>
+                path, _, query = self.path.partition("?")
+                parts = path.strip("/").split("/")
+                # /checkpoint/<step>/<what>[?n=<stripes>]
                 if len(parts) != 3 or parts[0] != "checkpoint":
                     self.send_error(404, "unknown path")
                     return
@@ -85,16 +131,36 @@ class HTTPTransport(CheckpointTransport):
                     self.send_error(400, "bad step")
                     return
                 what = parts[2]
+                n_req: Optional[int] = None
+                if query:
+                    try:
+                        raw_n = urllib.parse.parse_qs(query).get("n", [None])[0]
+                        if raw_n is not None:
+                            n_req = int(raw_n)
+                    except ValueError:
+                        self.send_error(400, "bad stripe count")
+                        return
+                    if n_req is not None and n_req <= 0:
+                        self.send_error(400, "bad stripe count")
+                        return
                 try:
+                    # A snapshot for this step may still be flattening on the
+                    # worker thread: block (bounded) for the flip instead of
+                    # 404ing a healer that raced the async pipeline.
+                    transport._await_flip(step)
                     with transport._checkpoint_lock.r_lock(transport._timeout):
-                        if transport._state is None or transport._step != step:
-                            self.send_error(
-                                404,
-                                f"checkpoint for step {step} not available "
-                                f"(serving {transport._step})",
-                            )
-                            return
-                        meta, buffers = transport._state
+                        with transport._snap_cond:
+                            if transport._state is None or transport._step != step:
+                                self.send_error(
+                                    404,
+                                    f"checkpoint for step {step} not available "
+                                    f"(serving {transport._step})",
+                                )
+                                return
+                            # Buffer references are immutable after the flip:
+                            # serving can proceed outside the condvar even if
+                            # a newer snapshot flips mid-stream.
+                            meta, buffers = transport._state
                         if what == "full":
                             # Stream header + raw buffers straight to the
                             # socket: materializing a multi-GB BytesIO first
@@ -109,7 +175,12 @@ class HTTPTransport(CheckpointTransport):
                             )
                             self.send_header("Content-Length", str(total))
                             self.end_headers()
-                            write_state_dict(meta, buffers, self.wfile, prefix=prefix)
+                            write_state_dict(
+                                meta,
+                                buffers,
+                                _paced(self.wfile, transport._pacer),
+                                prefix=prefix,
+                            )
                             return
                         if what.startswith("chunk_"):
                             # Chunks stream too: building a ~GB chunk in a
@@ -117,7 +188,7 @@ class HTTPTransport(CheckpointTransport):
                             # holding the GIL, which convoys the parallel
                             # chunk readers (measured 3x worse than
                             # sequential on a 1-core host).
-                            framed = transport._chunk_frame(meta, buffers, what)
+                            framed = transport._chunk_frame(meta, buffers, what, n_req)
                             if framed is None:
                                 self.send_error(404, f"unknown object {what}")
                                 return
@@ -128,9 +199,10 @@ class HTTPTransport(CheckpointTransport):
                             )
                             self.send_header("Content-Length", str(total))
                             self.end_headers()
-                            self.wfile.write(sub_prefix)
+                            out = _paced(self.wfile, transport._pacer)
+                            out.write(sub_prefix)
                             for i in sel:
-                                self.wfile.write(memoryview(as_u8(buffers[i])))
+                                out.write(memoryview(as_u8(buffers[i])))
                             return
                         payload = transport._render(meta, buffers, what)
                         if payload is None:
@@ -150,21 +222,106 @@ class HTTPTransport(CheckpointTransport):
             target=self._server.serve_forever, name="tpuft_http_transport", daemon=True
         )
         self._thread.start()
+        self._snap_thread = threading.Thread(
+            target=self._snapshot_loop, name="tpuft_http_snapshot", daemon=True
+        )
+        self._snap_thread.start()
+
+    # -- async snapshot pipeline --------------------------------------------
+
+    def set_span_tracker(self, spans) -> None:
+        """Wires an :class:`~torchft_tpu.obs.spans.SpanTracker` so the
+        background flatten emits ``snapshot`` spans — the evidence in
+        ``obs.report`` that snapshotting overlaps the donor's train step
+        instead of sitting on its critical path."""
+        self._spans = spans
+
+    def _snapshot_loop(self) -> None:
+        """Worker: flatten the newest enqueued pytree into the inactive
+        buffer slot, then atomically flip the served snapshot."""
+        while True:
+            with self._snap_cond:
+                while self._snap_pending is None and not self._shutdown:
+                    self._snap_cond.wait()
+                if self._shutdown:
+                    return
+                step, state_dict = self._snap_pending
+                self._snap_pending = None
+            try:
+                # Device->host copies happen HERE, off the train loop.  The
+                # old snapshot keeps serving from the active slot until the
+                # flip below (double buffering).
+                if self._spans is not None:
+                    with self._spans.span("snapshot", step=step):
+                        meta, buffers = flatten_state_dict(state_dict, step=step)
+                else:
+                    meta, buffers = flatten_state_dict(state_dict, step=step)
+            except Exception as e:  # noqa: BLE001 — a failed snapshot must
+                # not kill the worker; healers see 404 and retry next round.
+                logger.exception("async snapshot for step %s failed: %s", step, e)
+                with self._snap_cond:
+                    self._snap_error = e
+                    if self._pending_step == step:
+                        self._pending_step = -1
+                    self._snap_cond.notify_all()
+                continue
+            with self._snap_cond:
+                if step >= self._step:
+                    self._state = (meta, buffers)
+                    self._step = step
+                self._snap_error = None
+                if self._pending_step == step:
+                    self._pending_step = -1
+                self._snap_cond.notify_all()
+
+    def _await_flip(self, step: int) -> None:
+        """Blocks while a snapshot for ``step`` is enqueued/flattening, until
+        it becomes servable (or fails / times out)."""
+        deadline = time.monotonic() + self._timeout
+        with self._snap_cond:
+            while (
+                self._step < step
+                and self._pending_step >= step
+                and not self._shutdown
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("snapshot still pending")
+                self._snap_cond.wait(remaining)
+
+    def wait_snapshot(self, timeout: Optional[float] = None) -> bool:
+        """Blocks until no snapshot is pending (benches/tests: separates
+        snapshot cost from fetch cost).  Returns False on timeout or when
+        the last snapshot FAILED to flatten — a silent True here would let
+        a bench/test treat an unservable donor as ready."""
+        deadline = time.monotonic() + (timeout if timeout is not None else self._timeout)
+        with self._snap_cond:
+            while (self._snap_pending is not None or self._pending_step >= 0) and not self._shutdown:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._snap_cond.wait(remaining)
+            return self._snap_error is None
 
     # -- serving ------------------------------------------------------------
 
     def _chunk_frame(
-        self, meta: StateDictMeta, buffers: List[np.ndarray], what: str
+        self,
+        meta: StateDictMeta,
+        buffers: List[np.ndarray],
+        what: str,
+        n_req: Optional[int] = None,
     ) -> Optional[Tuple[bytes, List[int], int]]:
         """(sub_meta prefix, selected buffer indices, total body length) for
-        one chunk_<i> request, or None for a bad index.  Round-robin
-        assignment keeps chunk sizes balanced without reordering metadata
+        one chunk_<i> request, or None for a bad index.  The receiver may
+        parameterize the round-robin split via ``?n=<total>`` (striped
+        multi-donor fetch); without it the server's own chunk config applies
         (torchft/checkpointing/http_transport.py:287-298)."""
         try:
             idx = int(what[len("chunk_"):])
         except ValueError:
             return None  # malformed chunk index -> 404, not a 500 traceback
-        n = self._chunk_count(buffers)
+        n = n_req if n_req is not None else self._chunk_count(buffers)
         if idx < 0 or idx >= n:
             return None
         sel = [i for i in range(len(buffers)) if i % n == idx]
@@ -200,10 +357,19 @@ class HTTPTransport(CheckpointTransport):
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
     ) -> None:
-        """Pull-based: snapshot to host and open the serving window."""
-        meta, buffers = flatten_state_dict(state_dict, step=step)
-        self._state = (meta, buffers)
-        self._step = step
+        """Pull-based: enqueue the snapshot and open the serving window.
+
+        Returns immediately — the flatten (device->host copy of every leaf)
+        runs on the background snapshotter.  The by-reference capture is
+        safe because jax.Arrays are immutable and the Manager builds a fresh
+        state-dict tree per call; a caller passing mutable numpy leaves must
+        not mutate them in place before the snapshot lands (wait_snapshot).
+        """
+        with self._snap_cond:
+            # Drop-stale: only the newest enqueued snapshot matters.
+            self._snap_pending = (step, state_dict)
+            self._pending_step = max(self._pending_step, step)
+            self._snap_cond.notify_all()
         self.allow_checkpoint(step)
 
     def allow_checkpoint(self, step: int) -> None:
@@ -216,67 +382,266 @@ class HTTPTransport(CheckpointTransport):
                 raise TimeoutError("timed out re-acquiring checkpoint write lock")
 
     def recv_checkpoint(
-        self, src_rank: int, metadata: str, step: int, timeout: float
+        self,
+        src_rank: int,
+        metadata: Union[str, Sequence[str]],
+        step: int,
+        timeout: float,
     ) -> Any:
-        base = f"{metadata}/checkpoint/{step}"
-        n_chunks = pickle.loads(_fetch(f"{base}/metadata", timeout))
-        # Parallel chunk pulls only pay when there are cores to run them:
-        # on a 1-core host the decode threads convoy on the GIL (measured
-        # 3x slower than sequential, 10x slower than one stream at 3.75 GB)
-        # — the RECEIVER decides, since the server serves /full regardless
-        # of its chunking config.  TPUFT_HTTP_CHUNK_WORKERS overrides the
-        # cpu-count heuristic (tests force the chunked path on 1-core CI).
+        """Fetches the checkpoint from one or many donors.
+
+        ``metadata`` may be a single donor base URL or an ordered donor
+        list; with several donors the fetch is striped across all of them
+        (disjoint byte ranges in parallel) and any stripe fails over to the
+        next donor, so one donor dying mid-heal degrades bandwidth instead
+        of aborting the heal.
+        """
+        donors = [metadata] if isinstance(metadata, str) else [m for m in metadata if m]
+        if not donors:
+            raise ValueError("recv_checkpoint: no donor metadata")
         try:
             forced = int(os.environ.get("TPUFT_HTTP_CHUNK_WORKERS") or 0)
         except ValueError:
             # A malformed tuning knob must not abort recovery itself.
             logger.warning("ignoring malformed TPUFT_HTTP_CHUNK_WORKERS")
             forced = 0
-        workers = forced or min(n_chunks, os.cpu_count() or 1)
-        if n_chunks <= 1 or workers < 2:
-            # Deserialize straight off the socket: buffering the whole
-            # multi-GB response into bytes first doubles peak memory and
-            # adds a full copy.
-            with urllib.request.urlopen(f"{base}/full", timeout=timeout) as resp:
-                meta, buffers = read_state_dict(resp)
+
+        n_stripes = 0
+        if len(donors) == 1:
+            base = f"{donors[0]}/checkpoint/{step}"
+            n_chunks = pickle.loads(self._fetch(f"{base}/metadata", timeout))
+            # Parallel chunk pulls only pay when there are cores to run
+            # them: on a 1-core host the decode threads convoy on the GIL —
+            # the RECEIVER decides, since the server serves /full regardless
+            # of its chunking config.  TPUFT_HTTP_CHUNK_WORKERS overrides
+            # the cpu-count heuristic (tests force the chunked path on
+            # 1-core CI).
+            workers = forced or min(n_chunks, os.cpu_count() or 1)
+            if n_chunks <= 1 or workers < 2:
+                # Deserialize straight off the socket: buffering the whole
+                # multi-GB response into bytes first doubles peak memory and
+                # adds a full copy.
+                with self._urlopen(f"{base}/full", timeout) as resp:
+                    meta, buffers = read_state_dict(resp)
+                return unflatten_state_dict(meta, buffers, self._restore_sharding)
+            n_stripes = n_chunks
         else:
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                parts = list(
-                    pool.map(
-                        lambda i: _fetch(f"{base}/chunk_{i}", timeout), range(n_chunks)
-                    )
-                )
-            meta, buffers = self._assemble_chunks(base, parts, timeout)
+            workers = forced or max(len(donors), min(2 * len(donors), os.cpu_count() or 1))
+
+        meta, buffers = self._recv_striped(donors, step, n_stripes, workers, timeout)
         return unflatten_state_dict(meta, buffers, self._restore_sharding)
 
-    def _assemble_chunks(
-        self, base: str, parts: List[bytes], timeout: float
+    # -- striped multi-donor receive ----------------------------------------
+
+    def _recv_striped(
+        self,
+        donors: List[str],
+        step: int,
+        n_stripes: int,
+        workers: int,
+        timeout: float,
     ) -> Tuple[StateDictMeta, List[np.ndarray]]:
-        meta_stream = io.BytesIO(_fetch(f"{base}/header", timeout))
-        header_len = int.from_bytes(meta_stream.read(8), "little")
-        meta: StateDictMeta = pickle.loads(meta_stream.read(header_len))
-        buffers: List[Optional[np.ndarray]] = [None] * len(meta.tensor_metas)
-        for part in parts:
-            sub_len = int.from_bytes(part[:8], "little")
-            idx, sel = pickle.loads(part[8 : 8 + sub_len])
-            offset = 8 + sub_len
-            for i in sel:
-                tm = meta.tensor_metas[i]
-                raw = part[offset : offset + tm.nbytes]
-                offset += tm.nbytes
-                buffers[i] = (
-                    np.frombuffer(raw, dtype=np.uint8).view(tm.dtype).reshape(tm.shape)
-                )
-        assert all(b is not None for b in buffers), "missing chunks"
-        return meta, buffers  # type: ignore[return-value]
+        dead: set = set()
+        meta = self._fetch_header(donors, step, timeout, dead)
+        n_tensors = len(meta.tensor_metas)
+        if n_tensors == 0:
+            return meta, []
+        if n_stripes <= 0:
+            # Over-stripe 2x the donor count: byte-greedy assignment can
+            # then balance donors with heterogeneous tensor sizes, and a
+            # dead donor's work splits across the survivors.
+            n_stripes = min(n_tensors, max(1, 2 * len(donors)))
+        n_stripes = min(n_stripes, n_tensors)
+        sels, sizes = _stripe_partition(meta, n_stripes)
+        assign = _assign_stripes_by_bytes(sizes, len(donors))
+
+        # Preallocate every tensor's final buffer once; stripe bodies stream
+        # straight into these (no whole-chunk bytes materialization, no
+        # per-tensor slice copies — this halves peak RSS during heal).
+        store = [bytearray(tm.nbytes) for tm in meta.tensor_metas]
+        views = [memoryview(b) for b in store]
+
+        def fetch_stripe(idx: int) -> None:
+            self._fetch_stripe(
+                donors, assign[idx], step, n_stripes, idx, sels[idx], meta, views,
+                timeout, dead,
+            )
+
+        if workers >= 2 and n_stripes > 1:
+            with ThreadPoolExecutor(max_workers=min(workers, n_stripes)) as pool:
+                list(pool.map(fetch_stripe, range(n_stripes)))
+        else:
+            for idx in range(n_stripes):
+                fetch_stripe(idx)
+
+        buffers = [
+            np.frombuffer(store[i], dtype=np.uint8).view(tm.dtype).reshape(tm.shape)
+            for i, tm in enumerate(meta.tensor_metas)
+        ]
+        return meta, buffers
+
+    def _fetch_header(
+        self, donors: List[str], step: int, timeout: float, dead: set
+    ) -> StateDictMeta:
+        last: Optional[Exception] = None
+        for d, donor in enumerate(donors):
+            try:
+                raw = self._fetch(f"{donor}/checkpoint/{step}/header", timeout)
+            except Exception as e:  # noqa: BLE001 — failover to next donor
+                dead.add(d)
+                last = e
+                logger.warning("header fetch from %s failed: %s", donor, e)
+                continue
+            stream = io.BytesIO(raw)
+            header_len = int.from_bytes(stream.read(8), "little")
+            return pickle.loads(stream.read(header_len))
+        raise RuntimeError(f"all {len(donors)} donors failed serving the header: {last}")
+
+    def _fetch_stripe(
+        self,
+        donors: List[str],
+        assigned: int,
+        step: int,
+        n: int,
+        idx: int,
+        sel: List[int],
+        meta: StateDictMeta,
+        views: List[memoryview],
+        timeout: float,
+        dead: set,
+    ) -> None:
+        """Pulls stripe ``idx`` of ``n`` into the preallocated views, failing
+        over from the assigned donor through the rest of the rotation."""
+        order = [(assigned + k) % len(donors) for k in range(len(donors))]
+        candidates = [d for d in order if d not in dead] or order
+        last: Optional[Exception] = None
+        # Single-donor chunked fetches omit the ?n= query: n already equals
+        # the chunk count the server advertised on /metadata, and a pre-PR
+        # donor's handler cannot parse a query string (rolling-upgrade
+        # back-compat the wire doc promises).
+        query = f"?n={n}" if len(donors) > 1 else ""
+        for attempt, d in enumerate(candidates):
+            url = f"{donors[d]}/checkpoint/{step}/chunk_{idx}{query}"
+            try:
+                with self._urlopen(url, timeout) as resp:
+                    sub_len = int.from_bytes(read_exact(resp, 8), "little")
+                    got_idx, got_sel = pickle.loads(bytes(read_exact(resp, sub_len)))
+                    if got_idx != idx or list(got_sel) != list(sel):
+                        raise RuntimeError(
+                            f"stripe mismatch: asked ({idx},{n}), got {got_idx}"
+                        )
+                    for i in got_sel:
+                        read_exact_into(resp, views[i])
+                return
+            except Exception as e:  # noqa: BLE001 — stripe failover
+                last = e
+                dead.add(d)
+                if attempt + 1 < len(candidates):
+                    logger.warning(
+                        "stripe %d/%d from %s failed (%s); failing over to %s",
+                        idx, n, donors[d], e, donors[candidates[attempt + 1]],
+                    )
+        raise RuntimeError(
+            f"stripe {idx}/{n} failed on all {len(candidates)} donors: {last}"
+        )
+
+    def _fetch(self, url: str, timeout: float) -> bytes:
+        with self._urlopen(url, timeout) as resp:
+            return resp.read()
+
+    def _urlopen(self, url: str, timeout: float):
+        """Single indirection for every receiver-side HTTP open (tests hook
+        this to inject donor death deterministically)."""
+        return urllib.request.urlopen(url, timeout=timeout)
 
     def shutdown(self, wait: bool = True) -> None:
+        with self._snap_cond:
+            self._shutdown = True
+            self._snap_cond.notify_all()
         self._server.shutdown()
         self._server.server_close()
         if wait:
             self._thread.join(timeout=5)
+            self._snap_thread.join(timeout=5)
 
 
-def _fetch(url: str, timeout: float) -> bytes:
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        return resp.read()
+def _stripe_partition(
+    meta: StateDictMeta, n: int
+) -> Tuple[List[List[int]], List[int]]:
+    """Round-robin buffer-index stripes and their byte sizes — must mirror
+    the server's ``sel`` arithmetic in ``_chunk_frame`` exactly."""
+    sels: List[List[int]] = [[] for _ in range(n)]
+    sizes = [0] * n
+    for i, tm in enumerate(meta.tensor_metas):
+        sels[i % n].append(i)
+        sizes[i % n] += tm.nbytes
+    return sels, sizes
+
+
+def _assign_stripes_by_bytes(sizes: List[int], n_donors: int) -> List[int]:
+    """Greedy byte-balanced stripe->donor assignment (largest stripes first
+    onto the least-loaded donor), so heterogeneous tensor sizes don't leave
+    one donor's link idle while another's saturates."""
+    loads = [0] * n_donors
+    assign = [0] * len(sizes)
+    for idx in sorted(range(len(sizes)), key=lambda s: -sizes[s]):
+        d = min(range(n_donors), key=lambda j: loads[j])
+        assign[idx] = d
+        loads[d] += sizes[idx]
+    return assign
+
+
+class _ServerPacer:
+    """Virtual-time link shared by every connection of one transport: each
+    write reserves `bytes / rate` seconds of the link and sleeps until its
+    reservation ends, so N parallel stripe readers see ONE donor-NIC's
+    bandwidth, not N connections' worth.  Benchmark-only (enabled by
+    TPUFT_HTTP_SHAPED_MBPS at transport construction)."""
+
+    def __init__(self, mbps: float) -> None:
+        self._rate = mbps * 1e6
+        self._lock = threading.Lock()
+        self._next_free = 0.0
+
+    @classmethod
+    def from_env(cls) -> Optional["_ServerPacer"]:
+        try:
+            mbps = float(os.environ.get("TPUFT_HTTP_SHAPED_MBPS") or 0.0)
+        except ValueError:
+            mbps = 0.0
+        return cls(mbps) if mbps > 0 else None
+
+    def consume(self, n: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            start = max(now, self._next_free)
+            self._next_free = start + n / self._rate
+            until = self._next_free
+        if until > now:
+            time.sleep(until - now)
+
+
+class _PacedStream:
+    """Write-through wrapper applying a shared _ServerPacer in ~4 MB slices
+    (smooth pacing; a donor killed mid-fetch dies mid-stripe)."""
+
+    _SLICE = 4 << 20
+
+    def __init__(self, raw, pacer: _ServerPacer) -> None:
+        self._raw = raw
+        self._pacer = pacer
+
+    def write(self, data) -> int:
+        mv = memoryview(data)
+        for off in range(0, len(mv), self._SLICE):
+            part = mv[off : off + self._SLICE]
+            # Reserve the link BEFORE writing: the actual socket write then
+            # overlaps the next reservation instead of adding to it, so the
+            # emulated link runs at its nominal rate.
+            self._pacer.consume(len(part))
+            self._raw.write(part)
+        return len(mv)
+
+
+def _paced(raw, pacer: Optional[_ServerPacer]):
+    return raw if pacer is None else _PacedStream(raw, pacer)

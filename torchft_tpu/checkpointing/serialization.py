@@ -26,6 +26,8 @@ __all__ = [
     "state_dict_frames",
     "write_state_dict",
     "read_state_dict",
+    "read_exact",
+    "read_exact_into",
     "sharding_restorer",
 ]
 
@@ -33,7 +35,24 @@ __all__ = [
 def as_u8(arr: np.ndarray) -> np.ndarray:
     """Reinterprets any contiguous array (including ml_dtypes such as
     bfloat16, which memoryview cannot cast) as a flat uint8 view."""
-    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+    arr = _contiguous(arr)
+    if arr.ndim == 0:
+        # .view(uint8) rejects 0-d arrays; reshape is a view, not a copy.
+        # Scalar leaves (e.g. optax.adam's `count`) keep their recorded ()
+        # shape in TensorMeta — only the byte view is 1-d.
+        arr = arr.reshape(1)
+    return arr.view(np.uint8).reshape(-1)
+
+
+def _contiguous(arr: np.ndarray) -> np.ndarray:
+    """C-contiguous view of ``arr`` without the copy ``ascontiguousarray``
+    would make for an already-contiguous input in edge cases (0-d arrays get
+    silently promoted to shape (1,), which would corrupt the recorded leaf
+    shape); the serialization hot path must never pay a full host copy for
+    a leaf that is already laid out correctly."""
+    if arr.flags.c_contiguous:
+        return arr
+    return np.ascontiguousarray(arr)
 
 
 @dataclass
@@ -91,7 +110,9 @@ def flatten_state_dict(state_dict: Any, step: int = 0) -> Tuple[StateDictMeta, L
     buffers: List[np.ndarray] = []
     for leaf in leaves:
         if isinstance(leaf, jax.Array):
-            host = np.ascontiguousarray(np.asarray(leaf))
+            # np.asarray already materializes a fresh host copy; only pay a
+            # SECOND copy when that copy came back non-contiguous.
+            host = _contiguous(np.asarray(leaf))
             meta.leaves.append(("tensor", len(buffers)))
             meta.tensor_metas.append(
                 TensorMeta(
@@ -104,7 +125,7 @@ def flatten_state_dict(state_dict: Any, step: int = 0) -> Tuple[StateDictMeta, L
             )
             buffers.append(host)
         elif isinstance(leaf, np.ndarray):
-            host = np.ascontiguousarray(leaf)
+            host = _contiguous(leaf)
             meta.leaves.append(("tensor", len(buffers)))
             meta.tensor_metas.append(
                 TensorMeta(
@@ -224,21 +245,22 @@ def write_state_dict(
 
 def read_state_dict(stream: io.RawIOBase) -> Tuple[StateDictMeta, List[np.ndarray]]:
     """Reads one write_state_dict frame: (header, raw host buffers)."""
-    header_len = int.from_bytes(_read_exact(stream, 8), "little")
-    meta: StateDictMeta = pickle.loads(_read_exact(stream, header_len))
+    header_len = int.from_bytes(read_exact(stream, 8), "little")
+    meta: StateDictMeta = pickle.loads(read_exact(stream, header_len))
     buffers: List[np.ndarray] = []
     for tm in meta.tensor_metas:
-        raw = _read_exact(stream, tm.nbytes)
+        raw = read_exact(stream, tm.nbytes)
         buffers.append(np.frombuffer(raw, dtype=np.uint8).view(tm.dtype).reshape(tm.shape))
     return meta, buffers
 
 
-def _read_exact(stream: io.RawIOBase, n: int) -> bytearray:
-    """Reads exactly n bytes into a preallocated buffer (readinto when the
-    stream supports it — no grow-and-recopy, and the result is returned
-    without a final bytes() copy; np.frombuffer/pickle accept bytearray)."""
-    out = bytearray(n)
-    view = memoryview(out)
+def read_exact_into(stream: io.RawIOBase, view: memoryview) -> None:
+    """Fills ``view`` completely from ``stream`` (readinto when the stream
+    supports it — bytes land directly in the caller's preallocated buffer,
+    no intermediate ``bytes`` materialization).  This is what lets the
+    chunked/striped HTTP receive path stream tensor payloads straight into
+    their final per-tensor buffers instead of double-copying."""
+    n = len(view)
     got = 0
     readinto = getattr(stream, "readinto", None)
     while got < n:
@@ -253,4 +275,12 @@ def _read_exact(stream: io.RawIOBase, n: int) -> bytearray:
                 raise EOFError(f"stream ended after {got}/{n} bytes")
             view[got : got + len(chunk)] = chunk
             got += len(chunk)
+
+
+def read_exact(stream: io.RawIOBase, n: int) -> bytearray:
+    """Reads exactly n bytes into a preallocated buffer, returned without a
+    final bytes() copy (np.frombuffer/pickle accept bytearray)."""
+    out = bytearray(n)
+    read_exact_into(stream, memoryview(out))
     return out
+

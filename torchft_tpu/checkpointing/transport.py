@@ -15,6 +15,14 @@ T = TypeVar("T")
 
 
 class CheckpointTransport(ABC, Generic[T]):
+    # True for pull-based transports whose serving is passive (an opened
+    # window costs nothing if unused): the manager then serves EVERY
+    # recovering group, enabling striped multi-donor fetches.  Push/
+    # point-to-point transports (collective send/recv) keep the default —
+    # their sends block until matched, so they only serve primary
+    # assignments.
+    serves_all_donors: bool = False
+
     @abstractmethod
     def metadata(self) -> str:
         """Returns transport metadata (e.g. "http://host:port") relayed to
@@ -36,7 +44,11 @@ class CheckpointTransport(ABC, Generic[T]):
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> T:
         """Fetches the state dict for `step` from the source replica rank
-        using its advertised `metadata`."""
+        using its advertised `metadata`.
+
+        The manager may pass an ordered donor-metadata LIST instead of one
+        string when the quorum assigned several healthy donors; transports
+        that cannot stripe across sources should use the first entry."""
 
     def shutdown(self, wait: bool = True) -> None:
         """Releases transport resources."""

@@ -53,6 +53,11 @@ REPLICA_ID_KEY: str = "replica_id"
 # Environment knobs (reference: torchft/manager.py:50,166-205).
 TPUFT_LIGHTHOUSE_ENV: str = "TPUFT_LIGHTHOUSE"
 TPUFT_MANAGER_PORT_ENV: str = "TPUFT_MANAGER_PORT"
+# Cap on how many donors one healer stripes a fetch across.  More donors =
+# more aggregate bandwidth (each serves a disjoint byte range) but also more
+# connections per heal; 4 saturates typical host NICs long before the donor
+# pool does.  0 = no cap.
+TPUFT_MAX_HEAL_DONORS_ENV: str = "TPUFT_MAX_HEAL_DONORS"
 
 
 class WorldSizeMode(Enum):
@@ -242,6 +247,15 @@ class Manager:
         # phase below runs inside a span, and the span's single monotonic
         # measurement also feeds the legacy *_ms fields.
         self._spans = SpanTracker(self._metrics)
+        self._wire_transport_spans()
+
+    def _wire_transport_spans(self) -> None:
+        """Hands the span tracker to transports that emit their own spans —
+        the HTTP transport's background snapshotter emits ``snapshot`` spans
+        so obs.report can show the flatten overlapping the train step."""
+        transport = self._checkpoint_transport
+        if transport is not None and hasattr(transport, "set_span_tracker"):
+            transport.set_span_tracker(self._spans)
 
     # -- registration -------------------------------------------------------
 
@@ -255,6 +269,7 @@ class Manager:
 
     def set_checkpoint_transport(self, transport: CheckpointTransport) -> None:
         self._checkpoint_transport = transport
+        self._wire_transport_spans()
 
     # -- quorum -------------------------------------------------------------
 
@@ -397,44 +412,77 @@ class Manager:
             )
 
         if allow_heal and self._checkpoint_transport is not None:
-            # Recovery source: serve our weights to the assigned destinations
-            # (torchft/manager.py:511-528).
-            if quorum.recover_dst_replica_ranks:
+            # Recovery source: serve our weights (torchft/manager.py:511-528).
+            # Pull-based transports serve the FULL recovering set (striped
+            # multi-donor fetch pulls disjoint byte ranges from every donor);
+            # point-to-point transports serve only primary assignments —
+            # their sends block until the healer's matching recv.
+            if self._checkpoint_transport.serves_all_donors:
+                serve_dsts = list(
+                    getattr(quorum, "recover_dst_replica_ranks_all", None)
+                    or quorum.recover_dst_replica_ranks
+                )
+            else:
+                serve_dsts = list(quorum.recover_dst_replica_ranks)
+            if serve_dsts:
                 self._logger.info(
                     f"serving checkpoint at step {max_step} to replicas "
-                    f"{quorum.recover_dst_replica_ranks}"
+                    f"{serve_dsts}"
                 )
                 self._checkpoint_transport.send_checkpoint(
-                    dst_ranks=list(quorum.recover_dst_replica_ranks),
+                    dst_ranks=serve_dsts,
                     step=max_step,
                     state_dict=self._manager_state_dict(),
                     timeout=self._timeout.total_seconds(),
                 )
-            # Recovery destination: fetch weights from our assigned source
-            # (torchft/manager.py:530-568).
+            # Recovery destination: fetch weights from the assigned donors —
+            # striped across every healthy max-step group the quorum listed,
+            # so heal bandwidth scales with the donor count and one donor
+            # dying mid-heal degrades instead of aborting
+            # (torchft/manager.py:530-568 is the single-donor ancestor).
             if heal:
                 self._healing = True
                 src_rank = cast(int, recover_src_replica_rank)
+                donor_ranks = list(quorum.recover_src_replica_ranks) or [src_rank]
+                donor_addrs = list(quorum.recover_src_manager_addresses) or [
+                    quorum.recover_src_manager_address
+                ]
+                max_donors = _max_heal_donors()
+                if max_donors > 0:
+                    donor_ranks = donor_ranks[:max_donors]
+                    donor_addrs = donor_addrs[:max_donors]
+                if not self._checkpoint_transport.serves_all_donors:
+                    # Point-to-point transports: only the PRIMARY donor is
+                    # sending to us — failing over to another donor would
+                    # recv from a peer with no matching send (hang, then
+                    # timeout) instead of failing fast and re-planning on
+                    # the next quorum.
+                    donor_ranks = donor_ranks[:1]
+                    donor_addrs = donor_addrs[:1]
+                # "healing from replica" is a grep contract with bench.py's
+                # log-fallback heal counter (tests/test_bench_contract.py).
                 self._logger.info(
-                    f"healing from replica {src_rank} "
-                    f"({quorum.recover_src_manager_address}) at step {max_step}"
+                    f"healing from replica {src_rank} at step {max_step} via "
+                    f"{len(donor_addrs)} donor(s) {list(zip(donor_ranks, donor_addrs))}"
                 )
-                self._metrics.emit("heal_start", src_rank=src_rank, max_step=max_step)
+                self._metrics.emit(
+                    "heal_start",
+                    src_rank=src_rank,
+                    max_step=max_step,
+                    n_donors=len(donor_addrs),
+                )
                 self._set_status("heal")
                 with self._spans.span(
                     "heal", step=max_step, src_rank=src_rank
                 ) as sp_heal:
-                    src_client = self._manager_client_factory(
-                        quorum.recover_src_manager_address,
-                        connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
+                    donor_metas, donor_used = self._resolve_donor_metadatas(
+                        donor_ranks, donor_addrs
                     )
-                    src_metadata = src_client._checkpoint_metadata(
-                        self._rank, timeout_ms=int(self._timeout.total_seconds() * 1000)
-                    )
-                    src_client.close()
                     state = self._checkpoint_transport.recv_checkpoint(
-                        src_rank=src_rank,
-                        metadata=src_metadata,
+                        src_rank=donor_used[0],
+                        metadata=(
+                            donor_metas if len(donor_metas) > 1 else donor_metas[0]
+                        ),
                         step=max_step,
                         timeout=self._timeout.total_seconds(),
                     )
@@ -443,9 +491,10 @@ class Manager:
                     self._step = max_step
                 self._metrics.emit(
                     "heal_fetched",
-                    src_rank=src_rank,
+                    src_rank=donor_used[0],
                     step=max_step,
                     heal_ms=sp_heal.duration_ms,
+                    n_donors=len(donor_metas),
                 )
         elif heal:
             self._healing = True
@@ -454,6 +503,63 @@ class Manager:
         # the commit vote — without this the async-quorum overlap leaves the
         # replica labeled "quorum"/"heal" for the whole compute phase.
         self._set_status("step")
+
+    def _resolve_donor_metadatas(
+        self, donor_ranks: List[int], donor_addrs: List[str]
+    ) -> tuple:
+        """Dials each donor's manager for its per-rank transport metadata,
+        dropping donors that do not answer (a donor can die between the
+        quorum and the heal; the stripe fetch then simply never includes
+        it).  The dials run in parallel so one hung donor costs a single
+        timeout, not a sum of timeouts, on the heal critical path.  Raises
+        only when NO donor is reachable."""
+
+        def dial(pair) -> str:
+            rank_i, addr_i = pair
+            client = self._manager_client_factory(
+                addr_i,
+                connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
+            )
+            try:
+                return client._checkpoint_metadata(
+                    self._rank,
+                    timeout_ms=int(self._timeout.total_seconds() * 1000),
+                )
+            finally:
+                client.close()
+
+        pairs = list(zip(donor_ranks, donor_addrs))
+        metas: List[str] = []
+        used: List[int] = []
+        last_err: Optional[Exception] = None
+        if len(pairs) == 1:
+            outcomes = [self._try_call(dial, pairs[0])]
+        else:
+            with ThreadPoolExecutor(
+                max_workers=len(pairs), thread_name_prefix="tpuft_donor_dial"
+            ) as pool:
+                outcomes = list(pool.map(lambda p: self._try_call(dial, p), pairs))
+        for (rank_i, addr_i), (meta, err) in zip(pairs, outcomes):
+            if err is None:
+                metas.append(meta)
+                used.append(rank_i)
+            else:
+                last_err = err
+                self._logger.warn(f"donor {rank_i} ({addr_i}) unreachable: {err}")
+        if not metas:
+            raise RuntimeError(
+                f"no heal donor reachable (tried {len(donor_addrs)}): {last_err}"
+            )
+        return metas, used
+
+    @staticmethod
+    def _try_call(fn, arg) -> tuple:
+        """(result, None) or (None, exception) — lets a parallel map report
+        per-item failures without aborting the batch."""
+        try:
+            return fn(arg), None
+        except Exception as e:  # noqa: BLE001
+            return None, e
 
     def _manager_state_dict(self) -> Dict[str, object]:
         """Full transferable state: user trees + manager bookkeeping
@@ -828,6 +934,16 @@ class Manager:
             self._manager_server.shutdown()
         if self._store_server is not None:
             self._store_server.shutdown()
+
+
+def _max_heal_donors() -> int:
+    """Donor-count cap for one striped heal (``TPUFT_MAX_HEAL_DONORS``,
+    default 4, 0 = uncapped); malformed values fall back to the default —
+    a bad tuning knob must not abort recovery."""
+    try:
+        return int(os.environ.get(TPUFT_MAX_HEAL_DONORS_ENV, "4"))
+    except ValueError:
+        return 4
 
 
 def _is_jax_array(x) -> bool:

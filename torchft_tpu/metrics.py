@@ -48,8 +48,10 @@ EVENTS = {
     # -- Manager step lifecycle (torchft_tpu/manager.py) --------------------
     "quorum": "quorum result for a step (membership, participation, quorum_ms)",
     "reconfigure": "cross-group collective rebuilt for a new quorum id",
-    "heal_start": "this replica began fetching weights from a peer",
-    "heal_fetched": "healed state dict received (heal_ms = fetch duration)",
+    "heal_start": "this replica began fetching weights from its donors "
+                  "(n_donors = striped multi-donor fan-in)",
+    "heal_fetched": "healed state dict received (heal_ms = fetch duration, "
+                    "n_donors = donors actually striped across)",
     "error": "an error was latched for the current step",
     "commit": "two-phase commit vote decided (committed, vote_ms)",
     # -- spans (torchft_tpu/obs/spans.py) -----------------------------------

@@ -151,6 +151,14 @@ def deadwindow(
 # Per-step attribution
 # ---------------------------------------------------------------------------
 
+# Phases that run on background threads CONCURRENT with the train step
+# (torchft_tpu/obs/spans.py OVERLAPPED_PHASES): the donor-side async
+# snapshot flatten.  They are reported (snapshot_overlap_s) but never
+# charged against productive wall time — subtracting an overlapped span
+# from the step interval would fabricate FT cost that the async pipeline
+# specifically does not impose.
+_OVERLAPPED = ("snapshot",)
+
 # Phase ms a legacy (pre-span) stream carries on its lifecycle events,
 # mapped onto span phase names so old recordings still attribute.
 _LEGACY_MS = {
@@ -247,6 +255,9 @@ def attribute(events: Sequence[dict]) -> dict:
         "other_ft_s": 0.0,
         "drain_s": 0.0,
         "idle_s": 0.0,
+        # Informational: background snapshot time OVERLAPPED with the steps
+        # above — deliberately outside the accounted classification.
+        "snapshot_overlap_s": 0.0,
     }
     t0 = dw["t0"]
     for rid, seq in per_inc.items():
@@ -259,17 +270,19 @@ def attribute(events: Sequence[dict]) -> dict:
             phases = phase_ms.get((rid, step), {})
             q = phases.get("quorum", 0.0) / 1e3
             heal = phases.get("heal", 0.0) / 1e3
+            skip = ("quorum", "heal") + _OVERLAPPED
             other_ft = (
-                sum(v for k, v in phases.items() if k not in ("quorum", "heal"))
-                / 1e3
+                sum(v for k, v in phases.items() if k not in skip) / 1e3
+            )
+            snapshot_overlap = (
+                sum(phases.get(k, 0.0) for k in _OVERLAPPED) / 1e3
             )
             productive = max(0.0, wall - q - heal - other_ft)
             buckets = {
                 "productive": productive,
                 "quorum_wait": q,
                 "heal": heal,
-                **{k: v / 1e3 for k, v in phases.items()
-                   if k not in ("quorum", "heal")},
+                **{k: v / 1e3 for k, v in phases.items() if k not in skip},
             }
             critical = max(buckets, key=lambda k: buckets[k]) if wall > 0 else "-"
             steps.setdefault(step, []).append(
@@ -279,6 +292,7 @@ def attribute(events: Sequence[dict]) -> dict:
                     "quorum_wait_s": q,
                     "heal_s": heal,
                     "other_ft_s": other_ft,
+                    "snapshot_overlap_s": snapshot_overlap,
                     "productive_s": productive,
                     "critical": critical,
                 }
@@ -287,6 +301,7 @@ def attribute(events: Sequence[dict]) -> dict:
             totals["quorum_wait_s"] += q
             totals["heal_s"] += heal
             totals["other_ft_s"] += other_ft
+            totals["snapshot_overlap_s"] += snapshot_overlap
 
     # A restarted incarnation's heal span lies BEFORE its first commit, so
     # no commit interval covers it; credit it to the heal class (carved
@@ -349,6 +364,7 @@ def attribute(events: Sequence[dict]) -> dict:
                 "quorum_wait_s": round(slowest["quorum_wait_s"], 4),
                 "heal_s": round(slowest["heal_s"], 4),
                 "other_ft_s": round(slowest["other_ft_s"], 4),
+                "snapshot_overlap_s": round(slowest["snapshot_overlap_s"], 4),
                 "critical": slowest["critical"],
             }
         )

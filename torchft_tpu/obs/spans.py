@@ -28,14 +28,23 @@ from typing import Dict, Optional
 
 from torchft_tpu.metrics import MetricsLogger
 
-__all__ = ["PHASES", "Span", "SpanTracker"]
+__all__ = ["PHASES", "OVERLAPPED_PHASES", "Span", "SpanTracker"]
 
 # The Manager step phases report.py attributes (docs/architecture.md
 # "Observability").  quorum = blocking wait on the lighthouse round;
 # configure = collective rebuild on quorum change; heal = peer weight
 # fetch; allreduce_merge = drain of pending allreduce futures at commit
-# time; commit_vote = the two-phase commit barrier RPC.
-PHASES = ("quorum", "configure", "heal", "allreduce_merge", "commit_vote")
+# time; commit_vote = the two-phase commit barrier RPC; snapshot = the
+# donor-side device->host flatten on the HTTP transport's background
+# snapshotter — an OVERLAPPED phase (it runs concurrently with the train
+# step, so report.py shows it but does not charge it against productive
+# time; a snapshot span on the critical path is exactly the regression the
+# async pipeline exists to prevent).
+PHASES = ("quorum", "configure", "heal", "allreduce_merge", "commit_vote", "snapshot")
+
+# Phases that run on background threads concurrent with compute: report.py
+# excludes these from per-step critical-path attribution.
+OVERLAPPED_PHASES = ("snapshot",)
 
 
 class Span:
