@@ -402,17 +402,13 @@ def large_chip_benchmark() -> dict | None:
 
 
 def _read_events(metrics_path: str) -> list:
-    events = []
-    try:
-        with open(metrics_path, "rb") as f:
-            for line in f:
-                try:
-                    events.append(json.loads(line))
-                except ValueError:
-                    continue
-    except OSError:
-        pass
-    return events
+    # The hardened reader: skips torn/garbage lines AND JSON that parses to
+    # a non-dict (a corrupt line reading as a bare scalar would crash every
+    # ev.get() consumer below) — one implementation, shared with the
+    # attribution/report tooling.
+    from torchft_tpu.obs.report import read_events
+
+    return read_events([metrics_path])
 
 
 class _MetricsTail:
@@ -445,9 +441,11 @@ class _MetricsTail:
         self._pos += end + 1
         for line in chunk[: end + 1].splitlines():
             try:
-                self.events.append(json.loads(line))
+                ev = json.loads(line)
             except ValueError:
                 continue
+            if isinstance(ev, dict):  # scalar-parsing garbage: skip, see
+                self.events.append(ev)  # obs/report.py::read_events
         return self.events
 
 
@@ -496,6 +494,18 @@ def _run_scenario(
           preemption notices, SIGTERM grace periods) next to the crash
           numbers: dead time is the donor-to-replacement commit gap, and
           the survivors must see ZERO failed should_commit rounds.
+      {"type": "straggler", "victim", "auto_drain"} — no kill at all: at
+          window/3 the victim gets an injected per-step sleep (pid-pinned
+          straggle file read by examples/_common.maybe_straggle), modeling
+          the degraded-but-alive host no heartbeat timeout catches.  The
+          lighthouse's straggler sentinel must detect it (healthy ->
+          suspect -> straggler on /metrics, alert on /alerts.json; the
+          driver stamps the observation into the stream as an ``alert``
+          record).  With auto_drain the launcher runs a spare pool +
+          sentinel poll and rotates the slow host out through the
+          cooperative-drain path; the scenario's post-injection commit
+          rate then measures the goodput the sentinel recovered vs the
+          no-sentinel run that keeps pacing on the slow host.
 
     The measurement window only starts once BOTH groups have committed a
     step: startup JIT compilation is excluded from both scenarios, and a
@@ -523,7 +533,18 @@ def _run_scenario(
     fault_log = MetricsLogger(metrics_path, replica_id="bench-driver")
     victim = str(plan["victim"]) if plan else None
     kind = plan["type"] if plan else None
-    spares = 1 if kind in ("single_spare", "drain") else 0
+    straggler = kind == "straggler"
+    auto_drain = bool(plan.get("auto_drain")) if plan else False
+    straggle_sleep_s = float(os.environ.get("TPUFT_BENCH_STRAGGLE_SLEEP_S", "1.0"))
+    straggle_info: dict = {}
+    spares = 1 if kind in ("single_spare", "drain") or (straggler and auto_drain) else 0
+    child_env: dict = {
+        "JAX_PLATFORMS": None,  # parent may have pinned the TPU platform
+        "TPUFT_JAX_PLATFORM": "cpu",  # env alone is overridden by site hooks
+        "TPUFT_METRICS_PATH": metrics_path,
+    }
+    if straggler:
+        child_env["TPUFT_STRAGGLE_DIR"] = workdir
     launcher = Launcher(
         [sys.executable, os.path.join(repo, "examples", "train_ddp.py"),
          "--steps", "1000000"],
@@ -533,13 +554,10 @@ def _run_scenario(
         join_timeout_ms=2000,
         log_dir=workdir,
         cache_dir=cache_dir,
-        env={
-            "JAX_PLATFORMS": None,  # parent may have pinned the TPU platform
-            "TPUFT_JAX_PLATFORM": "cpu",  # env alone is overridden by site hooks
-            "TPUFT_METRICS_PATH": metrics_path,
-        },
+        env=child_env,
         cwd=repo,
         spares=spares,
+        straggler_auto_drain=auto_drain if straggler else None,
     )
     kill_events: list[tuple[float, str]] = []
     # Churn windows get extra tail so the LAST heal still has room to
@@ -548,6 +566,34 @@ def _run_scenario(
 
     def kill_victim():
         now = time.time()
+        if straggler:
+            # Not a kill: drop the pid-pinned straggle file the victim's
+            # train loop polls — from now on its every step pays an extra
+            # sleep, until the sentinel rotates the incarnation out (the
+            # replacement has a new pid and stays fast).
+            pid = launcher.pid(int(victim))
+            if pid is None:
+                # Victim momentarily dead (supervisor restarting it): a
+                # pid-less file would pin the slowness to EVERY future
+                # incarnation.  Skip; the next poll tick retries.
+                return
+            path = os.path.join(workdir, f"straggle_{victim}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"sleep_s": straggle_sleep_s, "pid": pid}, f)
+            os.replace(tmp, path)
+            fault_log.emit(
+                "fault", ts=now, kind="straggler", group=victim, plan=kind
+            )
+            fault_log.emit(
+                "straggler_injected",
+                group=victim,
+                sleep_s=straggle_sleep_s,
+                pid=pid,
+            )
+            straggle_info["inject_ts"] = now
+            straggle_info["sleep_s"] = straggle_sleep_s
+            return
         kill_events.append((now, victim))
         # Same ts as the in-memory kill list (the explicit ts field
         # overrides the logger's own clock) so the recorded stream yields
@@ -583,34 +629,65 @@ def _run_scenario(
         start = time.monotonic()
         first_kill_at = None if plan is None else (
             total_window / 3
-            if kind in ("single", "single_spare", "drain")
+            if kind in ("single", "single_spare", "drain", "straggler")
             else total_window / 4
         )
         pre_kill_ids: set = set()
-        second_done = kind in ("single", "single_spare", "drain")
+        second_done = kind in ("single", "single_spare", "drain", "straggler")
         second_deadline = None
+        last_alert_poll = 0.0
         tail = _MetricsTail(metrics_path)
         while time.monotonic() - start < total_window:
             time.sleep(0.25)
             if first_kill_at is not None and time.monotonic() - start >= first_kill_at:
                 # Draining a group that never committed (still in its first
                 # JIT) measures nothing: the handoff gap needs a donor
-                # commit timeline on both sides.  Hold the drain until the
-                # first commit — WITHOUT skipping the supervision below
-                # (the window clock keeps running either way).
-                fire_ok = kind != "drain" or any(
+                # commit timeline on both sides — and a straggler injection
+                # before the first commit has no pre-injection pace to
+                # score against.  Hold the fault until the first commit —
+                # WITHOUT skipping the supervision below (the window clock
+                # keeps running either way).
+                fire_ok = kind not in ("drain", "straggler") or any(
                     commit is not None
                     for _, commit in _victim_incarnations(
                         tail.poll(), victim
                     ).values()
                 )
+                if straggler and fire_ok:
+                    # The scenario models a host degrading MID-RUN, so the
+                    # injection additionally waits until the victim has
+                    # cleared the sentinel's warmup gate (which exists to
+                    # ignore JIT-phase pace skew) — injecting during warmup
+                    # would measure the gate, not the detection contract.
+                    try:
+                        warmup = max(
+                            0,
+                            int(os.environ.get(
+                                "TPUFT_STRAGGLER_WARMUP_STEPS", "10")),
+                        )
+                    except ValueError:
+                        warmup = 10
+                    n_commits = sum(
+                        1
+                        for ev in tail.poll()
+                        if ev.get("event") == "commit"
+                        and ev.get("committed")
+                        and str(ev.get("replica_id", "")).split(":", 1)[0]
+                        == victim
+                    )
+                    fire_ok = n_commits > warmup
                 if fire_ok:
                     pre_kill_ids = set(
                         _victim_incarnations(tail.poll(), victim)
                     )
                     kill_victim()
-                    first_kill_at = None
-                    second_deadline = time.monotonic() + 25.0
+                    if not straggler or "inject_ts" in straggle_info:
+                        # A straggler injection can decline to fire (victim
+                        # pid momentarily gone); leave the trigger armed so
+                        # the next tick retries instead of silently running
+                        # a fault-free window.
+                        first_kill_at = None
+                        second_deadline = time.monotonic() + 25.0
             elif not second_done and kill_events:
                 # Watch for the respawned incarnation to reach the trigger
                 # state, with a deadline fallback so a stuck restart can't
@@ -625,11 +702,149 @@ def _run_scenario(
                 if fire or (second_deadline and time.monotonic() > second_deadline):
                     kill_victim()
                     second_done = True
+            # Straggler scenario: watch the lighthouse's /alerts.json for
+            # the sentinel's detection and stamp it into the stream (the
+            # `alert` record), so detection latency and the trace view come
+            # from the recorded data alone.
+            if (
+                straggler
+                and "inject_ts" in straggle_info
+                and "alert" not in straggle_info
+                and time.monotonic() - last_alert_poll >= 1.0
+            ):
+                last_alert_poll = time.monotonic()
+                alert = _poll_straggler_alert(
+                    launcher.lighthouse_http_address, victim,
+                    after_ts=straggle_info["inject_ts"],
+                )
+                if alert is not None:
+                    straggle_info["alert"] = alert
+                    fault_log.emit(
+                        "alert",
+                        group=victim,
+                        alert_id=alert.get("id"),
+                        kind=alert.get("kind"),
+                        replica_id=alert.get("replica_id"),
+                        raised_ms=alert.get("raised_ms"),
+                        ratio=alert.get("ratio"),
+                        step_time_ms=alert.get("step_time_ms"),
+                        auto_drained=alert.get("auto_drained"),
+                    )
             # Supervisor: restart any group that died for other reasons.
             launcher.supervise_once()
 
     fault_log.close()
-    return _scenario_stats(workdir, metrics_path, kill_events, plan)
+    stats = _scenario_stats(workdir, metrics_path, kill_events, plan)
+    if straggler:
+        stats["straggler"] = _straggler_stats(
+            metrics_path, straggle_info, victim, plan
+        )
+    return stats
+
+
+def _poll_straggler_alert(http_address: str, victim: str, after_ts: float = 0.0):
+    """First straggler alert for the victim group raised AFTER ``after_ts``
+    on the lighthouse's /alerts.json, or None.  The time filter keeps a
+    stale pre-injection alert (e.g. one the warmup gate would normally
+    suppress) from masquerading as the injection's detection.  Any failure
+    reads as 'not yet' — the poll runs inside the measured window and must
+    never abort the trial."""
+    from torchft_tpu.launch import fetch_alerts
+
+    alerts = fetch_alerts(http_address)
+    if alerts is None:
+        return None
+    for alert in alerts.get("alerts", []):
+        if alert.get("kind") != "straggler":
+            continue
+        if float(alert.get("raised_ms", 0)) / 1e3 < after_ts:
+            continue
+        if str(alert.get("replica_id", "")).split(":", 1)[0] == victim:
+            return alert
+    return None
+
+
+def _straggler_stats(
+    metrics_path: str, info: dict, victim: str, plan: dict
+) -> dict:
+    """Sentinel scorecard for one straggler trial: detection latency (wall
+    seconds AND victim steps vs the grace budget) plus the post-injection
+    cluster commit rate — the number the auto-drain run must beat the
+    no-sentinel run on."""
+    from torchft_tpu.obs import report as obs_report
+
+    events = _read_events(metrics_path)
+    # Same per-group commit timelines the goodput accounting uses — one
+    # implementation of the commit-record semantics (obs/report.py).
+    commits = obs_report.commit_timelines(events)
+    try:
+        grace = max(1, int(os.environ.get("TPUFT_STRAGGLER_GRACE_STEPS", "5")))
+    except ValueError:
+        grace = 5
+    try:
+        ratio = float(os.environ.get("TPUFT_STRAGGLER_RATIO", "1.5"))
+    except ValueError:
+        ratio = 1.5
+    inject_ts = info.get("inject_ts")
+    alert = info.get("alert")
+    out: dict = {
+        "auto_drain": bool(plan.get("auto_drain")),
+        "sleep_s": info.get("sleep_s"),
+        "inject_ts": inject_ts,
+        "grace_steps": grace,
+        "ratio_threshold": ratio,
+        "detected": alert is not None,
+        "alert": alert,
+        "detect_latency_s": None,
+        "detect_latency_steps": None,
+        "detected_within_grace": None,
+        "rotated_out": any(ev.get("event") == "straggler_drain" for ev in events),
+        "post_inject_commits": None,
+        "post_inject_span_s": None,
+        "post_inject_rate_per_s": None,
+        "pre_inject_rate_per_s": None,
+    }
+    if inject_ts is None:
+        return out
+    all_ts = sorted(ts for lst in commits.values() for ts in lst)
+    if all_ts:
+        t0 = max(min(lst) for lst in commits.values())
+        post = [ts for ts in all_ts if ts >= inject_ts]
+        pre = [ts for ts in all_ts if t0 <= ts < inject_ts]
+        span_post = max(all_ts) - inject_ts
+        span_pre = inject_ts - t0
+        out["post_inject_commits"] = len(post)
+        out["post_inject_span_s"] = round(span_post, 2)
+        if span_post > 0:
+            out["post_inject_rate_per_s"] = round(len(post) / span_post, 3)
+        if span_pre > 0 and pre:
+            out["pre_inject_rate_per_s"] = round(len(pre) / span_pre, 3)
+    if alert is not None and alert.get("raised_ms"):
+        raised_s = float(alert["raised_ms"]) / 1e3
+        out["detect_latency_s"] = round(raised_s - inject_ts, 2)
+        steps = sum(
+            1 for ts in commits.get(victim, []) if inject_ts < ts <= raised_s
+        )
+        out["detect_latency_steps"] = steps
+        # The sentinel's contract is promotion on the grace-th SLOW step
+        # observation.  The raw commit count above includes 1-2 boundary
+        # commits (steps in flight when the injection landed, whose
+        # telemetry still reflects pre-injection pace), so the contract is
+        # checked against the count of commits that actually MEASURED slow
+        # — victim step_summaries in the window whose busy time shows the
+        # injected sleep.
+        slow_thresh_ms = float(info.get("sleep_s", 0.0)) * 1e3 * 0.5
+        slow_steps = sum(
+            1
+            for ev in events
+            if ev.get("event") == "step_summary"
+            and str(ev.get("replica_id", "")).split(":", 1)[0] == victim
+            and inject_ts < float(ev.get("ts", 0.0)) <= raised_s
+            and float(ev.get("step_time_ms", 0.0) or 0.0) >= slow_thresh_ms
+        )
+        out["detect_latency_slow_steps"] = slow_steps
+        out["detected_within_grace"] = slow_steps <= grace
+    return out
 
 
 def _scenario_stats(
@@ -711,6 +926,7 @@ def _scenario_stats(
             "victims_recovered": False,
             "drain_handoff_gap_s": None,
             "failed_commits_after_kill": {},
+            "step_time_stats": None,
             "metrics_stream": False,
         }
 
@@ -720,6 +936,41 @@ def _scenario_stats(
         g: sum(1 for ts in ts_list if ts >= t0)
         for g, ts_list in sorted(commits.items())
     }
+
+    # Per-step wall-time distributions (perf-trajectory evidence beyond the
+    # goodput scalar): commit-interval percentiles per group, plus the
+    # Manager's own BUSY-time telemetry (step_summary step_time_ms — wall
+    # minus FT waits, the straggler sentinel's signal) where present.
+    def _dist(values: list, unit_round: int) -> dict | None:
+        ordered = sorted(values)
+        if not ordered:
+            return None
+        return {
+            "p50": round(ordered[len(ordered) // 2], unit_round),
+            "p99": round(ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))],
+                         unit_round),
+            "max": round(ordered[-1], unit_round),
+            "n": len(ordered),
+        }
+
+    step_time_stats: dict[str, dict] = {}
+    busy_ms: dict[str, list[float]] = {}
+    for ev in events:
+        if ev.get("event") == "step_summary" and ev.get("step_time_ms") is not None:
+            group = str(ev.get("replica_id", "")).split(":", 1)[0]
+            busy_ms.setdefault(group, []).append(float(ev["step_time_ms"]))
+    for g, ts_list in sorted(commits.items()):
+        ordered = sorted(ts for ts in ts_list if ts >= t0)
+        intervals = [b - a for a, b in zip(ordered, ordered[1:])]
+        entry: dict = {}
+        iv = _dist(intervals, 4)
+        if iv:
+            entry["interval_s"] = iv
+        bz = _dist(busy_ms.get(g, []), 2)
+        if bz:
+            entry["busy_ms"] = bz
+        if entry:
+            step_time_stats[g] = entry
 
     # --- dead-window accounting (all kill plans) -------------------------
     # Shared with the attribution tool: obs/report.py::deadwindow is the
@@ -891,6 +1142,7 @@ def _scenario_stats(
             round(drain_handoff_gap, 3) if drain_handoff_gap is not None else None
         ),
         "failed_commits_after_kill": failed_after_kill,
+        "step_time_stats": step_time_stats,
         "metrics_stream": True,
     }
 
@@ -1136,6 +1388,13 @@ def kill_benchmark() -> dict:
             if k["victim_downtime_s"] is not None and k["victim_restart_s"] is None
         ),
         "heal_ms_median": heal_ms[len(heal_ms) // 2] if heal_ms else None,
+        # Per-step wall-time distributions (commit intervals + Manager busy
+        # time, p50/p99/max per replica group) so the perf trajectory
+        # captures the step-time SHAPE, not just the goodput scalar.
+        "step_time_stats_single_trials": [
+            k.get("step_time_stats") for k in singles
+        ],
+        "step_time_stats_baseline": [b.get("step_time_stats") for b in bases],
         "committed_batches_undisturbed": sum(b["committed_batches"] for b in bases),
         "committed_batches_with_kill": sum(k["committed_batches"] for _, k in kills),
         "per_group_undisturbed": [b["per_group"] for b in bases],
@@ -1210,6 +1469,7 @@ def kill_scenario_benchmark(trials: int | None = None) -> dict:
         ),
         "heals": sum(k["heals"] for k in results),
         "victims_recovered": all(k["victims_recovered"] for k in results),
+        "step_time_stats": [k.get("step_time_stats") for k in results],
     }
 
 
@@ -1262,6 +1522,140 @@ def drain_benchmark(trials: int | None = None) -> dict:
         ),
         "drains_recovered": all(k["victims_recovered"] for _, k in results),
         "heals": sum(k["heals"] for _, k in results),
+    }
+
+
+def straggler_benchmark(trials: int | None = None) -> dict:
+    """Straggler sentinel benchmark (``--scenario straggler``): paired
+    runs on the same schedule — per trial, one run WITHOUT auto-drain (the
+    sentinel detects, but the cluster keeps pacing on the slow host for
+    the rest of the window: the MegaScale-style goodput killer) and one
+    WITH ``TPUFT_STRAGGLER_AUTO_DRAIN=1`` + a hot spare (the sentinel's
+    alert triggers the cooperative-drain rotation).  Reported:
+
+    - detection latency, in wall seconds AND victim steps, against the
+      ``TPUFT_STRAGGLER_GRACE_STEPS`` budget (the sentinel's contract is
+      detection within grace steps of the slowness onset);
+    - post-injection cluster commit rate for both runs, and their ratio —
+      the goodput the auto-drain rotation recovered.
+
+    Workdirs (with per-trial ``metrics.jsonl``) are KEPT so
+    ``tools/trace_export.py`` can render the sentinel arc as a timeline."""
+    window = float(
+        os.environ.get(
+            "TPUFT_BENCH_STRAGGLER_WINDOW_S",
+            os.environ.get("TPUFT_BENCH_KILL_WINDOW_S", "45"),
+        )
+    )
+    trials = trials if trials is not None else max(
+        1, int(os.environ.get("TPUFT_BENCH_STRAGGLER_TRIALS", "1"))
+    )
+    # Sentinel knobs for the embedded lighthouse (read from THIS process's
+    # environment at Launcher construction).  Grace 3 keeps detection well
+    # inside a 45 s window at ~1 s steps.  Every mutation is restored on
+    # exit: a later benchmark in the same process must see the documented
+    # defaults, not this scenario's tuning.
+    prior = {
+        k: os.environ.get(k)
+        for k in (
+            "TPUFT_STRAGGLER_RATIO",
+            "TPUFT_STRAGGLER_GRACE_STEPS",
+            "TPUFT_STRAGGLER_AUTO_DRAIN",
+        )
+    }
+    os.environ.setdefault("TPUFT_STRAGGLER_RATIO", "1.5")
+    os.environ.setdefault("TPUFT_STRAGGLER_GRACE_STEPS", "3")
+    # Effective knobs, captured while set (the finally below restores the
+    # caller's environment before the summary is built).
+    ratio_used = float(os.environ["TPUFT_STRAGGLER_RATIO"])
+    grace_used = int(os.environ["TPUFT_STRAGGLER_GRACE_STEPS"])
+    out_root = os.environ.get("TPUFT_BENCH_WORKDIR") or tempfile.mkdtemp(
+        prefix="tpuft_bench_straggler_"
+    )
+    results: list[tuple[dict, dict]] = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="tpuft_bench_cache_") as cache_dir:
+            for i in range(trials):
+                for auto in (False, True):
+                    os.environ["TPUFT_STRAGGLER_AUTO_DRAIN"] = "1" if auto else "0"
+                    d = os.path.join(
+                        out_root,
+                        f"straggler_{i}_{'auto' if auto else 'noauto'}",
+                    )
+                    os.makedirs(d, exist_ok=True)
+                    plan = {
+                        "type": "straggler",
+                        "victim": i % 2,
+                        "auto_drain": auto,
+                    }
+                    results.append(
+                        (plan, _run_scenario(d, window_s=window, plan=plan,
+                                             cache_dir=cache_dir))
+                    )
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    no_auto = [k["straggler"] for p, k in results if not p["auto_drain"]]
+    auto = [k["straggler"] for p, k in results if p["auto_drain"]]
+    all_s = no_auto + auto
+    latencies_s = [
+        s["detect_latency_s"] for s in all_s if s["detect_latency_s"] is not None
+    ]
+    latencies_steps = [
+        s["detect_latency_steps"]
+        for s in all_s
+        if s["detect_latency_steps"] is not None
+    ]
+    rate_no = _mean([s["post_inject_rate_per_s"] for s in no_auto])
+    rate_auto = _mean([s["post_inject_rate_per_s"] for s in auto])
+    recovered = (
+        round(rate_auto / rate_no, 3) if rate_no and rate_auto else None
+    )
+    return {
+        "window_s": window,
+        "trials": len(results),
+        "workdir": out_root,
+        "metrics_jsonl": [
+            os.path.join(out_root, f"straggler_{i}_{tag}", "metrics.jsonl")
+            for i in range(trials)
+            for tag in ("noauto", "auto")
+        ],
+        "sleep_s": float(os.environ.get("TPUFT_BENCH_STRAGGLE_SLEEP_S", "1.0")),
+        "ratio_threshold": ratio_used,
+        "grace_steps": grace_used,
+        "detected_all": all(s["detected"] for s in all_s) if all_s else False,
+        "detect_latency_s_trials": latencies_s,
+        "detect_latency_s_mean": _mean(latencies_s),
+        "detect_latency_steps_trials": latencies_steps,
+        "detect_latency_steps_mean": _mean([float(x) for x in latencies_steps]),
+        "detect_latency_slow_steps_trials": [
+            s.get("detect_latency_slow_steps")
+            for s in all_s
+            if s.get("detect_latency_slow_steps") is not None
+        ],
+        "detected_within_grace": (
+            all(s["detected_within_grace"] for s in all_s
+                if s["detected_within_grace"] is not None)
+            if any(s["detected_within_grace"] is not None for s in all_s)
+            else False
+        ),
+        "rotated_out_all": all(s["rotated_out"] for s in auto) if auto else False,
+        "pre_inject_rate_per_s": _mean(
+            [s["pre_inject_rate_per_s"] for s in all_s]
+        ),
+        "post_inject_rate_no_drain": rate_no,
+        "post_inject_rate_auto_drain": rate_auto,
+        "goodput_recovered_fraction": recovered,
+        "auto_drain_beats_no_sentinel": (
+            rate_auto > rate_no if rate_no and rate_auto else None
+        ),
+        "per_trial": [
+            {"plan": p, **k["straggler"]} for p, k in results
+        ],
     }
 
 
@@ -1338,6 +1732,7 @@ def selftest() -> None:
     inspect.signature(chip_benchmark).bind()
     inspect.signature(drain_benchmark).bind()
     inspect.signature(kill_scenario_benchmark).bind()
+    inspect.signature(straggler_benchmark).bind()
     plans = _trial_plans(10)
     assert len(plans) == 10
     assert {p["type"] for p in plans} == {
@@ -1354,10 +1749,22 @@ if __name__ == "__main__":
         selftest()
     elif "--scenario" in sys.argv:
         which = sys.argv[sys.argv.index("--scenario") + 1:]
-        if not which or which[0] not in ("drain", "kill"):
+        if not which or which[0] not in ("drain", "kill", "straggler"):
             print(f"unknown --scenario {which[:1] or '(missing)'}", file=sys.stderr)
             sys.exit(2)
-        if which[0] == "drain":
+        if which[0] == "straggler":
+            straggler = straggler_benchmark()
+            print(
+                json.dumps(
+                    {
+                        "metric": "straggler_sentinel",
+                        "value": straggler["detect_latency_steps_mean"],
+                        "unit": "steps_to_detect",
+                        "detail": straggler,
+                    }
+                )
+            )
+        elif which[0] == "drain":
             drain = drain_benchmark()
             print(
                 json.dumps(

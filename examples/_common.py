@@ -72,6 +72,37 @@ def replica_env() -> tuple:
     )
 
 
+def maybe_straggle(replica_group: int) -> float:
+    """Fault injection for the straggler bench scenario: when the driver
+    wrote ``<TPUFT_STRAGGLE_DIR>/straggle_<group>.json`` this step sleeps
+    ``sleep_s`` extra, simulating a degraded-but-alive host (the failure
+    mode no heartbeat timeout ever catches).  The notice is PID-pinned: a
+    replacement incarnation adopting the same group id models a healthy
+    spare host and must not inherit the slowness.  Returns the seconds
+    slept (0 = no injection)."""
+    d = os.environ.get("TPUFT_STRAGGLE_DIR")
+    if not d:
+        return 0.0
+    import json
+
+    path = os.path.join(d, f"straggle_{replica_group}.json")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return 0.0
+    pid = data.get("pid")
+    # A notice MUST name a pid: a pid-less file matching every incarnation
+    # would pin the slowness to each replacement forever, turning one slow
+    # host into an unrecoverable slow group.
+    if pid is None or int(pid) != os.getpid():
+        return 0.0
+    sleep_s = float(data.get("sleep_s", 0.0))
+    if sleep_s > 0.0:
+        time.sleep(sleep_s)
+    return sleep_s
+
+
 def make_manager(
     save: Callable[[], Any],
     load: Callable[[Any], None],

@@ -37,6 +37,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from _common import (
     TrainGate,
     make_manager,
+    maybe_straggle,
     params_digest,
     pin_platform_and_cache,
     replica_env,
@@ -167,6 +168,9 @@ def main() -> None:
             x, y = dataset_x[idx], dataset_y[idx]
 
             loss, grads = grad_fn(state["opt"].params, x, y)
+            # Straggler-bench injection point (no-op outside the scenario):
+            # extra per-step sleep here models slow compute on this host.
+            maybe_straggle(replica_group)
             grads = averager.allreduce(grads)
             committed = state["opt"].step(grads)
             gate.note_commit(committed)

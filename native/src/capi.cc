@@ -104,8 +104,10 @@ void* tf_manager_new(const char* replica_id, const char* lighthouse_addr, const 
 
 char* tf_manager_address(void* p) { return CopyString(static_cast<ManagerServer*>(p)->address()); }
 
-void tf_manager_set_status(void* p, int64_t step, const char* state) {
-  static_cast<ManagerServer*>(p)->SetStatus(step, state ? state : "");
+void tf_manager_set_status(void* p, int64_t step, const char* state,
+                           double step_time_ms_ewma, double step_time_ms_last) {
+  static_cast<ManagerServer*>(p)->SetStatus(step, state ? state : "",
+                                            step_time_ms_ewma, step_time_ms_last);
 }
 
 void tf_manager_shutdown(void* p) { static_cast<ManagerServer*>(p)->Shutdown(); }

@@ -143,6 +143,24 @@ bool Lighthouse::AdminAllowed(const std::string& token, bool peer_loopback) cons
 
 bool Lighthouse::Start(std::string* err) {
   if (const char* tok = std::getenv("TPUFT_ADMIN_TOKEN")) admin_token_ = tok;
+  // Straggler sentinel knobs.  Malformed values fall back to the defaults —
+  // a bad tuning knob must not take the coordination plane down.
+  if (const char* r = std::getenv("TPUFT_STRAGGLER_RATIO")) {
+    char* end = nullptr;
+    double v = std::strtod(r, &end);
+    if (end != r && v > 1.0) straggler_ratio_ = v;
+  }
+  if (const char* g = std::getenv("TPUFT_STRAGGLER_GRACE_STEPS")) {
+    long long v = std::atoll(g);
+    if (v >= 1) straggler_grace_ = v;
+  }
+  if (const char* a = std::getenv("TPUFT_STRAGGLER_AUTO_DRAIN")) {
+    straggler_auto_drain_ = std::string(a) == "1";
+  }
+  if (const char* w = std::getenv("TPUFT_STRAGGLER_WARMUP_STEPS")) {
+    long long v = std::atoll(w);
+    if (v >= 0) straggler_warmup_ = v;
+  }
   server_ = std::make_unique<RpcServer>(
       opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl, std::string* resp) {
         return Dispatch(method, req, dl, resp);
@@ -179,6 +197,11 @@ bool Lighthouse::Start(std::string* err) {
             // /status.json): cluster-level gauges a scraper can alert on.
             r.content_type = "text/plain; version=0.0.4; charset=utf-8";
             r.body = MetricsText();
+          } else if (method == "GET" && path == "/alerts.json") {
+            // Straggler-sentinel alert feed (read-only, ungated): raised
+            // and resolved alerts with the scores that triggered them.
+            r.content_type = "application/json";
+            r.body = AlertsJson();
           } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
                      path.size() > 14 && path.substr(path.size() - 5) == "/kill") {
             std::string replica_id = path.substr(9, path.size() - 9 - 5);
@@ -297,12 +320,190 @@ Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
   // advance time is the lighthouse's last-commit timestamp for /metrics
   // and /status.json.
   auto it = hb_step_.find(req.replica_id());
-  if (it == hb_step_.end() || req.step() > it->second) {
+  bool advanced = it == hb_step_.end() || req.step() > it->second;
+  if (advanced) {
     if (it != hb_step_.end()) last_commit_ms_[req.replica_id()] = NowEpochMs();
     hb_step_[req.replica_id()] = req.step();
   }
   if (!req.state().empty()) hb_state_[req.replica_id()] = req.state();
+  // Straggler sentinel: keep the rolling step-time telemetry fresh on every
+  // heartbeat, but run a state-machine OBSERVATION only when the replica's
+  // reported step advances past the sentinel's own cursor — the hysteresis
+  // grace is counted in steps, not heartbeats (at a 100 ms cadence one
+  // slow step would otherwise burn the whole grace budget before a second
+  // step ever committed).  The cursor is per-health-entry, NOT hb_step_:
+  // quorum joins advance hb_step_ too and usually beat the heartbeat to a
+  // fresh step, which would silently swallow most observations.
+  if (req.step_time_ms_ewma() > 0.0) {
+    ReplicaHealth& h = health_[req.replica_id()];
+    h.ewma_ms = req.step_time_ms_ewma();
+    h.last_ms = req.step_time_ms_last();
+    if (req.step() > h.last_step) {
+      h.last_step = req.step();
+      ObserveStepTimeLocked(req.replica_id());
+    }
+  }
   return Status::kOk;
+}
+
+double Lighthouse::ClusterMedianEwmaLocked() const {
+  // Lower median ((n-1)/2 after sort) of the eligible reporting replicas:
+  // robust while a MINORITY is slow — with 2 replicas [fast, slow] the
+  // upper median would be the slow one's own EWMA and its ratio would read
+  // 1.0, hiding exactly the replica the sentinel exists to catch.  The dual
+  // failure mode (a majority of stragglers reads as "the fast one is the
+  // outlier") is inherent to relative scoring and documented in wire.md.
+  auto now = Clock::now();
+  auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
+  std::vector<double> ewmas;
+  for (const auto& [id, h] : health_) {
+    if (h.ewma_ms <= 0.0) continue;
+    if (state_.draining.count(id)) continue;
+    auto hb = state_.heartbeats.find(id);
+    if (hb == state_.heartbeats.end() || now - hb->second >= hb_timeout) continue;
+    ewmas.push_back(h.ewma_ms);
+  }
+  if (ewmas.size() < 2) return 0.0;  // nothing to be relative to
+  std::sort(ewmas.begin(), ewmas.end());
+  return ewmas[(ewmas.size() - 1) / 2];
+}
+
+void Lighthouse::ObserveStepTimeLocked(const std::string& id) {
+  ReplicaHealth& h = health_[id];
+  h.observations += 1;
+  double med = ClusterMedianEwmaLocked();
+  h.ratio = med > 0.0 ? h.ewma_ms / med : 0.0;
+  if (med <= 0.0) {
+    // Unscorable (fewer than two eligible reporters): relative slowness is
+    // meaningless, so the observation counts toward RECOVERY — without
+    // this, a flagged straggler whose last peer died would stay in state 2
+    // with an active alert forever (nothing else clears a state while the
+    // replica keeps heartbeating), paging operators about a healthy sole
+    // survivor.
+    if (h.state != 0) {
+      h.over = 0;
+      h.under += 1;
+      if (h.state == 1) {
+        h.state = 0;
+        h.under = 0;
+      } else if (h.state == 2 && h.under >= straggler_grace_) {
+        h.state = 0;
+        h.under = 0;
+        LOGI("lighthouse: replica %s straggler state cleared (no peers left "
+             "to score against)", id.c_str());
+        ResolveAlertsLocked(id);
+      }
+    }
+    return;
+  }
+  if (h.ratio >= straggler_ratio_) {
+    h.under = 0;
+    h.over += 1;
+    if (h.state == 0) {
+      h.state = 1;
+      LOGW("lighthouse: replica %s suspect straggler (step time %.1f ms, "
+           "%.2fx cluster median)", id.c_str(), h.ewma_ms, h.ratio);
+    } else if (h.state == 1 && h.over >= straggler_grace_ &&
+               h.observations > straggler_warmup_) {
+      // The warmup gate keeps JIT-compile asymmetry (first steps are
+      // 10-100x steady state, and not evenly so across replicas) from
+      // raising alerts — or worse, auto-draining — a replica that is
+      // merely compiling.  A genuinely slow host stays suspect through
+      // the warmup and promotes on the first eligible observation.
+      h.state = 2;
+      RaiseStragglerAlertLocked(id, &h);
+    } else if (h.state == 2) {
+      // Still confirmed slow: re-attempt a rotation that was skipped at
+      // the min_replicas floor when the alert first raised — capacity may
+      // have recovered since (a new replica joined), and "auto-drain,
+      // never below the floor" must mean whenever capacity allows, not
+      // only at the instant of the first alert.
+      if (MaybeAutoDrainLocked(id, /*log_skip=*/false)) {
+        for (auto& a : alerts_) {
+          if (a.replica_id == id && a.resolved_ms == 0) a.auto_drained = true;
+        }
+      }
+    }
+  } else {
+    h.over = 0;
+    h.under += 1;
+    if (h.state == 1) {
+      // A suspect that produced one on-pace step was a blip, not a slow
+      // host; drop it immediately (promotion needed the full grace).
+      h.state = 0;
+      h.under = 0;
+    } else if (h.state == 2 && h.under >= straggler_grace_) {
+      h.state = 0;
+      h.under = 0;
+      LOGI("lighthouse: replica %s recovered from straggler state "
+           "(step time %.1f ms, %.2fx median)", id.c_str(), h.ewma_ms, h.ratio);
+      ResolveAlertsLocked(id);
+    }
+  }
+}
+
+void Lighthouse::RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth* h) {
+  for (const auto& a : alerts_) {
+    if (a.replica_id == id && a.resolved_ms == 0) return;  // already active
+  }
+  AlertRecord a;
+  a.id = ++alert_seq_;
+  a.kind = "straggler";
+  a.replica_id = id;
+  a.raised_ms = NowEpochMs();
+  a.ratio = h->ratio;
+  a.step_time_ms = h->ewma_ms;
+  LOGW("lighthouse: replica %s is a persistent straggler (step time %.1f ms, "
+       "%.2fx cluster median over %lld steps) — alert %lld raised",
+       id.c_str(), h->ewma_ms, h->ratio,
+       static_cast<long long>(straggler_grace_), static_cast<long long>(a.id));
+  a.auto_drained = MaybeAutoDrainLocked(id, /*log_skip=*/true);
+  alerts_.push_back(std::move(a));
+  // Bounded history: drop the oldest RESOLVED record first; active alerts
+  // are never evicted (there can be at most one per live replica id).
+  const size_t kMaxAlerts = 64;
+  if (alerts_.size() > kMaxAlerts) {
+    for (auto it = alerts_.begin(); it != alerts_.end(); ++it) {
+      if (it->resolved_ms != 0) {
+        alerts_.erase(it);
+        break;
+      }
+    }
+  }
+}
+
+void Lighthouse::ResolveAlertsLocked(const std::string& id) {
+  for (auto& a : alerts_) {
+    if (a.replica_id == id && a.resolved_ms == 0) a.resolved_ms = NowEpochMs();
+  }
+}
+
+bool Lighthouse::MaybeAutoDrainLocked(const std::string& id, bool log_skip) {
+  // Rotate the slow host out through the cooperative-drain path, but only
+  // while the remaining healthy set still satisfies the quorum floor —
+  // the sentinel must never drain the cluster below min_replicas.  The
+  // supervisor completes the handoff (Launcher polls /alerts.json and
+  // pre-warms the replacement); the mark alone already removes the
+  // straggler from the NEXT quorum so survivors stop pacing on it.
+  if (!straggler_auto_drain_) return false;
+  if (state_.draining.count(id)) return true;  // already rotating
+  auto now = Clock::now();
+  auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
+  int64_t healthy = 0;
+  for (const auto& [hid, last] : state_.heartbeats) {
+    if (!state_.draining.count(hid) && now - last < hb_timeout) ++healthy;
+  }
+  if (healthy > static_cast<int64_t>(opt_.min_replicas)) {
+    DrainLocked(id, 0);
+    return true;
+  }
+  if (log_skip) {
+    LOGW("lighthouse: auto-drain of straggler %s deferred — only %lld "
+         "healthy replicas (min_replicas %llu); retried while the replica "
+         "stays a straggler", id.c_str(), static_cast<long long>(healthy),
+         static_cast<unsigned long long>(opt_.min_replicas));
+  }
+  return false;
 }
 
 Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline deadline,
@@ -324,7 +525,11 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     // The incarnation announced a cooperative departure: it finishes its
     // in-flight step and exits — it must not start a NEW round.  (The
     // drain controller stops the train loop before the next quorum; this
-    // guards the race where the notice lands mid-call.)
+    // guards the race where the notice lands mid-call.)  "is draining" is
+    // a GREP CONTRACT with the Python Manager (_async_quorum converts this
+    // abort into a cooperative drain exit; pinned by
+    // tests/test_straggler.py) — keep both message sites in sync if
+    // rewording.
     *err = "replica " + id + " is draining; rejoin as a new incarnation";
     return Status::kAborted;
   }
@@ -498,6 +703,18 @@ void Lighthouse::TickLocked() {
   prune_with_heartbeats(hb_step_);
   prune_with_heartbeats(hb_state_);
   prune_with_heartbeats(last_commit_ms_);
+  // Sentinel health follows the graveyard too, and a pruned replica's
+  // active alert resolves here: a process that is gone (crashed, drained
+  // out, auto-drained straggler that exited) can never post the recovery
+  // observations that would clear it organically.
+  for (auto it = health_.begin(); it != health_.end();) {
+    if (state_.heartbeats.find(it->first) == state_.heartbeats.end()) {
+      ResolveAlertsLocked(it->first);
+      it = health_.erase(it);
+    } else {
+      ++it;
+    }
+  }
 
   std::string reason;
   auto members = QuorumCompute(Clock::now(), state_, opt_, &reason);
@@ -569,6 +786,17 @@ void Lighthouse::FillStatus(LighthouseStatusResponse* resp) {
   for (const auto& [id, step] : hb_step_) (*resp->mutable_replica_step())[id] = step;
   for (const auto& [id, ms] : last_commit_ms_) (*resp->mutable_last_commit_ts_ms())[id] = ms;
   for (const auto& [id, st] : hb_state_) (*resp->mutable_replica_state())[id] = st;
+  // Straggler sentinel maps (ints on the wire: state, rounded EWMA ms,
+  // slowness in permille; the full-precision doubles ride on /metrics).
+  for (const auto& [id, h] : health_) {
+    (*resp->mutable_straggler_state())[id] = h.state;
+    (*resp->mutable_replica_step_time_ms())[id] =
+        static_cast<int64_t>(h.ewma_ms + 0.5);
+    if (h.ratio > 0.0) {
+      (*resp->mutable_replica_slowness_permille())[id] =
+          static_cast<int64_t>(h.ratio * 1000.0 + 0.5);
+    }
+  }
 }
 
 int Lighthouse::EvictReplica(const std::string& prefix) {
@@ -622,6 +850,12 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
   erase_matching(hb_step_);
   erase_matching(hb_state_);
   erase_matching(last_commit_ms_);
+  erase_matching(health_);
+  // An evicted incarnation's straggler alert resolves with it (the
+  // supervisor already replaced the process; the alert described a corpse).
+  for (auto& a : alerts_) {
+    if (a.resolved_ms == 0 && matches(a.replica_id)) a.resolved_ms = NowEpochMs();
+  }
   // Wake blocked quorum handlers: an evicted id's own handler must notice
   // its tombstone and abort instead of waiting out its deadline.
   quorum_cv_.notify_all();
@@ -634,6 +868,11 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
 }
 
 int Lighthouse::DrainReplica(const std::string& prefix, int64_t deadline_ms) {
+  std::lock_guard<std::mutex> lk(mu_);
+  return DrainLocked(prefix, deadline_ms);
+}
+
+int Lighthouse::DrainLocked(const std::string& prefix, int64_t deadline_ms) {
   // Unlike EvictReplica, the heartbeat entries stay: the departing process
   // is ALIVE and finishing its step — the dashboard should keep showing it
   // (as draining) until it actually exits.  Exclusion from quorum comes
@@ -641,7 +880,6 @@ int Lighthouse::DrainReplica(const std::string& prefix, int64_t deadline_ms) {
   // from everything the lighthouse currently knows: heartbeats, pending
   // joins, and the previous quorum's membership (a member between rounds
   // has neither a heartbeat-map-only presence nor a pending join).
-  std::lock_guard<std::mutex> lk(mu_);
   auto matches = [&](const std::string& id) {
     return id == prefix || id.rfind(prefix + ":", 0) == 0;
   };
@@ -815,6 +1053,70 @@ std::string Lighthouse::MetricsText() {
     o << "tpuft_replica_last_commit_age_seconds{replica=\"" << PromEscape(id)
       << "\"} " << (NowEpochMs() - ms) / 1000.0 << "\n";
   }
+
+  // Straggler sentinel (docs/wire.md "Straggler sentinel").
+  int64_t stragglers = 0, alerts_active = 0;
+  for (const auto& [id, h] : health_) {
+    if (h.state == 2) ++stragglers;
+  }
+  for (const auto& a : alerts_) {
+    if (a.resolved_ms == 0) ++alerts_active;
+  }
+  gauge("tpuft_replica_step_time_seconds",
+        "rolling per-step busy-time EWMA reported on heartbeats");
+  for (const auto& [id, h] : health_) {
+    o << "tpuft_replica_step_time_seconds{replica=\"" << PromEscape(id)
+      << "\"} " << h.ewma_ms / 1000.0 << "\n";
+  }
+  gauge("tpuft_replica_slowness_ratio",
+        "replica step-time EWMA over the cluster median (1.0 = on pace)");
+  for (const auto& [id, h] : health_) {
+    if (h.ratio > 0.0) {
+      o << "tpuft_replica_slowness_ratio{replica=\"" << PromEscape(id)
+        << "\"} " << h.ratio << "\n";
+    }
+  }
+  gauge("tpuft_straggler_state",
+        "sentinel state per replica: 0 healthy, 1 suspect, 2 straggler");
+  for (const auto& [id, h] : health_) {
+    o << "tpuft_straggler_state{replica=\"" << PromEscape(id) << "\"} "
+      << h.state << "\n";
+  }
+  gauge("tpuft_stragglers", "replicas currently in the straggler state");
+  o << "tpuft_stragglers " << stragglers << "\n";
+  gauge("tpuft_alerts_active", "unresolved sentinel alerts (see /alerts.json)");
+  o << "tpuft_alerts_active " << alerts_active << "\n";
+  return o.str();
+}
+
+int Lighthouse::StragglerState(const std::string& replica_id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = health_.find(replica_id);
+  return it == health_.end() ? 0 : it->second.state;
+}
+
+std::string Lighthouse::AlertsJson() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::ostringstream o;
+  int64_t active = 0;
+  for (const auto& a : alerts_) {
+    if (a.resolved_ms == 0) ++active;
+  }
+  o << "{\"active\":" << active << ",\"alerts\":[";
+  bool first = true;
+  for (const auto& a : alerts_) {
+    if (!first) o << ",";
+    first = false;
+    o << "{\"id\":" << a.id << ",\"kind\":\"" << JsonEscape(a.kind)
+      << "\",\"replica_id\":\"" << JsonEscape(a.replica_id)
+      << "\",\"raised_ms\":" << a.raised_ms
+      << ",\"resolved_ms\":" << a.resolved_ms
+      << ",\"ratio\":" << a.ratio
+      << ",\"step_time_ms\":" << a.step_time_ms
+      << ",\"auto_drained\":" << (a.auto_drained ? "true" : "false")
+      << ",\"active\":" << (a.resolved_ms == 0 ? "true" : "false") << "}";
+  }
+  o << "]}";
   return o.str();
 }
 
@@ -876,6 +1178,29 @@ std::string Lighthouse::StatusJson() {
     first = false;
     o << "\"" << JsonEscape(id) << "\":\"" << JsonEscape(st) << "\"";
   }
+  // Straggler sentinel: per-replica health state (0/1/2), rounded step-time
+  // EWMA, and slowness ratio (permille scaled back to a float here).
+  o << "},\"straggler_state\":{";
+  first = true;
+  for (const auto& [id, st] : s.straggler_state()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\":" << st;
+  }
+  o << "},\"replica_step_time_ms\":{";
+  first = true;
+  for (const auto& [id, ms] : s.replica_step_time_ms()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\":" << ms;
+  }
+  o << "},\"replica_slowness\":{";
+  first = true;
+  for (const auto& [id, pm] : s.replica_slowness_permille()) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\":" << pm / 1000.0;
+  }
   o << "}}";
   return o.str();
 }
@@ -893,6 +1218,8 @@ std::string Lighthouse::StatusHtml() {
        "min-width:18em;vertical-align:top}"
        ".recovering{border-color:orange}.stale{color:#f66}"
        ".draining{border-color:#6af}"
+       ".suspect{border-color:#fc6}.straggler{border-color:#f33}"
+       ".slow{color:#fc6}.veryslow{color:#f33}"
        "button{background:#a33;color:#fff;border:0;padding:.3em .8em;border-radius:4px;"
        "cursor:pointer}</style></head><body>"
        "<h1>tpu-ft lighthouse</h1>";
@@ -916,11 +1243,43 @@ std::string Lighthouse::StatusHtml() {
     std::string state;
     auto st_it = s.replica_state().find(m.replica_id());
     if (st_it != s.replica_state().end()) state = st_it->second;
-    o << "<div class=\"card" << (is_draining ? " draining" : recovering ? " recovering" : "")
+    // Sentinel badge: suspects amber, confirmed stragglers red — the
+    // degraded-but-alive host no heartbeat timeout would ever flag.
+    int64_t straggle = 0;
+    auto sg_it = s.straggler_state().find(m.replica_id());
+    if (sg_it != s.straggler_state().end()) straggle = sg_it->second;
+    int64_t step_ms = 0;
+    auto tm_it = s.replica_step_time_ms().find(m.replica_id());
+    if (tm_it != s.replica_step_time_ms().end()) step_ms = tm_it->second;
+    double slowness = 0.0;
+    auto sl_it = s.replica_slowness_permille().find(m.replica_id());
+    if (sl_it != s.replica_slowness_permille().end()) {
+      slowness = sl_it->second / 1000.0;
+    }
+    const char* card_class = is_draining ? " draining"
+                             : straggle == 2 ? " straggler"
+                             : straggle == 1 ? " suspect"
+                             : recovering    ? " recovering"
+                                             : "";
+    std::ostringstream pace;
+    if (step_ms > 0) {
+      pace << "<br><span class=\""
+           << (straggle == 2 ? "veryslow" : straggle == 1 ? "slow" : "")
+           << "\">step time: " << step_ms << " ms";
+      if (slowness > 0.0) {
+        char buf[32];
+        snprintf(buf, sizeof(buf), " (%.2fx median)", slowness);
+        pace << buf;
+      }
+      pace << (straggle == 2 ? " STRAGGLER" : straggle == 1 ? " suspect" : "")
+           << "</span>";
+    }
+    o << "<div class=\"card" << card_class
       << "\"><b>" << m.replica_id() << "</b><br>step: " << live
       << " <span class=\"" << (lag > 0 ? "stale" : "") << "\">(lag " << lag << ")</span>"
       << (state.empty() ? "" : " [" + state + "]")
       << (is_draining ? " (draining)" : recovering ? " (recovering)" : "")
+      << pace.str()
       << "<br>world_size: " << m.world_size() << "<br>manager: " << m.address()
       << "<br><span class=\"" << (age > 2500 ? "stale" : "") << "\">heartbeat: " << age
       << " ms ago</span><br><form method=\"post\" action=\"/replica/" << m.replica_id()

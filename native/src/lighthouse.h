@@ -39,6 +39,49 @@ struct LighthouseOpt {
   uint64_t heartbeat_timeout_ms = 5000;
 };
 
+// Straggler sentinel state for one replica (docs/architecture.md
+// "Straggler detection").  Heartbeats carry a rolling per-step busy-time
+// EWMA; the engine scores each replica's EWMA against the cluster's lower
+// median and runs a hysteresis state machine over per-step observations:
+//   healthy --(ratio >= R)--> suspect --(grace consecutive over)--> straggler
+//   straggler --(grace consecutive under)--> healthy (alert resolved)
+//   suspect --(one under)--> healthy
+// R = TPUFT_STRAGGLER_RATIO, grace = TPUFT_STRAGGLER_GRACE_STEPS.
+struct ReplicaHealth {
+  double ewma_ms = 0.0;   // latest reported step-time EWMA
+  double last_ms = 0.0;   // latest single-step observation
+  double ratio = 0.0;     // ewma / cluster lower-median ewma (0 = unscored)
+  int state = 0;          // 0 healthy, 1 suspect, 2 straggler
+  int64_t over = 0;       // consecutive step observations at ratio >= R
+  int64_t under = 0;      // consecutive step observations at ratio < R
+  // The sentinel's OWN step cursor.  hb_step_ is also advanced by quorum
+  // joins (which carry no step-time telemetry and usually beat the next
+  // heartbeat to a freshly committed step), so gating observations on a
+  // hb_step_ advance would drop most steps to a race; this cursor moves
+  // only on telemetry-carrying heartbeats, giving exactly one observation
+  // per committed step.
+  int64_t last_step = -1;
+  // Total observations for this incarnation: promotions to straggler are
+  // suppressed until past the warmup (JIT compilation skews early busy
+  // times wildly and replica-asymmetrically — without the gate a slow
+  // first compile reads as a straggler and can trigger a spurious
+  // auto-drain).
+  int64_t observations = 0;
+};
+
+// One operator-visible alert, served on GET /alerts.json.  resolved_ms == 0
+// while active.
+struct AlertRecord {
+  int64_t id = 0;
+  std::string kind;        // "straggler"
+  std::string replica_id;
+  int64_t raised_ms = 0;   // epoch ms
+  int64_t resolved_ms = 0;
+  double ratio = 0.0;        // slowness ratio at raise time
+  double step_time_ms = 0.0; // EWMA at raise time
+  bool auto_drained = false; // the sentinel rotated the replica out itself
+};
+
 // Pure quorum math, unit-testable without sockets.
 // Reference parity: quorum_compute, src/lighthouse.rs:133-261.
 struct QuorumState {
@@ -106,6 +149,12 @@ class Lighthouse {
   // Reference parity: src/lighthouse.rs:433-458.
   bool KillReplica(const std::string& replica_id, std::string* err);
 
+  // Straggler sentinel introspection (public for in-process tests; the
+  // wire-facing surfaces are /metrics, /status.json and /alerts.json).
+  int StragglerState(const std::string& replica_id);
+  // JSON alert feed: {"active": N, "alerts": [...]} — newest last.
+  std::string AlertsJson();
+
  private:
   Status Dispatch(uint16_t method, const std::string& req, Deadline deadline, std::string* resp);
   // True when an ops-endpoint request may mutate state (docs/wire.md
@@ -116,6 +165,26 @@ class Lighthouse {
   // Runs one quorum attempt; on success installs + broadcasts it.
   // Caller must hold mu_.
   void TickLocked();
+  // DrainReplica body; caller must hold mu_ (the sentinel's auto-drain
+  // fires from inside HandleHeartbeat, which already does).
+  int DrainLocked(const std::string& prefix, int64_t deadline_ms);
+  // One sentinel observation for `id` (its reported step advanced with a
+  // step-time EWMA attached): rescore against the cluster median and run
+  // the hysteresis state machine.  Caller must hold mu_.
+  void ObserveStepTimeLocked(const std::string& id);
+  // Lower median of eligible (fresh, non-draining, reporting) replica
+  // EWMAs; 0 when fewer than two replicas report.  Caller must hold mu_.
+  double ClusterMedianEwmaLocked() const;
+  // Raise/resolve the straggler alert for one replica.  Caller holds mu_.
+  void RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth* h);
+  void ResolveAlertsLocked(const std::string& id);
+  // Auto-drain attempt for a confirmed straggler: marks it draining via
+  // the cooperative path iff enabled and the remaining healthy count
+  // stays above min_replicas.  Returns whether the replica is (now)
+  // draining.  Retried on every later straggler observation, so a
+  // rotation skipped at the capacity floor happens as soon as capacity
+  // recovers.  Caller holds mu_.
+  bool MaybeAutoDrainLocked(const std::string& id, bool log_skip);
   std::string StatusJson();
   std::string StatusHtml();
   // Prometheus text exposition for GET /metrics: quorum size/id/age,
@@ -166,6 +235,31 @@ class Lighthouse {
   // Shared secret for the mutating HTTP ops endpoints, from
   // TPUFT_ADMIN_TOKEN at Start; empty = loopback-only access.
   std::string admin_token_;
+  // Straggler sentinel (docs/architecture.md "Straggler detection").
+  // Rolling health per replica id, pruned with the heartbeat graveyard.
+  std::map<std::string, ReplicaHealth> health_;
+  // Alert history (newest last, bounded); active = resolved_ms == 0.
+  std::vector<AlertRecord> alerts_;
+  int64_t alert_seq_ = 0;
+  // Knobs, read from the environment at Start:
+  //   TPUFT_STRAGGLER_RATIO        slowness ratio threshold (default 1.5)
+  //   TPUFT_STRAGGLER_GRACE_STEPS  consecutive step observations over/under
+  //                                the threshold before promoting to
+  //                                straggler / demoting back (default 5)
+  //   TPUFT_STRAGGLER_AUTO_DRAIN   "1": a confirmed straggler is marked
+  //                                draining (the PR-1 cooperative path) the
+  //                                moment its alert raises, provided the
+  //                                remaining healthy count stays above
+  //                                min_replicas
+  //   TPUFT_STRAGGLER_WARMUP_STEPS observations per incarnation before a
+  //                                suspect may be promoted to straggler
+  //                                (default 10): JIT warmup skews early
+  //                                busy times asymmetrically and must not
+  //                                raise alerts or trigger auto-drain
+  double straggler_ratio_ = 1.5;
+  int64_t straggler_grace_ = 5;
+  bool straggler_auto_drain_ = false;
+  int64_t straggler_warmup_ = 10;
 
   std::thread tick_thread_;
   bool shutdown_ = false;

@@ -171,10 +171,18 @@ void ManagerServer::Shutdown() {
 
 std::string ManagerServer::address() const { return server_ ? server_->address() : ""; }
 
-void ManagerServer::SetStatus(int64_t step, const std::string& state) {
+void ManagerServer::SetStatus(int64_t step, const std::string& state,
+                              double step_time_ms_ewma, double step_time_ms_last) {
   std::lock_guard<std::mutex> lk(mu_);
   status_step_ = step;
   status_state_ = state;
+  // 0 means "no new telemetry": a phase-transition push (e.g. "quorum")
+  // between commits must not wipe the last committed step's pacing data off
+  // the heartbeat — the sentinel needs the EWMA continuously visible.
+  if (step_time_ms_ewma > 0.0) {
+    status_step_time_ewma_ms_ = step_time_ms_ewma;
+    status_step_time_last_ms_ = step_time_ms_last;
+  }
 }
 
 void ManagerServer::HeartbeatLoop() {
@@ -213,6 +221,8 @@ void ManagerServer::HeartbeatLoop() {
       std::lock_guard<std::mutex> lk(mu_);
       req.set_step(status_step_);
       req.set_state(status_state_);
+      req.set_step_time_ms_ewma(status_step_time_ewma_ms_);
+      req.set_step_time_ms_last(status_step_time_last_ms_);
       req.SerializeToString(&payload);
     }
     Status st = heartbeat_client_->Call(kLighthouseHeartbeat, payload, call_timeout_ms,

@@ -59,8 +59,11 @@ class ManagerServer {
   // Live training status pushed by the Python Manager (rank 0) at phase
   // transitions; carried on every subsequent lighthouse heartbeat so the
   // cluster's GET /metrics exposition and dashboard show per-replica step
-  // and state without waiting for the next quorum snapshot.
-  void SetStatus(int64_t step, const std::string& state);
+  // and state without waiting for the next quorum snapshot.  The optional
+  // step-time telemetry (rolling busy-time EWMA + last observation, ms; 0 =
+  // not reported) feeds the lighthouse's straggler sentinel.
+  void SetStatus(int64_t step, const std::string& state,
+                 double step_time_ms_ewma = 0.0, double step_time_ms_last = 0.0);
 
   // RPC handlers (public for in-process tests).
   Status HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
@@ -99,6 +102,8 @@ class ManagerServer {
   // Live status for heartbeat enrichment (SetStatus).
   int64_t status_step_ = 0;
   std::string status_state_ = "init";
+  double status_step_time_ewma_ms_ = 0.0;
+  double status_step_time_last_ms_ = 0.0;
 
   // should_commit barrier per (step) round (reference: src/manager.rs:313-371).
   struct CommitRound {

@@ -1230,6 +1230,126 @@ void TestHttpAdminGate() {
   }
 }
 
+// --- Straggler sentinel ------------------------------------------------------
+// Heartbeats carrying step-time EWMAs drive the hysteresis state machine
+// healthy -> suspect -> straggler and back, with the alert raised on
+// /alerts.json and the scores exposed on /metrics (docs/wire.md).
+void TestStragglerSentinel() {
+  setenv("TPUFT_STRAGGLER_RATIO", "1.5", 1);
+  setenv("TPUFT_STRAGGLER_GRACE_STEPS", "3", 1);
+  setenv("TPUFT_STRAGGLER_AUTO_DRAIN", "0", 1);
+  setenv("TPUFT_STRAGGLER_WARMUP_STEPS", "0", 1);
+  LighthouseOpt opt;
+  opt.bind = "127.0.0.1:0";
+  opt.http_bind = "127.0.0.1:0";
+  opt.min_replicas = 1;
+  opt.quorum_tick_ms = 20;
+  Lighthouse lh(opt);
+  std::string err;
+  CHECK(lh.Start(&err));
+  auto hb = [&](const std::string& id, int64_t step, double ewma) {
+    LighthouseHeartbeatRequest r;
+    r.set_replica_id(id);
+    r.set_step(step);
+    r.set_state("step");
+    r.set_step_time_ms_ewma(ewma);
+    r.set_step_time_ms_last(ewma);
+    CHECK(lh.HandleHeartbeat(r) == Status::kOk);
+  };
+
+  // On pace: both replicas report ~equal EWMAs.
+  hb("0:fast", 1, 100.0);
+  hb("1:slow", 1, 100.0);
+  CHECK(lh.StragglerState("0:fast") == 0);
+  CHECK(lh.StragglerState("1:slow") == 0);
+
+  // One replica degrades to 3x the median: first over-threshold step makes
+  // it suspect, grace consecutive steps confirm the straggler + raise the
+  // alert.
+  hb("1:slow", 2, 300.0);
+  CHECK(lh.StragglerState("1:slow") == 1);
+  hb("0:fast", 2, 100.0);
+  CHECK(lh.StragglerState("0:fast") == 0);
+  hb("1:slow", 3, 300.0);
+  CHECK(lh.StragglerState("1:slow") == 1);
+  hb("1:slow", 4, 300.0);
+  CHECK(lh.StragglerState("1:slow") == 2);
+
+  std::string m = HttpGet(lh.http_address(), "/metrics");
+  CHECK(m.find("tpuft_straggler_state{replica=\"1:slow\"} 2") != std::string::npos);
+  CHECK(m.find("tpuft_straggler_state{replica=\"0:fast\"} 0") != std::string::npos);
+  CHECK(m.find("tpuft_replica_slowness_ratio{replica=\"1:slow\"} 3") != std::string::npos);
+  CHECK(m.find("tpuft_replica_step_time_seconds{replica=\"1:slow\"} 0.3") != std::string::npos);
+  CHECK(m.find("tpuft_stragglers 1") != std::string::npos);
+  CHECK(m.find("tpuft_alerts_active 1") != std::string::npos);
+  std::string a = HttpGet(lh.http_address(), "/alerts.json");
+  CHECK(a.find("\"active\":1") != std::string::npos);
+  CHECK(a.find("\"kind\":\"straggler\"") != std::string::npos);
+  CHECK(a.find("\"replica_id\":\"1:slow\"") != std::string::npos);
+  CHECK(a.find("\"resolved_ms\":0") != std::string::npos);
+  std::string js = HttpGet(lh.http_address(), "/status.json");
+  CHECK(js.find("\"straggler_state\"") != std::string::npos);
+  CHECK(js.find("\"replica_step_time_ms\"") != std::string::npos);
+
+  // Hysteresis down: grace consecutive on-pace steps clear the state and
+  // resolve the alert.
+  hb("1:slow", 5, 100.0);
+  CHECK(lh.StragglerState("1:slow") == 2);
+  hb("1:slow", 6, 100.0);
+  hb("1:slow", 7, 100.0);
+  CHECK(lh.StragglerState("1:slow") == 0);
+  a = HttpGet(lh.http_address(), "/alerts.json");
+  CHECK(a.find("\"active\":0") != std::string::npos);
+  CHECK(a.find("\"resolved_ms\":0") == std::string::npos);
+
+  lh.Shutdown();
+  unsetenv("TPUFT_STRAGGLER_RATIO");
+  unsetenv("TPUFT_STRAGGLER_GRACE_STEPS");
+  unsetenv("TPUFT_STRAGGLER_AUTO_DRAIN");
+  unsetenv("TPUFT_STRAGGLER_WARMUP_STEPS");
+}
+
+// Auto-drain: a confirmed straggler is marked draining (cooperative path)
+// provided the remaining healthy set keeps the quorum floor.
+void TestStragglerAutoDrain() {
+  setenv("TPUFT_STRAGGLER_RATIO", "1.5", 1);
+  setenv("TPUFT_STRAGGLER_GRACE_STEPS", "2", 1);
+  setenv("TPUFT_STRAGGLER_AUTO_DRAIN", "1", 1);
+  setenv("TPUFT_STRAGGLER_WARMUP_STEPS", "0", 1);
+  LighthouseOpt opt;
+  opt.bind = "127.0.0.1:0";
+  opt.http_bind = "";
+  opt.min_replicas = 1;
+  opt.quorum_tick_ms = 20;
+  Lighthouse lh(opt);
+  std::string err;
+  CHECK(lh.Start(&err));
+  auto hb = [&](const std::string& id, int64_t step, double ewma) {
+    LighthouseHeartbeatRequest r;
+    r.set_replica_id(id);
+    r.set_step(step);
+    r.set_step_time_ms_ewma(ewma);
+    CHECK(lh.HandleHeartbeat(r) == Status::kOk);
+  };
+  hb("0:fast", 1, 100.0);
+  hb("1:slow", 1, 100.0);
+  hb("1:slow", 2, 400.0);
+  hb("1:slow", 3, 400.0);  // grace 2 -> straggler -> auto-drain fires
+  LighthouseStatusResponse s;
+  lh.FillStatus(&s);
+  bool draining = false;
+  for (const auto& id : s.draining()) draining = draining || id == "1:slow";
+  CHECK(draining);
+  // 2 healthy, min_replicas 1: the drain left the floor intact, and the
+  // healthy survivor was never touched.
+  for (const auto& id : s.draining()) CHECK(id != "0:fast");
+  lh.Shutdown();
+  unsetenv("TPUFT_STRAGGLER_RATIO");
+  unsetenv("TPUFT_STRAGGLER_GRACE_STEPS");
+  unsetenv("TPUFT_STRAGGLER_AUTO_DRAIN");
+  unsetenv("TPUFT_STRAGGLER_WARMUP_STEPS");
+}
+
 // --- QuorumCompute property fuzz ---------------------------------------------
 // Randomized join/leave/heartbeat/round sequences; the invariants the
 // reference effectively specs with ~590 test lines (src/lighthouse.rs:606-1038):
@@ -1339,6 +1459,8 @@ int main() {
   TestHttpAdminGate();
   TestMetricsExposition();
   TestManagerHeartbeatCarriesStatus();
+  TestStragglerSentinel();
+  TestStragglerAutoDrain();
   TestQuorumComputeFuzz();
   printf("all native tests passed\n");
   return 0;

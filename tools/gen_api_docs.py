@@ -56,6 +56,7 @@ MODULES = [
     "torchft_tpu.metrics",
     "torchft_tpu.obs.spans",
     "torchft_tpu.obs.report",
+    "torchft_tpu.obs.trace",
     "torchft_tpu.multihost",
     "torchft_tpu.launch",
     "torchft_tpu.lighthouse_cli",

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Export merged multi-replica metrics JSONL as a Chrome/Perfetto trace.
+
+Any bench or kill-run workdir becomes a viewable timeline::
+
+    python bench.py --scenario kill                  # keeps its workdirs
+    python tools/trace_export.py <workdir>/kill_0/metrics.jsonl
+    # -> <workdir>/kill_0/trace.json; open in ui.perfetto.dev
+
+or point it at a directory and it collects every ``*.jsonl`` inside::
+
+    python tools/trace_export.py --workdir <workdir>/kill_0
+
+The output is standard Chrome trace-event JSON: one process per replica
+group, one track per incarnation (background snapshot work on a sub-track),
+phase slices carrying ``step``/``slice_gen`` args, and fault / drain /
+alert instant events — clock-aligned across replicas via the
+``step_summary`` commit barrier (torchft_tpu/obs/trace.py).
+
+``--quick`` runs the tier-1 smoke: build a synthetic 2-replica stream,
+export it, validate the trace schema, print a JSON summary, exit non-zero
+on any problem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/trace_export.py",
+        description="Merge tpu-ft metrics JSONL streams into a Chrome/"
+        "Perfetto trace.json (one track per replica).",
+    )
+    ap.add_argument("paths", nargs="*", help="metrics.jsonl file(s)")
+    ap.add_argument(
+        "--workdir", help="collect every *.jsonl under this directory instead"
+    )
+    ap.add_argument("-o", "--out", help="output path (default: trace.json next "
+                    "to the first input)")
+    ap.add_argument(
+        "--no-align", action="store_true",
+        help="skip the step_summary commit-barrier clock alignment",
+    )
+    ap.add_argument(
+        "--quick", action="store_true",
+        help="self-contained smoke: synthetic 2-replica stream -> export -> "
+        "schema validation (used by tier-1 tests)",
+    )
+    args = ap.parse_args(argv)
+
+    from torchft_tpu.obs import trace as obs_trace
+
+    if args.quick:
+        events = obs_trace.synthetic_stream(n_replicas=2, steps=4)
+        built = obs_trace.build_trace(events, align=not args.no_align)
+        problems = obs_trace.validate_trace(built)
+        out = args.out
+        if out is None:
+            fd, out = tempfile.mkstemp(prefix="tpuft_trace_", suffix=".json")
+            os.close(fd)
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(built, f)
+        print(
+            json.dumps(
+                {
+                    "ok": not problems,
+                    "out": out,
+                    "input_events": len(events),
+                    "trace_events": len(built["traceEvents"]),
+                    "replicas": len(built.get("otherData", {}).get("replicas", {})),
+                    "problems": problems,
+                }
+            )
+        )
+        return 0 if not problems else 1
+
+    paths = list(args.paths)
+    if args.workdir:
+        paths += sorted(
+            glob.glob(os.path.join(args.workdir, "**", "*.jsonl"), recursive=True)
+        )
+    if not paths:
+        ap.error("no input: pass metrics.jsonl path(s) or --workdir")
+    out = args.out or os.path.join(os.path.dirname(paths[0]) or ".", "trace.json")
+    summary = obs_trace.export(paths, out, align=not args.no_align)
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

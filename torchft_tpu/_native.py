@@ -117,6 +117,8 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_void_p,
         ctypes.c_int64,
         ctypes.c_char_p,
+        ctypes.c_double,
+        ctypes.c_double,
     ]
     lib.tf_manager_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_manager_free.argtypes = [ctypes.c_void_p]
@@ -354,11 +356,19 @@ class LighthouseClient:
         timeout_ms: int = 5000,
         step: int = 0,
         state: str = "",
+        step_time_ms_ewma: float = 0.0,
+        step_time_ms_last: float = 0.0,
     ) -> None:
         """One heartbeat; ``step``/``state`` feed the lighthouse's live
-        per-replica observability (GET /metrics step lag, /status.json)."""
+        per-replica observability (GET /metrics step lag, /status.json) and
+        the step-time fields feed its straggler sentinel (fields 4-5,
+        docs/wire.md)."""
         req = pb.LighthouseHeartbeatRequest(
-            replica_id=replica_id, step=int(step), state=state
+            replica_id=replica_id,
+            step=int(step),
+            state=state,
+            step_time_ms_ewma=float(step_time_ms_ewma),
+            step_time_ms_last=float(step_time_ms_last),
         )
         self._client.call(LIGHTHOUSE_HEARTBEAT, req.SerializeToString(), timeout_ms)
 
@@ -433,12 +443,27 @@ class ManagerServer:
     def address(self) -> str:
         return _take_string(_lib.tf_manager_address(self._ptr))
 
-    def set_status(self, step: int, state: str) -> None:
+    def set_status(
+        self,
+        step: int,
+        state: str,
+        step_time_ms_ewma: float = 0.0,
+        step_time_ms_last: float = 0.0,
+    ) -> None:
         """Pushes live (step, state) into the heartbeat payload so the
         lighthouse's ``GET /metrics`` and ``/status.json`` show per-replica
-        progress in real time (see docs/wire.md, Heartbeat fields)."""
+        progress in real time (see docs/wire.md, Heartbeat fields).  The
+        optional step-time telemetry (rolling busy-time EWMA + last
+        observation, milliseconds) feeds the lighthouse's straggler
+        sentinel; 0 keeps the previously pushed values."""
         if self._ptr:
-            _lib.tf_manager_set_status(self._ptr, int(step), state.encode())
+            _lib.tf_manager_set_status(
+                self._ptr,
+                int(step),
+                state.encode(),
+                float(step_time_ms_ewma),
+                float(step_time_ms_last),
+            )
 
     def shutdown(self) -> None:
         if self._ptr:

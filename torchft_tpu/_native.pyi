@@ -82,6 +82,8 @@ class LighthouseClient:
         timeout_ms: int = ...,
         step: int = ...,
         state: str = ...,
+        step_time_ms_ewma: float = ...,
+        step_time_ms_last: float = ...,
     ) -> None: ...
     def evict(self, replica_prefix: str, timeout_ms: int = ...) -> int: ...
     def drain(
@@ -101,7 +103,13 @@ class ManagerServer:
         connect_timeout_ms: int = ...,
     ) -> None: ...
     def address(self) -> str: ...
-    def set_status(self, step: int, state: str) -> None: ...
+    def set_status(
+        self,
+        step: int,
+        state: str,
+        step_time_ms_ewma: float = ...,
+        step_time_ms_last: float = ...,
+    ) -> None: ...
     def shutdown(self) -> None: ...
 
 class ManagerClient:

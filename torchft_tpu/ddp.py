@@ -103,10 +103,20 @@ class GradientAverager:
             futures.append((bucket, fut))
 
         out: List[Any] = list(hosts)
-        for bucket, fut in futures:
-            flat = np.asarray(fut.result())
-            for idx, arr in bucket.unpack(flat):
-                out[idx] = arr
+        # The bucket drain blocks this (train) thread on the ring exchange —
+        # i.e. on the SLOWEST peer's gradients.  Span it as allreduce_merge:
+        # unrecorded, this wait would be charged as productive/busy time,
+        # and on a cluster with one slow host EVERY fast replica would read
+        # as busy for the whole stall — hiding exactly the straggler the
+        # step-time telemetry exists to expose (the commit-time drain of
+        # what remains keeps the same phase name; the accumulator sums).
+        with self._manager.spans.span(
+            "allreduce_merge", step=self._manager.current_step()
+        ):
+            for bucket, fut in futures:
+                flat = np.asarray(fut.result())
+                for idx, arr in bucket.unpack(flat):
+                    out[idx] = arr
 
         devices = [
             jax.device_put(a, leaves[i].sharding) if is_jax[i] else a
@@ -151,7 +161,14 @@ class PerLeafGradientAverager:
             )
             for l in leaves
         ]
-        return jax.tree.unflatten(treedef, [f.result() for f in futs])
+        # Same accounting contract as GradientAverager: the drain blocks on
+        # the slowest peer's gradients and must be spanned, or the wait is
+        # charged as busy time and the straggler sentinel goes blind.
+        with self._manager.spans.span(
+            "allreduce_merge", step=self._manager.current_step()
+        ):
+            results = [f.result() for f in futs]
+        return jax.tree.unflatten(treedef, results)
 
 
 def allreduce_pytree(manager: Manager, tree: Any, bucket_bytes: int = 25 << 20) -> Any:

@@ -42,7 +42,30 @@ logger = logging.getLogger(__name__)
 # and restarted with exponential backoff rather than immediately.
 _MIN_UPTIME_S = 5.0
 
-__all__ = ["Launcher", "main"]
+__all__ = ["Launcher", "fetch_alerts", "main"]
+
+
+def fetch_alerts(http_address: str, timeout: float = 2.0):
+    """Fetches the lighthouse's straggler-sentinel alert feed
+    (``GET /alerts.json``) from a ``host:port`` HTTP address.  Returns the
+    parsed dict, or None on any failure — callers poll inside supervision
+    or measurement loops and must treat a missed fetch as 'retry later',
+    never as an error.  Dials 127.0.0.1 with the advertised port: embedded
+    lighthouses bind loopback, and the advertised hostname may not resolve
+    inside sandboxes."""
+    import json
+    import urllib.request
+
+    if not http_address:
+        return None
+    port = http_address.rsplit(":", 1)[-1]
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/alerts.json", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read().decode())
+    except Exception:  # noqa: BLE001
+        return None
 
 
 @dataclass
@@ -126,6 +149,15 @@ class Launcher:
             ``victim_restart_s``), and the pool is refilled in the
             background.  Requires the command to resolve its group id via
             the ``replica_env`` contract (``examples/_common.py``).
+        straggler_auto_drain: act on the lighthouse's straggler-sentinel
+            alerts — ``supervise_once`` polls ``GET /alerts.json`` (embedded
+            lighthouse only) and rotates a confirmed straggler out through
+            :meth:`drain`, i.e. the PR-1 cooperative handoff: a replacement
+            is pre-warmed (hot spare when available) while the slow donor
+            finishes its step and exits, so a degraded-but-alive host costs
+            one handoff gap instead of dragging every synchronous step for
+            the rest of the job.  Default: ``TPUFT_STRAGGLER_AUTO_DRAIN=1``
+            in the environment.
     """
 
     def __init__(
@@ -142,6 +174,7 @@ class Launcher:
         env: Optional[Dict[str, Optional[str]]] = None,
         cwd: Optional[str] = None,
         spares: int = 0,
+        straggler_auto_drain: Optional[bool] = None,
     ) -> None:
         self._cmd = list(cmd)
         self._num_groups = num_groups
@@ -161,7 +194,15 @@ class Launcher:
         self._draining: List[_Draining] = []
         self._drain_dir: Optional[str] = None
         self._drain_dir_created = False
+        if straggler_auto_drain is None:
+            straggler_auto_drain = (
+                os.environ.get("TPUFT_STRAGGLER_AUTO_DRAIN", "") == "1"
+            )
+        self._straggler_auto_drain = straggler_auto_drain
+        self._sentinel_last_poll = 0.0
+        self._handled_alerts: set = set()
 
+        lighthouse_http = ""
         if lighthouse == "embed":
             from torchft_tpu._native import LighthouseServer
 
@@ -171,6 +212,7 @@ class Launcher:
                 join_timeout_ms=join_timeout_ms,
             )
             lighthouse_addr = self._embedded.address()
+            lighthouse_http = self._embedded.http_address()
         elif lighthouse is not None:
             lighthouse_addr = lighthouse
         else:
@@ -212,6 +254,10 @@ class Launcher:
         base["TPUFT_DRAIN_SUPERVISED"] = "1"
         self._base_env = base
         self.lighthouse_address = lighthouse_addr
+        # Dashboard/metrics HTTP address of the embedded lighthouse (empty
+        # for external ones): the sentinel poll and ops tooling read
+        # /metrics and /alerts.json here.
+        self.lighthouse_http_address = lighthouse_http
         from torchft_tpu.metrics import MetricsLogger
 
         self._metrics = MetricsLogger(base.get("TPUFT_METRICS_PATH"), "launcher")
@@ -624,7 +670,95 @@ class Launcher:
                 continue
             self._spares.remove(spare)
             self._note_spare_death(spare)
+        # Straggler sentinel: rotate confirmed-slow hosts out (throttled,
+        # no-op unless straggler_auto_drain and an embedded lighthouse).
+        self._sentinel_once()
         return restarted
+
+    def pid(self, group: int) -> Optional[int]:
+        """PID of the group's current process (None while dead) — lets fault
+        injectors pin per-incarnation state (e.g. the straggler bench's
+        pid-pinned slow-step file, which must not follow the group id onto
+        the replacement)."""
+        g = self._groups[group]
+        if g.proc is not None and g.proc.poll() is None:
+            return g.proc.pid
+        return None
+
+    def _sentinel_once(self) -> None:
+        """Acts on the lighthouse's straggler alerts (``/alerts.json``,
+        polled at most once a second): an ACTIVE, unhandled straggler alert
+        for a group this supervisor owns triggers the cooperative-drain
+        rotation — exactly what an operator clicking "drain" on the slow
+        host would do, automated.  The lighthouse detects (it sees every
+        replica's pace); the supervisor acts (it owns the spare pool).
+        When the pool is configured but momentarily empty the alert is left
+        unhandled and retried next poll — rotating without a warm
+        replacement would trade a slow step for a cold-start gap."""
+        if not self._straggler_auto_drain or not self.lighthouse_http_address:
+            return
+        now = time.monotonic()
+        if now - self._sentinel_last_poll < 1.0:
+            return
+        self._sentinel_last_poll = now
+        alerts = fetch_alerts(self.lighthouse_http_address)
+        if alerts is None:
+            return  # missed poll; retried in a second
+        for alert in alerts.get("alerts", []):
+            if not alert.get("active") or alert.get("kind") != "straggler":
+                continue
+            if alert.get("id") in self._handled_alerts:
+                continue
+            group_s = str(alert.get("replica_id", "")).split(":", 1)[0]
+            try:
+                group = int(group_s)
+            except ValueError:
+                continue
+            if group not in self._groups:
+                continue
+            g = self._groups[group]
+            # The alert names an INCARNATION; the group slot may already
+            # hold a different process (the alerted one crashed and was
+            # restarted before the graveyard prune resolved its alert).
+            # Draining the fresh replacement over a stale alert would be a
+            # spurious handoff — skip when the slot's process is younger
+            # than the alert.  Clock bases differ (alert: epoch ms; spawn:
+            # monotonic), so compare AGES, with a 1 s slack for the skew
+            # between time.time() and the lighthouse's stamp.
+            alert_age = time.time() - float(alert.get("raised_ms", 0)) / 1e3
+            proc_age = (
+                now - g.spawned_at if g.proc is not None else float("inf")
+            )
+            if proc_age + 1.0 < alert_age:
+                self._handled_alerts.add(alert.get("id"))  # stale: never act
+                continue
+            if self._spares_target > 0 and self.spare_count() == 0:
+                continue  # pool refilling; retry next poll
+            self._handled_alerts.add(alert.get("id"))
+            logger.warning(
+                "group %d (%s) confirmed straggler (%.2fx median, step time "
+                "%.0f ms); rotating out via cooperative drain",
+                group, alert.get("replica_id"),
+                float(alert.get("ratio", 0.0)),
+                float(alert.get("step_time_ms", 0.0)),
+            )
+            self._metrics.emit(
+                "straggler_drain",
+                group=str(group),
+                replica_id=alert.get("replica_id"),
+                alert_id=alert.get("id"),
+                ratio=alert.get("ratio"),
+                step_time_ms=alert.get("step_time_ms"),
+            )
+            try:
+                self.drain(group, deadline_s=30.0)
+            except RuntimeError:
+                # The donor already exited (the lighthouse's own auto-drain
+                # mark aborts its quorum joins, and a cooperative Manager
+                # exits cleanly on that) — just make sure a replacement
+                # owns the slot.
+                if g.proc is None or g.proc.poll() is not None:
+                    self.spawn(group)
 
     def running(self) -> bool:
         """True while any group process is alive."""

@@ -240,13 +240,18 @@ class Manager:
         self._logger = _ManagerLogger(self, self._replica_id, self._rank)
         # JSONL event stream when TPUFT_METRICS_PATH is set (no-op otherwise).
         from torchft_tpu.metrics import MetricsLogger
-        from torchft_tpu.obs.spans import SpanTracker
+        from torchft_tpu.obs.spans import SpanTracker, StepTimeStats
 
         self._metrics = MetricsLogger.from_env(self._replica_id)
         # Step-scoped trace spans over the same stream (obs/spans.py): each
         # phase below runs inside a span, and the span's single monotonic
         # measurement also feeds the legacy *_ms fields.
         self._spans = SpanTracker(self._metrics)
+        # Straggler-sentinel telemetry: rolling busy-time per committed step
+        # (EWMA + p50/p99), pushed onto lighthouse heartbeats via SetStatus
+        # so the cluster-level health scoring sees this replica's pace.
+        self._step_stats = StepTimeStats()
+        self._last_commit_mono: Optional[float] = None
         self._wire_transport_spans()
 
     def _wire_transport_spans(self) -> None:
@@ -320,7 +325,29 @@ class Manager:
         try:
             self._quorum_inner(allow_heal, shrink_only, quorum_timeout)
         except Exception as e:  # noqa: BLE001
-            self._logger.exception(f"quorum failed: {e}")
+            if "is draining" in str(e):
+                # The LIGHTHOUSE marked this incarnation draining (operator
+                # /replica/<id>/drain, or the straggler sentinel's
+                # auto-drain) and refuses its joins.  That is a drain
+                # notice delivered through the quorum path: begin the
+                # cooperative exit so the train loop finishes this step and
+                # leaves cleanly instead of flailing through failed commits
+                # until something kills it.  "is draining" is the grep
+                # contract with both HandleQuorum message sites in
+                # native/src/lighthouse.cc (the framed-TCP wire carries
+                # status + message only, no structured error payload);
+                # pinned by tests/test_straggler.py.
+                from torchft_tpu.drain import DrainNotice
+
+                self._logger.warn(
+                    "lighthouse declared this replica draining; beginning "
+                    "cooperative exit"
+                )
+                self.begin_drain(
+                    DrainNotice(source="lighthouse", deadline=time.time() + 30.0)
+                )
+            else:
+                self._logger.exception(f"quorum failed: {e}")
             self.report_error(e)
             # Not participating this step.
             self._participating_replica_rank = None
@@ -675,6 +702,16 @@ class Manager:
         return out
 
     @property
+    def spans(self):
+        """The Manager's :class:`~torchft_tpu.obs.spans.SpanTracker`.
+        Public so wrappers that BLOCK the train thread on FT work outside
+        the Manager's own phases (GradientAverager's bucket drain, custom
+        sync loops) can record that wait as a span — anything not spanned
+        here is charged as busy/productive time by both obs.report and the
+        straggler sentinel's step-time telemetry."""
+        return self._spans
+
+    @property
     def timeout(self) -> timedelta:
         """Default per-operation deadline.  Public so wrappers can bound their
         own device->host materializations and RPC waits without reaching into
@@ -685,16 +722,22 @@ class Manager:
     # -- status -------------------------------------------------------------
 
     def _set_status(self, state: str) -> None:
-        """Pushes (step, state) into this group's native ManagerServer so its
-        lighthouse heartbeats carry live per-replica progress — the feed for
-        the lighthouse's ``GET /metrics`` exposition and the dashboard's
-        step-lag column.  Rank != 0 has no server; best-effort by design
-        (status must never fail a step)."""
+        """Pushes (step, state) plus the rolling step-time telemetry into
+        this group's native ManagerServer so its lighthouse heartbeats carry
+        live per-replica progress AND pace — the feed for the lighthouse's
+        ``GET /metrics`` exposition, the dashboard's step-lag column, and
+        the straggler sentinel's health scoring.  Rank != 0 has no server;
+        best-effort by design (status must never fail a step)."""
         srv = self._manager_server
         if srv is None:
             return
         try:
-            srv.set_status(self._step, state)
+            srv.set_status(
+                self._step,
+                state,
+                self._step_stats.ewma_ms,
+                self._step_stats.last_ms,
+            )
         except Exception:  # noqa: BLE001
             pass
 
@@ -714,6 +757,13 @@ class Manager:
     def should_commit(self, timeout: Optional[timedelta] = None) -> bool:
         """Two-phase commit vote across all local ranks of the group
         (reference: torchft/manager.py:587-663)."""
+        # Settle the quorum before voting: the vote concerns state the
+        # quorum thread may still be mutating (heal fast-forward of _step,
+        # _healing, participation bookkeeping).  A loop that allreduced
+        # already waited; this closes the race for loops that vote without
+        # gradient traffic (num_participants() read 0 mid-flight there).
+        if self._quorum_future is not None:
+            self.wait_quorum()
         # Drain pending allreduces; their errors are already latched.  The
         # span is the merge wait: how long commit time blocked on gradient
         # traffic the step's compute did not already hide.
@@ -754,7 +804,35 @@ class Manager:
             error=repr(self._errored) if self._errored else None,
             vote_ms=sp_vote.duration_ms,
         )
-        self._spans.step_summary(vote_step, committed=should_commit)
+        # Straggler-sentinel observation: this step's BUSY time = the
+        # commit-to-commit wall interval minus the FT wait phases the span
+        # accumulator holds for the step in flight (read BEFORE step_summary
+        # flushes it).  In lockstep training the raw interval equalizes
+        # across the quorum — everyone waits for the slowest — so only
+        # wall-minus-waits identifies the host that actually computed the
+        # whole time.  Failed commits produce no observation (their eventual
+        # commit interval spans the retries and would misread as slowness).
+        step_time_fields: Dict[str, float] = {}
+        if should_commit:
+            now_mono = time.monotonic()
+            if self._last_commit_mono is not None:
+                wall_ms = (now_mono - self._last_commit_mono) * 1e3
+                busy_ms = max(0.0, wall_ms - self._spans.ft_accounted_ms())
+                self._step_stats.observe(busy_ms)
+                snap = self._step_stats.snapshot()
+                step_time_fields = {
+                    "step_wall_ms": round(wall_ms, 3),
+                    "step_time_ms": round(busy_ms, 3),
+                    "step_time_ms_ewma": snap["ewma"],
+                    "step_time_ms_p50": snap["p50"],
+                    "step_time_ms_p99": snap["p99"],
+                }
+            self._last_commit_mono = now_mono
+        else:
+            self._last_commit_mono = None
+        self._spans.step_summary(
+            vote_step, committed=should_commit, **step_time_fields
+        )
 
         if self._checkpoint_transport is not None:
             # Weights are about to be mutated: stop serving the stale

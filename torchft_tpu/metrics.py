@@ -62,10 +62,18 @@ EVENTS = {
     "drain_complete": "cooperative departure finished cleanly",
     "drain_handoff": "launcher handed the draining group's id to a spare",
     "drain_donor_exit": "draining donor process exited",
+    # -- straggler sentinel (native lighthouse + launch.py, bench.py) -------
+    "straggler_injected": "bench driver began the per-step sleep injection "
+                          "on the victim group (sleep_s, pid-pinned)",
+    "alert": "bench driver observed a sentinel alert on the lighthouse's "
+             "/alerts.json (alert_id, ratio, raised_ms) — stamps detection "
+             "into the stream so trace export and latency accounting see it",
+    "straggler_drain": "launcher sentinel rotated a confirmed straggler out "
+                       "through the cooperative-drain path",
     # -- fault injection (bench.py) -----------------------------------------
-    "fault": "scripted fault fired (kind=kill|drain, group=victim) — written "
-             "by the benchmark driver so obs/report.py sees the same fault "
-             "timeline the goodput accounting charges",
+    "fault": "scripted fault fired (kind=kill|drain|straggler, group=victim) "
+             "— written by the benchmark driver so obs/report.py sees the "
+             "same fault timeline the goodput accounting charges",
 }
 
 

@@ -1,6 +1,6 @@
-"""Observability: step-scoped tracing and goodput attribution.
+"""Observability: step-scoped tracing, goodput attribution, trace export.
 
-Two halves:
+Three halves plus the live exposition:
 
 - :mod:`torchft_tpu.obs.spans` — the *producer* side.  ``SpanTracker``
   wraps each Manager step phase (quorum, configure, heal, allreduce-merge,
@@ -8,7 +8,9 @@ Two halves:
   replica_id)`` with monotonic-clock durations, emitted through
   :class:`~torchft_tpu.metrics.MetricsLogger` as versioned ``span``
   records, plus one ``step_summary`` record per step carrying the full
-  phase breakdown.
+  phase breakdown.  ``StepTimeStats`` keeps the rolling per-step busy-time
+  EWMA + p50/p99 the Manager pushes onto heartbeats for the lighthouse's
+  straggler sentinel.
 
 - :mod:`torchft_tpu.obs.report` — the *consumer* side.  Merges every
   replica's JSONL stream into a per-step cluster timeline, classifies wall
@@ -19,10 +21,18 @@ Two halves:
 
       python -m torchft_tpu.obs.report metrics.jsonl [...]
 
-The third leg — live cluster metrics — is served by the native lighthouse
-(``GET /metrics``, Prometheus text exposition; see docs/wire.md).
+- :mod:`torchft_tpu.obs.trace` — the *timeline* side.  Merges the same
+  streams into one Chrome/Perfetto ``trace.json`` (one track per replica
+  incarnation, phase slices, fault/drain/alert instants, commit-barrier
+  clock alignment).  CLI::
+
+      python tools/trace_export.py metrics.jsonl [...]
+
+The live leg — cluster metrics and the straggler sentinel — is served by
+the native lighthouse (``GET /metrics``, ``GET /alerts.json``; see
+docs/wire.md).
 """
 
-from torchft_tpu.obs.spans import SpanTracker
+from torchft_tpu.obs.spans import SpanTracker, StepTimeStats
 
-__all__ = ["SpanTracker"]
+__all__ = ["SpanTracker", "StepTimeStats"]

@@ -46,20 +46,65 @@ __all__ = [
 ]
 
 
-def read_events(paths: Sequence[str]) -> List[dict]:
+def read_events(
+    paths: Sequence[str], stats: Optional[dict] = None
+) -> List[dict]:
     """Reads + merges JSONL streams, sorted by wall-clock ``ts``.
-    Unparseable lines (torn writes) are skipped."""
+
+    Garbage lines never raise: a writer killed mid-record leaves a
+    truncated trailing line, a torn multi-process write can interleave two
+    records, and stray text parses to a non-dict JSON value — all are
+    skipped and COUNTED, with one warning per file, so a kill-run stream is
+    always readable and the caller can see how much was lost.  Pass
+    ``stats`` (a dict, filled in place) to receive ``skipped_lines``,
+    ``skipped_by_file`` and ``unreadable_files`` — the last lists files
+    that could not be opened OR failed mid-read (flaky storage); a
+    partially read file keeps its already-parsed events and its skipped
+    count.  The CLI surfaces these in its ``--json`` output.
+    """
     events: List[dict] = []
+    skipped_by_file: Dict[str, int] = {}
+    unreadable: List[str] = []
     for path in paths:
+        skipped = 0
         try:
-            with open(path, "rb") as f:
-                for line in f:
-                    try:
-                        events.append(json.loads(line))
-                    except ValueError:
-                        continue
+            f = open(path, "rb")
         except OSError:
+            unreadable.append(path)
             continue
+        with f:
+            try:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        skipped += 1
+                        continue
+                    if not isinstance(ev, dict):
+                        # json.loads accepts bare scalars; a corrupted line
+                        # that happens to parse must not crash consumers
+                        # doing ev.get(...).
+                        skipped += 1
+                        continue
+                    events.append(ev)
+            except OSError:
+                # Mid-file I/O failure: keep what parsed, keep the skip
+                # count, and flag the file so the caller knows the stream
+                # is incomplete.
+                unreadable.append(path)
+        if skipped:
+            skipped_by_file[path] = skipped
+            print(
+                f"warning: {path}: skipped {skipped} unparseable line(s) "
+                "(truncated or torn writes)",
+                file=sys.stderr,
+            )
+    if stats is not None:
+        stats["skipped_lines"] = sum(skipped_by_file.values())
+        stats["skipped_by_file"] = skipped_by_file
+        stats["unreadable_files"] = unreadable
     events.sort(key=lambda ev: float(ev.get("ts", 0.0)))
     return events
 
@@ -84,11 +129,17 @@ def commit_timelines(events: Sequence[dict]) -> Dict[str, List[float]]:
 
 
 def fault_times(events: Sequence[dict]) -> List[Tuple[float, str]]:
-    """[(ts, victim group)] from ``fault`` records (written by bench.py)."""
+    """[(ts, victim group)] from ``fault`` records (written by bench.py).
+
+    ``straggler`` faults are excluded: an injected slowdown is not a death
+    — the victim keeps committing (slowly), so charging its commit gap as
+    a dead window would fabricate downtime.  The straggler scenario's own
+    accounting (detection latency, post-injection rate) lives in bench.py.
+    """
     return [
         (float(ev["ts"]), str(ev.get("group", "")))
         for ev in events
-        if ev.get("event") == "fault"
+        if ev.get("event") == "fault" and str(ev.get("kind")) != "straggler"
     ]
 
 
@@ -330,7 +381,7 @@ def attribute(events: Sequence[dict]) -> dict:
         kill_groups = {
             str(ev.get("group", ""))
             for ev in _fault_records(events)
-            if str(ev.get("kind")) != "drain"
+            if str(ev.get("kind")) not in ("drain", "straggler")
         }
         for g, ts_list in commits.items():
             covered = 0.0
@@ -429,16 +480,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("paths", nargs="+", help="metrics.jsonl file(s)")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     args = ap.parse_args(argv)
-    events = read_events(args.paths)
+    stats: dict = {}
+    events = read_events(args.paths, stats=stats)
     if not events:
         print("no events parsed", file=sys.stderr)
         return 1
     result = attribute(events)
+    result["input"] = {
+        "events": len(events),
+        "skipped_lines": stats.get("skipped_lines", 0),
+        "unreadable_files": stats.get("unreadable_files", []),
+    }
     if args.json:
         json.dump(result, sys.stdout)
         print()
     else:
         render(result)
+        if stats.get("skipped_lines"):
+            sys.stdout.write(
+                f"\n({stats['skipped_lines']} unparseable line(s) skipped)\n"
+            )
     return 0
 
 

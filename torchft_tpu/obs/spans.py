@@ -24,11 +24,18 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, Optional
 
 from torchft_tpu.metrics import MetricsLogger
 
-__all__ = ["PHASES", "OVERLAPPED_PHASES", "Span", "SpanTracker"]
+__all__ = [
+    "PHASES",
+    "OVERLAPPED_PHASES",
+    "Span",
+    "SpanTracker",
+    "StepTimeStats",
+]
 
 # The Manager step phases report.py attributes (docs/architecture.md
 # "Observability").  quorum = blocking wait on the lighthouse round;
@@ -103,6 +110,19 @@ class SpanTracker:
         """Context manager measuring one phase of one step."""
         return Span(self, phase, step, fields)
 
+    def ft_accounted_ms(self) -> float:
+        """Milliseconds accumulated in NON-overlapped phases since the last
+        ``step_summary`` flush — the FT wait time of the step in flight.
+        The Manager subtracts this from the commit-to-commit wall interval
+        to get the step's BUSY time for the straggler sentinel: in lockstep
+        training the raw commit interval equalizes across the quorum (the
+        slow host delays everyone), so only wall-minus-waits distinguishes
+        the replica that actually computed the whole time."""
+        with self._lock:
+            return sum(
+                v for k, v in self._acc.items() if k not in OVERLAPPED_PHASES
+            )
+
     def _finish(self, span: Span, ok: bool) -> None:
         with self._lock:
             self._acc[span.phase] = self._acc.get(span.phase, 0.0) + span.duration_ms
@@ -131,3 +151,95 @@ class SpanTracker:
             self._acc = {}
         rec.update(fields)
         self._metrics.emit("step_summary", **rec)
+
+
+class StepTimeStats:
+    """Rolling per-step wall-time statistics for the straggler sentinel.
+
+    ``observe(ms)`` once per committed step with the step's BUSY
+    milliseconds (commit-to-commit wall minus the FT wait phases; see
+    ``SpanTracker.ft_accounted_ms``).  Maintains an EWMA — the smoothed
+    pace the Manager pushes onto its lighthouse heartbeats — plus a sliding
+    window for p50/p99, which ride in the ``step_summary`` record and
+    bench.py's step-time distributions.
+
+    Knobs: ``TPUFT_STEP_TIME_ALPHA`` (EWMA weight of the newest step,
+    default 0.5 — heavy enough that a host going 2x slow crosses a 1.5x
+    alert threshold on its first slow step, so detection latency is the
+    sentinel's grace count, not the smoothing) and
+    ``TPUFT_STEP_TIME_WINDOW`` (percentile window, default 64 steps).
+    Thread-safe: observe runs on the train thread, snapshots may be read
+    from anywhere.
+    """
+
+    def __init__(
+        self, alpha: Optional[float] = None, window: Optional[int] = None
+    ) -> None:
+        if alpha is None:
+            try:
+                alpha = float(os.environ.get("TPUFT_STEP_TIME_ALPHA", "0.5"))
+            except ValueError:
+                alpha = 0.5
+        if not (0.0 < alpha <= 1.0):
+            alpha = 0.5
+        if window is None:
+            try:
+                window = int(os.environ.get("TPUFT_STEP_TIME_WINDOW", "64"))
+            except ValueError:
+                window = 64
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        self._window: deque = deque(maxlen=max(2, window))
+        self._ewma: Optional[float] = None
+        self._last: float = 0.0
+        self._n = 0
+
+    def observe(self, ms: float) -> None:
+        if ms < 0.0:
+            return
+        with self._lock:
+            self._last = ms
+            self._ewma = (
+                ms
+                if self._ewma is None
+                else self.alpha * ms + (1.0 - self.alpha) * self._ewma
+            )
+            self._window.append(ms)
+            self._n += 1
+
+    @property
+    def ewma_ms(self) -> float:
+        with self._lock:
+            return self._ewma or 0.0
+
+    @property
+    def last_ms(self) -> float:
+        with self._lock:
+            return self._last
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the sliding window (0 when empty)."""
+        with self._lock:
+            if not self._window:
+                return 0.0
+            ordered = sorted(self._window)
+            idx = min(len(ordered) - 1, int(p / 100.0 * len(ordered)))
+            return ordered[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        """{ewma, last, p50, p99, max, n} in ms — the step_summary payload."""
+        with self._lock:
+            ordered = sorted(self._window)
+            n = len(ordered)
+
+            def pct(p: float) -> float:
+                return ordered[min(n - 1, int(p / 100.0 * n))] if n else 0.0
+
+            return {
+                "ewma": round(self._ewma or 0.0, 3),
+                "last": round(self._last, 3),
+                "p50": round(pct(50.0), 3),
+                "p99": round(pct(99.0), 3),
+                "max": round(ordered[-1], 3) if n else 0.0,
+                "n": self._n,
+            }

@@ -1,0 +1,442 @@
+"""Merged Chrome/Perfetto trace export: every replica's span stream on one
+timeline.
+
+Input is the metrics JSONL documented in torchft_tpu/metrics.py (``span``,
+``step_summary``, ``fault``, ``drain_*`` records from any number of
+replicas, one file or many).  Output is Chrome trace-event JSON — load it
+in Perfetto (ui.perfetto.dev) or chrome://tracing — with:
+
+- one **process** per replica group (the stable ``<group>`` prefix of
+  ``<group>:<uuid>`` ids) and one **track (thread)** per incarnation, so a
+  killed-and-restarted group shows its incarnations stacked in one lane;
+  overlapped phases (the donor-side background ``snapshot``) get their own
+  sub-track so the main track stays strictly sequential;
+- phase **slices** (``X`` events) named by span phase, with ``step`` /
+  ``slice_gen`` / ``ok`` in args;
+- fault / drain / alert **instant** events, so a kill or a cooperative
+  handoff is visible at the exact moment the goodput accounting charges it;
+- **clock alignment** via the ``step_summary`` commit barrier: each
+  committed step's summaries are written right after the same two-phase
+  commit vote on every replica, so the cross-replica median of their wall
+  timestamps estimates per-replica clock/write skew; each replica's events
+  are shifted by its median offset before merging.  (Within one stream
+  this is a no-op; across hosts it removes NTP-level skew without any
+  shared clock.)
+
+Span records carry their END timestamp (they are written when the phase
+finishes); the slice start is ``ts - duration``.  Slices on one track are
+clamped to be non-overlapping (later start wins), which keeps the trace
+valid even when the quorum thread and the train thread measured
+concurrently.
+
+The CLI wrapper is tools/trace_export.py.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["build_trace", "validate_trace", "synthetic_stream"]
+
+# Events rendered as instant markers on the emitting replica's track (or
+# the global track for the bench driver's fault schedule).
+_INSTANT_EVENTS = (
+    "fault",
+    "drain_notice",
+    "drain_complete",
+    "drain_handoff",
+    "drain_donor_exit",
+    "alert",
+    "straggler_injected",
+    "heal_start",
+    "error",
+)
+
+
+def _group(replica_id: str) -> str:
+    return str(replica_id).split(":", 1)[0]
+
+
+def _median(values: Sequence[float]) -> float:
+    ordered = sorted(values)
+    return ordered[(len(ordered) - 1) // 2] if ordered else 0.0
+
+
+def _clock_offsets(events: Sequence[dict]) -> Dict[str, float]:
+    """Per-replica wall-clock offset estimated from the step_summary commit
+    barrier: all replicas emit the summary for a committed step right after
+    the same commit vote, so their timestamps SHOULD agree; the per-replica
+    median deviation from the cross-replica median is that replica's skew."""
+    by_step: Dict[Tuple[int, int], Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("event") != "step_summary" or not ev.get("committed"):
+            continue
+        rid = str(ev.get("replica_id", ""))
+        key = (int(ev.get("slice_gen", 0) or 0), int(ev.get("step", -1)))
+        # First summary per (step, replica): retried steps re-summarize.
+        by_step.setdefault(key, {}).setdefault(rid, float(ev.get("ts", 0.0)))
+    deltas: Dict[str, List[float]] = {}
+    for _, per_rid in by_step.items():
+        if len(per_rid) < 2:
+            continue  # no cross-replica barrier to compare against
+        ref = _median(list(per_rid.values()))
+        for rid, ts in per_rid.items():
+            deltas.setdefault(rid, []).append(ts - ref)
+    return {rid: _median(ds) for rid, ds in deltas.items()}
+
+
+def build_trace(events: Sequence[dict], align: bool = True) -> dict:
+    """Builds the Chrome trace-event dict from merged metrics events."""
+    offsets = _clock_offsets(events) if align else {}
+
+    def corrected(ev: dict) -> float:
+        return float(ev.get("ts", 0.0)) - offsets.get(
+            str(ev.get("replica_id", "")), 0.0
+        )
+
+    spans = [ev for ev in events if ev.get("event") == "span"]
+    instants = [ev for ev in events if ev.get("event") in _INSTANT_EVENTS]
+    if not spans and not instants:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    # Track layout: pid per group (sorted), tid per incarnation within the
+    # group (ordered by first appearance), +1 sub-track for overlapped
+    # phases.  The bench driver's fault schedule gets pid 0.
+    from torchft_tpu.obs.spans import OVERLAPPED_PHASES
+
+    # Only span-emitting replicas get tracks; instants from anything else
+    # (the bench driver's fault schedule, the launcher) render on the
+    # global pid-0 lane instead of minting a phantom replica.
+    first_seen: Dict[str, float] = {}
+    for ev in spans:
+        rid = str(ev.get("replica_id", ""))
+        ts = corrected(ev)
+        if rid not in first_seen or ts < first_seen[rid]:
+            first_seen[rid] = ts
+    for ev in instants:
+        rid = str(ev.get("replica_id", ""))
+        if rid in first_seen:
+            first_seen[rid] = min(first_seen[rid], corrected(ev))
+    groups = sorted({_group(rid) for rid in first_seen})
+    pid_of = {g: i + 1 for i, g in enumerate(groups)}
+    tid_of: Dict[str, int] = {}
+    for g in groups:
+        incarnations = sorted(
+            (rid for rid in first_seen if _group(rid) == g),
+            key=lambda rid: (first_seen[rid], rid),
+        )
+        for i, rid in enumerate(incarnations):
+            tid_of[rid] = 1 + 2 * i  # odd = phases, even (tid+1) = background
+
+    t0 = min(
+        min(
+            (corrected(ev) - float(ev.get("duration_ms", 0.0)) / 1e3 for ev in spans),
+            default=float("inf"),
+        ),
+        min((corrected(ev) for ev in instants), default=float("inf")),
+    )
+
+    def us(ts: float) -> float:
+        return round((ts - t0) * 1e6, 1)
+
+    out: List[dict] = []
+    for g in groups:
+        out.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid_of[g],
+                "tid": 0,
+                "args": {"name": f"replica group {g}"},
+            }
+        )
+    out.append(
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": 0,
+            "tid": 0,
+            "args": {"name": "bench driver / faults"},
+        }
+    )
+    for rid, tid in sorted(tid_of.items()):
+        pid = pid_of[_group(rid)]
+        out.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": rid},
+            }
+        )
+
+    # Phase slices, clamped non-overlapping per track.
+    per_track: Dict[Tuple[int, int], List[dict]] = {}
+    for ev in spans:
+        rid = str(ev.get("replica_id", ""))
+        if rid not in tid_of:
+            continue
+        pid = pid_of[_group(rid)]
+        phase = str(ev.get("phase", "?"))
+        tid = tid_of[rid] + (1 if phase in OVERLAPPED_PHASES else 0)
+        dur_s = float(ev.get("duration_ms", 0.0)) / 1e3
+        end = corrected(ev)
+        args = {
+            k: ev[k]
+            for k in ("step", "slice_gen", "src_rank")
+            if ev.get(k) is not None
+        }
+        if ev.get("ok") is False:
+            args["ok"] = False
+        per_track.setdefault((pid, tid), []).append(
+            {
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+                "name": phase,
+                "cat": "phase",
+                "_start": end - dur_s,
+                "_end": end,
+                "args": args,
+            }
+        )
+    for (_, _), slices in per_track.items():
+        slices.sort(key=lambda s: (s["_start"], s["_end"]))
+        prev_end = float("-inf")
+        for s in slices:
+            start = max(s["_start"], prev_end)
+            end = max(s["_end"], start)
+            prev_end = end
+            s["ts"] = us(start)
+            s["dur"] = round((end - start) * 1e6, 1)
+            del s["_start"], s["_end"]
+            out.append(s)
+
+    # Instant markers.
+    for ev in instants:
+        rid = str(ev.get("replica_id", ""))
+        kind = str(ev.get("event"))
+        name = kind
+        if kind == "fault":
+            name = f"fault:{ev.get('kind', '?')} g{ev.get('group', '?')}"
+        args = {
+            k: v
+            for k, v in ev.items()
+            if k
+            not in ("ts", "t_mono", "schema", "event", "replica_id")
+            and v is not None
+        }
+        if rid in tid_of:
+            out.append(
+                {
+                    "ph": "i",
+                    "s": "t",
+                    "pid": pid_of[_group(rid)],
+                    "tid": tid_of[rid],
+                    "name": name,
+                    "cat": "event",
+                    "ts": us(corrected(ev)),
+                    "args": args,
+                }
+            )
+        else:
+            # Driver records (fault schedule) are cluster-scoped.
+            out.append(
+                {
+                    "ph": "i",
+                    "s": "g",
+                    "pid": 0,
+                    "tid": 0,
+                    "name": name,
+                    "cat": "event",
+                    "ts": us(corrected(ev)),
+                    "args": args,
+                }
+            )
+
+    out.sort(key=lambda ev: (ev.get("ts", 0.0), ev.get("pid", 0), ev.get("tid", 0)))
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "tpu-ft tools/trace_export.py",
+            "replicas": {rid: f"pid {pid_of[_group(rid)]} tid {tid}"
+                         for rid, tid in tid_of.items()},
+            "clock_offsets_s": {k: round(v, 6) for k, v in offsets.items()},
+        },
+    }
+
+
+def validate_trace(trace: dict) -> List[str]:
+    """Structural checks on a Chrome trace-event dict; returns problems
+    (empty list = valid).  Pinned by tests/test_obs.py and --quick."""
+    problems: List[str] = []
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents is not a list"]
+    tracks: Dict[Tuple[int, int], float] = {}
+    thread_names: Dict[Tuple[int, int], str] = {}
+    for i, ev in enumerate(evs):
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M"):
+            problems.append(f"event {i}: unexpected ph {ph!r}")
+            continue
+        if ph == "M":
+            if ev.get("name") == "thread_name":
+                key = (ev.get("pid"), ev.get("tid"))
+                name = ev.get("args", {}).get("name", "")
+                if key in thread_names:
+                    problems.append(f"duplicate thread metadata for {key}")
+                thread_names[key] = name
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"event {i} ({ev.get('name')}): bad ts {ts!r}")
+            continue
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i} ({ev.get('name')}): bad dur {dur!r}")
+                continue
+            key = (ev.get("pid"), ev.get("tid"))
+            prev_end = tracks.get(key, float("-inf"))
+            if ts < prev_end - 0.5:  # 0.5 us slack for rounding
+                problems.append(
+                    f"event {i} ({ev.get('name')}): overlaps previous slice "
+                    f"on track {key} ({ts} < {prev_end})"
+                )
+            tracks[key] = max(prev_end, ts + dur)
+    # One named track per replica: every (pid, tid) that carries slices on
+    # an odd tid (the phases track) must have thread metadata.
+    for (pid, tid) in tracks:
+        base = (pid, tid if tid % 2 == 1 else tid - 1)
+        if pid != 0 and base not in thread_names:
+            problems.append(f"track {(pid, tid)} has slices but no thread_name")
+    names = list(thread_names.values())
+    if len(names) != len(set(names)):
+        problems.append("replica track names are not unique")
+    return problems
+
+
+def synthetic_stream(
+    n_replicas: int = 2, steps: int = 4, base_ts: float = 1_700_000_000.0
+) -> List[dict]:
+    """Deterministic multi-replica stream for --quick and tests: per step a
+    quorum span, an allreduce_merge span, a commit and a step_summary per
+    replica; replica 1 pays a heal on step 2; one kill fault and one drain
+    instant ride along."""
+    events: List[dict] = []
+    step_s = 1.0
+    for r in range(n_replicas):
+        rid = f"{r}:{'abcdef'[r % 6]}{r}"
+        skew = 0.002 * r  # small per-replica clock skew the aligner removes
+        for step in range(1, steps + 1):
+            end = base_ts + step * step_s + skew
+            quorum_ms = 40.0 + 5 * r
+            events.append(
+                {
+                    "ts": end - 0.5,
+                    "replica_id": rid,
+                    "event": "span",
+                    "phase": "quorum",
+                    "step": step,
+                    "slice_gen": 0,
+                    "duration_ms": quorum_ms,
+                }
+            )
+            if r == 1 and step == 2:
+                events.append(
+                    {
+                        "ts": end - 0.1,
+                        "replica_id": rid,
+                        "event": "span",
+                        "phase": "heal",
+                        "step": step,
+                        "slice_gen": 0,
+                        "duration_ms": 350.0,
+                        "src_rank": 0,
+                    }
+                )
+            events.append(
+                {
+                    "ts": end,
+                    "replica_id": rid,
+                    "event": "span",
+                    "phase": "allreduce_merge",
+                    "step": step,
+                    "slice_gen": 0,
+                    "duration_ms": 20.0,
+                }
+            )
+            events.append(
+                {
+                    "ts": end,
+                    "replica_id": rid,
+                    "event": "commit",
+                    "step": step,
+                    "committed": True,
+                }
+            )
+            events.append(
+                {
+                    "ts": end + 0.001,
+                    "replica_id": rid,
+                    "event": "step_summary",
+                    "step": step,
+                    "slice_gen": 0,
+                    "committed": True,
+                    "phases": {"quorum": quorum_ms, "allreduce_merge": 20.0},
+                }
+            )
+    events.append(
+        {
+            "ts": base_ts + 2.4,
+            "replica_id": "bench-driver",
+            "event": "fault",
+            "kind": "kill",
+            "group": "1",
+        }
+    )
+    events.append(
+        {
+            "ts": base_ts + 3.2,
+            "replica_id": "0:a0",
+            "event": "drain_notice",
+            "source": "supervisor",
+        }
+    )
+    events.sort(key=lambda ev: ev["ts"])
+    return events
+
+
+def export(
+    paths: Sequence[str],
+    out_path: str,
+    align: bool = True,
+    stats: Optional[dict] = None,
+) -> dict:
+    """Reads JSONL streams, builds the trace, writes ``out_path``.  Returns
+    a summary dict (events, replicas, problems)."""
+    from torchft_tpu.obs.report import read_events
+
+    read_stats: dict = {}
+    events = read_events(paths, stats=read_stats)
+    trace = build_trace(events, align=align)
+    problems = validate_trace(trace)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    replicas = trace.get("otherData", {}).get("replicas", {})
+    summary = {
+        "out": out_path,
+        "input_events": len(events),
+        "skipped_lines": read_stats.get("skipped_lines", 0),
+        "trace_events": len(trace["traceEvents"]),
+        "replicas": len(replicas),
+        "problems": problems,
+        "ok": not problems,
+    }
+    if stats is not None:
+        stats.update(summary)
+    return summary
