@@ -105,9 +105,11 @@ void* tf_manager_new(const char* replica_id, const char* lighthouse_addr, const 
 char* tf_manager_address(void* p) { return CopyString(static_cast<ManagerServer*>(p)->address()); }
 
 void tf_manager_set_status(void* p, int64_t step, const char* state,
-                           double step_time_ms_ewma, double step_time_ms_last) {
+                           double step_time_ms_ewma, double step_time_ms_last,
+                           double allreduce_gb_per_s) {
   static_cast<ManagerServer*>(p)->SetStatus(step, state ? state : "",
-                                            step_time_ms_ewma, step_time_ms_last);
+                                            step_time_ms_ewma, step_time_ms_last,
+                                            allreduce_gb_per_s);
 }
 
 void tf_manager_shutdown(void* p) { static_cast<ManagerServer*>(p)->Shutdown(); }

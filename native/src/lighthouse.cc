@@ -326,6 +326,10 @@ Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
     hb_step_[req.replica_id()] = req.step();
   }
   if (!req.state().empty()) hb_state_[req.replica_id()] = req.state();
+  // 0 is a real reading (committed step with no allreduce traffic —
+  // healing, spare): letting it through is what stops a stale healthy
+  // GB/s from masking a replica that moved zero gradient bytes for hours.
+  allreduce_gbps_[req.replica_id()] = req.allreduce_gb_per_s();
   // Straggler sentinel: keep the rolling step-time telemetry fresh on every
   // heartbeat, but run a state-machine OBSERVATION only when the replica's
   // reported step advances past the sentinel's own cursor — the hysteresis
@@ -703,6 +707,7 @@ void Lighthouse::TickLocked() {
   prune_with_heartbeats(hb_step_);
   prune_with_heartbeats(hb_state_);
   prune_with_heartbeats(last_commit_ms_);
+  prune_with_heartbeats(allreduce_gbps_);
   // Sentinel health follows the graveyard too, and a pruned replica's
   // active alert resolves here: a process that is gone (crashed, drained
   // out, auto-drained straggler that exited) can never post the recovery
@@ -850,6 +855,7 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
   erase_matching(hb_step_);
   erase_matching(hb_state_);
   erase_matching(last_commit_ms_);
+  erase_matching(allreduce_gbps_);
   erase_matching(health_);
   // An evicted incarnation's straggler alert resolves with it (the
   // supervisor already replaced the process; the alert described a corpse).
@@ -1067,6 +1073,12 @@ std::string Lighthouse::MetricsText() {
   for (const auto& [id, h] : health_) {
     o << "tpuft_replica_step_time_seconds{replica=\"" << PromEscape(id)
       << "\"} " << h.ewma_ms / 1000.0 << "\n";
+  }
+  gauge("tpuft_allreduce_gb_per_s",
+        "per-replica allreduce payload GB/s (last committed step, from heartbeats)");
+  for (const auto& [id, gbps] : allreduce_gbps_) {
+    o << "tpuft_allreduce_gb_per_s{replica=\"" << PromEscape(id) << "\"} "
+      << gbps << "\n";
   }
   gauge("tpuft_replica_slowness_ratio",
         "replica step-time EWMA over the cluster median (1.0 = on pace)");

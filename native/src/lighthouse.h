@@ -221,6 +221,9 @@ class Lighthouse {
   // Epoch ms when a replica's reported step last ADVANCED — the lighthouse's
   // view of its last commit (steps advance exactly on committed steps).
   std::map<std::string, int64_t> last_commit_ms_;
+  // Per-replica allreduce payload GB/s from heartbeat field 6 (last
+  // committed step's data-plane throughput; 0 = never reported).
+  std::map<std::string, double> allreduce_gbps_;
   // Tombstones for supervisor-evicted incarnations (id -> evict time): a
   // dead incarnation's still-blocked quorum handler or in-flight heartbeat
   // must not re-register the corpse after EvictReplica dropped it.  Pruned

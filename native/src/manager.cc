@@ -172,7 +172,8 @@ void ManagerServer::Shutdown() {
 std::string ManagerServer::address() const { return server_ ? server_->address() : ""; }
 
 void ManagerServer::SetStatus(int64_t step, const std::string& state,
-                              double step_time_ms_ewma, double step_time_ms_last) {
+                              double step_time_ms_ewma, double step_time_ms_last,
+                              double allreduce_gb_per_s) {
   std::lock_guard<std::mutex> lk(mu_);
   status_step_ = step;
   status_state_ = state;
@@ -182,6 +183,12 @@ void ManagerServer::SetStatus(int64_t step, const std::string& state,
   if (step_time_ms_ewma > 0.0) {
     status_step_time_ewma_ms_ = step_time_ms_ewma;
     status_step_time_last_ms_ = step_time_ms_last;
+  }
+  // Unlike the EWMA above, 0 IS a report here: the Manager always pushes
+  // the authoritative gauge (a committed no-traffic step — healing, spare —
+  // zeroes it), so only a negative value means "keep the prior reading".
+  if (allreduce_gb_per_s >= 0.0) {
+    status_allreduce_gbps_ = allreduce_gb_per_s;
   }
 }
 
@@ -223,6 +230,7 @@ void ManagerServer::HeartbeatLoop() {
       req.set_state(status_state_);
       req.set_step_time_ms_ewma(status_step_time_ewma_ms_);
       req.set_step_time_ms_last(status_step_time_last_ms_);
+      req.set_allreduce_gb_per_s(status_allreduce_gbps_);
       req.SerializeToString(&payload);
     }
     Status st = heartbeat_client_->Call(kLighthouseHeartbeat, payload, call_timeout_ms,

@@ -61,9 +61,14 @@ class ManagerServer {
   // cluster's GET /metrics exposition and dashboard show per-replica step
   // and state without waiting for the next quorum snapshot.  The optional
   // step-time telemetry (rolling busy-time EWMA + last observation, ms; 0 =
-  // not reported) feeds the lighthouse's straggler sentinel.
+  // not reported) feeds the lighthouse's straggler sentinel, and the
+  // allreduce payload GB/s the /metrics tpuft_allreduce_gb_per_s gauge —
+  // for which 0 IS a report (a committed step that moved no gradient
+  // bytes) and only a negative value means "keep the prior reading", so
+  // phase-only pushes must use the default.
   void SetStatus(int64_t step, const std::string& state,
-                 double step_time_ms_ewma = 0.0, double step_time_ms_last = 0.0);
+                 double step_time_ms_ewma = 0.0, double step_time_ms_last = 0.0,
+                 double allreduce_gb_per_s = -1.0);
 
   // RPC handlers (public for in-process tests).
   Status HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
@@ -104,6 +109,7 @@ class ManagerServer {
   std::string status_state_ = "init";
   double status_step_time_ewma_ms_ = 0.0;
   double status_step_time_last_ms_ = 0.0;
+  double status_allreduce_gbps_ = 0.0;
 
   // should_commit barrier per (step) round (reference: src/manager.rs:313-371).
   struct CommitRound {

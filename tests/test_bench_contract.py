@@ -62,6 +62,33 @@ def test_transfer_quick_smoke() -> None:
         assert r["fetch_s"] > 0 and r["fetch_gb_per_s"] > 0
 
 
+def test_allreduce_quick_smoke() -> None:
+    """bench_allreduce --quick in-process: the striped multi-lane ring (1
+    vs 2 lanes) and the pipelined-vs-monolithic bucket paths must complete
+    and commit on a small dict — data-plane regressions fail tier-1 here
+    instead of only showing up in ALLREDUCE_BENCH.json."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_allreduce
+    finally:
+        sys.path.pop(0)
+    payload = bench_allreduce.run_quick()
+    # Schema contract: the keys the full bench artifact is built from.
+    assert payload["quick"] is True
+    assert {r["lanes"] for r in payload["lanes"]} == {1, 2}
+    for r in payload["lanes"]:
+        assert r["gb_per_s"] > 0 and r["wall_s"] > 0
+        assert len(r["lane_bytes_sent"]) == r["lanes"]
+        assert all(b > 0 for b in r["lane_bytes_sent"])
+    modes = {r["mode"]: r for r in payload["e2e"]}
+    assert set(modes) == {"pipelined", "monolithic"}
+    for r in modes.values():
+        assert r["committed"] == r["steps"]  # healthy run: every step lands
+        assert r["steps_per_s"] > 0
+    # The pipelined path must never commit less than the monolithic one.
+    assert payload["pipelined_commits_ok"]
+
+
 def test_bench_selftest() -> None:
     """bench.py --selftest verifies its own scenario-call signatures without
     touching the chip or spawning training subprocesses."""

@@ -436,3 +436,151 @@ def test_shaped_link_halves_wire_bytes_with_bf16(store, monkeypatch) -> None:
     # exactly 2x.
     assert f32_bytes > bf16_bytes * 1.8, (f32_bytes, bf16_bytes)
     assert abs(auto_bytes - bf16_bytes) < 0.05 * bf16_bytes
+
+
+# -- multi-lane striped ring (TPUFT_RING_LANES) ------------------------------
+
+
+def _run_lanes(store, world_size: int, lanes: int, fn, wire_dtype: str = "auto",
+               chunk_bytes: int = 4 << 20):
+    """run_ranks with an explicit lane count (and wire dtype)."""
+    prefix = fresh_prefix()
+    collectives = [
+        TCPCollective(timeout=10.0, lanes=lanes, wire_dtype=wire_dtype,
+                      chunk_bytes=chunk_bytes)
+        for _ in range(world_size)
+    ]
+
+    def worker(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, world_size)
+        try:
+            return fn(c, rank)
+        finally:
+            c.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        return [f.result(timeout=60) for f in
+                [pool.submit(worker, r) for r in range(world_size)]]
+
+
+@pytest.mark.parametrize("world_size", [2, 3])
+@pytest.mark.parametrize("lanes", [2, 4])
+def test_lanes_allreduce_matches_single_lane_exactly(store, world_size, lanes) -> None:
+    """Striping across lanes must not change the arithmetic: f32 sums are
+    elementwise in fixed ring-step order, so the multi-lane result is
+    BITWISE identical to the 1-lane result on identical inputs — and
+    back-to-back ops (the bucket traffic shape) all land correctly even
+    though they overlap on the wire."""
+    rng = np.random.default_rng(7)
+    data = [rng.standard_normal(10_000).astype(np.float32)
+            for _ in range(world_size)]
+
+    def body(c, rank):
+        works = [c.allreduce([data[rank] * (k + 1)], op="sum") for k in range(4)]
+        return [w.wait(timeout=30)[0] for w in works]
+
+    # Small chunk_bytes forces real striping (several stripes per lane).
+    multi = _run_lanes(store, world_size, lanes, body, chunk_bytes=8 << 10)
+    single = _run_lanes(store, world_size, 1, body)
+    for rank in range(world_size):
+        for k in range(4):
+            np.testing.assert_array_equal(multi[rank][k], single[rank][k])
+            # Ring summation order differs from np.sum's pairwise order:
+            # rtol alone flags near-zero elements at world_size 3.
+            expected = np.sum([d * (k + 1) for d in data], axis=0)
+            np.testing.assert_allclose(multi[rank][k], expected, rtol=1e-5, atol=1e-5)
+
+
+def test_lanes_bf16_wire_bit_identical_across_lane_counts(store) -> None:
+    """bf16 wire compression under lanes: chunk striping must not change
+    the quantization order, so 1-lane and 4-lane reductions decode to
+    bitwise-identical values on every rank."""
+    rng = np.random.default_rng(13)
+    data = [rng.standard_normal(8192).astype(np.float32) for _ in range(2)]
+
+    def body(c, rank):
+        return c.allreduce([data[rank].copy()], op="sum").wait(timeout=30)[0]
+
+    one = _run_lanes(store, 2, 1, body, wire_dtype="bf16", chunk_bytes=4 << 10)
+    four = _run_lanes(store, 2, 4, body, wire_dtype="bf16", chunk_bytes=4 << 10)
+    for rank in range(2):
+        np.testing.assert_array_equal(one[rank], four[rank])
+    # Replica consistency holds within each lane count too.
+    np.testing.assert_array_equal(four[0], four[1])
+
+
+def test_lanes_integer_payload_bypasses_compression_on_every_lane(store) -> None:
+    """Integer payloads must travel uncompressed on EVERY lane (quantizing
+    them would corrupt values): each rank's full int64 payload crosses the
+    wire at full width, striped over all 4 lanes, and the sum is exact."""
+    n = 32768  # 256 KB of int64
+    payload = np.arange(n, dtype=np.int64)
+
+    def body(c, rank):
+        out = c.allreduce([payload * (rank + 1)], op="sum").wait(timeout=30)[0]
+        return out, c.lane_stats()
+
+    results = _run_lanes(store, 2, 4, body, wire_dtype="bf16",
+                         chunk_bytes=16 << 10)
+    for out, stats in results:
+        np.testing.assert_array_equal(out, payload * 3)
+        assert out.dtype == np.int64
+        assert stats["lanes"] == 4 and len(stats["sent"]) == 4
+        # Striping touched every lane.
+        assert all(b > 0 for b in stats["sent"]), stats
+        # Full-width wire: each rank moves the whole payload per direction
+        # (ring RS + AG for n=2); bf16 halving would cut this to ~nbytes/2.
+        assert sum(stats["sent"]) >= payload.nbytes, stats
+
+
+def test_lanes_abort_latches_and_reconfigure_rebuilds(store) -> None:
+    """Mid-op abort with lanes > 1: survivors latch (never raise into the
+    caller), and the next configure() rebuilds every lane with the old
+    lane sockets closed — the no-leaked-fds contract the Manager's quorum
+    reconfigure relies on."""
+    world_size = 3
+    lanes = 2
+    prefix, prefix2 = fresh_prefix(), fresh_prefix()
+    collectives = [TCPCollective(timeout=5.0, lanes=lanes) for _ in range(world_size)]
+    barrier = threading.Barrier(world_size)
+    old_sockets: Dict[int, List] = {}
+
+    def worker(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, world_size)
+        assert len(c._next_lanes) == lanes and len(c._prev_lanes) == lanes
+        old_sockets[rank] = list(c._next_lanes) + list(c._prev_lanes)
+        x = np.ones(4096, dtype=np.float32)
+        c.allreduce([x]).wait(timeout=20)
+        barrier.wait(timeout=10)
+        if rank == world_size - 1:
+            c.abort()
+            return "dead"
+        work = c.allreduce([x])
+        exc = work.exception(timeout=20)
+        assert exc is not None, "expected failure after peer abort"
+        assert c.errored() is not None
+        return "latched"
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        results = [f.result(timeout=60) for f in
+                   [pool.submit(worker, r) for r in range(world_size)]]
+    assert results.count("latched") == 2
+
+    def recover(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix2}", rank, 2)
+        assert c.errored() is None
+        assert len(c._next_lanes) == lanes and len(c._prev_lanes) == lanes
+        # Every pre-abort lane socket is closed (fileno -1), none leaked.
+        assert all(p.sock.fileno() == -1 for p in old_sockets[rank])
+        out = c.allreduce([np.full(4, float(rank + 1), dtype=np.float32)]).wait(
+            timeout=20
+        )
+        c.shutdown()
+        return out[0]
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        for f in [pool.submit(recover, r) for r in range(2)]:
+            np.testing.assert_allclose(f.result(timeout=60), np.full(4, 3.0))

@@ -373,6 +373,59 @@ def test_send_checkpoint_as_recovery_source(store) -> None:
         manager.shutdown()
 
 
+def test_force_recover_at_max_step_opens_own_serving_window(store) -> None:
+    """Mutual force-recover regression: a cluster-wide failed step (peer
+    killed mid-allreduce fails EVERY group's commit) force-recovers every
+    group at its CURRENT max step, and each group's assigned donor is
+    another force-recovering group.  commit_failures is request-local, so
+    a donor cannot be told to serve — the healer must open its own passive
+    serving window (it already holds the committed max_step state), or the
+    mutual heal deadlocks on closed windows until timeout, every quorum."""
+    client = MagicMock()
+    client._quorum.return_value = make_quorum(
+        max_step=0,  # == the manager's own step: the force_recover shape
+        heal=True,
+        recover_src=1,
+        donor_ranks=[1],
+        donor_addrs=["mgr-1:0"],
+    )
+    client.should_commit.return_value = True
+    transport = MagicMock()
+    transport.serves_all_donors = True
+    transport.metadata.return_value = "my-meta"
+    transport.recv_checkpoint.return_value = {
+        "user": {"default": {"w": np.ones(2)}},
+        "tpuft": {"step": 0, "batches_committed": 0},
+    }
+    loaded = {}
+    manager, _, _ = make_manager(
+        store,
+        client_mock=client,
+        checkpoint_transport=transport,
+        load_state_dict=lambda sd: loaded.update(sd),
+        state_dict=lambda: {"w": np.zeros(2)},
+    )
+
+    def factory(addr, connect_timeout_ms=0):
+        m = MagicMock()
+        m._checkpoint_metadata.return_value = "peer-meta"
+        return m
+
+    manager._manager_client_factory = factory
+    try:
+        manager.start_quorum()
+        manager.wait_quorum()
+        # The serving window opened even though the quorum listed no dsts...
+        transport.send_checkpoint.assert_called_once()
+        assert transport.send_checkpoint.call_args.kwargs["step"] == 0
+        # ...and the re-fetch from the (equally force-recovering) peer ran.
+        transport.recv_checkpoint.assert_called_once()
+        assert manager.should_commit()
+        assert "w" in loaded
+    finally:
+        manager.shutdown()
+
+
 def test_allow_heal_false_skips_transfer(store) -> None:
     client = MagicMock()
     client._quorum.return_value = make_quorum(

@@ -73,6 +73,7 @@ def test_phases_registry_is_stable() -> None:
         "quorum",
         "configure",
         "heal",
+        "allreduce_d2h",
         "allreduce_merge",
         "commit_vote",
         "snapshot",
@@ -215,6 +216,36 @@ def test_attribute_merges_retried_step_summaries() -> None:
     result = report.attribute(events)
     row = next(r for r in result["steps"] if r["step"] == 2)
     assert row["quorum_wait_s"] == pytest.approx(5.1, abs=0.01)
+
+
+def test_attribute_charges_allreduce_d2h_as_ft_not_productive() -> None:
+    """The bucket pipeline's per-bucket device->host wait (allreduce_d2h)
+    blocks the train thread: it must land in other_ft_s, carved OUT of
+    productive time — never treated like the overlapped snapshot phase.
+    Goodput accounting would otherwise report the D2H stall as compute."""
+    events = [
+        {"ts": 1.0, "t_mono": 1.0, "replica_id": "0:a", "event": "commit",
+         "step": 1, "committed": True},
+        {"ts": 4.0, "replica_id": "0:a", "event": "step_summary", "step": 2,
+         "committed": True,
+         "phases": {"allreduce_d2h": 1200.0, "allreduce_merge": 300.0,
+                    "commit_vote": 5.0, "snapshot": 900.0}},
+        {"ts": 4.0, "t_mono": 4.0, "replica_id": "0:a", "event": "commit",
+         "step": 2, "committed": True},
+        # A second group so t0/t_end cover the window.
+        {"ts": 1.0, "t_mono": 1.0, "replica_id": "1:b", "event": "commit",
+         "step": 1, "committed": True},
+        {"ts": 4.0, "t_mono": 4.0, "replica_id": "1:b", "event": "commit",
+         "step": 2, "committed": True},
+    ]
+    result = report.attribute(events)
+    row = next(r for r in result["steps"] if r["step"] == 2)
+    # d2h + merge + vote = 1.505 s of the 3 s wall is FT overhead...
+    assert row["other_ft_s"] == pytest.approx(1.505, abs=0.01)
+    assert row["productive_s"] == pytest.approx(3.0 - 1.505, abs=0.01)
+    # ...while the overlapped snapshot is reported but never charged.
+    assert row["snapshot_overlap_s"] == pytest.approx(0.9, abs=0.01)
+    assert result["totals"]["other_ft_s"] == pytest.approx(1.505, abs=0.01)
 
 
 def test_deadwindow_matches_bench_fixture(tmp_path) -> None:

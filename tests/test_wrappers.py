@@ -103,6 +103,88 @@ def test_gradient_averager_buckets_respect_dtype() -> None:
     assert manager.allreduce.call_count == 2  # dtype change forces a new bucket
 
 
+def test_plan_buckets_groups_alternating_dtypes() -> None:
+    """A tree whose leaf dtypes ALTERNATE (f64, i32, f64, i32, ...) must
+    pack into one bucket per dtype, not one per leaf — the planner
+    sort-stable groups by dtype before packing, preserving the original
+    index mapping."""
+    from torchft_tpu.ddp import plan_buckets
+
+    metas = []
+    for i in range(8):
+        metas.append(((16,), np.float64) if i % 2 == 0 else ((16,), np.int32))
+    buckets = plan_buckets(metas, bucket_bytes=1 << 20)
+
+    assert len(buckets) == 2
+    by_dtype = {b.dtype: b for b in buckets}
+    assert set(by_dtype) == {np.dtype(np.float64), np.dtype(np.int32)}
+    # Index mapping preserved, stable within each dtype run.
+    assert by_dtype[np.dtype(np.float64)].indices == [0, 2, 4, 6]
+    assert by_dtype[np.dtype(np.int32)].indices == [1, 3, 5, 7]
+    # Byte bounds: each bucket is exactly its leaves' bytes, under the cap.
+    assert by_dtype[np.dtype(np.float64)].nbytes == 4 * 16 * 8
+    assert by_dtype[np.dtype(np.int32)].nbytes == 4 * 16 * 4
+    assert all(b.nbytes <= 1 << 20 for b in buckets)
+    # Every original leaf lands in exactly one bucket.
+    assert sorted(i for b in buckets for i in b.indices) == list(range(8))
+
+
+def test_plan_buckets_byte_cap_and_edges() -> None:
+    from torchft_tpu.ddp import plan_buckets
+
+    # 0 leaves -> no buckets.
+    assert plan_buckets([], bucket_bytes=1 << 20) == []
+
+    # Same-dtype leaves split on the byte cap: 6 x 40-byte f32 leaves at a
+    # 100-byte cap -> ceil(240/80)=3 buckets of <=2 leaves, order kept.
+    metas = [((10,), np.float32)] * 6
+    buckets = plan_buckets(metas, bucket_bytes=100)
+    assert [b.indices for b in buckets] == [[0, 1], [2, 3], [4, 5]]
+    assert all(b.nbytes <= 100 for b in buckets)
+
+    # A single giant leaf (> bucket_bytes) gets its own bucket, whole.
+    metas = [((4,), np.float32), ((1000,), np.float32), ((4,), np.float32)]
+    buckets = plan_buckets(metas, bucket_bytes=256)
+    giant = next(b for b in buckets if 1 in b.indices)
+    assert giant.indices == [1] and giant.nbytes == 4000
+    assert sorted(i for b in buckets for i in b.indices) == [0, 1, 2]
+
+    # Scalar (0-d) leaves count as one element, not zero.
+    buckets = plan_buckets([((), np.float32)], bucket_bytes=64)
+    assert len(buckets) == 1 and buckets[0].numel == 1
+
+
+def test_gradient_averager_mixed_dtype_roundtrip_and_plan_cache() -> None:
+    """Alternating-dtype grads coalesce into 2 allreduces per step (not one
+    per leaf), values round-trip through the persistent buffers, and the
+    plan is cached: a second step with the same tree signature reuses the
+    same flat buffers (zero per-step allocation on the packing side)."""
+    from torchft_tpu.ddp import GradientAverager
+
+    manager = _mock_manager()
+    avg = GradientAverager(manager, bucket_bytes=1 << 20)
+    grads = {}
+    for i in range(6):
+        if i % 2 == 0:
+            grads[f"l{i}"] = np.arange(i + 3, dtype=np.float64)
+        else:
+            grads[f"l{i}"] = np.full((2, i + 1), i, dtype=np.int32)
+
+    out = avg.allreduce(grads)
+    assert manager.allreduce.call_count == 2  # one bucket per dtype
+    for k, v in grads.items():
+        np.testing.assert_array_equal(np.asarray(out[k]), v)
+        assert out[k].dtype == v.dtype
+
+    buffers_before = [id(b) for b in avg._plans[next(iter(avg._plans))].buffers]
+    out2 = avg.allreduce(grads)
+    assert len(avg._plans) == 1  # same signature -> cached plan
+    buffers_after = [id(b) for b in avg._plans[next(iter(avg._plans))].buffers]
+    assert buffers_before == buffers_after  # persistent, reused buffers
+    for k, v in grads.items():
+        np.testing.assert_array_equal(np.asarray(out2[k]), v)
+
+
 def test_per_leaf_averager() -> None:
     from torchft_tpu.ddp import PerLeafGradientAverager
 

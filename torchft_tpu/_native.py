@@ -119,6 +119,7 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_char_p,
         ctypes.c_double,
         ctypes.c_double,
+        ctypes.c_double,
     ]
     lib.tf_manager_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_manager_free.argtypes = [ctypes.c_void_p]
@@ -449,13 +450,19 @@ class ManagerServer:
         state: str,
         step_time_ms_ewma: float = 0.0,
         step_time_ms_last: float = 0.0,
+        allreduce_gb_per_s: float = -1.0,
     ) -> None:
         """Pushes live (step, state) into the heartbeat payload so the
         lighthouse's ``GET /metrics`` and ``/status.json`` show per-replica
         progress in real time (see docs/wire.md, Heartbeat fields).  The
         optional step-time telemetry (rolling busy-time EWMA + last
         observation, milliseconds) feeds the lighthouse's straggler
-        sentinel; 0 keeps the previously pushed values."""
+        sentinel; 0 keeps the previously pushed values.
+        ``allreduce_gb_per_s`` (the last committed step's gradient
+        data-plane throughput) feeds its ``tpuft_allreduce_gb_per_s``
+        gauge — there 0 is an authoritative reading (a committed step that
+        moved no gradient bytes) and only a negative value keeps the prior
+        one, so status-only pushes must leave the default."""
         if self._ptr:
             _lib.tf_manager_set_status(
                 self._ptr,
@@ -463,6 +470,7 @@ class ManagerServer:
                 state.encode(),
                 float(step_time_ms_ewma),
                 float(step_time_ms_last),
+                float(allreduce_gb_per_s),
             )
 
     def shutdown(self) -> None:

@@ -109,6 +109,7 @@ class ManagerServer:
         state: str,
         step_time_ms_ewma: float = ...,
         step_time_ms_last: float = ...,
+        allreduce_gb_per_s: float = ...,
     ) -> None: ...
     def shutdown(self) -> None: ...
 

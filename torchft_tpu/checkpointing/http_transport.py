@@ -149,6 +149,12 @@ class HTTPTransport(CheckpointTransport):
                     # 404ing a healer that raced the async pipeline.
                     transport._await_flip(step)
                     with transport._checkpoint_lock.r_lock(transport._timeout):
+                        # Re-check after acquiring the read lock: a request
+                        # that arrived before the serving window opened sees
+                        # the enqueue only now (r_lock blocked on it), so the
+                        # first _await_flip ran before there was anything
+                        # pending to wait for.
+                        transport._await_flip(step)
                         with transport._snap_cond:
                             if transport._state is None or transport._step != step:
                                 self.send_error(

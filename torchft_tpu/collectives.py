@@ -235,6 +235,13 @@ class LinkShaper:
     link.  Enabled for all TCPCollective peers via
     ``TPUFT_SHAPED_LINK="<mbps>:<rtt_ms>"``; wire-byte counters let tests
     assert traffic (e.g. the bf16 wire halving) without timing flakiness.
+
+    The serialization budget is a shared VIRTUAL-TIME pacer: concurrent
+    senders (the multi-lane ring shares ONE shaper per peer direction)
+    queue on the modeled link, so adding lanes cannot multiply the modeled
+    bandwidth — lanes may only win by overlapping propagation (the half-RTT
+    per frame) and host-side work with serialization, exactly the physics
+    of parallel TCP streams on one bottleneck link.
     """
 
     def __init__(self, mbps: float, rtt_ms: float) -> None:
@@ -243,6 +250,9 @@ class LinkShaper:
         self.bytes_sent = 0
         self.frames_sent = 0
         self._lock = threading.Lock()
+        # Virtual time (monotonic clock) until which the modeled link is
+        # busy serializing already-admitted frames.
+        self._busy_until = 0.0
 
     @classmethod
     def from_env(cls) -> Optional["LinkShaper"]:
@@ -259,7 +269,16 @@ class LinkShaper:
         with self._lock:
             self.bytes_sent += nbytes
             self.frames_sent += 1
-        time.sleep(self.delay_s(nbytes))
+            now = time.monotonic()
+            start = max(now, self._busy_until)
+            self._busy_until = start + nbytes / self.bytes_per_s
+            # Frame is delivered once its bytes clear the shared link plus
+            # one-way propagation; a lone sender sees exactly the legacy
+            # delay (serialization + half RTT per frame back-to-back).
+            wake = self._busy_until + self.half_rtt_s
+        remaining = wake - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
 
 
 class _Peer:
@@ -275,6 +294,11 @@ class _Peer:
         self.recv_lock = threading.Lock()
         self.shaper = shaper if shaper is not None else LinkShaper.from_env()
         self._stash: dict[int, "collections.deque[bytearray]"] = {}
+        # Wire-byte counters (headers included), always on — the per-lane
+        # throughput accounting the GB/s telemetry reads; ints under the
+        # send/recv locks, so the cost is a couple of adds per frame.
+        self.bytes_out = 0
+        self.bytes_in = 0
 
     def send_msg(self, tag: int, payload) -> None:
         """payload: one buffer, or a list of buffers sent as a single frame
@@ -288,6 +312,7 @@ class _Peer:
             self.sock.sendall(_HDR.pack(tag, total))
             for p in parts:
                 self.sock.sendall(p)
+            self.bytes_out += total + _HDR.size
 
     def recv_msg(self, expect_tag: int) -> bytearray:
         with self.recv_lock:
@@ -317,6 +342,7 @@ class _Peer:
             if r == 0:
                 raise ConnectionError("peer connection closed")
             got += r
+        self.bytes_in += n
         return buf
 
     def close(self) -> None:
@@ -372,8 +398,31 @@ class _FifoQueue:
             self.cond.notify_all()
 
 
+# Parallel ring connections ("lanes") per neighbor.  Lanes stripe ring
+# chunks across independent sockets and a per-lane worker pool, so one
+# bucket's reduce-scatter *sum* overlaps another bucket's send/recv, and
+# per-frame propagation (RTT) overlaps across lanes — the two effects that
+# keep a shaped/high-RTT link busy.  Shaped benches stay honest: all lanes
+# to one neighbor share a single LinkShaper serialization budget.
+TPUFT_RING_LANES_ENV = "TPUFT_RING_LANES"
+_MAX_LANES = 8
+# Stripes per ring chunk are capped so tag space and frame overhead stay
+# bounded; tags are carved as seq * _TAGS_PER_OP + stripe * 4 + subtag.
+_MAX_STRIPES = 64
+_TAGS_PER_OP = 4 * (_MAX_STRIPES + 1)
+
+
+def _ring_lanes_from_env() -> int:
+    try:
+        lanes = int(os.environ.get(TPUFT_RING_LANES_ENV, "2"))
+    except ValueError:
+        return 2
+    return max(1, min(_MAX_LANES, lanes))
+
+
 class TCPCollective(Collective):
-    """Ring collective over TCP sockets between replica groups.
+    """Striped multi-lane ring collective over TCP sockets between replica
+    groups.
 
     This is the tpu-ft data plane for the *replica* (DCN) dimension: gradients
     have already been reduced over ICI inside the pjit step; what crosses
@@ -381,11 +430,21 @@ class TCPCollective(Collective):
     2*(n-1)/n of the data per rank — bandwidth optimal, and each group talks
     only to its ring neighbors, matching how DCN links are provisioned.
 
+    Lanes: ``TPUFT_RING_LANES`` (default 2, max 8) parallel connections per
+    ring neighbor.  With lanes > 1 each allreduce is split into round-robin
+    chunk stripes, every stripe running its own ring on lane ``stripe %
+    lanes`` with a unique per-op tag, executed by a per-lane worker pool —
+    so stripe k's local *sum* overlaps stripe k+1's bytes on the wire, and
+    back-to-back allreduce calls (the GradientAverager's buckets) overlap
+    each other instead of serializing on one socket pair.  Submission order
+    of ring ops must still be identical on every rank (program order), but
+    alignment within that order is carried by tags, not timing.
+
     Reconfiguration: rendezvous through the group store under a caller-chosen
-    prefix; every rank publishes "host:port", rank i dials rank (i+1)%n.
-    abort() closes the sockets, causing in-flight ops to fail fast and latch
-    an error until the next configure() (the NCCL-abort analogue,
-    torchft/process_group.py:584-647).
+    prefix; every rank publishes "host:port", rank i dials rank (i+1)%n once
+    per lane.  abort() closes the sockets, causing in-flight ops to fail
+    fast and latch an error until the next configure() (the NCCL-abort
+    analogue, torchft/process_group.py:584-647).
     """
 
     RENDEZVOUS_TIMEOUT_MS = 60000
@@ -395,6 +454,7 @@ class TCPCollective(Collective):
         timeout: float = 60.0,
         chunk_bytes: int = 4 << 20,
         wire_dtype: str = "auto",
+        lanes: Optional[int] = None,
     ) -> None:
         """``wire_dtype="bf16"`` halves allreduce bytes on the wire (DCN is
         the cross-slice bottleneck): ring payloads are cast to bfloat16 per
@@ -429,13 +489,25 @@ class TCPCollective(Collective):
         self._timeout = timeout
         self._chunk_bytes = chunk_bytes
         self._wire_dtype = wire_dtype
+        self._lanes = lanes if lanes is not None else _ring_lanes_from_env()
+        self._lanes = max(1, min(_MAX_LANES, self._lanes))
         self._lock = threading.Lock()
         self._executor: Optional[object] = None
         self._ring_executor: Optional[object] = None
+        self._lane_executor: Optional[object] = None
+        # One single-worker sender pool per lane (see _exchange).
+        self._send_pools: List[object] = []
         self._rank = 0
         self._world_size = 1
-        self._next: Optional[_Peer] = None  # link to (rank+1) % n
-        self._prev: Optional[_Peer] = None  # link to (rank-1) % n
+        self._next_lanes: List[_Peer] = []  # links to (rank+1) % n, one per lane
+        self._prev_lanes: List[_Peer] = []  # links to (rank-1) % n, one per lane
+        # Ring-op sequence counter: allocated at CALL time on the caller's
+        # thread, so identical program order on every rank yields identical
+        # tags (the cross-rank alignment contract now that ops overlap).
+        self._op_seq = 0
+        self._op_seq_lock = threading.Lock()
+        # In-flight striped-op result futures, failed fast on abort().
+        self._inflight: set = set()
         self._peers: dict[int, _Peer] = {}
         self._accept_cond = threading.Condition()
         self._accept_thread: Optional[threading.Thread] = None
@@ -455,6 +527,17 @@ class TCPCollective(Collective):
 
     # -- lifecycle ----------------------------------------------------------
 
+    @property
+    def _next(self) -> Optional[_Peer]:
+        """Lane-0 link to (rank+1) % n — kept as the stable single-lane
+        handle (tests and diagnostics); all lanes of one direction share one
+        LinkShaper, so its byte counters cover the whole direction."""
+        return self._next_lanes[0] if self._next_lanes else None
+
+    @property
+    def _prev(self) -> Optional[_Peer]:
+        return self._prev_lanes[0] if self._prev_lanes else None
+
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         self.abort()
         with self._lock:
@@ -463,6 +546,8 @@ class TCPCollective(Collective):
             self._rank = rank
             self._world_size = world_size
             self._generation += 1
+            with self._op_seq_lock:
+                self._op_seq = 0
             # Abort may have cancelled queued p2p ops that will never call
             # done(); fresh turnstiles avoid cross-generation waits.
             with self._fifo_lock:
@@ -473,25 +558,42 @@ class TCPCollective(Collective):
             self._rendezvous()
             from concurrent.futures import ThreadPoolExecutor
 
-            # Ring ops share the _next/_prev sockets and fixed frame tags, so
-            # they must execute one at a time in submission order — program
-            # order is identical on every rank, which keeps the rings aligned.
-            # P2P send/recv use per-pair sockets with tag demux and may
-            # overlap freely.
+            # Single-lane ring ops share the lane-0 sockets and execute one
+            # at a time in submission order on this executor — program
+            # order is identical on every rank, which keeps the rings
+            # aligned.  Striped ops instead fan out to the per-lane pool
+            # below, aligned by per-op tags.  P2P send/recv use per-pair
+            # sockets with tag demux and may overlap freely.
             self._ring_executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="tpuft_ring"
             )
+            self._send_pools = [
+                ThreadPoolExecutor(max_workers=1, thread_name_prefix=f"tpuft_send{ln}")
+                for ln in range(self._lanes)
+            ]
+            if self._lanes > 1:
+                # Depth-2 per lane: a stripe's worker stays occupied through
+                # its link-serialization wait (real or shaped), so with only
+                # one worker per lane the next bucket's stripes could never
+                # enter the wire until the current bucket's cleared it —
+                # exactly the bubble lanes exist to remove.  2x lets stripe
+                # k+1 overlap stripe k's in-flight time; the shared per-peer
+                # shaper still bounds aggregate bandwidth.
+                self._lane_executor = ThreadPoolExecutor(
+                    max_workers=self._lanes * 2, thread_name_prefix="tpuft_lane"
+                )
             self._executor = ThreadPoolExecutor(
                 max_workers=4, thread_name_prefix="tpuft_p2p"
             )
 
-    # Channel ids in the 8-byte connection preamble (rank, channel).
+    # Channel ids in the 12-byte connection preamble (rank, channel, lane).
     _CH_RING = 0
     _CH_P2P = 1
+    _PREAMBLE = struct.Struct("<III")
 
     def _rendezvous(self) -> None:
         listener = socket.create_server(("", 0), family=socket.AF_INET6, dualstack_ipv6=True)
-        listener.listen(16)
+        listener.listen(16 + 2 * self._lanes)
         self._listener = listener
         port = listener.getsockname()[1]
         host = socket.gethostname()
@@ -499,14 +601,21 @@ class TCPCollective(Collective):
 
         n = self._world_size
         rank = self._rank
+        lanes = self._lanes
         next_rank = (rank + 1) % n
         prev_rank = (rank - 1) % n
         gen = self._generation
+        # One serialization budget per peer DIRECTION, shared by every lane
+        # of that direction: shaped benches cannot widen the modeled link by
+        # adding lanes, and the direction's byte counters stay whole.
+        next_shaper = LinkShaper.from_env()
+        prev_shaper = LinkShaper.from_env()
 
-        # Persistent accept loop: registers the ring link from prev and any
-        # lazily-dialed point-to-point links (used by checkpoint transports
-        # to move weights between arbitrary replica pairs, the reference's
-        # pg.send/recv path, torchft/checkpointing/pg_transport.py:197-301).
+        # Persistent accept loop: registers the per-lane ring links from
+        # prev and any lazily-dialed point-to-point links (used by
+        # checkpoint transports to move weights between arbitrary replica
+        # pairs, the reference's pg.send/recv path,
+        # torchft/checkpointing/pg_transport.py:197-301).
         def accept_loop() -> None:
             while True:
                 try:
@@ -520,38 +629,60 @@ class TCPCollective(Collective):
                     # not block an executor thread forever.
                     conn.settimeout(self._timeout)
                     peer = _Peer(conn)
-                    their_rank, channel = struct.unpack("<II", peer._recv_exact(8))
+                    their_rank, channel, lane = self._PREAMBLE.unpack(
+                        peer._recv_exact(self._PREAMBLE.size)
+                    )
                     with self._accept_cond:
                         if self._generation != gen:
                             conn.close()
                             return
                         if channel == self._CH_RING:
-                            self._accepted_ring[their_rank] = peer
+                            peer.shaper = prev_shaper
+                            self._accepted_ring[(their_rank, lane)] = peer
                         else:
                             self._peers[their_rank] = peer
                         self._accept_cond.notify_all()
                 except Exception:  # noqa: BLE001
                     conn.close()
 
-        self._accepted_ring: dict[int, _Peer] = {}
+        self._accepted_ring: dict[tuple, _Peer] = {}
         self._accept_thread = threading.Thread(target=accept_loop, daemon=True)
         self._accept_thread.start()
 
-        # Dial our next ring neighbor.
-        self._next = self._dial_rank(next_rank, self._CH_RING)
+        # Dial our next ring neighbor, one connection per lane.
+        self._next_lanes = [
+            self._dial_rank(next_rank, self._CH_RING, lane=lane, shaper=next_shaper)
+            for lane in range(lanes)
+        ]
 
-        # Wait for prev's ring connection.
+        # Wait for all of prev's ring lanes.
         deadline = self.RENDEZVOUS_TIMEOUT_MS / 1000
         with self._accept_cond:
             ok = self._accept_cond.wait_for(
-                lambda: prev_rank in self._accepted_ring, timeout=deadline
+                lambda: all(
+                    (prev_rank, lane) in self._accepted_ring for lane in range(lanes)
+                ),
+                timeout=deadline,
             )
             if not ok:
-                raise TimeoutError(f"rendezvous: rank {prev_rank} never connected")
-            self._prev = self._accepted_ring.pop(prev_rank)
+                missing = [
+                    lane for lane in range(lanes)
+                    if (prev_rank, lane) not in self._accepted_ring
+                ]
+                raise TimeoutError(
+                    f"rendezvous: rank {prev_rank} never connected lanes {missing}"
+                )
+            self._prev_lanes = [
+                self._accepted_ring.pop((prev_rank, lane)) for lane in range(lanes)
+            ]
 
     def _dial_rank(
-        self, peer_rank: int, channel: int, timeout: Optional[float] = None
+        self,
+        peer_rank: int,
+        channel: int,
+        timeout: Optional[float] = None,
+        lane: int = 0,
+        shaper: Optional[LinkShaper] = None,
     ) -> _Peer:
         timeout = timeout if timeout is not None else self.RENDEZVOUS_TIMEOUT_MS / 1000
         addr = self._store.get(
@@ -567,8 +698,8 @@ class TCPCollective(Collective):
         # recv/send deadline; ops get the full op timeout.
         sock.settimeout(self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        peer = _Peer(sock)
-        peer.sock.sendall(struct.pack("<II", self._rank, channel))
+        peer = _Peer(sock, shaper=shaper)
+        peer.sock.sendall(self._PREAMBLE.pack(self._rank, channel, lane))
         return peer
 
     def _dial(self, peer_rank: int) -> _Peer:
@@ -645,23 +776,38 @@ class TCPCollective(Collective):
                 self._generation += 1
                 self._dialing = set()
                 self._accept_cond.notify_all()
-            for peer in [self._next, self._prev] + peers:
+            for peer in self._next_lanes + self._prev_lanes + peers:
                 if peer is not None:
                     peer.close()
             if self._listener is not None:
                 self._listener.close()
                 self._listener = None
-            self._next = None
-            self._prev = None
+            self._next_lanes = []
+            self._prev_lanes = []
             if self._executor is not None:
                 self._executor.shutdown(wait=False, cancel_futures=True)
                 self._executor = None
             if self._ring_executor is not None:
                 self._ring_executor.shutdown(wait=False, cancel_futures=True)
                 self._ring_executor = None
+            if self._lane_executor is not None:
+                self._lane_executor.shutdown(wait=False, cancel_futures=True)
+                self._lane_executor = None
+            for pool in self._send_pools:
+                pool.shutdown(wait=False, cancel_futures=True)
+            self._send_pools = []
             if self._store is not None:
                 self._store.close()
                 self._store = None
+            inflight, self._inflight = list(self._inflight), set()
+        # Outside the lock: failing a future runs its done-callbacks inline.
+        err = RuntimeError("collective aborted")
+        for fut in inflight:
+            if not fut.done():
+                try:
+                    fut.set_exception(err)
+                except Exception:  # noqa: BLE001 — racing completion
+                    pass
 
     def errored(self) -> Optional[Exception]:
         """Reports latched operation failures; cleared by configure()."""
@@ -703,6 +849,29 @@ class TCPCollective(Collective):
 
         return Work(executor.submit(run))
 
+    def _next_seq(self) -> int:
+        """Ring-op sequence number, allocated at call time so identical
+        program order on every rank yields identical tag blocks."""
+        with self._op_seq_lock:
+            seq = self._op_seq
+            self._op_seq += 1
+        return seq
+
+    def _tag_base(self, seq: int, stripe: int = 0) -> int:
+        return (seq * _TAGS_PER_OP + stripe * 4) & 0x7FFFFFFF
+
+    def lane_stats(self) -> dict:
+        """Per-lane wire-byte counters for the current configuration:
+        ``{"lanes": L, "sent": [bytes per next-lane], "recv": [bytes per
+        prev-lane]}``.  Cumulative since the last configure(); feeds the
+        Manager's allreduce GB/s telemetry and the bench artifacts."""
+        nexts, prevs = list(self._next_lanes), list(self._prev_lanes)
+        return {
+            "lanes": self._lanes,
+            "sent": [p.bytes_out for p in nexts],
+            "recv": [p.bytes_in for p in prevs],
+        }
+
     def allreduce(
         self,
         arrays: Sequence[np.ndarray],
@@ -716,91 +885,106 @@ class TCPCollective(Collective):
         arrays = [np.ascontiguousarray(a) for a in arrays]
         if self._world_size == 1:
             return Work(completed_future(list(arrays)))
+        seq = self._next_seq()
+        if self._lanes > 1:
+            return self._striped_allreduce(arrays, op, allow_wire_compression, seq)
         return self._submit(
-            lambda: self._ring_allreduce(arrays, op, allow_wire_compression)
+            lambda: self._ring_allreduce(arrays, op, allow_wire_compression, seq)
         )
 
-    def _exchange(self, tag: int, payload) -> bytes:
-        """Sends to the next neighbor while receiving from the previous one.
-        Full-duplex is required: with payloads larger than the kernel socket
-        buffers, blocking send-then-recv deadlocks the ring."""
-        send_exc: List[Exception] = []
-
-        def do_send() -> None:
-            try:
-                self._next.send_msg(tag, memoryview(payload) if isinstance(payload, (bytes, bytearray)) else payload)
-            except Exception as e:  # noqa: BLE001
-                send_exc.append(e)
-
-        sender = threading.Thread(target=do_send, daemon=True)
-        sender.start()
-        try:
-            received = self._prev.recv_msg(tag)
-        finally:
-            sender.join(timeout=self._timeout)
-        if send_exc:
-            raise send_exc[0]
+    def _exchange(self, tag: int, payload, lane: int = 0) -> bytes:
+        """Sends to the next neighbor while receiving from the previous one,
+        on the given lane's socket pair.  Full-duplex is required: with
+        payloads larger than the kernel socket buffers, blocking
+        send-then-recv deadlocks the ring.  The send runs on the lane's
+        persistent sender worker — a striped allreduce makes hundreds of
+        hops per op, and a fresh thread per hop is pure scheduler churn.
+        One worker per lane serializes sends exactly like the peer's
+        send_lock already does, so ordering is unchanged."""
+        nxt = self._next_lanes[lane]
+        prv = self._prev_lanes[lane]
+        pools = self._send_pools
+        if not pools:
+            raise RuntimeError("collective aborted")
+        if isinstance(payload, (bytes, bytearray)):
+            payload = memoryview(payload)
+        sent = pools[lane].submit(nxt.send_msg, tag, payload)
+        # A recv error propagates as-is (matching the old join-then-drop
+        # behavior); the in-flight send fails on its own when _fail_ring /
+        # abort closes the lane sockets.
+        received = prv.recv_msg(tag)
+        sent.result(timeout=self._timeout)
         return received
 
-    def _ring_allreduce(
-        self,
-        arrays: List[np.ndarray],
-        op: str,
-        allow_wire_compression: bool = True,
-    ) -> List[np.ndarray]:
-        from torchft_tpu.checkpointing.serialization import as_u8
-
-        n = self._world_size
-        rank = self._rank
-        combine = _REDUCE_COMBINE[op]
-        # Flatten all arrays into one contiguous f64-safe working buffer of
-        # the common dtype to cut per-message overhead.
-        flat = np.concatenate([a.reshape(-1) for a in arrays]) if len(arrays) > 1 \
-            else arrays[0].reshape(-1).copy()
-        chunks = np.array_split(flat, n)
-        offsets = np.cumsum([0] + [c.size for c in chunks])
-
-        # Optional wire compression: floating payloads travel as bfloat16
-        # (half the DCN bytes), accumulation stays in flat.dtype.  Gated on
-        # EVERY input array being floating (not just the promoted buffer
-        # dtype): a mixed [f32, int64] call promotes flat to float64, and
-        # quantizing the integer values would corrupt them.
-        wire = None
+    def _wire_for(
+        self, arrays: Sequence[np.ndarray], flat_dtype, allow_wire_compression: bool
+    ):
+        """The wire dtype for one allreduce: bfloat16 when compression is
+        allowed, configured, and EVERY input array is floating (not just the
+        promoted buffer dtype) — a mixed [f32, int64] call promotes flat to
+        float64, and quantizing the integer values would corrupt them."""
         if (
             allow_wire_compression
             and self._wire_dtype == "bf16"
-            and np.issubdtype(flat.dtype, np.floating)
+            and np.issubdtype(flat_dtype, np.floating)
             and all(np.issubdtype(a.dtype, np.floating) for a in arrays)
         ):
             import ml_dtypes
 
-            wire = np.dtype(ml_dtypes.bfloat16)
+            return np.dtype(ml_dtypes.bfloat16)
+        return None
+
+    def _ring_rs_ag(
+        self,
+        chunks: List[np.ndarray],
+        combine,
+        wire,
+        acc_dtype,
+        lane: int,
+        tag_base: int,
+    ) -> List[np.ndarray]:
+        """One complete ring pass (reduce-scatter then allgather) over
+        ``chunks`` — one 1-D array per rank slot — on the given lane.
+        Returns the fully reduced chunk list.  ``tag_base`` reserves two
+        tags (+1 reduce-scatter, +2 allgather) so concurrent stripes and
+        back-to-back ops demux cleanly on shared lane sockets.
+
+        Wire compression: floating payloads travel as bfloat16 per hop with
+        accumulation in ``acc_dtype``; in the allgather phase each rank
+        quantizes its OWNED chunk exactly once and every other rank forwards
+        the received WIRE BYTES untouched — no per-hop decode/re-encode, so
+        all ranks decode bitwise-identical values (replica consistency — the
+        commit protocol's premise).  Both quantization and accumulation are
+        elementwise in fixed ring-step order, so striping a chunk across
+        lanes reproduces the single-lane result BIT FOR BIT.
+        """
+        from torchft_tpu.checkpointing.serialization import as_u8
+
+        n = self._world_size
+        rank = self._rank
+        chunks = list(chunks)
 
         def encode(chunk: np.ndarray) -> memoryview:
             if wire is not None:
                 chunk = chunk.astype(wire)
+            # as_u8 (not memoryview.cast) so ml_dtypes payloads like
+            # bfloat16 frame correctly.
             return memoryview(as_u8(chunk))
 
         def decode(raw: bytes) -> np.ndarray:
             if wire is not None:
-                return np.frombuffer(raw, dtype=wire).astype(flat.dtype)
-            return np.frombuffer(raw, dtype=flat.dtype)
+                return np.frombuffer(raw, dtype=wire).astype(acc_dtype)
+            return np.frombuffer(raw, dtype=acc_dtype)
 
         # Reduce-scatter phase: after n-1 steps, chunk (rank+1)%n holds the
-        # full reduction on this rank.  as_u8 (not memoryview.cast) so
-        # ml_dtypes payloads like bfloat16 frame correctly.
+        # full reduction on this rank.
         for step in range(n - 1):
             send_idx = (rank - step) % n
             recv_idx = (rank - step - 1) % n
-            incoming = decode(self._exchange(1, encode(chunks[send_idx])))
+            incoming = decode(self._exchange(tag_base + 1, encode(chunks[send_idx]), lane))
             chunks[recv_idx] = combine(chunks[recv_idx], incoming)
 
-        # Allgather phase: circulate the reduced chunks.  With compression,
-        # each rank quantizes its OWNED chunk exactly once and every other
-        # rank forwards the received WIRE BYTES untouched — no per-hop
-        # decode/re-encode, so all ranks decode bitwise-identical values
-        # regardless of input dtype (replica consistency — divergent grads
-        # across groups would defeat the commit protocol).
+        # Allgather phase: circulate the reduced chunks.
         if wire is not None:
             own = (rank + 1) % n
             raw_chunks: List[Optional[bytes]] = [None] * n
@@ -809,36 +993,210 @@ class TCPCollective(Collective):
                 send_idx = (rank - step + 1) % n
                 recv_idx = (rank - step) % n
                 raw_chunks[recv_idx] = self._exchange(
-                    2, memoryview(cast(bytes, raw_chunks[send_idx]))
+                    tag_base + 2, memoryview(cast(bytes, raw_chunks[send_idx])), lane
                 )
             for i in range(n):
                 chunks[i] = np.frombuffer(
                     cast(bytes, raw_chunks[i]), dtype=wire
-                ).astype(flat.dtype)
+                ).astype(acc_dtype)
         else:
             for step in range(n - 1):
                 send_idx = (rank - step + 1) % n
                 recv_idx = (rank - step) % n
                 payload = encode(chunks[send_idx])
-                chunks[recv_idx] = decode(self._exchange(2, payload)).copy()
+                chunks[recv_idx] = decode(
+                    self._exchange(tag_base + 2, payload, lane)
+                ).copy()
+        return chunks
 
-        out_flat = np.concatenate(chunks)
+    def _flatten(self, arrays: Sequence[np.ndarray]) -> np.ndarray:
+        """One contiguous working buffer of the common dtype.  A single
+        input is viewed, not copied — the ring never mutates its inputs
+        (every combine allocates), so the zero-copy view is safe and saves
+        a full memcpy per gradient bucket."""
+        if len(arrays) > 1:
+            return np.concatenate([a.reshape(-1) for a in arrays])
+        return arrays[0].reshape(-1)
+
+    def _unflatten(
+        self, out_flat: np.ndarray, arrays: Sequence[np.ndarray], op: str
+    ) -> List[np.ndarray]:
         if op == "avg":
-            out_flat = out_flat / n
+            out_flat = out_flat / self._world_size
         out: List[np.ndarray] = []
         pos = 0
         for a in arrays:
-            out.append(out_flat[pos : pos + a.size].reshape(a.shape).astype(a.dtype, copy=False))
+            out.append(
+                out_flat[pos : pos + a.size].reshape(a.shape).astype(a.dtype, copy=False)
+            )
             pos += a.size
         return out
+
+    def _ring_allreduce(
+        self,
+        arrays: List[np.ndarray],
+        op: str,
+        allow_wire_compression: bool = True,
+        seq: Optional[int] = None,
+    ) -> List[np.ndarray]:
+        """Single-lane whole-chunk ring allreduce (the lanes=1 path, and the
+        building block reduce_scatter/barrier reuse)."""
+        if seq is None:
+            seq = self._next_seq()
+        n = self._world_size
+        combine = _REDUCE_COMBINE[op]
+        flat = self._flatten(arrays)
+        chunks = np.array_split(flat, n)
+        wire = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+        chunks = self._ring_rs_ag(
+            chunks, combine, wire, flat.dtype, lane=0, tag_base=self._tag_base(seq)
+        )
+        return self._unflatten(np.concatenate(chunks), arrays, op)
+
+    def _stripe_count(self, max_chunk_nbytes: int) -> int:
+        """Stripes per ring chunk: enough to keep every lane busy, sized at
+        ~chunk_bytes so stripe k's combine overlaps stripe k+1's wire time,
+        rounded to a lane multiple for balance, capped for tag/frame
+        overhead."""
+        per = max(1, self._chunk_bytes)
+        s = max(self._lanes, -(-max_chunk_nbytes // per))
+        s = -(-s // self._lanes) * self._lanes
+        # The cap must stay a lane multiple AND come after the rounding: a
+        # post-cap round-up (e.g. 64 -> 66 at 6 lanes) would spill stripe
+        # tags past this seq's _TAGS_PER_OP block into the next op's.
+        return min(s, _MAX_STRIPES - _MAX_STRIPES % self._lanes)
+
+    def _striped_allreduce(
+        self,
+        arrays: List[np.ndarray],
+        op: str,
+        allow_wire_compression: bool,
+        seq: int,
+    ) -> Work:
+        """Lanes > 1: stripe the ring chunks round-robin across lanes and run
+        each stripe as an independent tagged ring on the per-lane worker
+        pool.  Stripes of one op overlap each other (sum vs wire), and
+        back-to-back ops (gradient buckets) overlap too — the Work future
+        resolves when every stripe lands."""
+        with self._lock:
+            lane_exec = self._lane_executor
+            gen = self._generation
+        if lane_exec is None:
+            err = self._op_error or RuntimeError("collective not configured")
+            return Work(failed_future(err))
+
+        n = self._world_size
+        combine = _REDUCE_COMBINE[op]
+        try:
+            flat = self._flatten(arrays)
+            chunks = np.array_split(flat, n)
+            wire = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+            nstripes = self._stripe_count(max(c.nbytes for c in chunks))
+            # sub[i][s]: stripe s of rank-chunk i.  array_split depends only
+            # on sizes derived from the (identical) flat length, so every
+            # rank cuts identical stripe boundaries.
+            sub = [np.array_split(c, nstripes) for c in chunks]
+        except Exception as e:  # noqa: BLE001
+            self._latch(e)
+            return Work(failed_future(e))
+
+        results: List[Optional[List[np.ndarray]]] = [None] * nstripes
+        out: Future = Future()
+        state_lock = threading.Lock()
+        state = {"pending": nstripes, "failed": False}
+        with self._lock:
+            self._inflight.add(out)
+
+        def settle_err(e: Exception) -> None:
+            self._latch(e)
+            # Close the ring lanes of THIS generation so sibling stripes
+            # blocked in send/recv fail fast instead of burning the full op
+            # timeout; the op is already doomed and errors latch until the
+            # next configure() rebuilds every lane.
+            self._fail_ring(gen)
+            with self._lock:
+                self._inflight.discard(out)
+            if not out.done():
+                try:
+                    out.set_exception(e)
+                except Exception:  # noqa: BLE001 — racing abort()
+                    pass
+
+        def finish() -> None:
+            try:
+                # One concatenate in (chunk, stripe) order — a per-chunk
+                # concat followed by a cross-chunk concat would memcpy the
+                # whole reduced payload twice on the hot path.
+                segs = [
+                    cast(list, results[s])[i]
+                    for i in range(n)
+                    for s in range(nstripes)
+                ]
+                outs = self._unflatten(np.concatenate(segs), arrays, op)
+            except Exception as e:  # noqa: BLE001
+                settle_err(e)
+                return
+            with self._lock:
+                self._inflight.discard(out)
+            if not out.done():
+                try:
+                    out.set_result(outs)
+                except Exception:  # noqa: BLE001 — racing abort()
+                    pass
+
+        def make_stripe(s: int):
+            def run() -> None:
+                try:
+                    res = self._ring_rs_ag(
+                        [sub[i][s] for i in range(n)],
+                        combine,
+                        wire,
+                        flat.dtype,
+                        lane=s % self._lanes,
+                        tag_base=self._tag_base(seq, s),
+                    )
+                except Exception as e:  # noqa: BLE001
+                    with state_lock:
+                        first = not state["failed"]
+                        state["failed"] = True
+                    if first:
+                        settle_err(e)
+                    return
+                results[s] = res
+                with state_lock:
+                    state["pending"] -= 1
+                    done = state["pending"] == 0 and not state["failed"]
+                if done:
+                    finish()
+
+            return run
+
+        try:
+            for s in range(nstripes):
+                lane_exec.submit(make_stripe(s))
+        except RuntimeError as e:  # executor shut down by a concurrent abort
+            settle_err(e)
+        return Work(out)
+
+    def _fail_ring(self, gen: int) -> None:
+        """Closes this generation's ring lane sockets so every stripe/op
+        blocked on them fails fast.  The generation guard keeps a stale
+        failure from touching the next quorum's fresh lanes."""
+        with self._lock:
+            if self._generation != gen:
+                return
+            peers = list(self._next_lanes) + list(self._prev_lanes)
+        for p in peers:
+            p.close()
 
     def allgather(self, array: np.ndarray) -> Work:
         array = np.ascontiguousarray(array)
         if self._world_size == 1:
             return Work(completed_future([array.copy()]))
-        return self._submit(lambda: self._ring_allgather(array))
+        seq = self._next_seq()
+        return self._submit(lambda: self._ring_allgather(array, self._tag_base(seq) + 3))
 
-    def _ring_allgather(self, array: np.ndarray) -> List[np.ndarray]:
+    def _ring_allgather(self, array: np.ndarray, tag: int) -> List[np.ndarray]:
         import pickle
 
         n = self._world_size
@@ -848,16 +1206,17 @@ class TCPCollective(Collective):
         for step in range(n - 1):
             send_idx = (rank - step) % n
             recv_idx = (rank - step - 1) % n
-            slots[recv_idx] = self._exchange(3, slots[send_idx])
+            slots[recv_idx] = self._exchange(tag, slots[send_idx])
         return [pickle.loads(s) for s in slots]
 
     def broadcast(self, array: np.ndarray, root: int = 0) -> Work:
         array = np.ascontiguousarray(array)
         if self._world_size == 1:
             return Work(completed_future(array.copy()))
+        seq = self._next_seq()
 
         def run() -> np.ndarray:
-            out = self._ring_allgather(array)[root]
+            out = self._ring_allgather(array, self._tag_base(seq) + 3)[root]
             return out
 
         return self._submit(run)
@@ -877,12 +1236,13 @@ class TCPCollective(Collective):
                     )
                 )
             )
+        seq = self._next_seq()
 
         def run() -> np.ndarray:
             # Implemented over ring allreduce of the stacked buffer; rank i
             # keeps slice i.  Adequate for the replica dim's small world sizes.
             stacked = np.stack(arrays)
-            reduced = self._ring_allreduce([stacked], op)[0]
+            reduced = self._ring_allreduce([stacked], op, seq=seq)[0]
             return reduced[self._rank]
 
         return self._submit(run)
@@ -891,6 +1251,7 @@ class TCPCollective(Collective):
         arrays = [np.ascontiguousarray(a) for a in arrays]
         if self._world_size == 1:
             return Work(completed_future([arrays[0].copy()]))
+        seq = self._next_seq()
 
         def run() -> List[np.ndarray]:
             import pickle
@@ -900,10 +1261,11 @@ class TCPCollective(Collective):
             # Route through the ring: circulate everyone's full payload list.
             slots: List[Optional[bytes]] = [None] * n
             slots[rank] = pickle.dumps(list(arrays))
+            tag = self._tag_base(seq) + 3
             for step in range(n - 1):
                 send_idx = (rank - step) % n
                 recv_idx = (rank - step - 1) % n
-                slots[recv_idx] = self._exchange(4, slots[send_idx])
+                slots[recv_idx] = self._exchange(tag, slots[send_idx])
             lists = [pickle.loads(s) for s in slots]
             return [lists[src][rank] for src in range(n)]
 
@@ -1028,7 +1390,10 @@ class TCPCollective(Collective):
         if self._world_size == 1:
             return Work(completed_future(None))
         token = np.zeros(1, dtype=np.int32)
-        return self._submit(lambda: (self._ring_allreduce([token], "sum"), None)[1])
+        seq = self._next_seq()
+        return self._submit(
+            lambda: (self._ring_allreduce([token], "sum", seq=seq), None)[1]
+        )
 
 
 class ErrorSwallowingCollective(Collective):

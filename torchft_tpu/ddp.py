@@ -4,11 +4,17 @@ Reference parity: torchft/ddp.py.  The reference subclasses torch DDP and
 installs a comm hook that routes each gradient bucket through
 ``manager.allreduce`` so reduction overlaps with the rest of backward
 (torchft/ddp.py:47-71).  JAX has no autograd hooks — ``jax.grad`` returns the
-whole gradient pytree at once — so the overlap point moves: leaves are
-coalesced into fixed-size flat buckets and each bucket's cross-group
-allreduce is issued asynchronously the moment it is packed, letting bucket
-k's DCN transfer overlap with bucket k+1's host packing (and, in a real step,
-with the next microbatch's compute thanks to JAX async dispatch).
+whole gradient pytree at once — so the overlap point moves to the bucket
+pipeline: leaves are coalesced into fixed-size flat buckets **planned once
+per tree shape and packed into persistent preallocated buffers**, and each
+bucket's device->host fetch and cross-group allreduce are issued the moment
+that bucket's leaves land — bucket 0 is on the DCN wire while bucket 2 is
+still leaving the device, and with a multi-lane ring collective
+(``TPUFT_RING_LANES``) the buckets overlap each other on the wire too.
+
+The per-bucket D2H wait runs in an ``allreduce_d2h`` span and the final
+drain in ``allreduce_merge`` (both FT time, never charged as productive
+compute — obs/report.py and the straggler sentinel depend on that).
 
 ``PerLeafGradientAverager`` mirrors PureDistributedDataParallel's
 per-parameter variant (torchft/ddp.py:74-97).
@@ -17,34 +23,115 @@ per-parameter variant (torchft/ddp.py:74-97).
 from __future__ import annotations
 
 from concurrent.futures import Future
-from typing import Any, List, Sequence, Tuple
+from typing import Any, Dict, List, Sequence, Tuple
 
 import numpy as np
 
 from torchft_tpu.manager import Manager
 
-__all__ = ["GradientAverager", "PerLeafGradientAverager", "allreduce_pytree"]
+__all__ = [
+    "GradientAverager",
+    "PerLeafGradientAverager",
+    "allreduce_pytree",
+    "plan_buckets",
+]
 
 
 class _Bucket:
-    """A contiguous flat buffer packing a run of gradient leaves."""
+    """One dtype-homogeneous flat slice of a bucket plan: which leaves it
+    packs (original tree indices), where each lives in the flat buffer, and
+    how big the whole bucket is.  Pure metadata — the backing buffer lives
+    in the :class:`_BucketPlan` and is reused across steps."""
 
-    def __init__(self, leaves: List[np.ndarray], indices: List[int]) -> None:
+    def __init__(
+        self,
+        indices: List[int],
+        shapes: List[tuple],
+        sizes: List[int],
+        dtype: np.dtype,
+    ) -> None:
         self.indices = indices
-        self.shapes = [l.shape for l in leaves]
-        self.sizes = [l.size for l in leaves]
-        self.dtype = leaves[0].dtype
-        self.flat = np.concatenate([np.ravel(l) for l in leaves]) if leaves else np.zeros(
-            0, dtype=self.dtype
-        )
+        self.shapes = shapes
+        self.sizes = sizes
+        self.dtype = np.dtype(dtype)
+        self.offsets: List[int] = []
+        off = 0
+        for size in sizes:
+            self.offsets.append(off)
+            off += size
+        self.numel = off
+        self.nbytes = off * self.dtype.itemsize
 
     def unpack(self, flat: np.ndarray) -> List[Tuple[int, np.ndarray]]:
-        out: List[Tuple[int, np.ndarray]] = []
-        offset = 0
-        for idx, shape, size in zip(self.indices, self.shapes, self.sizes):
-            out.append((idx, flat[offset : offset + size].reshape(shape)))
-            offset += size
-        return out
+        """(leaf index, reshaped view into ``flat``) per packed leaf."""
+        return [
+            (idx, flat[off : off + size].reshape(shape))
+            for idx, off, size, shape in zip(
+                self.indices, self.offsets, self.sizes, self.shapes
+            )
+        ]
+
+
+def plan_buckets(
+    metas: Sequence[Tuple[tuple, Any]], bucket_bytes: int
+) -> List[_Bucket]:
+    """Plans the bucket layout for a leaf list given ``(shape, dtype)`` per
+    leaf.
+
+    Leaves are sort-stable GROUPED BY DTYPE first (a tree whose dtypes
+    alternate — f32, i32, f32, i32 — packs into two buckets, not one per
+    leaf; the original index mapping is preserved in ``_Bucket.indices``),
+    then packed greedily up to ``bucket_bytes``.  A single leaf larger than
+    ``bucket_bytes`` gets its own bucket.  An empty leaf list plans to no
+    buckets.
+    """
+    order = sorted(
+        # .name, not .str: distinct ml_dtypes (float8 variants, int4) share
+        # the opaque '<V1' str and would interleave instead of grouping.
+        range(len(metas)), key=lambda i: np.dtype(metas[i][1]).name
+    )  # stable: same-dtype leaves keep their relative order
+    buckets: List[_Bucket] = []
+    cur_idx: List[int] = []
+    cur_shapes: List[tuple] = []
+    cur_sizes: List[int] = []
+    cur_bytes = 0
+    cur_dtype: Any = None
+
+    def flush() -> None:
+        nonlocal cur_idx, cur_shapes, cur_sizes, cur_bytes
+        if cur_idx:
+            buckets.append(_Bucket(cur_idx, cur_shapes, cur_sizes, cur_dtype))
+        cur_idx, cur_shapes, cur_sizes, cur_bytes = [], [], [], 0
+
+    for i in order:
+        shape, dtype = metas[i]
+        dtype = np.dtype(dtype)
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        nbytes = size * dtype.itemsize
+        if cur_idx and (cur_bytes + nbytes > bucket_bytes or dtype != cur_dtype):
+            flush()
+        cur_idx.append(i)
+        cur_shapes.append(tuple(shape))
+        cur_sizes.append(size)
+        cur_bytes += nbytes
+        cur_dtype = dtype
+    flush()
+    return buckets
+
+
+class _BucketPlan:
+    """A bucket layout plus its persistent flat buffers and precomputed
+    pack views — allocated once per (treedef, shapes, dtypes) and reused
+    every step, so the steady-state data plane does zero per-step
+    concatenate/allocation work on the packing side."""
+
+    def __init__(self, metas: Sequence[Tuple[tuple, Any]], bucket_bytes: int) -> None:
+        self.buckets = plan_buckets(metas, bucket_bytes)
+        self.buffers = [np.empty(b.numel, dtype=b.dtype) for b in self.buckets]
+        # views[k]: [(leaf index, writable reshaped view into buffers[k])].
+        self.views: List[List[Tuple[int, np.ndarray]]] = [
+            b.unpack(buf) for b, buf in zip(self.buckets, self.buffers)
+        ]
 
 
 class GradientAverager:
@@ -53,15 +140,49 @@ class GradientAverager:
     The bucket size default matches torch DDP's 25 MB first-bucket heuristic;
     larger buckets amortize DCN round-trips, smaller ones start the overlap
     earlier.
+
+    ``pipelined=True`` (default) issues each bucket's D2H fetch and its
+    ``manager.allreduce`` as soon as that bucket's leaves land, so early
+    buckets ride the wire while later ones are still leaving the device.
+    ``pipelined=False`` is the monolithic reference path — one blocking
+    ``device_get_tree`` of every leaf, then pack+issue — kept for A/B
+    benchmarking (``bench_allreduce.py``) and debugging.
     """
 
-    def __init__(self, manager: Manager, bucket_bytes: int = 25 << 20) -> None:
+    def __init__(
+        self,
+        manager: Manager,
+        bucket_bytes: int = 25 << 20,
+        pipelined: bool = True,
+    ) -> None:
         self._manager = manager
         self._bucket_bytes = bucket_bytes
+        self._pipelined = pipelined
+        self._plans: Dict[Any, _BucketPlan] = {}
 
     @property
     def manager(self) -> Manager:
         return self._manager
+
+    def _plan_for(self, leaves: List[Any], treedef: Any) -> _BucketPlan:
+        """The cached plan for this tree signature (treedef + per-leaf
+        shape/dtype); a new signature plans and allocates fresh buffers."""
+        metas = [(tuple(l.shape), np.dtype(l.dtype)) for l in leaves]
+        # d.name, not d.str: many distinct ml_dtypes (float8 variants, int4)
+        # share the opaque '<V1' str and would collide on one cached plan.
+        key = (treedef, tuple((s, d.name) for s, d in metas))
+        plan = self._plans.pop(key, None)
+        if plan is None:
+            if len(self._plans) >= 8:
+                # A churning signature set (odd for a train loop) must not
+                # pin unbounded buffer memory — evict the least recently
+                # used plan only (the hit below re-inserts, so dict order
+                # IS recency order), keeping a multi-signature workload's
+                # hot plans alive instead of replanning everything.
+                self._plans.pop(next(iter(self._plans)))
+            plan = _BucketPlan(metas, self._bucket_bytes)
+        self._plans[key] = plan
+        return plan
 
     def allreduce(self, grads: Any) -> Any:
         """Averages a gradient pytree across participating replica groups.
@@ -71,6 +192,8 @@ class GradientAverager:
         resolved at should_commit — reference: torchft/manager.py:262-323).
         """
         import jax
+
+        from torchft_tpu.futures import device_get_into, device_get_tree
 
         leaves, treedef = jax.tree.flatten(grads)
         if not leaves:
@@ -87,22 +210,64 @@ class GradientAverager:
             return grads
 
         is_jax = [isinstance(l, jax.Array) for l in leaves]
-        try:
-            # Deadline-guarded device->host: wedged device work latches an
-            # error instead of hanging the step (stream_timeout analogue).
-            from torchft_tpu.futures import device_get_tree
+        # Python scalars (a float loss riding in the grad tree) carry no
+        # .shape/.dtype — promote them to 0-d arrays so planning and the
+        # D2H copy see uniform leaves, as the monolithic asarray path did.
+        leaves = [
+            l if hasattr(l, "shape") else np.asarray(l) for l in leaves
+        ]
+        plan = self._plan_for(leaves, treedef)
+        step = self._manager.current_step()
+        timeout = self._manager.timeout.total_seconds()
 
-            hosts = device_get_tree(leaves, self._manager.timeout.total_seconds())
-        except TimeoutError as e:
-            self._manager.report_error(e)
-            return grads
+        # Kick off the device->host DMA for every leaf up front (no-op off
+        # accelerator): by the time bucket k's blocking copy runs, its bytes
+        # are already in flight behind buckets 0..k-1's.
+        for l in leaves:
+            copy_async = getattr(l, "copy_to_host_async", None)
+            if copy_async is not None:
+                try:
+                    copy_async()
+                except Exception:  # noqa: BLE001 — a hint, never load-bearing
+                    pass
 
-        futures: List[Tuple[_Bucket, Future]] = []
-        for bucket in self._make_buckets(hosts):
-            fut = self._manager.allreduce(bucket.flat)
-            futures.append((bucket, fut))
+        hosts: List[Any] = []
+        if not self._pipelined:
+            # Monolithic reference path: one deadline-guarded fetch of the
+            # whole tree, then pack+issue every bucket.
+            with self._manager.spans.span("allreduce_d2h", step=step):
+                try:
+                    hosts = device_get_tree(leaves, timeout)
+                except TimeoutError as e:
+                    self._manager.report_error(e)
+                    return grads
 
-        out: List[Any] = list(hosts)
+        pending: List[Tuple[_Bucket, np.ndarray, Future]] = []
+        for bucket, buf, views in zip(plan.buckets, plan.buffers, plan.views):
+            if self._pipelined:
+                # Deadline-guarded device->host straight into the persistent
+                # buffer: wedged device work latches an error instead of
+                # hanging the step (stream_timeout analogue).  Spanned as
+                # allreduce_d2h — this wait blocks the train thread and must
+                # be attributed as FT time, not productive compute.
+                with self._manager.spans.span("allreduce_d2h", step=step):
+                    try:
+                        device_get_into(
+                            [(leaves[i], view) for i, view in views], timeout
+                        )
+                    except TimeoutError as e:
+                        self._manager.report_error(e)
+                        return grads
+            else:
+                for i, view in views:
+                    np.copyto(view, np.asarray(hosts[i]).reshape(view.shape))
+            # Bucket k hits the wire here while bucket k+1 is still copying
+            # off the device (and, with ring lanes, while bucket k-1 is still
+            # mid-flight — the collective overlaps back-to-back calls).
+            fut = self._manager.allreduce(buf)
+            pending.append((bucket, buf, fut))
+
+        out: List[Any] = list(leaves)
         # The bucket drain blocks this (train) thread on the ring exchange —
         # i.e. on the SLOWEST peer's gradients.  Span it as allreduce_merge:
         # unrecorded, this wait would be charged as productive/busy time,
@@ -110,11 +275,14 @@ class GradientAverager:
         # as busy for the whole stall — hiding exactly the straggler the
         # step-time telemetry exists to expose (the commit-time drain of
         # what remains keeps the same phase name; the accumulator sums).
-        with self._manager.spans.span(
-            "allreduce_merge", step=self._manager.current_step()
-        ):
-            for bucket, fut in futures:
+        with self._manager.spans.span("allreduce_merge", step=step):
+            for bucket, buf, fut in pending:
                 flat = np.asarray(fut.result())
+                if flat is buf:
+                    # Failure fallback resolved to the input: detach from the
+                    # persistent buffer (reused next step) before handing
+                    # views to the caller.
+                    flat = flat.copy()
                 for idx, arr in bucket.unpack(flat):
                     out[idx] = arr
 
@@ -123,24 +291,6 @@ class GradientAverager:
             for i, a in enumerate(out)
         ]
         return jax.tree.unflatten(treedef, devices)
-
-    def _make_buckets(self, hosts: Sequence[np.ndarray]) -> List[_Bucket]:
-        buckets: List[_Bucket] = []
-        cur: List[np.ndarray] = []
-        cur_idx: List[int] = []
-        cur_bytes = 0
-        cur_dtype = None
-        for i, h in enumerate(hosts):
-            if cur and (cur_bytes + h.nbytes > self._bucket_bytes or h.dtype != cur_dtype):
-                buckets.append(_Bucket(cur, cur_idx))
-                cur, cur_idx, cur_bytes = [], [], 0
-            cur.append(h)
-            cur_idx.append(i)
-            cur_bytes += h.nbytes
-            cur_dtype = h.dtype
-        if cur:
-            buckets.append(_Bucket(cur, cur_idx))
-        return buckets
 
 
 class PerLeafGradientAverager:
@@ -155,6 +305,19 @@ class PerLeafGradientAverager:
         import jax
 
         leaves, treedef = jax.tree.flatten(grads)
+        if not leaves:
+            return grads
+        # Parity with GradientAverager: settle the quorum once up front and
+        # take the alone-in-the-ring fast path before ANY device->host
+        # traffic — N per-leaf roundtrips for an identity average is pure
+        # HBM-bandwidth waste.
+        self._manager.wait_quorum()
+        if (
+            self._manager.errored() is None
+            and self._manager.collective().size() == 1
+            and self._manager.is_participating()
+        ):
+            return grads
         futs = [
             self._manager.allreduce(
                 l, allow_wire_compression=allow_wire_compression
@@ -168,7 +331,17 @@ class PerLeafGradientAverager:
             "allreduce_merge", step=self._manager.current_step()
         ):
             results = [f.result() for f in futs]
-        return jax.tree.unflatten(treedef, results)
+        # Results land back on each leaf's original device/sharding, like
+        # GradientAverager: Manager.allreduce device_puts jax inputs itself,
+        # but a swapped-in manager (tests, wrappers) may hand back host
+        # arrays — re-place those so callers always see device-resident
+        # leaves where they provided device-resident gradients.
+        out = []
+        for leaf, res in zip(leaves, results):
+            if isinstance(leaf, jax.Array) and not isinstance(res, jax.Array):
+                res = jax.device_put(res, leaf.sharding)
+            out.append(res)
+        return jax.tree.unflatten(treedef, out)
 
 
 def allreduce_pytree(manager: Manager, tree: Any, bucket_bytes: int = 25 << 20) -> Any:

@@ -246,6 +246,7 @@ class DrainWatcher:
 
     def _file_loop(self) -> None:
         path = self.notice_file_path()
+        junk_ticks = 0
         while path and not self._stop.is_set() and not self._fired.is_set():
             if os.path.exists(path):
                 grace = self._grace_s
@@ -257,11 +258,19 @@ class DrainWatcher:
                     grace = float(data.get("deadline_ms", grace * 1000)) / 1000.0
                     source = str(data.get("source", source))
                     pid = int(data["pid"]) if data.get("pid") is not None else None
+                    junk_ticks = 0
                 except (OSError, ValueError, TypeError, AttributeError):
                     # A bare `touch`, non-dict JSON, or junk fields: still a
                     # valid (unpinned) trigger — and never a reason to kill
-                    # this poller thread.
-                    pass
+                    # this poller thread.  But a supervisor writing the file
+                    # non-atomically looks identical mid-write (empty or
+                    # truncated JSON), so give it one poll tick to finish
+                    # before consuming it as a touch-trigger — otherwise the
+                    # notice fires without its deadline/source/pid payload.
+                    junk_ticks += 1
+                    if junk_ticks < 2:
+                        self._stop.wait(self._poll_interval_s)
+                        continue
                 if pid is not None and pid != os.getpid():
                     # A notice addressed to the donor, observed by its
                     # replacement (same group id, same file name): not
@@ -287,6 +296,9 @@ class DrainWatcher:
                     DrainNotice(source=source, deadline=time.time() + grace)
                 )
                 return
+            # File absent: any mid-write grace state is stale (the writer
+            # aborted and removed it) — a future notice gets a fresh tick.
+            junk_ticks = 0
             self._stop.wait(self._poll_interval_s)
 
     def _gce_fetch(self, endpoint: str) -> Optional[str]:

@@ -240,6 +240,23 @@ def device_get_tree(leaves: list, timeout: float) -> list:
     return _MATERIALIZER.get(lambda: [np.asarray(l) for l in leaves], timeout)
 
 
+def device_get_into(pairs: list, timeout: float) -> None:
+    """Materializes ``(src, dst)`` pairs host-side under one shared deadline,
+    landing each source directly in its destination view — the bucket-
+    pipelined D2H path: every gradient leaf is copied straight into its slot
+    of a persistent flat buffer, with no per-step concatenate or fresh
+    allocation.  ``dst`` must be a writable numpy view shaped like ``src``;
+    dtype mismatches raise (``casting="no"``) rather than silently convert.
+    """
+    import numpy as np
+
+    def run() -> None:
+        for src, dst in pairs:
+            np.copyto(dst, np.asarray(src).reshape(dst.shape), casting="no")
+
+    _MATERIALIZER.get(run, timeout)
+
+
 def completed_future(value: T = None) -> Future:
     """A future already resolved with `value`."""
     fut: Future = Future()

@@ -252,6 +252,17 @@ class Manager:
         # so the cluster-level health scoring sees this replica's pace.
         self._step_stats = StepTimeStats()
         self._last_commit_mono: Optional[float] = None
+        # Allreduce data-plane throughput: payload bytes and the first-issue
+        # timestamp of the step in flight, summarized at commit time as
+        # allreduce_gb_per_s (step_summary field + the lighthouse's
+        # tpuft_allreduce_gb_per_s heartbeat gauge).  End-to-end rate — from
+        # first issue to drain — so overlap wins (bucket pipelining, ring
+        # lanes) show up here, not just in microbenchmarks.
+        self._ar_lock = threading.Lock()
+        self._ar_bytes = 0
+        self._ar_t_first: Optional[float] = None
+        self._ar_t_last: Optional[float] = None
+        self._ar_gbps = 0.0
         self._wire_transport_spans()
 
     def _wire_transport_spans(self) -> None:
@@ -297,6 +308,12 @@ class Manager:
         self._errored = None
         self._healing = False
         self._pending_work = []
+        with self._ar_lock:
+            # Defensive: a loop that skipped should_commit must not bleed
+            # its bytes into the next step's throughput summary.
+            self._ar_bytes = 0
+            self._ar_t_first = None
+            self._ar_t_last = None
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -449,6 +466,26 @@ class Manager:
                     getattr(quorum, "recover_dst_replica_ranks_all", None)
                     or quorum.recover_dst_replica_ranks
                 )
+                # Force-recover symmetry: when we heal WHILE already holding
+                # the max_step state (commit_failures re-fetch, step ==
+                # max_step), our peers may be in exactly the same position —
+                # a cluster-wide failed step (e.g. a replica killed
+                # mid-allreduce fails EVERY group's commit) force-recovers
+                # everyone, and each group's assigned donor is another
+                # force-recovering group.  commit_failures is request-local,
+                # so donors cannot be told to serve us; without this, nobody
+                # opens a serving window and the mutual heal deadlocks until
+                # timeout, every quorum, forever.  Serving is passive for
+                # pull transports and our state IS the committed max_step
+                # state (a failed vote discards the speculative update), so
+                # opening the window is always safe.
+                if not serve_dsts and heal and max_step == self._step:
+                    # Our own donor rotation names the peers most likely
+                    # healing from us (HTTP serving ignores the dst list —
+                    # it is passive — this only makes the log truthful).
+                    serve_dsts = list(quorum.recover_src_replica_ranks) or [
+                        quorum.recover_src_replica_rank
+                    ]
             else:
                 serve_dsts = list(quorum.recover_dst_replica_ranks)
             if serve_dsts:
@@ -657,6 +694,11 @@ class Manager:
             # Healing replicas / spares contribute zeros (torchft/manager.py:287-288).
             host = np.zeros_like(host)
 
+        with self._ar_lock:
+            if self._ar_t_first is None:
+                self._ar_t_first = time.monotonic()
+            self._ar_bytes += int(host.nbytes)
+
         try:
             work = self._collective.allreduce(
                 [host], op="sum", allow_wire_compression=allow_wire_compression
@@ -689,6 +731,12 @@ class Manager:
         out: Future = Future()
 
         def settle(f: Future) -> None:
+            # Drain edge for the allreduce GB/s window: the LAST settle of
+            # the step, not should_commit() time, ends the wire window — a
+            # loop that runs its optimizer between the averager's drain and
+            # the vote must not see that compute charged to the DCN path.
+            with self._ar_lock:
+                self._ar_t_last = time.monotonic()
             exc = f.exception()
             if exc is not None:
                 self._logger.exception(f"async work failed: {exc}")
@@ -722,12 +770,14 @@ class Manager:
     # -- status -------------------------------------------------------------
 
     def _set_status(self, state: str) -> None:
-        """Pushes (step, state) plus the rolling step-time telemetry into
-        this group's native ManagerServer so its lighthouse heartbeats carry
-        live per-replica progress AND pace — the feed for the lighthouse's
-        ``GET /metrics`` exposition, the dashboard's step-lag column, and
-        the straggler sentinel's health scoring.  Rank != 0 has no server;
-        best-effort by design (status must never fail a step)."""
+        """Pushes (step, state) plus the rolling step-time telemetry and the
+        last committed step's allreduce GB/s into this group's native
+        ManagerServer so its lighthouse heartbeats carry live per-replica
+        progress AND pace — the feed for the lighthouse's ``GET /metrics``
+        exposition (including ``tpuft_allreduce_gb_per_s``), the dashboard's
+        step-lag column, and the straggler sentinel's health scoring.
+        Rank != 0 has no server; best-effort by design (status must never
+        fail a step)."""
         srv = self._manager_server
         if srv is None:
             return
@@ -737,6 +787,7 @@ class Manager:
                 state,
                 self._step_stats.ewma_ms,
                 self._step_stats.last_ms,
+                self._ar_gbps,
             )
         except Exception:  # noqa: BLE001
             pass
@@ -774,6 +825,34 @@ class Manager:
                 except Exception:  # noqa: BLE001
                     pass
             self._pending_work = []
+
+        # Allreduce data-plane throughput for this step: payload bytes over
+        # the first-issue -> drained window.  Computed after the drain so
+        # pipelining/lane overlap is reflected; pushed to the lighthouse on
+        # the post-vote status heartbeat and into step_summary below.
+        with self._ar_lock:
+            ar_bytes, ar_t_first = self._ar_bytes, self._ar_t_first
+            ar_t_last = self._ar_t_last
+            self._ar_bytes, self._ar_t_first = 0, None
+            self._ar_t_last = None
+        ar_fields: Dict[str, object] = {}
+        ar_gbps: Optional[float] = None
+        if ar_bytes and ar_t_first is not None:
+            if ar_t_last is None or ar_t_last <= ar_t_first:
+                ar_t_last = time.monotonic()
+            ar_dur = max(1e-9, ar_t_last - ar_t_first)
+            ar_gbps = ar_bytes / 1e9 / ar_dur
+            ar_fields = {
+                "allreduce_bytes": ar_bytes,
+                "allreduce_s": round(ar_dur, 4),
+                "allreduce_gb_per_s": round(ar_gbps, 4),
+            }
+            lane_stats = getattr(self._collective, "lane_stats", None)
+            if callable(lane_stats):
+                try:
+                    ar_fields["allreduce_lanes"] = lane_stats()
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
 
         if self._collective.errored() is not None:
             self.report_error(cast(Exception, self._collective.errored()))
@@ -831,7 +910,7 @@ class Manager:
         else:
             self._last_commit_mono = None
         self._spans.step_summary(
-            vote_step, committed=should_commit, **step_time_fields
+            vote_step, committed=should_commit, **step_time_fields, **ar_fields
         )
 
         if self._checkpoint_transport is not None:
@@ -843,6 +922,12 @@ class Manager:
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
+            # The gauge is "the last COMMITTED step's" throughput (proto
+            # field 6): a failed vote's timeout-stretched window must not
+            # overwrite it, and a committed step with no allreduce traffic
+            # (healing, spare) clears it — a stale healthy number would
+            # mask exactly the DCN degradation the gauge exists to expose.
+            self._ar_gbps = ar_gbps if ar_gbps is not None else 0.0
             self._set_status("step")
         else:
             self._commit_failures += 1

@@ -11,8 +11,9 @@ have its own).  Output:
 
 - a per-step phase attribution table: for every committed step, the
   slowest replica's wall time split into productive compute vs the FT
-  phases (quorum wait, configure, heal, allreduce merge, commit vote) and
-  the critical-path phase — the bucket that dominated the slowest replica;
+  phases (quorum wait, configure, heal, allreduce d2h, allreduce merge,
+  commit vote) and the critical-path phase — the bucket that dominated the
+  slowest replica;
 - cluster totals: wall time classified productive / quorum-wait / heal /
   drain / idle per group and summed;
 - the dead-window goodput fraction, computed by :func:`deadwindow` — the
@@ -208,6 +209,14 @@ def deadwindow(
 # charged against productive wall time — subtracting an overlapped span
 # from the step interval would fabricate FT cost that the async pipeline
 # specifically does not impose.
+#
+# NOT in this tuple: ``allreduce_d2h``, the GradientAverager's per-bucket
+# device->host wait.  It blocks the train thread (the pipeline overlaps
+# bucket k's WIRE time with bucket k+1's copy, but the copy wait itself is
+# serial with compute), so it falls through the generic branch below into
+# ``other_ft`` — FT overhead, never productive.  Moving it here would
+# inflate productive time by exactly the D2H stall and break the
+# dead-window math bench.py reproduces from these streams.
 _OVERLAPPED = ("snapshot",)
 
 # Phase ms a legacy (pre-span) stream carries on its lifecycle events,

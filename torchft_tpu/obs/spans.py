@@ -40,14 +40,26 @@ __all__ = [
 # The Manager step phases report.py attributes (docs/architecture.md
 # "Observability").  quorum = blocking wait on the lighthouse round;
 # configure = collective rebuild on quorum change; heal = peer weight
-# fetch; allreduce_merge = drain of pending allreduce futures at commit
+# fetch; allreduce_d2h = the GradientAverager's per-bucket device->host
+# fetch into the persistent flat buffers (blocks the train thread, so it
+# is FT-overhead time, NOT productive compute — report.py charges it to
+# the other-FT bucket and the straggler sentinel subtracts it from busy
+# time); allreduce_merge = drain of pending allreduce futures at commit
 # time; commit_vote = the two-phase commit barrier RPC; snapshot = the
 # donor-side device->host flatten on the HTTP transport's background
 # snapshotter — an OVERLAPPED phase (it runs concurrently with the train
 # step, so report.py shows it but does not charge it against productive
 # time; a snapshot span on the critical path is exactly the regression the
 # async pipeline exists to prevent).
-PHASES = ("quorum", "configure", "heal", "allreduce_merge", "commit_vote", "snapshot")
+PHASES = (
+    "quorum",
+    "configure",
+    "heal",
+    "allreduce_d2h",
+    "allreduce_merge",
+    "commit_vote",
+    "snapshot",
+)
 
 # Phases that run on background threads concurrent with compute: report.py
 # excludes these from per-step critical-path attribution.
