@@ -1659,6 +1659,41 @@ def straggler_benchmark(trials: int | None = None) -> dict:
     }
 
 
+def lighthouse_failover_benchmark() -> dict:
+    """HA lighthouse failover scenario (``--scenario lighthouse-failover``):
+    N lighthouse replicas behind the lease election, G Manager worker
+    groups, one SIGKILL of the active leader mid-run.  Criteria (each
+    recorded in HA_BENCH.json): quorum formation resumed within one lease
+    period of the kill, ZERO failed commits on the (all-healthy) replica
+    groups, straggler-sentinel state and /metrics history intact on the
+    new leader at epoch+1, the takeover visible as a
+    ``lighthouse_failover`` event in the obs stream, and any remaining
+    standby still answering as a follower (no dual-serving).  The heavy
+    lifting lives in bench_ha.py (quick mode is tier-1's
+    test_ha_quick_smoke)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import bench_ha
+    finally:
+        sys.path.pop(0)
+    workdir = os.environ.get("TPUFT_BENCH_WORKDIR") or tempfile.mkdtemp(
+        prefix="tpuft_bench_ha_"
+    )
+    payload = bench_ha.run_failover(
+        workdir,
+        lighthouses=int(os.environ.get("TPUFT_BENCH_HA_LIGHTHOUSES", "3")),
+        groups=int(os.environ.get("TPUFT_BENCH_HA_GROUPS", "2")),
+        lease_ms=int(os.environ.get("TPUFT_BENCH_HA_LEASE_MS", "1500")),
+        window_s=float(os.environ.get("TPUFT_BENCH_HA_WINDOW_S", "30")),
+    )
+    payload["workdir"] = workdir
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "HA_BENCH.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return payload
+
+
 def main() -> None:
     # The chip result is computed, assembled, and (on any kill-scenario
     # failure) still printed first: a failure on the subprocess-heavy kill
@@ -1733,6 +1768,7 @@ def selftest() -> None:
     inspect.signature(drain_benchmark).bind()
     inspect.signature(kill_scenario_benchmark).bind()
     inspect.signature(straggler_benchmark).bind()
+    inspect.signature(lighthouse_failover_benchmark).bind()
     plans = _trial_plans(10)
     assert len(plans) == 10
     assert {p["type"] for p in plans} == {
@@ -1749,10 +1785,24 @@ if __name__ == "__main__":
         selftest()
     elif "--scenario" in sys.argv:
         which = sys.argv[sys.argv.index("--scenario") + 1:]
-        if not which or which[0] not in ("drain", "kill", "straggler"):
+        if not which or which[0] not in (
+            "drain", "kill", "straggler", "lighthouse-failover"
+        ):
             print(f"unknown --scenario {which[:1] or '(missing)'}", file=sys.stderr)
             sys.exit(2)
-        if which[0] == "straggler":
+        if which[0] == "lighthouse-failover":
+            ha = lighthouse_failover_benchmark()
+            print(
+                json.dumps(
+                    {
+                        "metric": "lighthouse_failover",
+                        "value": ha.get("takeover_s"),
+                        "unit": "seconds_to_takeover",
+                        "detail": ha,
+                    }
+                )
+            )
+        elif which[0] == "straggler":
             straggler = straggler_benchmark()
             print(
                 json.dumps(

@@ -73,6 +73,33 @@ int tf_lighthouse_drain(void* p, const char* prefix, int64_t deadline_ms) {
   return static_cast<Lighthouse*>(p)->DrainReplica(prefix ? prefix : "", deadline_ms);
 }
 
+// HA role control (docs/wire.md "HA lighthouse"): the Python election
+// driver (torchft_tpu/ha) flips the role on every lease transition; the
+// serve-time expiry guard lives native-side so a stalled Python thread
+// cannot leave an expired leader answering Quorum.
+void tf_lighthouse_set_role(void* p, int is_leader, const char* leader_addr,
+                            const char* leader_http, int64_t epoch,
+                            int64_t lease_expires_ms) {
+  static_cast<Lighthouse*>(p)->SetRole(is_leader != 0, leader_addr ? leader_addr : "",
+                                       leader_http ? leader_http : "", epoch,
+                                       lease_expires_ms);
+}
+
+int tf_lighthouse_role(void* p) { return static_cast<Lighthouse*>(p)->Role(); }
+
+int64_t tf_lighthouse_leader_epoch(void* p) {
+  return static_cast<Lighthouse*>(p)->LeaderEpoch();
+}
+
+// Serialized LighthouseReplicateRequest of the full replicable state; the
+// election driver pushes these bytes to each standby (wire method 6).
+void tf_lighthouse_snapshot(void* p, uint8_t** buf, size_t* len) {
+  std::string s = static_cast<Lighthouse*>(p)->SnapshotState();
+  *buf = static_cast<uint8_t*>(malloc(s.size() ? s.size() : 1));
+  memcpy(*buf, s.data(), s.size());
+  *len = s.size();
+}
+
 void tf_lighthouse_shutdown(void* p) { static_cast<Lighthouse*>(p)->Shutdown(); }
 
 void tf_lighthouse_free(void* p) { delete static_cast<Lighthouse*>(p); }

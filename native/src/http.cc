@@ -92,10 +92,14 @@ bool PeerIsLoopback(int fd) {
 }
 
 void WriteResponse(int fd, const HttpResponse& resp) {
-  const char* reason = resp.code == 200 ? "OK" : (resp.code == 404 ? "Not Found" : "Error");
+  const char* reason = resp.code == 200   ? "OK"
+                       : resp.code == 404 ? "Not Found"
+                       : resp.code == 307 ? "Temporary Redirect"
+                                          : "Error";
   std::string out = "HTTP/1.1 " + std::to_string(resp.code) + " " + reason +
                     "\r\nContent-Type: " + resp.content_type +
                     "\r\nContent-Length: " + std::to_string(resp.body.size()) +
+                    (resp.location.empty() ? "" : "\r\nLocation: " + resp.location) +
                     "\r\nConnection: close\r\n\r\n" + resp.body;
   size_t sent = 0;
   while (sent < out.size()) {

@@ -17,6 +17,9 @@ struct HttpResponse {
   int code = 200;
   std::string content_type = "text/html; charset=utf-8";
   std::string body;
+  // When non-empty, emitted as a Location header (redirect-to-leader on
+  // HA standbys; pair with code 307 so POSTs re-POST).
+  std::string location;
 };
 
 // One parsed request plus the connection facts the ops-endpoint trust

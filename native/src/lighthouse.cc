@@ -141,8 +141,232 @@ bool Lighthouse::AdminAllowed(const std::string& token, bool peer_loopback) cons
   return peer_loopback;
 }
 
+// ---------------------------------------------------------------------------
+// HA role (docs/wire.md "HA lighthouse")
+// ---------------------------------------------------------------------------
+
+bool Lighthouse::IsLeaderLocked() const {
+  if (!role_leader_) return false;
+  // Serve-time lease guard: a leader whose lease lapsed (stalled renewal
+  // thread, frozen process resumed) must refuse authoritative answers —
+  // a rival may already hold the lease.  0 = no lease (standalone).
+  return lease_expires_ms_ == 0 || NowEpochMs() < lease_expires_ms_;
+}
+
+std::string Lighthouse::NotLeaderErrLocked() const {
+  // kNotLeaderPrefix contract (wire.h): clients parse "leader=<addr>".
+  // A leader we can name only when it is NOT ourselves (a demoted/expired
+  // leader must not redirect clients back to itself).
+  std::string addr, http;
+  if (!role_leader_) {
+    addr = leader_addr_;
+    http = leader_http_;
+  }
+  return std::string(kNotLeaderPrefix) + "; leader=" + addr + " http=" + http +
+         " epoch=" + std::to_string(leader_epoch_);
+}
+
+void Lighthouse::SetRole(bool leader, const std::string& leader_addr,
+                         const std::string& leader_http, int64_t epoch,
+                         int64_t lease_expires_ms) {
+  std::lock_guard<std::mutex> lk(mu_);
+  bool was = role_leader_;
+  role_leader_ = leader;
+  leader_addr_ = leader_addr;
+  leader_http_ = leader_http;
+  leader_epoch_ = epoch;
+  lease_expires_ms_ = lease_expires_ms;
+  if (was != leader) {
+    if (leader) {
+      LOGI("lighthouse: became LEADER (epoch %lld, lease until +%lld ms)",
+           static_cast<long long>(epoch),
+           static_cast<long long>(lease_expires_ms ? lease_expires_ms - NowEpochMs()
+                                                   : 0));
+    } else {
+      LOGW("lighthouse: demoted to FOLLOWER (leader %s, epoch %lld)",
+           leader_addr.empty() ? "<unknown>" : leader_addr.c_str(),
+           static_cast<long long>(epoch));
+    }
+    // Blocked quorum joins on a demoted leader must abort with the
+    // redirect instead of waiting out their deadlines.
+    quorum_cv_.notify_all();
+  }
+}
+
+int Lighthouse::Role() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return IsLeaderLocked() ? 1 : 0;
+}
+
+int64_t Lighthouse::LeaderEpoch() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return leader_epoch_;
+}
+
+std::string Lighthouse::SnapshotState() {
+  LighthouseReplicateRequest req;
+  std::lock_guard<std::mutex> lk(mu_);
+  auto* l = req.mutable_leader();
+  l->set_leader_address(leader_addr_);
+  l->set_leader_http_address(leader_http_);
+  l->set_leader_epoch(leader_epoch_);
+  l->set_lease_expires_ms(lease_expires_ms_);
+  if (state_.prev_quorum) *req.mutable_prev_quorum() = *state_.prev_quorum;
+  req.set_quorum_id(state_.quorum_id);
+  auto now = Clock::now();
+  for (const auto& [id, last] : state_.heartbeats) {
+    auto* r = req.add_replicas();
+    r->set_replica_id(id);
+    r->set_heartbeat_age_ms(
+        std::chrono::duration_cast<std::chrono::milliseconds>(now - last).count());
+    auto step = hb_step_.find(id);
+    if (step != hb_step_.end()) r->set_step(step->second);
+    auto st = hb_state_.find(id);
+    if (st != hb_state_.end()) r->set_state(st->second);
+    auto lc = last_commit_ms_.find(id);
+    if (lc != last_commit_ms_.end()) r->set_last_commit_ms(lc->second);
+    auto gbps = allreduce_gbps_.find(id);
+    if (gbps != allreduce_gbps_.end()) r->set_allreduce_gb_per_s(gbps->second);
+    auto h = health_.find(id);
+    if (h != health_.end()) {
+      r->set_step_time_ms_ewma(h->second.ewma_ms);
+      r->set_step_time_ms_last(h->second.last_ms);
+      r->set_straggler_state(h->second.state);
+      r->set_straggler_over(h->second.over);
+      r->set_straggler_under(h->second.under);
+      r->set_straggler_last_step(h->second.last_step);
+      r->set_straggler_observations(h->second.observations);
+      r->set_straggler_ratio(h->second.ratio);
+    }
+    if (state_.draining.count(id)) {
+      r->set_draining(true);
+      auto dl = drain_deadline_ms_.find(id);
+      if (dl != drain_deadline_ms_.end()) r->set_drain_deadline_ms(dl->second);
+    }
+  }
+  for (const auto& a : alerts_) {
+    auto* out = req.add_alerts();
+    out->set_id(a.id);
+    out->set_kind(a.kind);
+    out->set_replica_id(a.replica_id);
+    out->set_raised_ms(a.raised_ms);
+    out->set_resolved_ms(a.resolved_ms);
+    out->set_ratio(a.ratio);
+    out->set_step_time_ms(a.step_time_ms);
+    out->set_auto_drained(a.auto_drained);
+  }
+  req.set_alert_seq(alert_seq_);
+  std::string out;
+  req.SerializeToString(&out);
+  return out;
+}
+
+Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
+                                   LighthouseReplicateResponse* resp) {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t in_epoch = req.leader().leader_epoch();
+  // Fencing: a push from a LOWER epoch is a deposed leader that has not
+  // noticed yet; and a live leader refuses pushes from its own epoch or
+  // below (two same-epoch leaders cannot exist under the lease protocol —
+  // refusing is the safe answer to a confused peer either way).
+  if (in_epoch < leader_epoch_ || (role_leader_ && in_epoch <= leader_epoch_)) {
+    resp->set_applied(false);
+    resp->set_leader_epoch(leader_epoch_);
+    return Status::kOk;
+  }
+  if (role_leader_) {
+    // A push from a higher epoch: we were deposed (e.g. this process froze
+    // past its lease and a rival won).  Demote before applying.
+    LOGW("lighthouse: replication push from epoch %lld > own %lld — demoted",
+         static_cast<long long>(in_epoch), static_cast<long long>(leader_epoch_));
+    role_leader_ = false;
+    quorum_cv_.notify_all();
+  }
+  leader_addr_ = req.leader().leader_address();
+  leader_http_ = req.leader().leader_http_address();
+  leader_epoch_ = in_epoch;
+  // Full-state replace: the leader's view is authoritative for a standby.
+  // Local tombstones (evicted_) stand — they fence zombies this instance
+  // itself observed.  Pending joins are untouched (a follower refuses
+  // joins, so there are none).
+  state_.heartbeats.clear();
+  state_.draining.clear();
+  drain_deadline_ms_.clear();
+  hb_step_.clear();
+  hb_state_.clear();
+  last_commit_ms_.clear();
+  allreduce_gbps_.clear();
+  health_.clear();
+  auto now = Clock::now();
+  for (const auto& r : req.replicas()) {
+    const std::string& id = r.replica_id();
+    if (evicted_.count(id)) continue;
+    state_.heartbeats[id] =
+        now - std::chrono::milliseconds(r.heartbeat_age_ms());
+    hb_step_[id] = r.step();
+    if (!r.state().empty()) hb_state_[id] = r.state();
+    if (r.last_commit_ms() > 0) last_commit_ms_[id] = r.last_commit_ms();
+    allreduce_gbps_[id] = r.allreduce_gb_per_s();
+    if (r.step_time_ms_ewma() > 0.0 || r.straggler_state() != 0) {
+      ReplicaHealth& h = health_[id];
+      h.ewma_ms = r.step_time_ms_ewma();
+      h.last_ms = r.step_time_ms_last();
+      h.ratio = r.straggler_ratio();
+      h.state = static_cast<int>(r.straggler_state());
+      h.over = r.straggler_over();
+      h.under = r.straggler_under();
+      h.last_step = r.straggler_last_step();
+      h.observations = r.straggler_observations();
+    }
+    if (r.draining()) {
+      state_.draining[id] = now;
+      if (r.drain_deadline_ms() > 0) drain_deadline_ms_[id] = r.drain_deadline_ms();
+    }
+  }
+  if (req.prev_quorum().participants_size() > 0) {
+    state_.prev_quorum = req.prev_quorum();
+  }
+  if (req.quorum_id() > state_.quorum_id) state_.quorum_id = req.quorum_id();
+  alerts_.clear();
+  for (const auto& a : req.alerts()) {
+    AlertRecord rec;
+    rec.id = a.id();
+    rec.kind = a.kind();
+    rec.replica_id = a.replica_id();
+    rec.raised_ms = a.raised_ms();
+    rec.resolved_ms = a.resolved_ms();
+    rec.ratio = a.ratio();
+    rec.step_time_ms = a.step_time_ms();
+    rec.auto_drained = a.auto_drained();
+    alerts_.push_back(std::move(rec));
+  }
+  if (req.alert_seq() > alert_seq_) alert_seq_ = req.alert_seq();
+  resp->set_applied(true);
+  resp->set_leader_epoch(leader_epoch_);
+  return Status::kOk;
+}
+
+void Lighthouse::FillLeaderInfo(LighthouseLeaderInfoResponse* resp) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto* l = resp->mutable_leader();
+  l->set_leader_address(leader_addr_);
+  l->set_leader_http_address(leader_http_);
+  l->set_leader_epoch(leader_epoch_);
+  l->set_lease_expires_ms(lease_expires_ms_);
+  resp->set_role(IsLeaderLocked() ? 1 : 0);
+}
+
 bool Lighthouse::Start(std::string* err) {
   if (const char* tok = std::getenv("TPUFT_ADMIN_TOKEN")) admin_token_ = tok;
+  // HA replicas start as followers BEFORE the listeners open (the HA
+  // driver sets this env before constructing the server): the default
+  // standalone-permanent-leader role would otherwise answer a heartbeat
+  // or quorum authoritatively in the window between Start() and the
+  // driver's first SetRole(false) — a brief dual-authoritative hole while
+  // an election is already in progress elsewhere.
+  if (const char* f = std::getenv("TPUFT_HA_START_FOLLOWER")) {
+    if (f[0] != '\0' && f[0] != '0') role_leader_ = false;
+  }
   // Straggler sentinel knobs.  Malformed values fall back to the defaults —
   // a bad tuning knob must not take the coordination plane down.
   if (const char* r = std::getenv("TPUFT_STRAGGLER_RATIO")) {
@@ -173,6 +397,37 @@ bool Lighthouse::Start(std::string* err) {
           const std::string& method = req.method;
           const std::string& path = req.path;
           HttpResponse r;
+          // HA standby: redirect everything except /metrics to the leader
+          // (docs/wire.md "HA lighthouse").  /metrics is served locally so
+          // each instance exposes its own tpuft_lighthouse_role gauge —
+          // redirecting it would double-count the leader under scrapes.
+          if (path != "/metrics") {
+            std::string leader_http;
+            bool follower;
+            {
+              std::lock_guard<std::mutex> lk(mu_);
+              follower = !IsLeaderLocked();
+              leader_http = role_leader_ ? "" : leader_http_;
+            }
+            if (follower) {
+              if (!leader_http.empty()) {
+                r.code = 307;  // preserves the method: POSTs re-POST
+                // leader_http may arrive with or without a scheme
+                // (http_address() returns "http://host:port").
+                r.location = (leader_http.rfind("http://", 0) == 0
+                                  ? leader_http
+                                  : "http://" + leader_http) +
+                             path;
+                r.content_type = "text/plain";
+                r.body = "not the leader; see " + r.location + "\n";
+              } else {
+                r.code = 503;
+                r.content_type = "text/plain";
+                r.body = "not the leader; leader election in progress\n";
+              }
+              return r;
+            }
+          }
           bool is_mutation = method == "POST" && path.rfind("/replica/", 0) == 0;
           if (is_mutation && !AdminAllowed(req.token, req.peer_loopback)) {
             // Ops endpoints mutate cluster membership; see docs/wire.md
@@ -276,6 +531,13 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
       LighthouseHeartbeatRequest h;
       if (!h.ParseFromString(req)) return Status::kInvalidArgument;
       Status st = HandleHeartbeat(h);
+      if (st == Status::kUnavailable) {
+        // Standby rejection: carry the redirect in the error payload so
+        // the manager's failover client can jump to the leader.
+        std::lock_guard<std::mutex> lk(mu_);
+        *resp = NotLeaderErrLocked();
+        return st;
+      }
       LighthouseHeartbeatResponse r;
       r.SerializeToString(resp);
       return st;
@@ -289,6 +551,15 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
     case kLighthouseEvict: {
       LighthouseEvictRequest q;
       if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      {
+        // Membership mutations on a standby would fork the view the leader
+        // replicates over it; redirect like Quorum/Heartbeat.
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!IsLeaderLocked()) {
+          *resp = NotLeaderErrLocked();
+          return Status::kUnavailable;
+        }
+      }
       LighthouseEvictResponse r;
       r.set_evicted(EvictReplica(q.replica_prefix()));
       r.SerializeToString(resp);
@@ -297,8 +568,31 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
     case kLighthouseDrain: {
       LighthouseDrainRequest q;
       if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!IsLeaderLocked()) {
+          *resp = NotLeaderErrLocked();
+          return Status::kUnavailable;
+        }
+      }
       LighthouseDrainResponse r;
       r.set_drained(DrainReplica(q.replica_prefix(), q.deadline_ms()));
+      r.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kLighthouseReplicate: {
+      LighthouseReplicateRequest q;
+      if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      LighthouseReplicateResponse r;
+      Status st = HandleReplicate(q, &r);
+      r.SerializeToString(resp);
+      return st;
+    }
+    case kLighthouseLeaderInfo: {
+      // Read-only leader discovery: answered by every replica regardless
+      // of role (clients use it to find the leader without guessing).
+      LighthouseLeaderInfoResponse r;
+      FillLeaderInfo(&r);
       r.SerializeToString(resp);
       return Status::kOk;
     }
@@ -310,6 +604,12 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
 
 Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
   std::lock_guard<std::mutex> lk(mu_);
+  if (!IsLeaderLocked()) {
+    // A standby must not accept heartbeats: its membership view is written
+    // by replication only, and the rejection (carrying the leader address)
+    // is what steers the manager's failover client to the live leader.
+    return Status::kUnavailable;
+  }
   if (evicted_.count(req.replica_id())) {
     return Status::kAborted;  // a zombie's in-flight heartbeat
   }
@@ -518,6 +818,14 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     return Status::kInvalidArgument;
   }
   std::unique_lock<std::mutex> lk(mu_);
+  if (!IsLeaderLocked()) {
+    // Split-brain guard: a standby (or an expired-lease leader) must never
+    // serve a quorum — two lighthouses forming quorums independently could
+    // hand two disjoint replica sets the same quorum id.  The rejection
+    // names the leader so the client redirects instead of retrying here.
+    *err = NotLeaderErrLocked();
+    return Status::kUnavailable;
+  }
   if (evicted_.count(id)) {
     // The supervisor declared this exact incarnation dead; a late join
     // from it is a zombie (e.g. a request already in flight when the
@@ -558,6 +866,15 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
   // excluded from the quorum its own join triggered (e.g. shrink_only), in
   // which case it keeps waiting for a later round (src/lighthouse.rs:494-530).
   while (true) {
+    if (!IsLeaderLocked()) {
+      // Demoted (or lease lapsed) while this join was blocked: the quorum
+      // it waits for will never form HERE — unblock the caller with the
+      // redirect so it rejoins at the new leader.  This is what "an
+      // expired-lease leader stops answering Quorum authoritatively"
+      // means for handlers already in flight.
+      *err = NotLeaderErrLocked();
+      return Status::kUnavailable;
+    }
     if (evicted_.count(id)) {
       // Evicted while blocked here: abort instead of re-registering (the
       // re-register below would resurrect a corpse the supervisor already
@@ -594,7 +911,7 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     int64_t gen = quorum_gen_;
     bool woke = quorum_cv_.wait_until(lk, deadline.at, [&] {
       return quorum_gen_ != gen || shutdown_ || evicted_.count(id) > 0 ||
-             state_.draining.count(id) > 0;
+             state_.draining.count(id) > 0 || !IsLeaderLocked();
     });
     if (shutdown_) {
       *err = "lighthouse shutting down";
@@ -619,6 +936,15 @@ void Lighthouse::TickLoop() {
 }
 
 void Lighthouse::TickLocked() {
+  // HA: only the live lease holder runs the quorum machine.  A follower's
+  // tick would otherwise form quorums from its replicated view — the exact
+  // split brain the role exists to prevent.  The wakeup covers the lease
+  // LAPSING between SetRole calls (a stalled renewal thread): blocked
+  // quorum joins must notice within a tick, not at their deadlines.
+  if (!IsLeaderLocked()) {
+    quorum_cv_.notify_all();
+    return;
+  }
   // Log healthy<->stale transitions: when a replica is declared dead (or
   // comes back) the operator must be able to see it and its heartbeat age.
   auto tick_now = Clock::now();
@@ -1011,6 +1337,15 @@ std::string Lighthouse::MetricsText() {
   auto gauge = [&](const char* name, const char* help) {
     o << "# HELP " << name << " " << help << "\n# TYPE " << name << " gauge\n";
   };
+  // HA role first: scraped per instance (never redirected), this is the
+  // gauge an operator alerts on — sum(tpuft_lighthouse_role) over the
+  // replica set must be exactly 1.
+  gauge("tpuft_lighthouse_role",
+        "this lighthouse's role: 1 leader (live lease), 0 follower");
+  o << "tpuft_lighthouse_role " << (IsLeaderLocked() ? 1 : 0) << "\n";
+  gauge("tpuft_lighthouse_leader_epoch",
+        "lease epoch of the current leadership (bumps on every takeover)");
+  o << "tpuft_lighthouse_leader_epoch " << leader_epoch_ << "\n";
   gauge("tpuft_quorum_size", "participants in the current quorum");
   o << "tpuft_quorum_size "
     << (state_.prev_quorum ? state_.prev_quorum->participants_size() : 0) << "\n";
@@ -1135,8 +1470,16 @@ std::string Lighthouse::AlertsJson() {
 std::string Lighthouse::StatusJson() {
   LighthouseStatusResponse s;
   FillStatus(&s);
+  std::string role;
+  int64_t epoch;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    role = IsLeaderLocked() ? "leader" : "follower";
+    epoch = leader_epoch_;
+  }
   std::ostringstream o;
-  o << "{\"quorum_id\":" << s.quorum_id() << ",\"participants\":[";
+  o << "{\"role\":\"" << role << "\",\"leader_epoch\":" << epoch
+    << ",\"quorum_id\":" << s.quorum_id() << ",\"participants\":[";
   bool first = true;
   for (const auto& m : s.prev_quorum().participants()) {
     if (!first) o << ",";

@@ -155,6 +155,39 @@ class Lighthouse {
   // JSON alert feed: {"active": N, "alerts": [...]} — newest last.
   std::string AlertsJson();
 
+  // -- HA role (docs/wire.md "HA lighthouse") -----------------------------
+  // A standalone lighthouse is a permanent leader (the default — existing
+  // single-instance deployments are unchanged).  Under the HA election
+  // (torchft_tpu/ha), the election driver flips the role here on every
+  // lease transition:
+  //   - leader: serve authoritatively, but ONLY while the lease is valid —
+  //     lease_expires_ms is the serve-time guard: once it passes without a
+  //     renewal, HandleQuorum/HandleHeartbeat refuse with "not the leader"
+  //     (an expired-lease leader must stop answering Quorum before a rival
+  //     can win the lease), and blocked quorum joins are woken to abort;
+  //   - follower: every mutating method (Quorum/Heartbeat/Evict/Drain) is
+  //     refused with "not the leader; leader=<addr> ..." so clients
+  //     redirect instead of split-braining; HTTP redirects with 307.
+  // leader_addr/leader_http name the CURRENT leader (self when leader),
+  // epoch is the lease epoch (fencing token for replication pushes).
+  void SetRole(bool leader, const std::string& leader_addr,
+               const std::string& leader_http, int64_t epoch,
+               int64_t lease_expires_ms);
+  // 1 leader (with a live lease), 0 otherwise.
+  int Role();
+  int64_t LeaderEpoch();
+
+  // Serializes the full replicable state (membership, health, alerts,
+  // prev quorum) as a LighthouseReplicateRequest — what the HA election
+  // driver pushes to the standbys every replication tick.
+  std::string SnapshotState();
+  // Ingests a replication push (wire method 6 body).  Returns false (and
+  // fills the response's applied=false) when this replica holds a HIGHER
+  // epoch than the sender — the sender is a deposed leader.
+  Status HandleReplicate(const LighthouseReplicateRequest& req,
+                         LighthouseReplicateResponse* resp);
+  void FillLeaderInfo(LighthouseLeaderInfoResponse* resp);
+
  private:
   Status Dispatch(uint16_t method, const std::string& req, Deadline deadline, std::string* resp);
   // True when an ops-endpoint request may mutate state (docs/wire.md
@@ -263,6 +296,19 @@ class Lighthouse {
   int64_t straggler_grace_ = 5;
   bool straggler_auto_drain_ = false;
   int64_t straggler_warmup_ = 10;
+
+  // HA role state (SetRole).  Default: standalone permanent leader with no
+  // lease (lease_expires_ms_ == 0 disables the serve-time expiry guard).
+  bool role_leader_ = true;
+  std::string leader_addr_;
+  std::string leader_http_;
+  int64_t leader_epoch_ = 0;
+  int64_t lease_expires_ms_ = 0;
+  // True when this instance may answer authoritatively RIGHT NOW: leader
+  // role AND (no lease configured OR lease unexpired).  Caller holds mu_.
+  bool IsLeaderLocked() const;
+  // The standby-rejection message (kNotLeaderPrefix contract, wire.h).
+  std::string NotLeaderErrLocked() const;
 
   std::thread tick_thread_;
   bool shutdown_ = false;

@@ -148,8 +148,17 @@ bool ManagerServer::Start(std::string* err) {
         return Dispatch(method, req, dl, resp);
       });
   if (!server_->Start(err)) return false;
-  heartbeat_client_ = std::make_unique<RpcClient>(opt_.lighthouse_addr);
-  quorum_client_ = std::make_unique<RpcClient>(opt_.lighthouse_addr);
+  heartbeat_client_ = std::make_unique<FailoverRpcClient>(opt_.lighthouse_addr);
+  quorum_client_ = std::make_unique<FailoverRpcClient>(opt_.lighthouse_addr);
+  // Startup reachability probe: with EVERY lighthouse address dead (typo'd
+  // TPUFT_LIGHTHOUSE, lighthouse not started), fail construction with an
+  // actionable error within the connect timeout — without this, the first
+  // quorum call sat in the retry loop for its full deadline and a train
+  // loop with a long quorum_timeout looked simply hung.
+  if (quorum_client_->Connect(opt_.connect_timeout_ms, err) != Status::kOk) {
+    server_->Shutdown();
+    return false;
+  }
   hb_thread_ = std::thread([this] { HeartbeatLoop(); });
   LOGI("manager %s listening on %s (lighthouse %s)", opt_.replica_id.c_str(),
        server_->address().c_str(), opt_.lighthouse_addr.c_str());

@@ -23,6 +23,9 @@ namespace tpuft {
 
 struct ManagerOpt {
   std::string replica_id;
+  // Lighthouse RPC address, or a comma-separated list of them (HA replica
+  // set, docs/wire.md "HA lighthouse"): calls fail over across the list
+  // and follow "not the leader" redirects (FailoverRpcClient, wire.h).
   std::string lighthouse_addr;
   std::string bind = "[::]:0";
   // The group's rendezvous store address, advertised in the quorum member.
@@ -84,8 +87,10 @@ class ManagerServer {
 
   ManagerOpt opt_;
   std::unique_ptr<RpcServer> server_;
-  std::unique_ptr<RpcClient> heartbeat_client_;
-  std::unique_ptr<RpcClient> quorum_client_;
+  // Separate failover clients so a slow quorum call cannot head-of-line
+  // block the heartbeat cadence (and vice versa).
+  std::unique_ptr<FailoverRpcClient> heartbeat_client_;
+  std::unique_ptr<FailoverRpcClient> quorum_client_;
 
   std::mutex mu_;
   std::condition_variable cv_;

@@ -1,7 +1,15 @@
-// Exponential backoff with jitter, bounded by an overall deadline.
-// Reference parity: retry_backoff / ExponentialBackoff, src/retry.rs:6-41.
+// Exponential backoff with DECORRELATED jitter, bounded by an overall
+// deadline.  Reference parity: retry_backoff / ExponentialBackoff,
+// src/retry.rs:6-41 — extended with the decorrelated-jitter scheme
+// (sleep_{k+1} = uniform(initial, 3 * sleep_k), capped): when N replica
+// groups lose the same lighthouse at the same instant (a leader SIGKILL),
+// plain exponential backoff keeps their retries phase-locked and every
+// round slams the new leader simultaneously; decorrelating the sleeps
+// spreads the reconnect wave across the whole interval.  The Python
+// analogue is torchft_tpu/ha/backoff.py — keep the algorithms in sync.
 #pragma once
 
+#include <algorithm>
 #include <chrono>
 #include <cstdint>
 #include <random>
@@ -15,28 +23,50 @@ class ExponentialBackoff {
  public:
   ExponentialBackoff(uint64_t initial_ms = 100, double multiplier = 1.5,
                      uint64_t max_ms = 10000, uint64_t jitter_ms = 100)
-      : next_ms_(initial_ms), multiplier_(multiplier), max_ms_(max_ms), jitter_ms_(jitter_ms) {}
+      : initial_ms_(initial_ms ? initial_ms : 1),
+        prev_ms_(initial_ms ? initial_ms : 1),
+        next_ms_(initial_ms ? initial_ms : 1),
+        multiplier_(multiplier),
+        max_ms_(max_ms),
+        jitter_(jitter_ms > 0) {}
+
+  // Computes the next decorrelated sleep without sleeping (for callers
+  // that wait on a condition variable instead of a bare sleep).
+  uint64_t NextSleepMs() {
+    uint64_t sleep_ms;
+    if (jitter_) {
+      // Decorrelated jitter: uniform in [initial, 3 * previous sleep].
+      uint64_t hi = std::max<uint64_t>(initial_ms_ + 1, prev_ms_ * 3);
+      sleep_ms = initial_ms_ + rng_() % (hi - initial_ms_);
+    } else {
+      // Jitter disabled: plain bounded exponential (deterministic tests).
+      sleep_ms = next_ms_;
+    }
+    sleep_ms = std::min(sleep_ms, max_ms_);
+    prev_ms_ = std::max<uint64_t>(1, sleep_ms);
+    next_ms_ = std::min<uint64_t>(max_ms_, static_cast<uint64_t>(next_ms_ * multiplier_));
+    return sleep_ms;
+  }
 
   // Sleeps for the next backoff interval unless the deadline would be crossed.
   // Returns false when the deadline has fewer ms left than the sleep needs.
   template <typename DeadlineT>
   bool Sleep(const DeadlineT& deadline) {
-    uint64_t jitter = jitter_ms_ ? (rng_() % jitter_ms_) : 0;
-    uint64_t sleep_ms = next_ms_ + jitter;
+    uint64_t sleep_ms = NextSleepMs();
     if (static_cast<int64_t>(sleep_ms) >= deadline.remaining_ms()) return false;
     std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
-    next_ms_ = static_cast<uint64_t>(next_ms_ * multiplier_);
-    if (next_ms_ > max_ms_) next_ms_ = max_ms_;
     return true;
   }
 
   uint64_t next_ms() const { return next_ms_; }
 
  private:
+  uint64_t initial_ms_;
+  uint64_t prev_ms_;
   uint64_t next_ms_;
   double multiplier_;
   uint64_t max_ms_;
-  uint64_t jitter_ms_;
+  bool jitter_;
   std::minstd_rand rng_{std::random_device{}()};
 };
 

@@ -396,6 +396,192 @@ int DialTcp(const std::string& addr, uint64_t timeout_ms, std::string* err) {
   return fd;
 }
 
+// ---------------------------------------------------------------------------
+// Failover client (HA lighthouse)
+// ---------------------------------------------------------------------------
+
+const char kNotLeaderPrefix[] = "not the leader";
+
+bool ParseNotLeader(const std::string& err, std::string* leader_addr) {
+  if (err.rfind(kNotLeaderPrefix, 0) != 0) return false;
+  if (leader_addr) {
+    leader_addr->clear();
+    auto pos = err.find("leader=");
+    if (pos != std::string::npos) {
+      pos += 7;
+      auto end = err.find(' ', pos);
+      *leader_addr = err.substr(pos, end == std::string::npos ? std::string::npos
+                                                              : end - pos);
+    }
+  }
+  return true;
+}
+
+std::vector<std::string> SplitAddressList(const std::string& addrs) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (start <= addrs.size()) {
+    size_t comma = addrs.find(',', start);
+    std::string part = addrs.substr(
+        start, comma == std::string::npos ? std::string::npos : comma - start);
+    // Trim surrounding whitespace.
+    size_t b = part.find_first_not_of(" \t");
+    size_t e = part.find_last_not_of(" \t");
+    if (b != std::string::npos) out.push_back(part.substr(b, e - b + 1));
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return out;
+}
+
+FailoverRpcClient::FailoverRpcClient(const std::string& addrs)
+    : addrs_(SplitAddressList(addrs)) {}
+
+FailoverRpcClient::~FailoverRpcClient() { Close(); }
+
+void FailoverRpcClient::Close() {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& [addr, c] : clients_) c->Close();
+  clients_.clear();
+}
+
+std::string FailoverRpcClient::current() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!leader_override_.empty()) return leader_override_;
+  return addrs_.empty() ? "" : addrs_[cur_ % addrs_.size()];
+}
+
+RpcClient* FailoverRpcClient::ClientForLocked(const std::string& addr) {
+  auto it = clients_.find(addr);
+  if (it == clients_.end()) {
+    it = clients_.emplace(addr, std::make_unique<RpcClient>(addr)).first;
+  }
+  return it->second.get();
+}
+
+Status FailoverRpcClient::Connect(uint64_t connect_timeout_ms, std::string* err) {
+  if (addrs_.empty()) {
+    if (err) *err = "no lighthouse address configured";
+    return Status::kInvalidArgument;
+  }
+  Deadline dl = Deadline::FromMillis(connect_timeout_ms);
+  ExponentialBackoff backoff(50, 1.5, 1000);
+  std::string last_err;
+  do {
+    for (const auto& addr : addrs_) {
+      // Short per-address budget so one black-holing address cannot eat
+      // the whole window before its siblings are ever tried.
+      uint64_t per = std::max<uint64_t>(
+          250, std::min<int64_t>(dl.remaining_ms(),
+                                 static_cast<int64_t>(connect_timeout_ms /
+                                                      (2 * addrs_.size()) + 1)));
+      int fd = DialTcp(addr, per, &last_err);
+      if (fd >= 0) {
+        close(fd);  // reachability probe only; Call() dials its own
+        return Status::kOk;
+      }
+      if (dl.expired()) break;
+    }
+  } while (backoff.Sleep(dl));
+  if (err) {
+    std::string joined;
+    for (const auto& a : addrs_) {
+      if (!joined.empty()) joined += ", ";
+      joined += a;
+    }
+    *err = "no lighthouse reachable at any of [" + joined + "] within " +
+           std::to_string(connect_timeout_ms) +
+           " ms — check TPUFT_LIGHTHOUSE and that the lighthouse processes "
+           "are running (last error: " + last_err + ")";
+  }
+  return Status::kDeadlineExceeded;
+}
+
+Status FailoverRpcClient::Call(uint16_t method, const std::string& req,
+                               uint64_t timeout_ms, std::string* resp,
+                               std::string* err) {
+  if (addrs_.empty()) {
+    if (err) *err = "no lighthouse address configured";
+    return Status::kInvalidArgument;
+  }
+  Deadline dl = Deadline::FromMillis(timeout_ms);
+  // Cap well under a lease period: during a leader election every address
+  // answers "no leader yet", and a sleep that outgrows the election itself
+  // (not the rejection round-trips) becomes the failover latency floor.
+  // 500 ms of decorrelated jitter still smears an N-group stampede.
+  ExponentialBackoff backoff(50, 1.5, 500);
+  Status last = Status::kUnavailable;
+  std::string last_err;
+  bool first_attempt = true;
+  int attempts = 0;
+  // With no deadline a redirect ping-pong (two confused followers naming
+  // each other) must still terminate: bound the sweep instead.
+  const int max_attempts_no_deadline = static_cast<int>(2 * addrs_.size() + 4);
+  while (first_attempt || !dl.expired()) {
+    first_attempt = false;
+    if (timeout_ms == 0 && ++attempts > max_attempts_no_deadline) break;
+    std::string target;
+    RpcClient* client;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      target = !leader_override_.empty() ? leader_override_
+                                         : addrs_[cur_ % addrs_.size()];
+      client = ClientForLocked(target);
+    }
+    uint64_t attempt_ms = timeout_ms;
+    if (timeout_ms > 0) {
+      int64_t left = dl.remaining_ms();
+      if (left <= 0) break;
+      attempt_ms = static_cast<uint64_t>(left);
+    }
+    std::string e;
+    Status st = client->Call(method, req, attempt_ms, resp, &e);
+    if (st == Status::kOk) return st;
+    last = st;
+    last_err = e;
+    std::string leader;
+    if (st == Status::kUnavailable && ParseNotLeader(e, &leader)) {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!leader.empty() && leader != target) {
+        // Redirect: jump straight to the named leader (no backoff — the
+        // rejection itself proves the service is up and answering).
+        leader_override_ = leader;
+        continue;
+      }
+      // A standby that knows no leader yet (election in progress), or the
+      // named leader is the one that just rejected us: rotate + back off.
+      leader_override_.clear();
+      cur_ = (cur_ + 1) % addrs_.size();
+    } else if (st == Status::kUnavailable) {
+      // Transport-level failure: rotate to the next address.
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!leader_override_.empty()) {
+        leader_override_.clear();  // the learned leader died; re-discover
+      } else {
+        cur_ = (cur_ + 1) % addrs_.size();
+      }
+    } else {
+      // Application-level statuses (ABORTED "is draining", NOT_FOUND,
+      // DEADLINE_EXCEEDED from the server, ...) are not failover events.
+      if (err) *err = e;
+      return st;
+    }
+    if (timeout_ms == 0) {
+      // No deadline given: a single failover sweep, not an infinite loop.
+      bool wrapped;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        wrapped = cur_ == 0 && leader_override_.empty();
+      }
+      if (wrapped) break;
+      continue;
+    }
+    if (!backoff.Sleep(dl)) break;
+  }
+  if (err) *err = last_err.empty() ? StatusName(last) : last_err;
+  return last == Status::kOk ? Status::kUnavailable : last;
+}
+
 RpcClient::~RpcClient() { Close(); }
 
 void RpcClient::Close() {

@@ -71,6 +71,11 @@ enum Method : uint16_t {
   kLighthouseStatus = 3,
   kLighthouseEvict = 4,
   kLighthouseDrain = 5,
+  // HA lighthouse (docs/wire.md "HA lighthouse"): leader->standby state
+  // replication push, and read-only leader discovery answered by every
+  // replica regardless of role.
+  kLighthouseReplicate = 6,
+  kLighthouseLeaderInfo = 7,
   kManagerQuorum = 10,
   kManagerCheckpointMetadata = 11,
   kManagerShouldCommit = 12,
@@ -190,5 +195,66 @@ class RpcClient {
 int DialTcp(const std::string& addr, uint64_t timeout_ms, std::string* err);
 
 std::string StatusName(Status s);
+
+// ---------------------------------------------------------------------------
+// Failover client (HA lighthouse, docs/wire.md)
+// ---------------------------------------------------------------------------
+
+// The standby-rejection contract: a lighthouse that is not the current
+// lease holder answers every mutating method with kUnavailable and an
+// error string starting with this prefix, optionally naming the leader:
+//   "not the leader; leader=<rpc_addr> http=<http_addr> epoch=<N>"
+// (the framed-TCP wire carries status + message only — no structured
+// error payload — so the address rides in the message like the Python
+// Manager's "is draining" contract).  ParseNotLeader extracts the
+// leader's RPC address ("" when unknown / not a redirect).
+extern const char kNotLeaderPrefix[];
+bool ParseNotLeader(const std::string& err, std::string* leader_addr);
+
+// Multi-address RPC client for a replicated service: Call() tries the
+// current address and, on transport failure or an UNAVAILABLE rejection,
+// fails over — a "not the leader; leader=<addr>" rejection jumps straight
+// to the named leader, anything else rotates to the next address — and
+// keeps retrying with decorrelated-jitter backoff until the call deadline
+// expires.  The jitter matters at fleet scale: N replica groups failing
+// over simultaneously must not stampede the new leader with synchronized
+// retries.  One live RpcClient per address is kept for connection reuse.
+// Thread-safe like RpcClient (calls serialize on an internal mutex).
+class FailoverRpcClient {
+ public:
+  // addrs: comma-separated "host:port" list (single address = plain
+  // client with retry).
+  explicit FailoverRpcClient(const std::string& addrs);
+  ~FailoverRpcClient();
+
+  Status Call(uint16_t method, const std::string& req, uint64_t timeout_ms,
+              std::string* resp, std::string* err);
+
+  // Probes reachability: succeeds as soon as ANY address accepts a TCP
+  // connection, fails with an error naming every address once
+  // connect_timeout_ms elapses.  Used at Manager startup so a dead
+  // address list raises a clean, actionable error instead of the first
+  // quorum hanging out its full deadline.
+  Status Connect(uint64_t connect_timeout_ms, std::string* err);
+
+  const std::vector<std::string>& addrs() const { return addrs_; }
+  // Address the last successful (or currently preferred) call targets.
+  std::string current();
+  void Close();
+
+ private:
+  RpcClient* ClientForLocked(const std::string& addr);
+
+  std::vector<std::string> addrs_;
+  std::mutex mu_;
+  size_t cur_ = 0;
+  // Leader learned from a redirect; tried first while set.  May name an
+  // address outside addrs_ (a replica set that moved).
+  std::string leader_override_;
+  std::map<std::string, std::unique_ptr<RpcClient>> clients_;
+};
+
+// Splits a comma-separated address list, trimming blanks.
+std::vector<std::string> SplitAddressList(const std::string& addrs);
 
 }  // namespace tpuft

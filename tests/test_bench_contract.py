@@ -62,6 +62,47 @@ def test_transfer_quick_smoke() -> None:
         assert r["fetch_s"] > 0 and r["fetch_gb_per_s"] > 0
 
 
+def test_ha_quick_smoke() -> None:
+    """bench_ha --quick in-process: 2 HA lighthouse replicas, 2 replica
+    groups, one SIGKILL of the active leader mid-run.  The tier-1 gate on
+    the whole failover arc: quorum formation resumes within one lease
+    period, ZERO failed commits on the healthy groups, /metrics +
+    straggler-sentinel continuity on the new leader at epoch+1, the
+    surviving standby (none in quick mode) never dual-serves, and the
+    takeover lands in the obs stream — control-plane HA regressions fail
+    here instead of only showing up in HA_BENCH.json."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_ha
+    finally:
+        sys.path.pop(0)
+    payload = bench_ha.run_quick()
+    # Schema contract: the keys the full HA_BENCH.json artifact is built
+    # from (bench.py --scenario lighthouse-failover writes the same dict).
+    for key in (
+        "quick", "lighthouses", "groups", "lease_ms", "takeover_s",
+        "leader_epoch_before", "leader_epoch_after", "resume_gap_s",
+        "max_resume_gap_s", "resume_budget_s", "resumed_within_lease",
+        "failed_commits_healthy_groups", "metrics_continuity_ok",
+        "failover_event_seen", "failover_event_epoch", "worker_summaries",
+        "per_group_commits", "standby_roles_after", "ok",
+    ):
+        assert key in payload, f"HA_BENCH schema missing {key}"
+    assert payload["quick"] is True
+    assert payload["takeover_s"] is not None and payload["takeover_s"] > 0
+    assert payload["leader_epoch_after"] == payload["leader_epoch_before"] + 1
+    assert payload["resumed_within_lease"], payload
+    # The headline criterion: no healthy replica group failed a commit
+    # because the control plane failed over.
+    assert payload["failed_commits_healthy_groups"] == 0, payload
+    assert payload["metrics_continuity_ok"], payload
+    assert payload["failover_event_seen"]
+    assert payload["failover_event_epoch"] == payload["leader_epoch_after"]
+    for summary in payload["worker_summaries"]:
+        assert summary["commits"] > 0 and summary["failed"] == 0
+    assert payload["ok"], payload
+
+
 def test_allreduce_quick_smoke() -> None:
     """bench_allreduce --quick in-process: the striped multi-lane ring (1
     vs 2 lanes) and the pipelined-vs-monolithic bucket paths must complete

@@ -58,6 +58,9 @@ MODULES = [
     "torchft_tpu.obs.report",
     "torchft_tpu.obs.trace",
     "torchft_tpu.multihost",
+    "torchft_tpu.ha.lease",
+    "torchft_tpu.ha.replica",
+    "torchft_tpu.ha.backoff",
     "torchft_tpu.launch",
     "torchft_tpu.lighthouse_cli",
     "torchft_tpu.parameter_server",

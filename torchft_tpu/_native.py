@@ -37,6 +37,8 @@ LIGHTHOUSE_HEARTBEAT = 2
 LIGHTHOUSE_STATUS = 3
 LIGHTHOUSE_EVICT = 4
 LIGHTHOUSE_DRAIN = 5
+LIGHTHOUSE_REPLICATE = 6
+LIGHTHOUSE_LEADER_INFO = 7
 MANAGER_QUORUM = 10
 MANAGER_CHECKPOINT_METADATA = 11
 MANAGER_SHOULD_COMMIT = 12
@@ -98,6 +100,23 @@ def _load_lib() -> ctypes.CDLL:
     lib.tf_lighthouse_evict.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.tf_lighthouse_drain.restype = ctypes.c_int
     lib.tf_lighthouse_drain.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.tf_lighthouse_set_role.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.tf_lighthouse_role.restype = ctypes.c_int
+    lib.tf_lighthouse_role.argtypes = [ctypes.c_void_p]
+    lib.tf_lighthouse_leader_epoch.restype = ctypes.c_int64
+    lib.tf_lighthouse_leader_epoch.argtypes = [ctypes.c_void_p]
+    lib.tf_lighthouse_snapshot.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
     lib.tf_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_lighthouse_free.argtypes = [ctypes.c_void_p]
     lib.tf_manager_new.restype = ctypes.c_void_p
@@ -170,9 +189,38 @@ def _take_error(err: "ctypes.c_char_p") -> str:
 
 
 def _raise_for_status(status: int, msg: str) -> None:
+    exc: Exception
     if status in (_CANCELLED, _DEADLINE_EXCEEDED):
-        raise TimeoutError(msg)
-    raise RuntimeError(msg)
+        exc = TimeoutError(msg)
+    else:
+        exc = RuntimeError(msg)
+    # The wire status rides on the exception so failover-aware callers can
+    # distinguish UNAVAILABLE (retry elsewhere) from application errors
+    # like ABORTED "is draining" (final).
+    exc.wire_status = status  # type: ignore[attr-defined]
+    raise exc
+
+
+# Wire status UNAVAILABLE (native/src/wire.h): transport failure or an HA
+# standby's "not the leader" rejection — the two conditions a multi-address
+# client fails over on.
+_UNAVAILABLE = 14
+
+# The HA standby-rejection contract (native/src/wire.h kNotLeaderPrefix):
+# "not the leader; leader=<rpc_addr> http=<http_addr> epoch=<N>".
+NOT_LEADER_PREFIX = "not the leader"
+
+
+def parse_not_leader(msg: str) -> Optional[str]:
+    """Returns the leader RPC address named by a standby rejection, ""
+    when the standby knows no leader yet, or None when ``msg`` is not a
+    not-leader rejection at all."""
+    if not msg.startswith(NOT_LEADER_PREFIX):
+        return None
+    import re
+
+    m = re.search(r"leader=(\S*)", msg)
+    return m.group(1) if m else ""
 
 
 class _Client:
@@ -301,6 +349,54 @@ class LighthouseServer:
             _lib.tf_lighthouse_drain(self._ptr, replica_prefix.encode(), int(deadline_ms))
         )
 
+    def set_role(
+        self,
+        leader: bool,
+        leader_address: str = "",
+        leader_http_address: str = "",
+        epoch: int = 0,
+        lease_expires_ms: int = 0,
+    ) -> None:
+        """HA role control (docs/wire.md "HA lighthouse").  A standalone
+        lighthouse is a permanent leader; under the lease-based election
+        (:mod:`torchft_tpu.ha`) the driver flips the role here on every
+        lease transition.  As leader, ``lease_expires_ms`` (epoch ms) is
+        the serve-time guard — once it passes without a renewed SetRole,
+        Quorum/Heartbeat are refused so an expired-lease leader can never
+        split-brain with the lease's next winner.  As follower, the
+        leader_* fields are what the redirect rejections and HTTP 307s
+        point clients at."""
+        if self._ptr:
+            _lib.tf_lighthouse_set_role(
+                self._ptr,
+                1 if leader else 0,
+                leader_address.encode(),
+                leader_http_address.encode(),
+                int(epoch),
+                int(lease_expires_ms),
+            )
+
+    def role(self) -> int:
+        """1 = leader with a live lease, 0 = follower (or lapsed lease)."""
+        return int(_lib.tf_lighthouse_role(self._ptr)) if self._ptr else 0
+
+    def leader_epoch(self) -> int:
+        return int(_lib.tf_lighthouse_leader_epoch(self._ptr)) if self._ptr else 0
+
+    def snapshot(self) -> bytes:
+        """Serialized ``LighthouseReplicateRequest`` of the full replicable
+        state (membership, live step/state, straggler-sentinel health,
+        alerts, previous quorum + id) — what the HA election driver pushes
+        to each standby over wire method 6."""
+        if not self._ptr:
+            return b""
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        length = ctypes.c_size_t()
+        _lib.tf_lighthouse_snapshot(self._ptr, ctypes.byref(buf), ctypes.byref(length))
+        data = ctypes.string_at(buf, length.value)
+        _lib.tf_free(ctypes.cast(buf, ctypes.c_void_p))
+        return data
+
     def shutdown(self) -> None:
         if self._ptr:
             _lib.tf_lighthouse_shutdown(self._ptr)
@@ -317,10 +413,88 @@ class LighthouseServer:
 
 class LighthouseClient:
     """Direct lighthouse access for tooling and LocalSGD-style algorithms
-    (reference: LighthouseClient, src/lib.rs:475-565)."""
+    (reference: LighthouseClient, src/lib.rs:475-565).
+
+    ``addr`` may be a single ``host:port`` or a comma-separated list (an HA
+    lighthouse replica set, docs/wire.md "HA lighthouse"): every call fails
+    over across the list with decorrelated-jitter backoff, follows a
+    standby's "not the leader; leader=<addr>" redirect straight to the
+    leader, and raises a clean, actionable error naming every address when
+    none is reachable within the connect timeout."""
 
     def __init__(self, addr: str, connect_timeout_ms: int = 10000) -> None:
-        self._client = _Client(addr, connect_timeout_ms)
+        self._addrs = [a.strip() for a in addr.split(",") if a.strip()]
+        if not self._addrs:
+            raise ValueError("empty lighthouse address")
+        self._connect_timeout_ms = connect_timeout_ms
+        self._cur = 0
+        self._leader_override: Optional[str] = None
+        self._clients: dict = {}
+
+    def _client_for(self, addr: str, budget_ms: int) -> _Client:
+        client = self._clients.get(addr)
+        if client is None:
+            # Short per-attempt connect budget so one dead address cannot
+            # eat the whole failover window before its siblings are tried.
+            per = min(2000, max(250, budget_ms))
+            client = _Client(addr, connect_timeout_ms=per)
+            self._clients[addr] = client
+        return client
+
+    def _call_failover(self, method: int, payload: bytes, timeout_ms: int) -> bytes:
+        """One logical RPC against the replica set: try the current (or
+        redirect-learned leader) address; on UNAVAILABLE or a connect
+        failure rotate/follow with decorrelated-jitter backoff until
+        ``timeout_ms`` elapses.  Application-level errors (ABORTED "is
+        draining", NOT_FOUND, server-side DEADLINE_EXCEEDED) are final."""
+        import time as _time
+
+        from torchft_tpu.ha.backoff import DecorrelatedBackoff
+
+        deadline = _time.monotonic() + max(0.05, timeout_ms / 1e3)
+        # Cap under a lease period (mirrors FailoverRpcClient): mid-election
+        # every address rejects, and the sleep — not the rejections — would
+        # otherwise become the failover latency floor.
+        backoff = DecorrelatedBackoff(base_s=0.05, cap_s=0.5)
+        last_exc: Optional[Exception] = None
+        first = True
+        while first or _time.monotonic() < deadline:
+            first = False
+            left_ms = max(250, int((deadline - _time.monotonic()) * 1e3))
+            addr = self._leader_override or self._addrs[self._cur % len(self._addrs)]
+            try:
+                client = self._client_for(addr, min(self._connect_timeout_ms, left_ms))
+                return client.call(method, payload, min(timeout_ms, left_ms))
+            except TimeoutError as e:
+                if getattr(e, "wire_status", None) is not None:
+                    raise  # DEADLINE_EXCEEDED from a live server: final
+                last_exc = e  # connect failure: rotate below
+            except RuntimeError as e:
+                if getattr(e, "wire_status", None) != _UNAVAILABLE:
+                    raise  # application error (e.g. "is draining"): final
+                last_exc = e
+                leader = parse_not_leader(str(e))
+                if leader and leader != addr:
+                    # Redirect: jump straight to the named leader; the
+                    # rejection proves the service is up, skip the backoff.
+                    self._leader_override = leader
+                    continue
+            # Transport failure or a standby that knows no leader: drop a
+            # learned leader (it may have just died) else rotate.
+            if self._leader_override is not None:
+                self._leader_override = None
+            else:
+                self._cur = (self._cur + 1) % len(self._addrs)
+            sleep_s = backoff.next()
+            if _time.monotonic() + sleep_s >= deadline:
+                break
+            _time.sleep(sleep_s)
+        raise TimeoutError(
+            "no lighthouse answered at any of ["
+            + ", ".join(self._addrs)
+            + f"] within {timeout_ms} ms — check TPUFT_LIGHTHOUSE and that "
+            f"the lighthouse processes are running (last error: {last_exc})"
+        )
 
     def quorum(
         self,
@@ -347,7 +521,7 @@ class LighthouseClient:
             m.data = json.dumps(data)
         resp = pb.LighthouseQuorumResponse()
         resp.ParseFromString(
-            self._client.call(LIGHTHOUSE_QUORUM, req.SerializeToString(), timeout_ms)
+            self._call_failover(LIGHTHOUSE_QUORUM, req.SerializeToString(), timeout_ms)
         )
         return resp.quorum
 
@@ -371,7 +545,7 @@ class LighthouseClient:
             step_time_ms_ewma=float(step_time_ms_ewma),
             step_time_ms_last=float(step_time_ms_last),
         )
-        self._client.call(LIGHTHOUSE_HEARTBEAT, req.SerializeToString(), timeout_ms)
+        self._call_failover(LIGHTHOUSE_HEARTBEAT, req.SerializeToString(), timeout_ms)
 
     def evict(self, replica_prefix: str, timeout_ms: int = 5000) -> int:
         """Supervisor-assisted failure notification over the wire (method 4,
@@ -381,7 +555,7 @@ class LighthouseClient:
         req = pb.LighthouseEvictRequest(replica_prefix=replica_prefix)
         resp = pb.LighthouseEvictResponse()
         resp.ParseFromString(
-            self._client.call(LIGHTHOUSE_EVICT, req.SerializeToString(), timeout_ms)
+            self._call_failover(LIGHTHOUSE_EVICT, req.SerializeToString(), timeout_ms)
         )
         return int(resp.evicted)
 
@@ -398,19 +572,45 @@ class LighthouseClient:
         )
         resp = pb.LighthouseDrainResponse()
         resp.ParseFromString(
-            self._client.call(LIGHTHOUSE_DRAIN, req.SerializeToString(), timeout_ms)
+            self._call_failover(LIGHTHOUSE_DRAIN, req.SerializeToString(), timeout_ms)
         )
         return int(resp.drained)
 
     def status(self, timeout_ms: int = 5000) -> "pb.LighthouseStatusResponse":
         resp = pb.LighthouseStatusResponse()
         resp.ParseFromString(
-            self._client.call(LIGHTHOUSE_STATUS, b"", timeout_ms)
+            self._call_failover(LIGHTHOUSE_STATUS, b"", timeout_ms)
+        )
+        return resp
+
+    def leader(self, timeout_ms: int = 5000) -> "pb.LighthouseLeaderInfoResponse":
+        """Leader discovery (wire method 7): who the answering replica
+        believes the leader is, plus its own role (1 leader, 0 follower).
+        Answered by every replica — followers do not redirect this."""
+        resp = pb.LighthouseLeaderInfoResponse()
+        resp.ParseFromString(
+            self._call_failover(LIGHTHOUSE_LEADER_INFO, b"", timeout_ms)
+        )
+        return resp
+
+    def replicate(self, snapshot: bytes, timeout_ms: int = 5000) -> "pb.LighthouseReplicateResponse":
+        """Pushes a ``LighthouseServer.snapshot()`` to the replica this
+        client currently targets (wire method 6).  Used by the HA election
+        driver; applied=False means the receiver holds a higher epoch and
+        the SENDER should demote itself."""
+        resp = pb.LighthouseReplicateResponse()
+        resp.ParseFromString(
+            self._call_failover(LIGHTHOUSE_REPLICATE, snapshot, timeout_ms)
         )
         return resp
 
     def close(self) -> None:
-        self._client.close()
+        for client in self._clients.values():
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._clients.clear()
 
 
 class ManagerServer:

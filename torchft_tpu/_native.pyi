@@ -5,6 +5,12 @@ from typing import Any, Dict, List, Optional
 
 LIGHTHOUSE_QUORUM: int
 LIGHTHOUSE_HEARTBEAT: int
+LIGHTHOUSE_STATUS: int
+LIGHTHOUSE_EVICT: int
+LIGHTHOUSE_DRAIN: int
+LIGHTHOUSE_REPLICATE: int
+LIGHTHOUSE_LEADER_INFO: int
+NOT_LEADER_PREFIX: str
 MANAGER_QUORUM: int
 MANAGER_CHECKPOINT_METADATA: int
 MANAGER_SHOULD_COMMIT: int
@@ -61,7 +67,20 @@ class LighthouseServer:
     def http_address(self) -> str: ...
     def evict(self, replica_prefix: str) -> int: ...
     def drain(self, replica_prefix: str, deadline_ms: int = ...) -> int: ...
+    def set_role(
+        self,
+        leader: bool,
+        leader_address: str = ...,
+        leader_http_address: str = ...,
+        epoch: int = ...,
+        lease_expires_ms: int = ...,
+    ) -> None: ...
+    def role(self) -> int: ...
+    def leader_epoch(self) -> int: ...
+    def snapshot(self) -> bytes: ...
     def shutdown(self) -> None: ...
+
+def parse_not_leader(msg: str) -> Optional[str]: ...
 
 class LighthouseClient:
     def __init__(self, addr: str, connect_timeout_ms: int = ...) -> None: ...
@@ -89,6 +108,9 @@ class LighthouseClient:
     def drain(
         self, replica_prefix: str, deadline_ms: int = ..., timeout_ms: int = ...
     ) -> int: ...
+    def status(self, timeout_ms: int = ...) -> Any: ...  # pb.LighthouseStatusResponse
+    def leader(self, timeout_ms: int = ...) -> Any: ...  # pb.LighthouseLeaderInfoResponse
+    def replicate(self, snapshot: bytes, timeout_ms: int = ...) -> Any: ...
     def close(self) -> None: ...
 
 class ManagerServer:

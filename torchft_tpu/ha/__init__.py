@@ -1,0 +1,41 @@
+"""Highly-available lighthouse: warm standbys behind a lease-based leader.
+
+The lighthouse is the control plane's single point of failure — the
+reference abandoned Raft and accepted a centralized service (PAPER.md §1),
+and tpu-ft inherited that: one SIGKILL froze every quorum until an
+operator intervened.  This package removes the SPOF without reintroducing
+consensus:
+
+- :mod:`~torchft_tpu.ha.lease` — leader election as a lease in a shared
+  file (atomic-rename writes, settle-and-confirm acquisition, serve-time
+  expiry guard in the native server);
+- :mod:`~torchft_tpu.ha.replica` — :class:`HALighthouse`, one replica of
+  the group: native lighthouse + election loop + continuous leader-to-
+  standby state replication (membership, sentinel health, alerts, the
+  previous quorum and its id), so a takeover resumes quorum formation on
+  the fast path with no observability reset;
+- :mod:`~torchft_tpu.ha.backoff` — decorrelated-jitter retry pacing shared
+  by every lighthouse reconnect loop, so N replica groups failing over at
+  the same instant do not stampede the new leader.
+
+Run replicas with the CLI (``python -m torchft_tpu.lighthouse_cli
+--lease-file /shared/lease --peers a:1,b:1 ...``) and point clients at the
+whole set: ``TPUFT_LIGHTHOUSE=host1:29510,host2:29510`` — managers fail
+over and follow redirects automatically.
+"""
+
+from torchft_tpu.ha.backoff import DecorrelatedBackoff
+from torchft_tpu.ha.lease import FileLease, LeaseRecord
+
+__all__ = ["DecorrelatedBackoff", "FileLease", "LeaseRecord", "HALighthouse"]
+
+
+def __getattr__(name: str):
+    # HALighthouse imports _native (which may build the C++ core on first
+    # import); keep that cost out of `import torchft_tpu.ha` for users who
+    # only want the lease/backoff primitives.
+    if name == "HALighthouse":
+        from torchft_tpu.ha.replica import HALighthouse
+
+        return HALighthouse
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,332 @@
+"""One lighthouse replica of a highly-available lighthouse group.
+
+``HALighthouse`` wraps the native :class:`~torchft_tpu._native.LighthouseServer`
+with the two loops that turn N independent processes into one logical
+service:
+
+- **election** — a lease in a shared file (:class:`torchft_tpu.ha.lease.FileLease`):
+  the leader renews at ~lease/3 and pushes the renewed expiry into the
+  native server (whose serve-time guard refuses Quorum/Heartbeat once the
+  expiry passes — a stalled renewal thread cannot leave a zombie leader
+  answering); a follower polls the file and takes over the moment the
+  lease expires, bumping the epoch;
+- **replication** — on every leader tick, the full lighthouse state
+  (membership + live step/state, straggler-sentinel health, alerts,
+  previous quorum + id) is serialized by the native server and pushed to
+  every peer over wire method 6, so the standby that wins the next
+  election resumes with the dead leader's exact view: quorum formation
+  restarts on the fast-quorum path with an UNCHANGED quorum id (managers
+  do not even reconfigure), and /metrics history has no reset.
+
+A follower keeps its native server in the follower role, which answers
+``Quorum``/``Heartbeat`` with ``"not the leader; leader=<addr> ..."`` and
+HTTP with a 307 to the leader — clients (the managers' failover clients)
+redirect instead of split-braining.
+
+Takeovers are visible in the observability stream: when a replica wins an
+election at epoch > 1 it emits a ``lighthouse_failover`` event (with the
+new ``leader_epoch``) through :class:`~torchft_tpu.metrics.MetricsLogger`,
+which ``obs/report.py`` uses to charge the election window like quorum
+wait rather than a worker fault.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Sequence
+
+from torchft_tpu.ha.backoff import DecorrelatedBackoff
+from torchft_tpu.ha.lease import FileLease, LeaseRecord
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["HALighthouse"]
+
+
+class HALighthouse:
+    """One replica of an HA lighthouse group.
+
+    Args:
+        lease_path: shared lease file (same path for every replica).
+        peers: RPC addresses of the OTHER replicas (the replication push
+            targets); entries matching this replica's own address are
+            dropped, so passing the full group list is fine.
+        lease_ms: lease duration — the failover floor (a standby takes
+            over at most one lease period after the leader dies) and the
+            serve-time guard horizon.
+        replicate_interval_ms: leader-to-standby push cadence (default
+            lease/3, the renewal cadence — state on a standby is at most
+            this stale at takeover).
+        bind / http_bind / min_replicas / join_timeout_ms / quorum_tick_ms
+            / heartbeat_timeout_ms: forwarded to the native server.
+        owner_id: stable id in the lease file (defaults to the bound RPC
+            address).
+    """
+
+    def __init__(
+        self,
+        lease_path: str,
+        peers: Sequence[str] = (),
+        lease_ms: int = 2000,
+        bind: str = "127.0.0.1:0",
+        http_bind: str = "127.0.0.1:0",
+        min_replicas: int = 1,
+        join_timeout_ms: int = 60000,
+        quorum_tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+        replicate_interval_ms: Optional[int] = None,
+        owner_id: Optional[str] = None,
+    ) -> None:
+        import os as _os
+
+        from torchft_tpu._native import LighthouseServer
+
+        # A fresh replica must never answer authoritatively before the
+        # election says so: the env flag makes the native server START in
+        # the follower role — before its listeners open — instead of the
+        # standalone-leader default (a set_role(False) after construction
+        # would leave a brief authoritative window while clients are
+        # already hammering every address of the replica set).  Scoped to
+        # this construction: a standalone LighthouseServer built later in
+        # the same process must keep its permanent-leader default.
+        prev_flag = _os.environ.get("TPUFT_HA_START_FOLLOWER")
+        _os.environ["TPUFT_HA_START_FOLLOWER"] = "1"
+        try:
+            self._server = LighthouseServer(
+                bind=bind,
+                min_replicas=min_replicas,
+                join_timeout_ms=join_timeout_ms,
+                quorum_tick_ms=quorum_tick_ms,
+                heartbeat_timeout_ms=heartbeat_timeout_ms,
+                http_bind=http_bind,
+            )
+        finally:
+            if prev_flag is None:
+                _os.environ.pop("TPUFT_HA_START_FOLLOWER", None)
+            else:
+                _os.environ["TPUFT_HA_START_FOLLOWER"] = prev_flag
+        self._addr = self._server.address()
+        self._http = self._server.http_address()
+        # Redundant with the env flag, but keeps the role state coherent
+        # (no known leader yet) for servers built before the flag existed.
+        self._server.set_role(False, "", "", 0, 0)
+        self._owner = owner_id or self._addr
+        self._lease = FileLease(lease_path, lease_ms, self._owner)
+        self._lease_ms = int(lease_ms)
+        self._peers = [p.strip() for p in peers if p.strip() and p.strip() != self._addr]
+        self._replicate_s = (
+            (replicate_interval_ms if replicate_interval_ms else max(50, lease_ms // 3))
+            / 1000.0
+        )
+        self._held: Optional[LeaseRecord] = None
+        # Serializes every (_held, native role) transition: the replication
+        # thread demotes on a higher-epoch fencing response while the
+        # election thread promotes/renews — unsynchronized, a renew landing
+        # just after a fencing demotion would re-promote a deposed leader.
+        self._role_lock = threading.Lock()
+        self._peer_clients: Dict[str, object] = {}
+        self._stop = threading.Event()
+        self._backoff = DecorrelatedBackoff(
+            base_s=max(0.02, lease_ms / 1000.0 / 20.0),
+            cap_s=max(0.1, lease_ms / 1000.0 / 3.0),
+        )
+        from torchft_tpu.metrics import MetricsLogger
+
+        self._metrics = MetricsLogger.from_env(f"lighthouse:{self._owner}")
+        self._thread = threading.Thread(
+            target=self._election_loop, name="tpuft_ha_election", daemon=True
+        )
+        self._thread.start()
+        # Replication runs on its OWN thread: a push to a dead standby
+        # blocks on its connect timeout, and eating that stall inside the
+        # election loop delays the renewal past the lease — the leader then
+        # demotes itself and re-acquires at epoch+1 every cycle, flapping
+        # leadership against a fault that killed no leader.
+        self._repl_thread = threading.Thread(
+            target=self._replicate_loop, name="tpuft_ha_replicate", daemon=True
+        )
+        self._repl_thread.start()
+
+    # -- introspection ------------------------------------------------------
+
+    def address(self) -> str:
+        return self._addr
+
+    def http_address(self) -> str:
+        return self._http
+
+    def role(self) -> str:
+        """"leader" (live lease) or "follower"."""
+        return "leader" if self._server.role() == 1 else "follower"
+
+    def leader_epoch(self) -> int:
+        return self._server.leader_epoch()
+
+    def is_leader(self) -> bool:
+        return self._held is not None
+
+    # -- election -----------------------------------------------------------
+
+    def _election_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._held is not None:
+                    self._leader_tick()
+                    # Renew + replicate at ~lease/3: two missed ticks still
+                    # land a renewal before expiry.
+                    self._stop.wait(self._lease_ms / 1000.0 / 3.0)
+                else:
+                    self._follower_tick()
+            except Exception:  # noqa: BLE001 — the election must outlive
+                # transient I/O errors (lease file on flaky shared storage,
+                # a peer mid-restart); the lease guard bounds the damage.
+                logger.exception("lighthouse %s: election tick failed", self._owner)
+                self._stop.wait(self._backoff.next())
+
+    def _leader_tick(self) -> None:
+        held = self._held
+        if held is None:
+            return  # deposed by the replication thread since the loop check
+        renewed = self._lease.renew(held)
+        if renewed is None:
+            # Stolen or lapsed: demote IMMEDIATELY — the native role flip is
+            # what stops this instance answering Quorum authoritatively.
+            current = self._lease.read()
+            logger.warning(
+                "lighthouse %s: lease lost (now held by %s); demoting",
+                self._owner,
+                current.owner if current else "<nobody>",
+            )
+            self._demote(current)
+            return
+        with self._role_lock:
+            if self._held is None:
+                # Deposed (higher-epoch fencing) while the renew was in
+                # flight: the file may still name us, but a peer serves at
+                # a higher epoch — stay demoted; the follower tick decides.
+                return
+            self._held = renewed
+            self._server.set_role(
+                True, self._addr, self._http, renewed.epoch, renewed.expires_ms
+            )
+
+    def _follower_tick(self) -> None:
+        rec = self._lease.read()
+        now_ms = int(time.time() * 1000)
+        if rec is not None and not rec.expired(now_ms):
+            # Live leader: follow it (feeds the redirect target) and poll
+            # again shortly before the lease could expire.
+            self._server.set_role(
+                False, rec.rpc_address, rec.http_address, rec.epoch, 0
+            )
+            self._backoff.reset()
+            self._stop.wait(
+                min(self._lease_ms / 1000.0 / 4.0, max(0.05, (rec.expires_ms - now_ms) / 1000.0))
+            )
+            return
+        won = self._lease.try_acquire(self._addr, self._http)
+        if won is None:
+            # Lost the race (or raced a fresh renewal): back off with
+            # jitter so rival candidates decorrelate, then re-read.
+            self._stop.wait(self._backoff.next())
+            return
+        with self._role_lock:
+            self._held = won
+            self._server.set_role(
+                True, self._addr, self._http, won.epoch, won.expires_ms
+            )
+        logger.warning(
+            "lighthouse %s: took over leadership (epoch %d)", self._owner, won.epoch
+        )
+        if won.epoch > 1:
+            # Epoch 1 is the group's initial election, not a failover.
+            self._metrics.emit("lighthouse_failover", leader_epoch=won.epoch)
+
+    def _demote(self, current: Optional[LeaseRecord]) -> None:
+        with self._role_lock:
+            self._held = None
+            if current is not None:
+                self._server.set_role(
+                    False, current.rpc_address, current.http_address, current.epoch, 0
+                )
+            else:
+                self._server.set_role(False, "", "", self._server.leader_epoch(), 0)
+
+    # -- replication --------------------------------------------------------
+
+    def _replicate_loop(self) -> None:
+        """Leader pushes on their own thread (see __init__): peer I/O —
+        dead-standby connect timeouts above all — must never delay a lease
+        renewal."""
+        backoff = DecorrelatedBackoff(base_s=0.05, cap_s=self._replicate_s * 4)
+        while not self._stop.is_set():
+            try:
+                if self._held is not None:
+                    self._replicate()
+                self._stop.wait(self._replicate_s)
+            except Exception:  # noqa: BLE001 — same discipline as the
+                # election loop: replication must outlive transient errors.
+                logger.exception("lighthouse %s: replicate tick failed", self._owner)
+                self._stop.wait(backoff.next())
+
+    def _replicate(self) -> None:
+        """One leader push to every peer.  Failures are per-peer and
+        non-fatal (a dead standby rejoins the stream when it restarts); a
+        peer answering with a HIGHER epoch means THIS leader was deposed
+        without noticing — demote on the spot."""
+        if not self._peers:
+            return
+        snapshot = self._server.snapshot()
+        from torchft_tpu import _native as native
+        from torchft_tpu.proto import tpuft_pb2 as pb
+
+        for peer in self._peers:
+            try:
+                client = self._peer_clients.get(peer)
+                if client is None:
+                    client = native._Client(peer, connect_timeout_ms=1000)
+                    self._peer_clients[peer] = client
+                raw = client.call(
+                    native.LIGHTHOUSE_REPLICATE, snapshot, timeout_ms=2000
+                )
+                resp = pb.LighthouseReplicateResponse.FromString(raw)
+                if not resp.applied and self._held is not None:
+                    if resp.leader_epoch > self._held.epoch:
+                        logger.warning(
+                            "lighthouse %s: peer %s holds epoch %d > own %d — "
+                            "deposed; demoting",
+                            self._owner, peer, resp.leader_epoch, self._held.epoch,
+                        )
+                        self._demote(self._lease.read())
+                        return
+            except Exception:  # noqa: BLE001 — dead standby: drop the
+                # cached connection so the next push redials.
+                self._peer_clients.pop(peer, None)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        if self._repl_thread.is_alive():
+            self._repl_thread.join(timeout=5.0)
+        if self._held is not None:
+            # Clean handoff: push the freshest state, then expire the lease
+            # NOW so a standby takes over without waiting it out.
+            try:
+                self._replicate()
+                self._lease.release(self._held)
+            except Exception:  # noqa: BLE001
+                pass
+            self._held = None
+        for client in self._peer_clients.values():
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._peer_clients.clear()
+        self._metrics.close()
+        self._server.shutdown()

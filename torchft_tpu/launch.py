@@ -127,8 +127,12 @@ class Launcher:
         cmd: argv of one replica group (e.g. ``[sys.executable, "train.py"]``).
         num_groups: number of replica groups (``NUM_REPLICA_GROUPS``).
         lighthouse: ``"embed"`` to run the native Lighthouse in-process,
-            an ``"host:port"`` address to use an external one, or None to
-            inherit ``TPUFT_LIGHTHOUSE`` from the environment.
+            an ``"host:port"`` address to use an external one — or a
+            comma-separated list of them (an HA lighthouse replica set,
+            docs/wire.md "HA lighthouse"): the children's managers and
+            this supervisor's evict/drain calls fail over across the list
+            and follow leader redirects — or None to inherit
+            ``TPUFT_LIGHTHOUSE`` from the environment.
         max_restarts: per-group restart budget (None = unlimited), the
             ``--max_restarts`` analogue (torchft/torchx.py:54).
         min_replicas: embedded Lighthouse quorum floor.

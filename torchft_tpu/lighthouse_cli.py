@@ -4,6 +4,17 @@ Reference parity: the ``torchft_lighthouse`` binary (src/bin/lighthouse.rs:11-23
 pyproject.toml:39-40).  Usage::
 
     python -m torchft_tpu.lighthouse_cli --bind [::]:29510 --min_replicas 2
+
+Highly-available mode (docs/architecture.md "HA lighthouse"): run N of
+these, one per host, sharing a lease file on common storage and naming
+each other as peers — a lease-based election keeps exactly one serving
+as leader while the rest are warm standbys receiving continuous state
+replication; clients set ``TPUFT_LIGHTHOUSE`` to the whole comma-separated
+list and fail over automatically::
+
+    python -m torchft_tpu.lighthouse_cli --bind host1:29510 \
+        --http_bind host1:29511 --lease-file /shared/tpuft_lease \
+        --lease-ms 2000 --peers host2:29510,host3:29510
 """
 
 from __future__ import annotations
@@ -16,7 +27,8 @@ import threading
 
 def main(argv=None) -> None:
     """CLI entry: standalone Lighthouse server with the HTML dashboard
-    (reference: torchft_lighthouse, src/bin/lighthouse.rs:11-23)."""
+    (reference: torchft_lighthouse, src/bin/lighthouse.rs:11-23), or one
+    replica of an HA lighthouse group when ``--lease-file`` is given."""
     parser = argparse.ArgumentParser(description="torchft_tpu lighthouse server")
     parser.add_argument("--bind", default="[::]:29510", help="RPC bind address")
     parser.add_argument("--http_bind", default="[::]:29511", help="dashboard bind address")
@@ -25,11 +37,58 @@ def main(argv=None) -> None:
                         help="straggler wait before forming a smaller quorum")
     parser.add_argument("--quorum_tick_ms", type=int, default=100)
     parser.add_argument("--heartbeat_timeout_ms", type=int, default=5000)
+    ha = parser.add_argument_group(
+        "high availability",
+        "run this process as one replica of an HA lighthouse group "
+        "(lease-based leader election + leader->standby state replication)",
+    )
+    ha.add_argument(
+        "--lease-file", default=None,
+        help="shared lease file enabling HA mode (same path on every replica)",
+    )
+    ha.add_argument(
+        "--lease-ms", type=int, default=2000,
+        help="lease duration: the failover floor — a standby takes over at "
+        "most one lease period after the leader dies (default 2000)",
+    )
+    ha.add_argument(
+        "--peers", default="",
+        help="comma-separated RPC addresses of the OTHER replicas (the "
+        "replication push targets); this replica's own address is ignored",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s"
     )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+
+    if args.lease_file:
+        from torchft_tpu.ha.replica import HALighthouse
+
+        server = HALighthouse(
+            lease_path=args.lease_file,
+            peers=[p for p in args.peers.split(",") if p.strip()],
+            lease_ms=args.lease_ms,
+            bind=args.bind,
+            http_bind=args.http_bind,
+            min_replicas=args.min_replicas,
+            join_timeout_ms=args.join_timeout_ms,
+            quorum_tick_ms=args.quorum_tick_ms,
+            heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+        )
+        logging.info(
+            "HA lighthouse replica on %s (dashboard at %s, lease %s, %d peer(s))",
+            server.address(), server.http_address(), args.lease_file,
+            len([p for p in args.peers.split(",") if p.strip()]),
+        )
+        stop.wait()
+        server.shutdown()
+        return
+
     from torchft_tpu._native import LighthouseServer
 
     server = LighthouseServer(
@@ -42,10 +101,6 @@ def main(argv=None) -> None:
     )
     logging.info("lighthouse listening on %s (dashboard at %s)",
                  server.address(), server.http_address())
-
-    stop = threading.Event()
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
     stop.wait()
     server.shutdown()
 

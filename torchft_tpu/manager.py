@@ -145,8 +145,13 @@ class Manager:
         self._fixed_world_size = fixed_world_size
 
         lighthouse_addr = lighthouse_addr or os.environ.get(TPUFT_LIGHTHOUSE_ENV, "")
-        # Kept for the cooperative-drain notice (begin_drain dials the
-        # lighthouse directly with this group's exact incarnation id).
+        # May be a comma-separated HA replica set ("host1:p,host2:p", see
+        # docs/wire.md "HA lighthouse"): the native ManagerServer fails its
+        # quorum/heartbeat calls over across the list and follows "not the
+        # leader" redirects, and every Python-side dial below goes through
+        # the failover-aware LighthouseClient.  Kept for the
+        # cooperative-drain notice (begin_drain dials the lighthouse
+        # directly with this group's exact incarnation id).
         self._lighthouse_addr = lighthouse_addr
 
         self._store_server: Optional[StoreServer] = None
@@ -987,22 +992,47 @@ class Manager:
         # main thread, and the final step must not stall behind a dial.
         if self._rank == 0 and self._lighthouse_addr:
             def _notify() -> None:
-                try:
-                    from torchft_tpu._native import LighthouseClient
+                # Reconnect loop with DECORRELATED jitter: the notice may
+                # land exactly during a lighthouse failover (the two
+                # events correlate — a host being preempted can take the
+                # lighthouse with it), and every draining group in a
+                # preemption wave retries this same call.  Jittered sleeps
+                # keep those retries from stampeding the new leader in
+                # sync; the loop gives up at the drain deadline (less a
+                # grace margin) because a notice that cannot be delivered
+                # degrades to the crash path (heartbeat timeout) — it must
+                # never outlive the process's own exit budget.
+                from torchft_tpu._native import LighthouseClient
+                from torchft_tpu.ha.backoff import DecorrelatedBackoff
 
-                    client = LighthouseClient(
-                        self._lighthouse_addr, connect_timeout_ms=2000
-                    )
-                    client.drain(
-                        self._replica_id,
-                        deadline_ms=notice.deadline_ms_from_now(),
-                        timeout_ms=2000,
-                    )
-                    client.close()
-                except Exception as e:  # noqa: BLE001 — a failed notice
-                    # degrades to the crash path (heartbeat timeout),
-                    # never kills the final step.
-                    self._logger.warn(f"lighthouse drain notice failed: {e}")
+                deadline = time.monotonic() + min(
+                    10.0, max(2.0, notice.remaining_s() - 2.0)
+                )
+                backoff = DecorrelatedBackoff(base_s=0.1, cap_s=1.5)
+                last_err: Optional[Exception] = None
+                while time.monotonic() < deadline:
+                    try:
+                        client = LighthouseClient(
+                            self._lighthouse_addr, connect_timeout_ms=2000
+                        )
+                        try:
+                            client.drain(
+                                self._replica_id,
+                                deadline_ms=notice.deadline_ms_from_now(),
+                                timeout_ms=2000,
+                            )
+                        finally:
+                            client.close()
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        last_err = e
+                        sleep_s = backoff.next()
+                        if time.monotonic() + sleep_s >= deadline:
+                            break
+                        time.sleep(sleep_s)
+                # A failed notice degrades to the crash path (heartbeat
+                # timeout), never kills the final step.
+                self._logger.warn(f"lighthouse drain notice failed: {last_err}")
 
             threading.Thread(
                 target=_notify, name="tpuft_drain_notify", daemon=True

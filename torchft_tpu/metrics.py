@@ -70,10 +70,16 @@ EVENTS = {
              "into the stream so trace export and latency accounting see it",
     "straggler_drain": "launcher sentinel rotated a confirmed straggler out "
                        "through the cooperative-drain path",
+    # -- HA lighthouse (torchft_tpu/ha/replica.py) --------------------------
+    "lighthouse_failover": "a standby lighthouse took over leadership "
+                           "(leader_epoch = the new lease epoch); "
+                           "obs/report.py charges the election window like "
+                           "quorum wait, not like a worker fault",
     # -- fault injection (bench.py) -----------------------------------------
-    "fault": "scripted fault fired (kind=kill|drain|straggler, group=victim) "
-             "— written by the benchmark driver so obs/report.py sees the "
-             "same fault timeline the goodput accounting charges",
+    "fault": "scripted fault fired (kind=kill|drain|straggler|lighthouse, "
+             "group=victim) — written by the benchmark driver so "
+             "obs/report.py sees the same fault timeline the goodput "
+             "accounting charges",
 }
 
 

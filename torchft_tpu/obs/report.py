@@ -41,6 +41,7 @@ __all__ = [
     "read_events",
     "commit_timelines",
     "fault_times",
+    "election_windows",
     "deadwindow",
     "attribute",
     "render",
@@ -136,12 +137,42 @@ def fault_times(events: Sequence[dict]) -> List[Tuple[float, str]]:
     — the victim keeps committing (slowly), so charging its commit gap as
     a dead window would fabricate downtime.  The straggler scenario's own
     accounting (detection latency, post-injection rate) lives in bench.py.
+
+    ``lighthouse`` faults are excluded too: a lighthouse kill is a CONTROL
+    PLANE fault, not a worker death — no replica group's commit timeline
+    belongs to it (charging it here would mark the trial unrecovered
+    against a group that never existed).  Leader-election dead time is
+    instead charged like quorum wait via :func:`election_windows`.
     """
     return [
         (float(ev["ts"]), str(ev.get("group", "")))
         for ev in events
-        if ev.get("event") == "fault" and str(ev.get("kind")) != "straggler"
+        if ev.get("event") == "fault"
+        and str(ev.get("kind")) not in ("straggler", "lighthouse")
     ]
+
+
+def election_windows(events: Sequence[dict]) -> List[Tuple[float, float]]:
+    """[(start_ts, end_ts)] of lighthouse leader elections in the stream:
+    from a scripted lighthouse fault (``fault`` kind="lighthouse") to the
+    next standby takeover (``lighthouse_failover``, emitted by
+    torchft_tpu/ha/replica.py with the new leader epoch).  A fault with no
+    subsequent takeover yields no window (the election never resolved —
+    nothing to bound)."""
+    starts = sorted(
+        float(ev["ts"])
+        for ev in events
+        if ev.get("event") == "fault" and str(ev.get("kind")) == "lighthouse"
+    )
+    takeovers = sorted(
+        float(ev["ts"]) for ev in events if ev.get("event") == "lighthouse_failover"
+    )
+    windows: List[Tuple[float, float]] = []
+    for s in starts:
+        ends = [t for t in takeovers if t >= s]
+        if ends:
+            windows.append((s, ends[0]))
+    return windows
 
 
 def _fault_records(events: Sequence[dict]) -> List[dict]:
@@ -294,6 +325,7 @@ def attribute(events: Sequence[dict]) -> dict:
     faults = fault_times(events)
     dw = deadwindow(commits, faults)
     phase_ms = _phase_ms(events)
+    elections = election_windows(events)
 
     # Per-incarnation commit sequences: (rid, [(ts, t_mono, step)...]).
     per_inc: Dict[str, List[Tuple[float, float, int]]] = {}
@@ -318,6 +350,12 @@ def attribute(events: Sequence[dict]) -> dict:
         # Informational: background snapshot time OVERLAPPED with the steps
         # above — deliberately outside the accounted classification.
         "snapshot_overlap_s": 0.0,
+        # Informational: leader-election time inside step intervals.  Its
+        # charge flows through quorum_wait_s (an election stalls exactly
+        # the quorum path, so it is classified as quorum wait, NOT as a
+        # worker fault's idle time) — this total just makes the election
+        # cost visible on its own line.
+        "election_s": 0.0,
     }
     t0 = dw["t0"]
     for rid, seq in per_inc.items():
@@ -329,6 +367,17 @@ def attribute(events: Sequence[dict]) -> dict:
             wall = max(0.0, mono_b - mono_a)
             phases = phase_ms.get((rid, step), {})
             q = phases.get("quorum", 0.0) / 1e3
+            # Leader-election overlap with this interval is charged like
+            # quorum wait: the quorum span usually measures the stall
+            # already (the blocked quorum RPC IS the election wait), so the
+            # election window acts as a FLOOR on q rather than adding to
+            # it — never double-charged, never read as productive time.
+            election = sum(
+                max(0.0, min(ts_b, e) - max(ts_a, s)) for s, e in elections
+            )
+            election = min(election, wall)
+            if election > q:
+                q = election
             heal = phases.get("heal", 0.0) / 1e3
             skip = ("quorum", "heal") + _OVERLAPPED
             other_ft = (
@@ -362,6 +411,7 @@ def attribute(events: Sequence[dict]) -> dict:
             totals["heal_s"] += heal
             totals["other_ft_s"] += other_ft
             totals["snapshot_overlap_s"] += snapshot_overlap
+            totals["election_s"] += election
 
     # A restarted incarnation's heal span lies BEFORE its first commit, so
     # no commit interval covers it; credit it to the heal class (carved
@@ -452,6 +502,10 @@ def attribute(events: Sequence[dict]) -> dict:
             "span_s": round(dw["span_s"], 3) if dw["span_s"] is not None else None,
             "victims_recovered": dw["victims_recovered"],
             "faults": len(faults),
+            # Control-plane fault visibility: resolved leader elections in
+            # the stream (their time is in totals.election_s, charged as
+            # quorum wait — never as a worker dead window).
+            "lighthouse_elections": len(elections),
         },
     }
 
