@@ -542,7 +542,16 @@ def _run_scenario(
         "JAX_PLATFORMS": None,  # parent may have pinned the TPU platform
         "TPUFT_JAX_PLATFORM": "cpu",  # env alone is overridden by site hooks
         "TPUFT_METRICS_PATH": metrics_path,
+        # Worker managers dump their flight recorders here on clean exit
+        # (drained donors); SIGKILLed victims leave no dump — their story
+        # lives in the LIGHTHOUSE's recorder, which dumps at launcher stop.
+        "TPUFT_FLIGHT_DIR": workdir,
     }
+    # The embedded lighthouse runs in THIS process; it reads the dump path
+    # from the driver's environment at SHUTDOWN, so the var only needs to
+    # be set inside the try below (children get it via child_env) — a
+    # Launcher construction failure then cannot leak it.
+    prev_flight_dir = os.environ.get("TPUFT_FLIGHT_DIR")
     if straggler:
         child_env["TPUFT_STRAGGLE_DIR"] = workdir
     launcher = Launcher(
@@ -625,121 +634,186 @@ def _run_scenario(
             time.sleep(3.0)  # restart delay: the dead window is real
             launcher.spawn(int(victim))
 
-    with launcher:
-        start = time.monotonic()
-        first_kill_at = None if plan is None else (
-            total_window / 3
-            if kind in ("single", "single_spare", "drain", "straggler")
-            else total_window / 4
-        )
-        pre_kill_ids: set = set()
-        second_done = kind in ("single", "single_spare", "drain", "straggler")
-        second_deadline = None
-        last_alert_poll = 0.0
-        tail = _MetricsTail(metrics_path)
-        while time.monotonic() - start < total_window:
-            time.sleep(0.25)
-            if first_kill_at is not None and time.monotonic() - start >= first_kill_at:
-                # Draining a group that never committed (still in its first
-                # JIT) measures nothing: the handoff gap needs a donor
-                # commit timeline on both sides — and a straggler injection
-                # before the first commit has no pre-injection pace to
-                # score against.  Hold the fault until the first commit —
-                # WITHOUT skipping the supervision below (the window clock
-                # keeps running either way).
-                fire_ok = kind not in ("drain", "straggler") or any(
-                    commit is not None
-                    for _, commit in _victim_incarnations(
-                        tail.poll(), victim
-                    ).values()
-                )
-                if straggler and fire_ok:
-                    # The scenario models a host degrading MID-RUN, so the
-                    # injection additionally waits until the victim has
-                    # cleared the sentinel's warmup gate (which exists to
-                    # ignore JIT-phase pace skew) — injecting during warmup
-                    # would measure the gate, not the detection contract.
-                    try:
-                        warmup = max(
-                            0,
-                            int(os.environ.get(
-                                "TPUFT_STRAGGLER_WARMUP_STEPS", "10")),
+    try:
+        os.environ["TPUFT_FLIGHT_DIR"] = workdir
+        with launcher:
+            start = time.monotonic()
+            first_kill_at = None if plan is None else (
+                total_window / 3
+                if kind in ("single", "single_spare", "drain", "straggler")
+                else total_window / 4
+            )
+            pre_kill_ids: set = set()
+            second_done = kind in ("single", "single_spare", "drain", "straggler")
+            second_deadline = None
+            last_alert_poll = 0.0
+            tail = _MetricsTail(metrics_path)
+            while time.monotonic() - start < total_window:
+                time.sleep(0.25)
+                if first_kill_at is not None and time.monotonic() - start >= first_kill_at:
+                    # Draining a group that never committed (still in its first
+                    # JIT) measures nothing: the handoff gap needs a donor
+                    # commit timeline on both sides — and a straggler injection
+                    # before the first commit has no pre-injection pace to
+                    # score against.  Hold the fault until the first commit —
+                    # WITHOUT skipping the supervision below (the window clock
+                    # keeps running either way).
+                    fire_ok = kind not in ("drain", "straggler") or any(
+                        commit is not None
+                        for _, commit in _victim_incarnations(
+                            tail.poll(), victim
+                        ).values()
+                    )
+                    if straggler and fire_ok:
+                        # The scenario models a host degrading MID-RUN, so the
+                        # injection additionally waits until the victim has
+                        # cleared the sentinel's warmup gate (which exists to
+                        # ignore JIT-phase pace skew) — injecting during warmup
+                        # would measure the gate, not the detection contract.
+                        try:
+                            warmup = max(
+                                0,
+                                int(os.environ.get(
+                                    "TPUFT_STRAGGLER_WARMUP_STEPS", "10")),
+                            )
+                        except ValueError:
+                            warmup = 10
+                        n_commits = sum(
+                            1
+                            for ev in tail.poll()
+                            if ev.get("event") == "commit"
+                            and ev.get("committed")
+                            and str(ev.get("replica_id", "")).split(":", 1)[0]
+                            == victim
                         )
-                    except ValueError:
-                        warmup = 10
-                    n_commits = sum(
-                        1
-                        for ev in tail.poll()
-                        if ev.get("event") == "commit"
-                        and ev.get("committed")
-                        and str(ev.get("replica_id", "")).split(":", 1)[0]
-                        == victim
+                        fire_ok = n_commits > warmup
+                    if fire_ok:
+                        pre_kill_ids = set(
+                            _victim_incarnations(tail.poll(), victim)
+                        )
+                        kill_victim()
+                        if not straggler or "inject_ts" in straggle_info:
+                            # A straggler injection can decline to fire (victim
+                            # pid momentarily gone); leave the trigger armed so
+                            # the next tick retries instead of silently running
+                            # a fault-free window.
+                            first_kill_at = None
+                            second_deadline = time.monotonic() + 25.0
+                elif not second_done and kill_events:
+                    # Watch for the respawned incarnation to reach the trigger
+                    # state, with a deadline fallback so a stuck restart can't
+                    # hang the bench.
+                    inc = _victim_incarnations(tail.poll(), victim)
+                    fresh = {k: v for k, v in inc.items() if k not in pre_kill_ids}
+                    fire = False
+                    if kind == "double":
+                        fire = any(commit is not None for _, commit in fresh.values())
+                    elif kind == "during_heal":
+                        fire = bool(fresh)
+                    if fire or (second_deadline and time.monotonic() > second_deadline):
+                        kill_victim()
+                        second_done = True
+                # Straggler scenario: watch the lighthouse's /alerts.json for
+                # the sentinel's detection and stamp it into the stream (the
+                # `alert` record), so detection latency and the trace view come
+                # from the recorded data alone.
+                if (
+                    straggler
+                    and "inject_ts" in straggle_info
+                    and "alert" not in straggle_info
+                    and time.monotonic() - last_alert_poll >= 1.0
+                ):
+                    last_alert_poll = time.monotonic()
+                    alert = _poll_straggler_alert(
+                        launcher.lighthouse_http_address, victim,
+                        after_ts=straggle_info["inject_ts"],
                     )
-                    fire_ok = n_commits > warmup
-                if fire_ok:
-                    pre_kill_ids = set(
-                        _victim_incarnations(tail.poll(), victim)
-                    )
-                    kill_victim()
-                    if not straggler or "inject_ts" in straggle_info:
-                        # A straggler injection can decline to fire (victim
-                        # pid momentarily gone); leave the trigger armed so
-                        # the next tick retries instead of silently running
-                        # a fault-free window.
-                        first_kill_at = None
-                        second_deadline = time.monotonic() + 25.0
-            elif not second_done and kill_events:
-                # Watch for the respawned incarnation to reach the trigger
-                # state, with a deadline fallback so a stuck restart can't
-                # hang the bench.
-                inc = _victim_incarnations(tail.poll(), victim)
-                fresh = {k: v for k, v in inc.items() if k not in pre_kill_ids}
-                fire = False
-                if kind == "double":
-                    fire = any(commit is not None for _, commit in fresh.values())
-                elif kind == "during_heal":
-                    fire = bool(fresh)
-                if fire or (second_deadline and time.monotonic() > second_deadline):
-                    kill_victim()
-                    second_done = True
-            # Straggler scenario: watch the lighthouse's /alerts.json for
-            # the sentinel's detection and stamp it into the stream (the
-            # `alert` record), so detection latency and the trace view come
-            # from the recorded data alone.
-            if (
-                straggler
-                and "inject_ts" in straggle_info
-                and "alert" not in straggle_info
-                and time.monotonic() - last_alert_poll >= 1.0
-            ):
-                last_alert_poll = time.monotonic()
-                alert = _poll_straggler_alert(
-                    launcher.lighthouse_http_address, victim,
-                    after_ts=straggle_info["inject_ts"],
-                )
-                if alert is not None:
-                    straggle_info["alert"] = alert
-                    fault_log.emit(
-                        "alert",
-                        group=victim,
-                        alert_id=alert.get("id"),
-                        kind=alert.get("kind"),
-                        replica_id=alert.get("replica_id"),
-                        raised_ms=alert.get("raised_ms"),
-                        ratio=alert.get("ratio"),
-                        step_time_ms=alert.get("step_time_ms"),
-                        auto_drained=alert.get("auto_drained"),
-                    )
-            # Supervisor: restart any group that died for other reasons.
-            launcher.supervise_once()
+                    if alert is not None:
+                        straggle_info["alert"] = alert
+                        fault_log.emit(
+                            "alert",
+                            group=victim,
+                            alert_id=alert.get("id"),
+                            kind=alert.get("kind"),
+                            replica_id=alert.get("replica_id"),
+                            raised_ms=alert.get("raised_ms"),
+                            ratio=alert.get("ratio"),
+                            step_time_ms=alert.get("step_time_ms"),
+                            auto_drained=alert.get("auto_drained"),
+                        )
+                # Supervisor: restart any group that died for other reasons.
+                launcher.supervise_once()
 
-    fault_log.close()
+    finally:
+        fault_log.close()
+        # Env restore runs on EVERY exit path (a spawn failure or ^C must
+        # not leave the driver pointing dumps at a dead temp workdir).
+        if prev_flight_dir is None:
+            os.environ.pop("TPUFT_FLIGHT_DIR", None)
+        else:
+            os.environ["TPUFT_FLIGHT_DIR"] = prev_flight_dir
     stats = _scenario_stats(workdir, metrics_path, kill_events, plan)
+    stats["flight"] = _flight_stats(workdir, assert_dump=bool(kill_events))
     if straggler:
         stats["straggler"] = _straggler_stats(
             metrics_path, straggle_info, victim, plan
         )
     return stats
+
+
+def _flight_stats(workdir: str, assert_dump: bool) -> dict:
+    """Flight-recorder dump inventory for one scenario workdir.
+
+    Kill trials ASSERT the black box: the embedded lighthouse must have
+    dumped at launcher stop, the dump must parse, and the quorum-transition
+    sequence around the SIGKILL must be reconstructable from it — the
+    post-mortem contract ISSUE 7's acceptance pins.  Fault-free baselines
+    report whatever dumped without asserting (a baseline window forms ONE
+    quorum whose membership never changes, which is still >= 1 transition).
+    """
+    import glob as _glob
+
+    from torchft_tpu.obs import flight as obs_flight
+
+    paths = sorted(
+        _glob.glob(os.path.join(workdir, "flight_*.json"))
+    )
+    lighthouse_paths = [p for p in paths if "lighthouse" in os.path.basename(p)]
+    if assert_dump:
+        assert lighthouse_paths, (
+            f"kill trial left no lighthouse flight-recorder dump in {workdir} "
+            "(TPUFT_FLIGHT_DIR contract broken)"
+        )
+    out: dict = {"paths": paths, "dumps": []}
+    for path in paths:
+        try:
+            dump = obs_flight.load_flight_dump(path)
+        except (OSError, ValueError) as e:
+            if assert_dump and path in lighthouse_paths:
+                raise AssertionError(f"flight dump {path} unparseable: {e}")
+            out["dumps"].append({"path": path, "ok": False})
+            continue
+        events = obs_flight.flight_events(dump)
+        transitions = obs_flight.quorum_transitions(events)
+        out["dumps"].append(
+            {
+                "path": path,
+                "ok": True,
+                "server": dump.get("server"),
+                "recorded": dump.get("recorded"),
+                "events": len(events),
+                "quorum_transitions": len(transitions),
+            }
+        )
+        if "lighthouse" in os.path.basename(path):
+            out["lighthouse_dump"] = path
+            out["quorum_transitions"] = transitions[-8:]
+    if assert_dump:
+        assert out.get("quorum_transitions"), (
+            "lighthouse flight dump holds no quorum_formed transitions — "
+            "cannot reconstruct the kill post-mortem"
+        )
+    return out
 
 
 def _poll_straggler_alert(http_address: str, victim: str, after_ts: float = 0.0):

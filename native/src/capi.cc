@@ -100,6 +100,12 @@ void tf_lighthouse_snapshot(void* p, uint8_t** buf, size_t* len) {
   *len = s.size();
 }
 
+// Flight-recorder snapshot (newest-first JSON document; limit 0 = all
+// retained events).  Same payload as GET /debug/flight.json.
+char* tf_lighthouse_flight_json(void* p, uint64_t limit) {
+  return CopyString(static_cast<Lighthouse*>(p)->FlightJson(limit));
+}
+
 void tf_lighthouse_shutdown(void* p) { static_cast<Lighthouse*>(p)->Shutdown(); }
 
 void tf_lighthouse_free(void* p) { delete static_cast<Lighthouse*>(p); }
@@ -137,6 +143,12 @@ void tf_manager_set_status(void* p, int64_t step, const char* state,
   static_cast<ManagerServer*>(p)->SetStatus(step, state ? state : "",
                                             step_time_ms_ewma, step_time_ms_last,
                                             allreduce_gb_per_s);
+}
+
+// Manager-side flight recorder (no HTTP server on managers — this is the
+// only live read path besides the shutdown dump).
+char* tf_manager_flight_json(void* p, uint64_t limit) {
+  return CopyString(static_cast<ManagerServer*>(p)->FlightJson(limit));
 }
 
 void tf_manager_shutdown(void* p) { static_cast<ManagerServer*>(p)->Shutdown(); }

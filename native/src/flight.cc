@@ -1,0 +1,236 @@
+#include "flight.h"
+
+#include <cctype>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <sstream>
+
+namespace tpuft {
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+namespace {
+
+int64_t EpochMsNow() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t MonoUsNow() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void AppendEventJson(std::ostringstream& o, const FlightEvent& ev) {
+  o << "{\"seq\":" << ev.seq << ",\"ts_ms\":" << ev.ts_ms
+    << ",\"mono_us\":" << ev.mono_us << ",\"kind\":\"" << JsonEscape(ev.kind)
+    << "\"";
+  if (!ev.method.empty()) o << ",\"method\":\"" << JsonEscape(ev.method) << "\"";
+  if (!ev.peer.empty()) o << ",\"peer\":\"" << JsonEscape(ev.peer) << "\"";
+  if (ev.kind == kFlightRpc) {
+    o << ",\"status\":" << ev.status << ",\"dur_us\":" << ev.dur_us;
+  }
+  if (!ev.trace_id.empty()) {
+    o << ",\"trace_id\":\"" << JsonEscape(ev.trace_id) << "\"";
+  }
+  if (!ev.detail.empty()) {
+    o << ",\"detail\":\"" << JsonEscape(ev.detail) << "\"";
+  }
+  o << "}";
+}
+
+}  // namespace
+
+FlightRecorder::FlightRecorder(size_t capacity)
+    : capacity_(capacity == 0 ? 1 : capacity) {
+  ring_.resize(capacity_);
+}
+
+void FlightRecorder::SetIdentity(const std::string& server, const std::string& id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  server_ = server;
+  id_ = id;
+}
+
+void FlightRecorder::Record(FlightEvent ev) {
+  ev.ts_ms = EpochMsNow();
+  ev.mono_us = MonoUsNow();
+  std::lock_guard<std::mutex> lk(mu_);
+  ev.seq = ++seq_;
+  ring_[next_] = std::move(ev);
+  next_ = (next_ + 1) % capacity_;
+}
+
+void FlightRecorder::RecordEvent(const char* kind, std::string detail,
+                                 std::string trace_id) {
+  FlightEvent ev;
+  ev.kind = kind;
+  ev.detail = std::move(detail);
+  ev.trace_id = std::move(trace_id);
+  Record(std::move(ev));
+}
+
+void FlightRecorder::RecordRpc(const char* method, std::string peer,
+                               uint16_t status, int64_t dur_us,
+                               std::string trace_id) {
+  FlightEvent ev;
+  ev.kind = kFlightRpc;
+  ev.method = method;
+  ev.peer = std::move(peer);
+  ev.status = status;
+  ev.dur_us = dur_us;
+  ev.trace_id = std::move(trace_id);
+  Record(std::move(ev));
+}
+
+int64_t FlightRecorder::recorded() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return seq_;
+}
+
+std::string FlightRecorder::Json(size_t limit) const {
+  std::ostringstream o;
+  std::lock_guard<std::mutex> lk(mu_);
+  size_t retained = seq_ < static_cast<int64_t>(capacity_)
+                        ? static_cast<size_t>(seq_)
+                        : capacity_;
+  size_t emit = (limit == 0 || limit > retained) ? retained : limit;
+  o << "{\"server\":\"" << JsonEscape(server_) << "\",\"id\":\""
+    << JsonEscape(id_) << "\",\"capacity\":" << capacity_
+    << ",\"recorded\":" << seq_
+    << ",\"dropped\":" << (seq_ - static_cast<int64_t>(retained))
+    << ",\"dumped_ts_ms\":" << EpochMsNow() << ",\"events\":[";
+  // Newest first: walk backwards from the slot before next_.
+  for (size_t i = 0; i < emit; ++i) {
+    size_t slot = (next_ + capacity_ - 1 - i) % capacity_;
+    if (i) o << ",";
+    AppendEventJson(o, ring_[slot]);
+  }
+  o << "]}";
+  return o.str();
+}
+
+bool FlightRecorder::DumpToFile(const std::string& path) const {
+  if (path.empty()) return false;
+  std::string body = Json(0);
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) return false;
+  size_t n = fwrite(body.data(), 1, body.size(), f);
+  bool ok = n == body.size();
+  ok = fclose(f) == 0 && ok;
+  if (ok) ok = rename(tmp.c_str(), path.c_str()) == 0;
+  if (!ok) remove(tmp.c_str());
+  return ok;
+}
+
+std::string FlightRecorder::DumpPathFromEnv() const {
+  const char* dir = std::getenv("TPUFT_FLIGHT_DIR");
+  if (!dir || !dir[0]) return "";
+  std::string server, id;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    server = server_;
+    id = id_;
+  }
+  std::string safe;
+  for (char c : id) {
+    safe += (isalnum(static_cast<unsigned char>(c)) || c == '-' || c == '.')
+                ? c
+                : '_';
+  }
+  return std::string(dir) + "/flight_" + server + (safe.empty() ? "" : "_" + safe) +
+         ".json";
+}
+
+// ---------------------------------------------------------------------------
+// LatencyHistogram
+// ---------------------------------------------------------------------------
+
+const std::vector<double>& LatencyHistogram::Bounds() {
+  static const std::vector<double> kBounds = {
+      0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+      0.05,   0.1,     0.25,   0.5,  1.0,    2.5,   5.0,  10.0};
+  return kBounds;
+}
+
+LatencyHistogram::LatencyHistogram() : counts_(Bounds().size() + 1, 0) {}
+
+void LatencyHistogram::Observe(double seconds) {
+  const auto& bounds = Bounds();
+  size_t idx = bounds.size();  // +Inf slot
+  for (size_t i = 0; i < bounds.size(); ++i) {
+    if (seconds <= bounds[i]) {
+      idx = i;
+      break;
+    }
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  counts_[idx] += 1;
+  sum_ += seconds;
+  count_ += 1;
+}
+
+uint64_t LatencyHistogram::count() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return count_;
+}
+
+std::vector<uint64_t> LatencyHistogram::Snapshot(double* sum, uint64_t* count) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (sum) *sum = sum_;
+  if (count) *count = count_;
+  return counts_;
+}
+
+void ExposeHistogram(
+    std::ostream& o, const std::string& name, const std::string& help,
+    const std::vector<std::pair<std::string, const LatencyHistogram*>>& series) {
+  o << "# HELP " << name << " " << help << "\n# TYPE " << name << " histogram\n";
+  const auto& bounds = LatencyHistogram::Bounds();
+  char le[32];
+  for (const auto& [label, hist] : series) {
+    double sum = 0.0;
+    uint64_t count = 0;
+    std::vector<uint64_t> counts = hist->Snapshot(&sum, &count);
+    uint64_t cum = 0;
+    for (size_t i = 0; i < bounds.size(); ++i) {
+      cum += counts[i];
+      snprintf(le, sizeof(le), "%g", bounds[i]);
+      o << name << "_bucket{" << label << (label.empty() ? "" : ",")
+        << "le=\"" << le << "\"} " << cum << "\n";
+    }
+    o << name << "_bucket{" << label << (label.empty() ? "" : ",")
+      << "le=\"+Inf\"} " << count << "\n";
+    o << name << "_sum" << (label.empty() ? "" : "{" + label + "}") << " "
+      << sum << "\n";
+    o << name << "_count" << (label.empty() ? "" : "{" + label + "}") << " "
+      << count << "\n";
+  }
+}
+
+}  // namespace tpuft

@@ -1,0 +1,141 @@
+// Control-plane flight recorder + native latency histograms.
+//
+// The "always-on, low-overhead, dump-on-demand" black box for the native
+// coordination servers (docs/architecture.md "Control-plane observability"):
+// a bounded ring buffer of RPC spans and state-transition events that every
+// Lighthouse and ManagerServer keeps in memory at all times, readable live
+// (GET /debug/flight.json on the lighthouse, a capi accessor everywhere) and
+// dumped to a JSON file on server shutdown so a crashed run leaves a
+// replayable record of why each quorum formed when it did.
+//
+// Recording is mutex-light by design: one short lock per event around a
+// fixed-slot ring write (strings are moved in, nothing allocates while the
+// lock is held beyond the slot's own strings).  Readers serialize the whole
+// ring under the same lock — reads are rare (debug endpoint, shutdown dump),
+// writes are the hot path.
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <ostream>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tpuft {
+
+// Flight-recorder event kinds.  EVERY kind recorded anywhere in the native
+// servers must be declared here — tests/test_flight.py greps these
+// constants against the Python-side registry
+// (torchft_tpu/obs/flight.py FLIGHT_EVENTS), the same grep-pinning
+// discipline as the metrics.EVENTS registry.
+constexpr char kFlightRpc[] = "rpc";
+constexpr char kFlightQuorumFormed[] = "quorum_formed";
+constexpr char kFlightReplicaJoin[] = "replica_join";
+constexpr char kFlightReplicaEvict[] = "replica_evict";
+constexpr char kFlightReplicaDrain[] = "replica_drain";
+constexpr char kFlightSentinelTransition[] = "sentinel_transition";
+constexpr char kFlightRoleChange[] = "role_change";
+constexpr char kFlightQuorumResult[] = "quorum_result";
+constexpr char kFlightShutdown[] = "shutdown";
+
+// One recorded event.  RPC spans fill method/peer/status/dur_us; state
+// events leave them defaulted (dur_us -1 = not a span).
+struct FlightEvent {
+  int64_t seq = 0;      // monotonically increasing per recorder
+  int64_t ts_ms = 0;    // epoch ms at record (= send) time
+  int64_t mono_us = 0;  // steady-clock µs at record time (same origin as
+                        // dur_us arithmetic; NTP-immune ordering)
+  std::string kind;     // one of the kFlight* constants above
+  std::string method;   // rpc: wire method name (MethodName, wire.h)
+  std::string peer;     // rpc: remote "host:port"
+  uint16_t status = 0;  // rpc: wire Status the response carried
+  int64_t dur_us = -1;  // rpc: recv -> send handling time in µs
+  std::string trace_id; // causal trace id carried by the request, if any
+  std::string detail;   // state events: "k=v k=[a,b]" tokens (obs/flight.py
+                        // parses these back into dicts)
+};
+
+// Bounded, process-lifetime event ring.  Thread-safe.
+class FlightRecorder {
+ public:
+  explicit FlightRecorder(size_t capacity = 2048);
+
+  // Identity stamped into Json()/dumps ("lighthouse" / "manager") plus a
+  // stable instance id (port / replica id).  Set once at server Start.
+  void SetIdentity(const std::string& server, const std::string& id);
+
+  void Record(FlightEvent ev);
+  // State-transition event.
+  void RecordEvent(const char* kind, std::string detail,
+                   std::string trace_id = "");
+  // Server-side RPC span (kind "rpc").
+  void RecordRpc(const char* method, std::string peer, uint16_t status,
+                 int64_t dur_us, std::string trace_id);
+
+  // JSON document: {"server","id","capacity","recorded","dropped",
+  // "dumped_ts_ms","events":[...]} with events NEWEST-FIRST, at most
+  // `limit` of them (0 = all retained).
+  std::string Json(size_t limit = 0) const;
+
+  // Writes Json() to `path` atomically (tmp + rename).  Best-effort:
+  // returns false on any I/O failure, never throws — the black box must
+  // not be able to fail a shutdown.
+  bool DumpToFile(const std::string& path) const;
+
+  // $TPUFT_FLIGHT_DIR/flight_<server>_<sanitized id>.json, or "" when the
+  // env knob is unset (dump disabled).
+  std::string DumpPathFromEnv() const;
+
+  int64_t recorded() const;
+
+ private:
+  mutable std::mutex mu_;
+  std::vector<FlightEvent> ring_;
+  size_t capacity_;
+  size_t next_ = 0;       // next write slot
+  int64_t seq_ = 0;       // total recorded (dropped = seq_ - min(seq_, cap))
+  std::string server_ = "server";
+  std::string id_;
+};
+
+// ---------------------------------------------------------------------------
+// Fixed-bucket latency histogram (Prometheus exposition)
+// ---------------------------------------------------------------------------
+
+// Cumulative-bucket histogram over a fixed bound set (100 µs .. 10 s —
+// covers a /metrics render at the bottom and a join_timeout quorum wait at
+// the top).  Observe() is lock-cheap (one mutex, index + two adds).
+class LatencyHistogram {
+ public:
+  LatencyHistogram();
+  void Observe(double seconds);
+  uint64_t count() const;
+  // Per-bucket (non-cumulative) counts + sum + count, atomically.
+  std::vector<uint64_t> Snapshot(double* sum, uint64_t* count) const;
+  // Shared upper bounds in seconds (last implicit bucket is +Inf).
+  static const std::vector<double>& Bounds();
+
+ private:
+  mutable std::mutex mu_;
+  std::vector<uint64_t> counts_;  // Bounds().size() + 1 slots (+Inf last)
+  double sum_ = 0.0;
+  uint64_t count_ = 0;
+};
+
+// Writes one Prometheus histogram family: HELP/TYPE once, then cumulative
+// _bucket{...,le="..."} / _sum / _count series per (label, histogram) pair.
+// `label` is the inner label text without braces ("method=\"Quorum\"") or
+// "" for an unlabelled family.
+void ExposeHistogram(
+    std::ostream& o, const std::string& name, const std::string& help,
+    const std::vector<std::pair<std::string, const LatencyHistogram*>>& series);
+
+// JSON string-value escaping (quotes, backslash, control characters).  The
+// ONE escaper for every hand-rolled JSON surface in the native servers
+// (/status.json, /alerts.json, /debug/flight.json, dumps) — two private
+// copies silently diverging is how one endpoint ships broken JSON for an
+// input its sibling handles.
+std::string JsonEscape(const std::string& s);
+
+}  // namespace tpuft

@@ -132,7 +132,15 @@ std::optional<std::vector<QuorumMember>> QuorumCompute(TimePoint now, const Quor
 // Lighthouse server
 // ---------------------------------------------------------------------------
 
-Lighthouse::Lighthouse(LighthouseOpt opt) : opt_(std::move(opt)) {}
+Lighthouse::Lighthouse(LighthouseOpt opt) : opt_(std::move(opt)) {
+  // Pre-populate the per-method latency histograms so Dispatch's lookups
+  // never mutate the map (lock-free reads against a frozen key set).
+  for (uint16_t m : {kLighthouseQuorum, kLighthouseHeartbeat, kLighthouseStatus,
+                     kLighthouseEvict, kLighthouseDrain, kLighthouseReplicate,
+                     kLighthouseLeaderInfo}) {
+    rpc_hist_[m];
+  }
+}
 
 Lighthouse::~Lighthouse() { Shutdown(); }
 
@@ -187,6 +195,10 @@ void Lighthouse::SetRole(bool leader, const std::string& leader_addr,
            leader_addr.empty() ? "<unknown>" : leader_addr.c_str(),
            static_cast<long long>(epoch));
     }
+    flight_.RecordEvent(kFlightRoleChange,
+                        std::string("role=") + (leader ? "leader" : "follower") +
+                            " epoch=" + std::to_string(epoch) +
+                            " leader_addr=" + leader_addr);
     // Blocked quorum joins on a demoted leader must abort with the
     // redirect instead of waiting out their deadlines.
     quorum_cv_.notify_all();
@@ -280,6 +292,10 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
     LOGW("lighthouse: replication push from epoch %lld > own %lld — demoted",
          static_cast<long long>(in_epoch), static_cast<long long>(leader_epoch_));
     role_leader_ = false;
+    flight_.RecordEvent(kFlightRoleChange,
+                        "role=follower epoch=" + std::to_string(in_epoch) +
+                            " leader_addr=" + req.leader().leader_address() +
+                            " cause=replication_fence");
     quorum_cv_.notify_all();
   }
   leader_addr_ = req.leader().leader_address();
@@ -386,22 +402,35 @@ bool Lighthouse::Start(std::string* err) {
     if (v >= 0) straggler_warmup_ = v;
   }
   server_ = std::make_unique<RpcServer>(
-      opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl, std::string* resp) {
-        return Dispatch(method, req, dl, resp);
+      opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl,
+                        const std::string& peer, std::string* resp) {
+        return Dispatch(method, req, dl, peer, resp);
       });
   if (!server_->Start(err)) return false;
+  flight_.SetIdentity("lighthouse", std::to_string(server_->port()));
   if (!opt_.http_bind.empty()) {
     http_ = std::make_unique<HttpServer>(
         opt_.http_bind,
         [this](const HttpRequestInfo& req) {
           const std::string& method = req.method;
-          const std::string& path = req.path;
+          // Split an optional query string off the path ("?limit=N" on the
+          // flight endpoint); route matching uses the bare path.
+          std::string path = req.path;
+          std::string query;
+          if (auto qpos = path.find('?'); qpos != std::string::npos) {
+            query = path.substr(qpos + 1);
+            path = path.substr(0, qpos);
+          }
           HttpResponse r;
-          // HA standby: redirect everything except /metrics to the leader
-          // (docs/wire.md "HA lighthouse").  /metrics is served locally so
-          // each instance exposes its own tpuft_lighthouse_role gauge —
-          // redirecting it would double-count the leader under scrapes.
-          if (path != "/metrics") {
+          // HA standby: redirect everything except /metrics and the flight
+          // recorder to the leader (docs/wire.md "HA lighthouse").
+          // /metrics is served locally so each instance exposes its own
+          // tpuft_lighthouse_role gauge — redirecting it would
+          // double-count the leader under scrapes — and
+          // /debug/flight.json is each instance's OWN black box
+          // (redirecting a standby's recorder would hide exactly the
+          // election evidence it exists to keep).
+          if (path != "/metrics" && path != "/debug/flight.json") {
             std::string leader_http;
             bool follower;
             {
@@ -450,8 +479,26 @@ bool Lighthouse::Start(std::string* err) {
           } else if (method == "GET" && path == "/metrics") {
             // Prometheus text exposition (read-only, ungated like
             // /status.json): cluster-level gauges a scraper can alert on.
+            // Self-observed: the render duration lands in the
+            // tpuft_metrics_scrape_seconds histogram AFTER the body is
+            // built, so the cost of scrape N is visible from scrape N+1 —
+            // the seed measurement for the scrape-cost-vs-N scale sweep.
+            auto scrape_t0 = Clock::now();
             r.content_type = "text/plain; version=0.0.4; charset=utf-8";
             r.body = MetricsText();
+            scrape_hist_.Observe(
+                std::chrono::duration<double>(Clock::now() - scrape_t0).count());
+          } else if (method == "GET" && path == "/debug/flight.json") {
+            // Control-plane flight recorder (read-only, ungated): bounded,
+            // newest-first RPC spans + state transitions.  ?limit=N caps
+            // the event count for quick looks at a busy server.
+            size_t limit = 0;
+            if (auto lpos = query.find("limit="); lpos != std::string::npos) {
+              long long v = atoll(query.c_str() + lpos + 6);
+              if (v > 0) limit = static_cast<size_t>(v);
+            }
+            r.content_type = "application/json";
+            r.body = FlightJson(limit);
           } else if (method == "GET" && path == "/alerts.json") {
             // Straggler-sentinel alert feed (read-only, ungated): raised
             // and resolved alerts with the scores that triggered them.
@@ -506,17 +553,56 @@ void Lighthouse::Shutdown() {
   if (tick_thread_.joinable()) tick_thread_.join();
   if (server_) server_->Shutdown();
   if (http_) http_->Shutdown();
+  // Black-box dump: with TPUFT_FLIGHT_DIR set, a shutting-down lighthouse
+  // leaves flight_lighthouse_<port>.json next to the run's span JSONL —
+  // the post-mortem artifact for runs whose WORKERS were SIGKILLed (the
+  // recorder holds the quorum transitions around every kill).
+  flight_.RecordEvent(kFlightShutdown, "server=lighthouse");
+  std::string dump = flight_.DumpPathFromEnv();
+  if (!dump.empty()) {
+    if (flight_.DumpToFile(dump)) {
+      LOGI("lighthouse: flight recorder dumped to %s", dump.c_str());
+    } else {
+      LOGW("lighthouse: flight recorder dump to %s failed", dump.c_str());
+    }
+  }
 }
 
 std::string Lighthouse::address() const { return server_ ? server_->address() : ""; }
 std::string Lighthouse::http_address() const { return http_ ? http_->address() : ""; }
 
 Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl,
-                            std::string* resp) {
+                            const std::string& peer, std::string* resp) {
+  // Server-side RPC span: recv (here) -> send (return) monotonic window,
+  // stamped with the request's causal trace id.  The span is recorded even
+  // for failed/redirected calls — a standby's rejection storm during an
+  // election is exactly the evidence the black box exists to keep.
+  auto t0 = Clock::now();
+  std::string trace_id;
+  Status st = DispatchInner(method, req, dl, resp, &trace_id);
+  int64_t dur_us =
+      std::chrono::duration_cast<std::chrono::microseconds>(Clock::now() - t0)
+          .count();
+  flight_.RecordRpc(MethodName(method).c_str(), peer,
+                    static_cast<uint16_t>(st), dur_us, std::move(trace_id));
+  auto hist = rpc_hist_.find(method);
+  if (hist != rpc_hist_.end()) hist->second.Observe(dur_us / 1e6);
+  if (method == kLighthouseHeartbeat) {
+    // Fan-in accounting: summed per quorum tick into
+    // tpuft_heartbeat_fanin_seconds by TickLoop.
+    hb_fanin_accum_us_.fetch_add(dur_us, std::memory_order_relaxed);
+    hb_fanin_count_.fetch_add(1, std::memory_order_relaxed);
+  }
+  return st;
+}
+
+Status Lighthouse::DispatchInner(uint16_t method, const std::string& req, Deadline dl,
+                                 std::string* resp, std::string* trace_id) {
   switch (method) {
     case kLighthouseQuorum: {
       LighthouseQuorumRequest q;
       if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      *trace_id = q.trace_id();
       LighthouseQuorumResponse r;
       std::string err;
       Status st = HandleQuorum(q, dl, &r, &err);
@@ -530,6 +616,7 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
     case kLighthouseHeartbeat: {
       LighthouseHeartbeatRequest h;
       if (!h.ParseFromString(req)) return Status::kInvalidArgument;
+      *trace_id = h.trace_id();
       Status st = HandleHeartbeat(h);
       if (st == Status::kUnavailable) {
         // Standby rejection: carry the redirect in the error payload so
@@ -568,6 +655,7 @@ Status Lighthouse::Dispatch(uint16_t method, const std::string& req, Deadline dl
     case kLighthouseDrain: {
       LighthouseDrainRequest q;
       if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      *trace_id = q.trace_id();
       {
         std::lock_guard<std::mutex> lk(mu_);
         if (!IsLeaderLocked()) {
@@ -672,8 +760,19 @@ double Lighthouse::ClusterMedianEwmaLocked() const {
   return ewmas[(ewmas.size() - 1) / 2];
 }
 
+void Lighthouse::RecordSentinelLocked(const std::string& id, int prev,
+                                      const ReplicaHealth& h) {
+  if (prev == h.state) return;
+  char rbuf[32];
+  snprintf(rbuf, sizeof(rbuf), "%.3f", h.ratio);
+  flight_.RecordEvent(kFlightSentinelTransition,
+                      "replica=" + id + " from=" + std::to_string(prev) +
+                          " to=" + std::to_string(h.state) + " ratio=" + rbuf);
+}
+
 void Lighthouse::ObserveStepTimeLocked(const std::string& id) {
   ReplicaHealth& h = health_[id];
+  const int prev_state = h.state;
   h.observations += 1;
   double med = ClusterMedianEwmaLocked();
   h.ratio = med > 0.0 ? h.ewma_ms / med : 0.0;
@@ -698,6 +797,7 @@ void Lighthouse::ObserveStepTimeLocked(const std::string& id) {
         ResolveAlertsLocked(id);
       }
     }
+    RecordSentinelLocked(id, prev_state, h);
     return;
   }
   if (h.ratio >= straggler_ratio_) {
@@ -744,6 +844,7 @@ void Lighthouse::ObserveStepTimeLocked(const std::string& id) {
       ResolveAlertsLocked(id);
     }
   }
+  RecordSentinelLocked(id, prev_state, h);
 }
 
 void Lighthouse::RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth* h) {
@@ -845,6 +946,14 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     *err = "replica " + id + " is draining; rejoin as a new incarnation";
     return Status::kAborted;
   }
+  // First contact from this incarnation (no heartbeat on file): the join
+  // that introduces a new member is a state transition worth keeping.
+  if (state_.heartbeats.find(id) == state_.heartbeats.end()) {
+    flight_.RecordEvent(kFlightReplicaJoin,
+                        "replica=" + id + " step=" +
+                            std::to_string(req.requester().step()),
+                        req.trace_id());
+  }
   // Joining is an implicit heartbeat (reference: src/lighthouse.rs:480-491).
   state_.heartbeats[id] = Clock::now();
   // ...and carries the requester's step: keep the live view fresh for
@@ -926,6 +1035,13 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
 
 void Lighthouse::TickLoop() {
   while (true) {
+    // Heartbeat fan-in cost since the previous tick: one histogram
+    // observation per tick interval that handled >= 1 heartbeat.  Observed
+    // here (not in TickLocked) so join-triggered quorum attempts do not
+    // fabricate extra intervals.
+    int64_t fanin_us = hb_fanin_accum_us_.exchange(0, std::memory_order_relaxed);
+    int64_t fanin_n = hb_fanin_count_.exchange(0, std::memory_order_relaxed);
+    if (fanin_n > 0) heartbeat_fanin_hist_.Observe(fanin_us / 1e6);
     {
       std::unique_lock<std::mutex> lk(mu_);
       if (shutdown_) return;
@@ -1047,6 +1163,14 @@ void Lighthouse::TickLocked() {
     }
   }
 
+  // Formation latency reference point: the round's first joiner (the same
+  // origin QuorumCompute's straggler wait uses).  Captured before the
+  // compute because formation clears `participants`.
+  TimePoint first_join = TimePoint::max();
+  for (const auto& [id, j] : state_.participants) {
+    first_join = std::min(first_join, j.joined_at);
+  }
+
   std::string reason;
   auto members = QuorumCompute(Clock::now(), state_, opt_, &reason);
   // Log each distinct reason ONCE per membership situation: during healthy
@@ -1059,20 +1183,23 @@ void Lighthouse::TickLocked() {
   }
   if (!members) return;
 
+  double formation_s =
+      first_join == TimePoint::max()
+          ? 0.0
+          : std::chrono::duration<double>(Clock::now() - first_join).count();
+  quorum_formation_hist_.Observe(formation_s);
+
   // Bump the quorum id only when membership changed
   // (reference: src/lighthouse.rs:288-304).
   bool changed = true;
+  std::set<std::string> new_ids;
+  for (const auto& m : *members) new_ids.insert(m.replica_id());
+  std::set<std::string> old_ids;
   if (state_.prev_quorum) {
-    const auto& prev = state_.prev_quorum->participants();
-    if (static_cast<size_t>(prev.size()) == members->size()) {
-      changed = false;
-      for (int i = 0; i < prev.size(); ++i) {
-        if (prev[i].replica_id() != (*members)[i].replica_id()) {
-          changed = true;
-          break;
-        }
-      }
+    for (const auto& m : state_.prev_quorum->participants()) {
+      old_ids.insert(m.replica_id());
     }
+    changed = old_ids != new_ids;
   }
   if (changed) state_.quorum_id += 1;
 
@@ -1100,6 +1227,32 @@ void Lighthouse::TickLocked() {
          static_cast<long long>(state_.quorum_id), q.participants_size(),
          ids.c_str());
     logged_reasons_.clear();
+    // Flight event only on MEMBERSHIP TRANSITIONS (same dedup discipline
+    // as the log line): the ring then retains the quorum-change history a
+    // post-mortem reconstructs, instead of O(steps) identical formations.
+    auto join_list = [](const std::set<std::string>& s) {
+      std::string out;
+      for (const auto& id : s) {
+        if (!out.empty()) out += ",";
+        out += id;
+      }
+      return out;
+    };
+    std::set<std::string> joined, left;
+    for (const auto& id : new_ids) {
+      if (!old_ids.count(id)) joined.insert(id);
+    }
+    for (const auto& id : old_ids) {
+      if (!new_ids.count(id)) left.insert(id);
+    }
+    char fbuf[32];
+    snprintf(fbuf, sizeof(fbuf), "%.3f", formation_s * 1e3);
+    flight_.RecordEvent(
+        kFlightQuorumFormed,
+        "quorum_id=" + std::to_string(state_.quorum_id) +
+            " members=[" + join_list(new_ids) + "] joined=[" +
+            join_list(joined) + "] left=[" + join_list(left) +
+            "] formation_ms=" + fbuf);
   }
 }
 
@@ -1194,6 +1347,9 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
   if (dropped > 0) {
     LOGI("lighthouse: evicted %d replica id(s) matching '%s' (supervisor "
          "reported dead)", dropped, prefix.c_str());
+    flight_.RecordEvent(kFlightReplicaEvict,
+                        "prefix=" + prefix +
+                            " dropped=" + std::to_string(dropped));
     TickLocked();  // a waiting quorum can now form without the straggler wait
   }
   return dropped;
@@ -1243,6 +1399,10 @@ int Lighthouse::DrainLocked(const std::string& prefix, int64_t deadline_ms) {
          deadline_ms > 0
              ? (", deadline " + std::to_string(deadline_ms) + " ms").c_str()
              : "");
+    flight_.RecordEvent(kFlightReplicaDrain,
+                        "prefix=" + prefix + " marked=" +
+                            std::to_string(marked) + " deadline_ms=" +
+                            std::to_string(deadline_ms));
     TickLocked();
   }
   return marked;
@@ -1277,7 +1437,11 @@ bool Lighthouse::KillReplica(const std::string& replica_id, std::string* err) {
 }
 
 namespace {
-std::string JsonEscape(const std::string& s) {
+// Prometheus label-value escaping.  NOT the shared JsonEscape: the text
+// exposition format defines exactly \\, \" and \n — JSON's \r/\t/\uXXXX
+// escapes are undefined there and corrupt the series for parsers that
+// take them literally.
+std::string PromEscape(const std::string& s) {
   std::string out;
   for (char c : s) {
     switch (c) {
@@ -1289,10 +1453,6 @@ std::string JsonEscape(const std::string& s) {
   }
   return out;
 }
-
-// Prometheus label-value escaping (same rules as JSON's subset: backslash,
-// double quote, newline).
-std::string PromEscape(const std::string& s) { return JsonEscape(s); }
 }  // namespace
 
 std::string Lighthouse::MetricsText() {
@@ -1433,6 +1593,32 @@ std::string Lighthouse::MetricsText() {
   o << "tpuft_stragglers " << stragglers << "\n";
   gauge("tpuft_alerts_active", "unresolved sentinel alerts (see /alerts.json)");
   o << "tpuft_alerts_active " << alerts_active << "\n";
+
+  // Control-plane latency distributions (docs/wire.md "Latency
+  // histograms") — the measurements ROADMAP item 2's scale sweep needs
+  // before quorum/heartbeat/scrape paths can be optimized.
+  ExposeHistogram(
+      o, "tpuft_quorum_formation_seconds",
+      "round first-joiner to quorum formation (server-side)",
+      {{"", &quorum_formation_hist_}});
+  std::vector<std::pair<std::string, const LatencyHistogram*>> rpc_series;
+  for (const auto& [m, hist] : rpc_hist_) {
+    rpc_series.emplace_back("method=\"" + MethodName(m) + "\"", &hist);
+  }
+  ExposeHistogram(
+      o, "tpuft_rpc_latency_seconds",
+      "server-side RPC handling latency per wire method (recv->send; "
+      "includes blocking waits, so Quorum spans cover the formation wait)",
+      rpc_series);
+  ExposeHistogram(
+      o, "tpuft_heartbeat_fanin_seconds",
+      "summed heartbeat handling time per quorum tick (fan-in cost)",
+      {{"", &heartbeat_fanin_hist_}});
+  ExposeHistogram(
+      o, "tpuft_metrics_scrape_seconds",
+      "self-observed /metrics render duration (visible from the scrape "
+      "after the one it measured)",
+      {{"", &scrape_hist_}});
   return o.str();
 }
 

@@ -17,6 +17,9 @@
 #include <thread>
 #include <vector>
 
+#include <atomic>
+
+#include "flight.h"
 #include "tpuft.pb.h"
 #include "wire.h"
 
@@ -155,6 +158,10 @@ class Lighthouse {
   // JSON alert feed: {"active": N, "alerts": [...]} — newest last.
   std::string AlertsJson();
 
+  // Flight-recorder snapshot (newest-first, bounded; 0 = all retained) —
+  // the GET /debug/flight.json body and the capi accessor.
+  std::string FlightJson(size_t limit = 0) { return flight_.Json(limit); }
+
   // -- HA role (docs/wire.md "HA lighthouse") -----------------------------
   // A standalone lighthouse is a permanent leader (the default — existing
   // single-instance deployments are unchanged).  Under the HA election
@@ -189,7 +196,16 @@ class Lighthouse {
   void FillLeaderInfo(LighthouseLeaderInfoResponse* resp);
 
  private:
-  Status Dispatch(uint16_t method, const std::string& req, Deadline deadline, std::string* resp);
+  // Outer dispatch: times the handler, records the server-side RPC span
+  // (method, peer, status, duration, trace id) into the flight recorder
+  // and the per-method latency histogram, then defers to DispatchInner —
+  // which surfaces the request's trace id from the message it parses
+  // anyway (re-parsing here would charge every heartbeat a second
+  // deserialization inside the very latency window being measured).
+  Status Dispatch(uint16_t method, const std::string& req, Deadline deadline,
+                  const std::string& peer, std::string* resp);
+  Status DispatchInner(uint16_t method, const std::string& req, Deadline deadline,
+                       std::string* resp, std::string* trace_id);
   // True when an ops-endpoint request may mutate state (docs/wire.md
   // "Trust model"): the shared-secret header matches TPUFT_ADMIN_TOKEN, or
   // no token is configured and the peer is loopback.
@@ -211,6 +227,9 @@ class Lighthouse {
   // Raise/resolve the straggler alert for one replica.  Caller holds mu_.
   void RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth* h);
   void ResolveAlertsLocked(const std::string& id);
+  // Flight-records a sentinel hysteresis transition when prev != h.state.
+  void RecordSentinelLocked(const std::string& id, int prev,
+                            const ReplicaHealth& h);
   // Auto-drain attempt for a confirmed straggler: marks it draining via
   // the cooperative path iff enabled and the remaining healthy count
   // stays above min_replicas.  Returns whether the replica is (now)
@@ -312,6 +331,25 @@ class Lighthouse {
 
   std::thread tick_thread_;
   bool shutdown_ = false;
+
+  // -- control-plane observability (docs/architecture.md) -----------------
+  // Always-on bounded black box: RPC spans + state transitions, served on
+  // GET /debug/flight.json and dumped to $TPUFT_FLIGHT_DIR on Shutdown.
+  FlightRecorder flight_;
+  // Server-side handling latency per wire method (pre-populated for
+  // methods 1-7 in the ctor so lookups never mutate the map).
+  std::map<uint16_t, LatencyHistogram> rpc_hist_;
+  // Round first-joiner -> formation latency, observed on every formation.
+  LatencyHistogram quorum_formation_hist_;
+  // Sum of heartbeat handling time between quorum ticks, observed once per
+  // tick that handled at least one heartbeat (the fan-in cost ROADMAP
+  // item 2's scale sweep measures vs replica count).
+  LatencyHistogram heartbeat_fanin_hist_;
+  std::atomic<int64_t> hb_fanin_accum_us_{0};
+  std::atomic<int64_t> hb_fanin_count_{0};
+  // /metrics self-observation: render duration of the PREVIOUS scrapes
+  // (observed after the body is built, so it appears from scrape 2 on).
+  LatencyHistogram scrape_hist_;
 };
 
 int64_t NowEpochMs();

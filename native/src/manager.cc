@@ -144,10 +144,12 @@ ManagerServer::~ManagerServer() { Shutdown(); }
 
 bool ManagerServer::Start(std::string* err) {
   server_ = std::make_unique<RpcServer>(
-      opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl, std::string* resp) {
-        return Dispatch(method, req, dl, resp);
+      opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl,
+                        const std::string& peer, std::string* resp) {
+        return Dispatch(method, req, dl, peer, resp);
       });
   if (!server_->Start(err)) return false;
+  flight_.SetIdentity("manager", opt_.replica_id);
   heartbeat_client_ = std::make_unique<FailoverRpcClient>(opt_.lighthouse_addr);
   quorum_client_ = std::make_unique<FailoverRpcClient>(opt_.lighthouse_addr);
   // Startup reachability probe: with EVERY lighthouse address dead (typo'd
@@ -176,6 +178,14 @@ void ManagerServer::Shutdown() {
   if (quorum_client_) quorum_client_->Close();
   if (hb_thread_.joinable()) hb_thread_.join();
   if (server_) server_->Shutdown();
+  // Black-box dump (see Lighthouse::Shutdown): a cleanly departing group
+  // leaves flight_manager_<replica_id>.json in $TPUFT_FLIGHT_DIR.
+  flight_.RecordEvent(kFlightShutdown, "server=manager replica=" + opt_.replica_id);
+  std::string dump = flight_.DumpPathFromEnv();
+  if (!dump.empty() && !flight_.DumpToFile(dump)) {
+    LOGW("manager %s: flight recorder dump to %s failed",
+         opt_.replica_id.c_str(), dump.c_str());
+  }
 }
 
 std::string ManagerServer::address() const { return server_ ? server_->address() : ""; }
@@ -240,6 +250,7 @@ void ManagerServer::HeartbeatLoop() {
       req.set_step_time_ms_ewma(status_step_time_ewma_ms_);
       req.set_step_time_ms_last(status_step_time_last_ms_);
       req.set_allreduce_gb_per_s(status_allreduce_gbps_);
+      req.set_trace_id(status_trace_id_);
       req.SerializeToString(&payload);
     }
     Status st = heartbeat_client_->Call(kLighthouseHeartbeat, payload, call_timeout_ms,
@@ -259,11 +270,25 @@ void ManagerServer::HeartbeatLoop() {
 }
 
 Status ManagerServer::Dispatch(uint16_t method, const std::string& req, Deadline dl,
-                               std::string* resp) {
+                               const std::string& peer, std::string* resp) {
+  auto t0 = Clock::now();
+  std::string trace_id;
+  Status st = DispatchInner(method, req, dl, resp, &trace_id);
+  int64_t dur_us =
+      std::chrono::duration_cast<std::chrono::microseconds>(Clock::now() - t0)
+          .count();
+  flight_.RecordRpc(MethodName(method).c_str(), peer,
+                    static_cast<uint16_t>(st), dur_us, std::move(trace_id));
+  return st;
+}
+
+Status ManagerServer::DispatchInner(uint16_t method, const std::string& req, Deadline dl,
+                                    std::string* resp, std::string* trace_id) {
   switch (method) {
     case kManagerQuorum: {
       ManagerQuorumRequest r;
       if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      *trace_id = r.trace_id();
       ManagerQuorumResponse out;
       std::string err;
       Status st = HandleQuorum(r, dl, &out, &err);
@@ -277,6 +302,7 @@ Status ManagerServer::Dispatch(uint16_t method, const std::string& req, Deadline
     case kManagerCheckpointMetadata: {
       CheckpointMetadataRequest r;
       if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      *trace_id = r.trace_id();
       CheckpointMetadataResponse out;
       std::string err;
       Status st = HandleCheckpointMetadata(r, &out, &err);
@@ -290,6 +316,7 @@ Status ManagerServer::Dispatch(uint16_t method, const std::string& req, Deadline
     case kManagerShouldCommit: {
       ShouldCommitRequest r;
       if (!r.ParseFromString(req)) return Status::kInvalidArgument;
+      *trace_id = r.trace_id();
       ShouldCommitResponse out;
       std::string err;
       Status st = HandleShouldCommit(r, dl, &out, &err);
@@ -323,16 +350,25 @@ Status ManagerServer::HandleQuorum(const ManagerQuorumRequest& req, Deadline dea
   checkpoint_metadata_[req.group_rank()] = req.checkpoint_metadata();
   round_reqs_[req.group_rank()] = req;
   int64_t my_round = round_;
+  if (!req.trace_id().empty()) {
+    // The step's causal trace id (minted by the Python Manager, docs/
+    // wire.md "Causal trace ids"): forwarded on the lighthouse RPC below
+    // and stamped onto every heartbeat until the next round replaces it.
+    status_trace_id_ = req.trace_id();
+  }
 
   if (round_reqs_.size() == opt_.world_size) {
     // This rank completed the set: perform the Lighthouse RPC for the group.
     int64_t step = 0;
     bool shrink_only = false;
+    std::string trace_id;
     for (const auto& [rank, r] : round_reqs_) {
       step = std::max(step, r.step());
       shrink_only = shrink_only || r.shrink_only();
+      if (!r.trace_id().empty()) trace_id = r.trace_id();
     }
     LighthouseQuorumRequest lreq;
+    lreq.set_trace_id(trace_id);
     auto* member = lreq.mutable_requester();
     member->set_replica_id(opt_.replica_id);
     member->set_address(server_->address());
@@ -362,6 +398,18 @@ Status ManagerServer::HandleQuorum(const ManagerQuorumRequest& req, Deadline dea
           result_quorum_ = lresp.quorum();
         }
       }
+      // Outcome of the round the group just paid for: quorum id +
+      // membership size on success, the failure status otherwise.
+      flight_.RecordEvent(
+          kFlightQuorumResult,
+          result_status_ == Status::kOk
+              ? "quorum_id=" + std::to_string(result_quorum_.quorum_id()) +
+                    " participants=" +
+                    std::to_string(result_quorum_.participants_size()) +
+                    " step=" + std::to_string(step)
+              : "status=" + StatusName(result_status_) + " step=" +
+                    std::to_string(step),
+          trace_id);
       round_ += 1;
       round_reqs_.clear();
       cv_.notify_all();

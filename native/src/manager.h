@@ -16,6 +16,7 @@
 #include <string>
 #include <thread>
 
+#include "flight.h"
 #include "tpuft.pb.h"
 #include "wire.h"
 
@@ -81,8 +82,18 @@ class ManagerServer {
   Status HandleShouldCommit(const ShouldCommitRequest& req, Deadline deadline,
                             ShouldCommitResponse* resp, std::string* err);
 
+  // Flight-recorder snapshot (newest-first; 0 = all retained), exposed to
+  // Python through the capi (`tf_manager_flight_json`).
+  std::string FlightJson(size_t limit = 0) { return flight_.Json(limit); }
+
  private:
-  Status Dispatch(uint16_t method, const std::string& req, Deadline deadline, std::string* resp);
+  // Outer dispatch: records the server-side RPC span (method, peer,
+  // status, duration, trace id) around DispatchInner, which surfaces the
+  // trace id from the request it parses anyway (no second parse).
+  Status Dispatch(uint16_t method, const std::string& req, Deadline deadline,
+                  const std::string& peer, std::string* resp);
+  Status DispatchInner(uint16_t method, const std::string& req, Deadline deadline,
+                       std::string* resp, std::string* trace_id);
   void HeartbeatLoop();
 
   ManagerOpt opt_;
@@ -115,6 +126,14 @@ class ManagerServer {
   double status_step_time_ewma_ms_ = 0.0;
   double status_step_time_last_ms_ = 0.0;
   double status_allreduce_gbps_ = 0.0;
+  // Causal trace id of the last quorum round this manager aggregated —
+  // stamped onto every lighthouse heartbeat (proto field 7) so the
+  // lighthouse's RPC spans correlate with the step in flight.
+  std::string status_trace_id_;
+
+  // Control-plane black box: server-side RPC spans + quorum outcomes,
+  // dumped to $TPUFT_FLIGHT_DIR on Shutdown.
+  FlightRecorder flight_;
 
   // should_commit barrier per (step) round (reference: src/manager.rs:313-371).
   struct CommitRound {

@@ -9,7 +9,9 @@ StoreServer::~StoreServer() { Shutdown(); }
 
 bool StoreServer::Start(std::string* err) {
   server_ = std::make_unique<RpcServer>(
-      bind_, [this](uint16_t method, const std::string& req, Deadline dl, std::string* resp) {
+      bind_, [this](uint16_t method, const std::string& req, Deadline dl,
+                    const std::string& peer, std::string* resp) {
+        (void)peer;  // the store keeps no flight recorder (pure KV hot path)
         return Dispatch(method, req, dl, resp);
       });
   if (!server_->Start(err)) return false;

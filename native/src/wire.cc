@@ -151,6 +151,43 @@ bool ParseAddress(const std::string& addr, SockAddr* out, std::string* err) {
   return true;
 }
 
+std::string MethodName(uint16_t method) {
+  switch (method) {
+    case kLighthouseQuorum: return "Quorum";
+    case kLighthouseHeartbeat: return "Heartbeat";
+    case kLighthouseStatus: return "Status";
+    case kLighthouseEvict: return "Evict";
+    case kLighthouseDrain: return "Drain";
+    case kLighthouseReplicate: return "Replicate";
+    case kLighthouseLeaderInfo: return "LeaderInfo";
+    case kManagerQuorum: return "ManagerQuorum";
+    case kManagerCheckpointMetadata: return "CheckpointMetadata";
+    case kManagerShouldCommit: return "ShouldCommit";
+    case kManagerKill: return "Kill";
+    case kStoreSet: return "StoreSet";
+    case kStoreGet: return "StoreGet";
+    case kStoreAdd: return "StoreAdd";
+    case kStoreDelete: return "StoreDelete";
+  }
+  return "Method" + std::to_string(method);
+}
+
+std::string PeerAddress(int fd) {
+  struct sockaddr_storage peer = {};
+  socklen_t plen = sizeof(peer);
+  if (getpeername(fd, reinterpret_cast<struct sockaddr*>(&peer), &plen) != 0) {
+    return "";
+  }
+  char host[NI_MAXHOST], port[NI_MAXSERV];
+  if (getnameinfo(reinterpret_cast<struct sockaddr*>(&peer), plen, host,
+                  sizeof(host), port, sizeof(port),
+                  NI_NUMERICHOST | NI_NUMERICSERV) != 0) {
+    return "";
+  }
+  std::string h(host);
+  return (h.find(':') != std::string::npos ? "[" + h + "]" : h) + ":" + port;
+}
+
 std::string StatusName(Status s) {
   switch (s) {
     case Status::kOk: return "OK";
@@ -277,6 +314,9 @@ void RpcServer::AcceptLoop() {
 }
 
 void RpcServer::Serve(int fd) {
+  // Resolved once per connection (it cannot change mid-stream) and handed
+  // to every dispatched frame for the flight recorder's RPC spans.
+  const std::string peer = PeerAddress(fd);
   while (!shutdown_.load()) {
     FrameHeader h;
     std::string payload;
@@ -292,7 +332,7 @@ void RpcServer::Serve(int fd) {
     std::string resp;
     Status st;
     try {
-      st = handler_(h.method, payload, dl, &resp);
+      st = handler_(h.method, payload, dl, peer, &resp);
     } catch (const std::exception& e) {
       st = Status::kInternal;
       resp = e.what();

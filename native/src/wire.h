@@ -115,10 +115,12 @@ bool ParseAddress(const std::string& addr, SockAddr* out, std::string* err);
 // Server
 // ---------------------------------------------------------------------------
 
-// Handler: (method, request payload, deadline) -> status + response payload.
+// Handler: (method, request payload, deadline, peer) -> status + response
+// payload.  `peer` is the remote "host:port" of the connection the frame
+// arrived on — what the flight recorder stamps into server-side RPC spans.
 using RpcHandler =
     std::function<Status(uint16_t method, const std::string& req, Deadline deadline,
-                         std::string* resp)>;
+                         const std::string& peer, std::string* resp)>;
 
 class RpcServer {
  public:
@@ -195,6 +197,14 @@ class RpcClient {
 int DialTcp(const std::string& addr, uint64_t timeout_ms, std::string* err);
 
 std::string StatusName(Status s);
+
+// Human-readable wire method name ("Quorum", "ManagerQuorum", "StoreGet",
+// ...; "Method<N>" for unknown ids) — the flight recorder's and the
+// tpuft_rpc_latency_seconds histogram's `method` label.
+std::string MethodName(uint16_t method);
+
+// Remote "host:port" of a connected socket ("" on failure).
+std::string PeerAddress(int fd);
 
 // ---------------------------------------------------------------------------
 // Failover client (HA lighthouse, docs/wire.md)

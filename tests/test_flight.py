@@ -1,0 +1,543 @@
+"""Control-plane flight recorder, causal trace ids, and native latency
+histograms (ISSUE 7).
+
+Covers the tentpole's three legs end to end against REAL native servers:
+
+1. trace ids minted by the (Python) Manager ride every control RPC and
+   land in the server-side flight recorders — including across an HA
+   lighthouse failover;
+2. the flight recorder is bounded, newest-first, served on
+   ``GET /debug/flight.json``, dumped on shutdown, and its dump supports
+   quorum-transition reconstruction;
+3. ``GET /metrics`` exposes well-formed Prometheus histograms
+   (``_bucket``/``_sum``/``_count``) for quorum formation, per-method RPC
+   latency, heartbeat fan-in, and the scrape's own cost — PARSED here, not
+   eyeballed.
+
+Plus the two static registries: flight event kinds (native ``kFlight*``
+constants vs ``obs.flight.FLIGHT_EVENTS``) and span-phase track mappings
+(``obs.spans.PHASES`` vs ``obs.trace.PHASE_TRACKS``) — the same
+grep-pinning discipline as tests/test_obs.py's metrics.EVENTS check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import urllib.request
+
+import pytest
+
+from torchft_tpu._native import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+)
+from torchft_tpu.obs.flight import (
+    FLIGHT_EVENTS,
+    flight_events,
+    flight_to_stream,
+    load_flight_dump,
+    mint_trace_id,
+    parse_trace_id,
+    quorum_transitions,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(url: str) -> str:
+    return urllib.request.urlopen(url, timeout=5).read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus histogram parsing (the "parsed by a test, not eyeballed" leg)
+# ---------------------------------------------------------------------------
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(?P<labels>[^}]*)\})? (?P<value>\S+)$"
+)
+
+
+def parse_histograms(text: str) -> dict:
+    """{(name, frozenset(non-le labels)): {"buckets": {le: cum}, "sum": x,
+    "count": n}} from a Prometheus exposition."""
+    out: dict = {}
+
+    def labels_of(raw):
+        if not raw:
+            return {}
+        return {
+            k: v
+            for k, v in re.findall(r'([a-zA-Z_]+)="([^"]*)"', raw)
+        }
+
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        m = _SERIES_RE.match(line)
+        if not m:
+            continue
+        name, raw_labels, value = m.group("name"), m.group("labels"), m.group("value")
+        for suffix, field in (("_bucket", "buckets"), ("_sum", "sum"), ("_count", "count")):
+            if not name.endswith(suffix):
+                continue
+            base = name[: -len(suffix)]
+            labels = labels_of(raw_labels)
+            le = labels.pop("le", None)
+            key = (base, frozenset(labels.items()))
+            entry = out.setdefault(key, {"buckets": {}, "sum": None, "count": None})
+            if field == "buckets":
+                entry["buckets"][le] = float(value)
+            else:
+                entry[field] = float(value)
+            break
+    return out
+
+
+def _assert_histogram_well_formed(entry: dict) -> None:
+    buckets = entry["buckets"]
+    assert "+Inf" in buckets, f"missing +Inf bucket: {buckets}"
+    finite = sorted(
+        ((float(le), c) for le, c in buckets.items() if le != "+Inf"),
+        key=lambda x: x[0],
+    )
+    # Cumulative monotone, +Inf == _count, _sum consistent.
+    prev = 0.0
+    for _, c in finite:
+        assert c >= prev, f"non-monotone cumulative buckets: {buckets}"
+        prev = c
+    assert buckets["+Inf"] >= prev
+    assert entry["count"] == buckets["+Inf"]
+    assert entry["sum"] is not None and entry["sum"] >= 0.0
+
+
+@pytest.fixture()
+def lighthouse():
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100,
+        quorum_tick_ms=20, http_bind="127.0.0.1:0",
+    )
+    yield lh
+    lh.shutdown()
+
+
+def test_metrics_histograms_well_formed(lighthouse) -> None:
+    client = LighthouseClient(lighthouse.address())
+    tid = mint_trace_id(0, "r0:aa", 3)
+    client.quorum("r0:aa", timeout_ms=5000, step=3, trace_id=tid)
+    client.heartbeat("r0:aa", step=3, state="step")
+    client.close()
+
+    text = _get(lighthouse.http_address() + "/metrics")
+    hists = parse_histograms(text)
+    # Quorum formation observed at least once (the join above formed one).
+    formation = hists[("tpuft_quorum_formation_seconds", frozenset())]
+    _assert_histogram_well_formed(formation)
+    assert formation["count"] >= 1
+    # Per-method RPC latency: every lighthouse wire method pre-registered,
+    # Quorum and Heartbeat actually observed.
+    for method in ("Quorum", "Heartbeat", "Status", "Evict", "Drain",
+                   "Replicate", "LeaderInfo"):
+        entry = hists[("tpuft_rpc_latency_seconds", frozenset({("method", method)}))]
+        _assert_histogram_well_formed(entry)
+    assert hists[("tpuft_rpc_latency_seconds", frozenset({("method", "Quorum")}))][
+        "count"
+    ] >= 1
+    assert hists[("tpuft_rpc_latency_seconds", frozenset({("method", "Heartbeat")}))][
+        "count"
+    ] >= 1
+    # Heartbeat fan-in: at least one tick observed the heartbeat above.
+    import time
+
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        fanin = parse_histograms(_get(lighthouse.http_address() + "/metrics"))[
+            ("tpuft_heartbeat_fanin_seconds", frozenset())
+        ]
+        if fanin["count"] >= 1:
+            break
+        time.sleep(0.05)
+    _assert_histogram_well_formed(fanin)
+    assert fanin["count"] >= 1
+
+
+def test_metrics_scrape_cost_appears_after_first_scrape(lighthouse) -> None:
+    """The /metrics self-observation contract: scrape N's render cost is in
+    the histogram from scrape N+1 (the seed measurement for ROADMAP item
+    2's scrape-cost-vs-N sweep)."""
+    url = lighthouse.http_address() + "/metrics"
+    first = parse_histograms(_get(url))[("tpuft_metrics_scrape_seconds", frozenset())]
+    assert first["count"] == 0  # nothing observed before the first render
+    second = parse_histograms(_get(url))[("tpuft_metrics_scrape_seconds", frozenset())]
+    _assert_histogram_well_formed(second)
+    assert second["count"] == 1
+    assert second["sum"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: endpoint, accessor, shutdown dump, reconstruction
+# ---------------------------------------------------------------------------
+
+
+def test_flight_endpoint_records_rpc_spans_newest_first(lighthouse) -> None:
+    client = LighthouseClient(lighthouse.address())
+    tid = mint_trace_id(2, "g0:aa", 7)
+    client.quorum("g0:aa", timeout_ms=5000, step=7, trace_id=tid)
+    client.heartbeat("g0:aa", step=7)
+    client.close()
+
+    blob = json.loads(_get(lighthouse.http_address() + "/debug/flight.json"))
+    assert blob["server"] == "lighthouse"
+    events = blob["events"]
+    assert events, "no events recorded"
+    # Newest first: seq strictly decreasing.
+    seqs = [ev["seq"] for ev in events]
+    assert seqs == sorted(seqs, reverse=True)
+    rpcs = [ev for ev in events if ev["kind"] == "rpc"]
+    quorum_rpcs = [ev for ev in rpcs if ev.get("method") == "Quorum"]
+    assert quorum_rpcs and quorum_rpcs[0]["trace_id"] == tid
+    assert quorum_rpcs[0]["status"] == 0
+    assert quorum_rpcs[0]["dur_us"] >= 0
+    assert quorum_rpcs[0]["peer"].startswith("127.0.0.1:")
+    # State transitions recorded alongside: the first join + the formation.
+    kinds = {ev["kind"] for ev in events}
+    assert "replica_join" in kinds and "quorum_formed" in kinds
+    # ?limit= bounds the payload.
+    small = json.loads(_get(lighthouse.http_address() + "/debug/flight.json?limit=2"))
+    assert len(small["events"]) == 2
+    assert small["events"][0]["seq"] == seqs[0]
+    # The ctypes accessor serves the same document.
+    via_capi = lighthouse.flight(limit=2)
+    assert [ev["seq"] for ev in via_capi["events"]][1] == small["events"][1]["seq"]
+
+
+def test_flight_dump_and_quorum_transition_reconstruction(tmp_path, monkeypatch) -> None:
+    """Kill post-mortem contract: membership transitions around an eviction
+    are reconstructable from the shutdown dump alone."""
+    monkeypatch.setenv("TPUFT_FLIGHT_DIR", str(tmp_path))
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, http_bind="127.0.0.1:0",
+    )
+    try:
+        import threading
+
+        client_a = LighthouseClient(lh.address())
+        client_b = LighthouseClient(lh.address())
+        # Heartbeat both BEFORE joining so the split-brain guard holds the
+        # first joiner until the second arrives — the round then forms
+        # {a, b} deterministically instead of racing to a singleton.
+        client_a.heartbeat("a:1111")
+        client_b.heartbeat("b:2222")
+        results = []
+        ta = threading.Thread(
+            target=lambda: results.append(
+                client_a.quorum("a:1111", timeout_ms=10000, step=1)
+            )
+        )
+        tb = threading.Thread(
+            target=lambda: results.append(
+                client_b.quorum("b:2222", timeout_ms=10000, step=1)
+            )
+        )
+        ta.start(); tb.start(); ta.join(); tb.join()
+        assert len(results) == 2
+        # "b" dies (supervisor evicts); the next quorum forms without it.
+        lh.evict("b")
+        client_a.quorum("a:1111", timeout_ms=10000, step=2)
+        client_a.close(); client_b.close()
+    finally:
+        lh.shutdown()
+
+    dumps = [p for p in os.listdir(tmp_path) if p.startswith("flight_lighthouse")]
+    assert dumps, "no shutdown dump written"
+    dump = load_flight_dump(os.path.join(tmp_path, dumps[0]))
+    events = flight_events(dump)
+    transitions = quorum_transitions(events)
+    # {a,b} formed, then (post-evict) {a} alone — the delta names b.
+    assert len(transitions) >= 2
+    assert transitions[0]["members"] == ["a:1111", "b:2222"]
+    assert transitions[-1]["members"] == ["a:1111"]
+    assert "b:2222" in transitions[-1]["left"]
+    assert any(ev["kind"] == "replica_evict" for ev in events)
+    assert events[-1]["kind"] == "shutdown"
+    # The dump converts into control-plane stream events for the Perfetto
+    # export (cp_rpc slices + cp_event instants).
+    stream = flight_to_stream(dump)
+    assert any(ev["event"] == "cp_rpc" for ev in stream)
+    assert any(
+        ev["event"] == "cp_event" and ev["kind"] == "quorum_formed"
+        for ev in stream
+    )
+
+
+def test_flight_ring_is_bounded(lighthouse) -> None:
+    client = LighthouseClient(lighthouse.address())
+    for i in range(40):
+        client.heartbeat("r:ring", step=i)
+    client.close()
+    blob = lighthouse.flight()
+    assert blob["capacity"] >= len(blob["events"])
+    assert blob["recorded"] >= 40
+
+
+# ---------------------------------------------------------------------------
+# Trace-id propagation: Manager -> lighthouse, including HA failover
+# ---------------------------------------------------------------------------
+
+
+def test_trace_id_propagates_manager_to_lighthouse(lighthouse) -> None:
+    mgr = ManagerServer(
+        replica_id="g0:tt", lighthouse_addr=lighthouse.address(),
+        bind="127.0.0.1:0", store_addr="s:1", world_size=1,
+    )
+    try:
+        tid = mint_trace_id(1, "g0:tt", 5)
+        mc = ManagerClient(mgr.address())
+        mc._quorum(
+            group_rank=0, step=5, checkpoint_metadata="m", shrink_only=False,
+            timeout_ms=10000, trace_id=tid,
+        )
+        mc.should_commit(0, 5, True, timeout_ms=5000, trace_id=tid)
+        mc.close()
+
+        # The SAME id observed at the manager appears in the lighthouse's
+        # recorder on the matching Quorum RPC (cross-process correlation).
+        lh_rpcs = [
+            ev for ev in lighthouse.flight()["events"]
+            if ev["kind"] == "rpc" and ev.get("method") == "Quorum"
+        ]
+        assert any(ev.get("trace_id") == tid for ev in lh_rpcs)
+        mgr_events = mgr.flight()["events"]
+        mgr_rpcs = [ev for ev in mgr_events if ev["kind"] == "rpc"]
+        assert any(
+            ev.get("method") == "ManagerQuorum" and ev.get("trace_id") == tid
+            for ev in mgr_rpcs
+        )
+        assert any(
+            ev.get("method") == "ShouldCommit" and ev.get("trace_id") == tid
+            for ev in mgr_rpcs
+        )
+        assert any(ev["kind"] == "quorum_result" for ev in mgr_events)
+    finally:
+        mgr.shutdown()
+
+
+def test_trace_id_survives_ha_failover() -> None:
+    """After a leader swap the NEW leader's flight recorder keeps the
+    causal chain: the post-failover step's trace id is recorded there."""
+    a = LighthouseServer(bind="127.0.0.1:0", min_replicas=1,
+                         join_timeout_ms=100, quorum_tick_ms=20, http_bind="")
+    b = LighthouseServer(bind="127.0.0.1:0", min_replicas=1,
+                         join_timeout_ms=100, quorum_tick_ms=20, http_bind="")
+    mgr = None
+    try:
+        a.set_role(True, a.address(), "", 1, 0)
+        b.set_role(False, a.address(), "", 1, 0)
+        mgr = ManagerServer(
+            replica_id="g0:ha", lighthouse_addr=f"{a.address()},{b.address()}",
+            bind="127.0.0.1:0", store_addr="s:1", world_size=1,
+        )
+        mc = ManagerClient(mgr.address())
+        tid1 = mint_trace_id(0, "g0:ha", 1)
+        mc._quorum(group_rank=0, step=1, checkpoint_metadata="", shrink_only=False,
+                   timeout_ms=10000, trace_id=tid1)
+        assert any(
+            ev.get("trace_id") == tid1
+            for ev in a.flight()["events"]
+            if ev["kind"] == "rpc" and ev.get("method") == "Quorum"
+        )
+
+        # Failover: A demotes naming B, B takes over with a higher epoch.
+        b.set_role(True, b.address(), "", 2, 0)
+        a.set_role(False, b.address(), "", 2, 0)
+
+        tid2 = mint_trace_id(0, "g0:ha", 2)
+        mc._quorum(group_rank=0, step=2, checkpoint_metadata="", shrink_only=False,
+                   timeout_ms=15000, trace_id=tid2)
+        mc.close()
+        b_quorums = [
+            ev for ev in b.flight()["events"]
+            if ev["kind"] == "rpc" and ev.get("method") == "Quorum"
+        ]
+        assert any(ev.get("trace_id") == tid2 and ev.get("status") == 0
+                   for ev in b_quorums), "new leader did not record the trace"
+        # Both instances logged their role flips with epochs.
+        for server, epoch in ((a, 2), (b, 2)):
+            roles = [ev for ev in server.flight()["events"]
+                     if ev["kind"] == "role_change"]
+            assert roles and any(f"epoch={epoch}" in ev.get("detail", "")
+                                 for ev in roles)
+    finally:
+        if mgr is not None:
+            mgr.shutdown()
+        a.shutdown()
+        b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Static registries (grep-pinned, test_obs.py discipline)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_event_kinds_match_native_registry() -> None:
+    """Every kFlight* kind constant in native/src/flight.h is registered in
+    obs.flight.FLIGHT_EVENTS and vice versa, and every RecordEvent call
+    site in the native servers uses a declared constant (no string-literal
+    kinds can ship unregistered)."""
+    flight_h = open(os.path.join(REPO, "native", "src", "flight.h")).read()
+    native_kinds = dict(
+        re.findall(r'constexpr char kFlight(\w+)\[\] = "([a-z_]+)";', flight_h)
+    )
+    assert native_kinds, "kFlight* grep found nothing — pattern rot?"
+    assert set(native_kinds.values()) == set(FLIGHT_EVENTS), (
+        f"native kinds {sorted(native_kinds.values())} != registry "
+        f"{sorted(FLIGHT_EVENTS)}"
+    )
+    for fname in ("lighthouse.cc", "manager.cc", "flight.cc"):
+        src = open(os.path.join(REPO, "native", "src", fname)).read()
+        # Call sites only (`flight_.RecordEvent(...)`) — the unqualified
+        # name also matches the method's own definition in flight.cc.
+        for arg in re.findall(r"\.RecordEvent\(\s*([A-Za-z_\"]+)", src):
+            assert not arg.startswith('"'), (
+                f"{fname}: RecordEvent with a string-literal kind {arg} — "
+                "declare a kFlight* constant instead"
+            )
+            assert arg.replace("kFlight", "") in native_kinds, (
+                f"{fname}: RecordEvent kind {arg} not declared in flight.h"
+            )
+
+
+def test_every_span_phase_has_a_track_mapping() -> None:
+    from torchft_tpu.obs.spans import OVERLAPPED_PHASES, PHASES
+    from torchft_tpu.obs.trace import PHASE_TRACKS
+
+    assert set(PHASES) == set(PHASE_TRACKS), (
+        f"PHASES {sorted(PHASES)} != PHASE_TRACKS {sorted(PHASE_TRACKS)}"
+    )
+    assert set(PHASE_TRACKS.values()) <= {"main", "background"}
+    # The background set IS the overlapped set — one source of truth each,
+    # pinned against each other.
+    assert {p for p, t in PHASE_TRACKS.items() if t == "background"} == set(
+        OVERLAPPED_PHASES
+    )
+
+
+def test_trace_id_mint_parse_roundtrip() -> None:
+    tid = mint_trace_id(3, "g0:abcd", 41)
+    assert parse_trace_id(tid) == (3, "g0:abcd", 41)
+    assert parse_trace_id("garbage") is None
+    # replica ids containing '/' and '#' still round-trip (first-'/' +
+    # last-'#' splitting).
+    assert parse_trace_id(mint_trace_id(0, "a/b#c", 7)) == (0, "a/b#c", 7)
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export: control-plane track next to worker tracks
+# ---------------------------------------------------------------------------
+
+
+def test_trace_export_includes_control_plane_track(tmp_path) -> None:
+    from torchft_tpu.obs import trace as obs_trace
+
+    events = obs_trace.synthetic_stream(n_replicas=2, steps=3)
+    events += obs_trace.synthetic_flight_stream(n_replicas=2, steps=3)
+    events.sort(key=lambda ev: ev["ts"])
+    built = obs_trace.build_trace(events)
+    assert not obs_trace.validate_trace(built)
+    cp = built["otherData"]["control_plane"]
+    assert len(cp) == 1
+    cp_pid = int(list(cp.values())[0].split()[1])
+    worker_pids = {
+        int(v.split()[1]) for v in built["otherData"]["replicas"].values()
+    }
+    assert cp_pid not in worker_pids
+    cp_slices = [ev for ev in built["traceEvents"]
+                 if ev.get("ph") == "X" and ev.get("pid") == cp_pid]
+    assert cp_slices, "no control-plane slices rendered"
+    assert {s["name"] for s in cp_slices} >= {"Quorum", "Heartbeat"}
+    # Time alignment: the lighthouse's server-side Quorum slice must sit
+    # INSIDE the matching worker quorum span's window (same trace id);
+    # both streams share the synthetic wall clock, and the aligner must
+    # not shift them apart.
+    worker_q = [ev for ev in built["traceEvents"]
+                if ev.get("ph") == "X" and ev.get("name") == "quorum"
+                and ev.get("pid") in worker_pids]
+    cp_q = [s for s in cp_slices if s["name"] == "Quorum"]
+    assert cp_q and worker_q
+    # Every server-side Quorum slice must sit inside (±60 ms of clamping
+    # slack) SOME worker quorum span's window — both streams share the
+    # synthetic wall clock, and the aligner must not shift them apart.
+    for s in cp_q:
+        s0, s1 = s["ts"], s["ts"] + s["dur"]
+        assert any(
+            w["ts"] - 60e3 <= s0 and s1 <= w["ts"] + w["dur"] + 60e3
+            for w in worker_q
+        ), f"control-plane slice at {s0}µs outside every worker quorum window"
+
+    # The instant transition renders on the control-plane pid.
+    cp_instants = [ev for ev in built["traceEvents"]
+                   if ev.get("ph") == "i" and ev.get("pid") == cp_pid]
+    assert any(ev["name"] == "cp:quorum_formed" for ev in cp_instants)
+
+
+def test_report_splits_quorum_wait_with_flight_data(tmp_path) -> None:
+    """obs.report splits quorum_wait into server-formation vs
+    client-transport using a REAL lighthouse flight dump joined by trace
+    id (the acceptance-criteria (c) leg, minus the full bench)."""
+    from torchft_tpu.metrics import MetricsLogger
+    from torchft_tpu.obs import report as obs_report
+
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=2,
+                          join_timeout_ms=2000, quorum_tick_ms=20, http_bind="")
+    try:
+        import threading
+        import time
+
+        client = LighthouseClient(lh.address())
+        peer = LighthouseClient(lh.address())
+        path = tmp_path / "m.jsonl"
+        logger = MetricsLogger(str(path), replica_id="g0:rr")
+
+        for step in (1, 2, 3):
+            tid = mint_trace_id(0, "g0:rr", step)
+            # The peer group joins ~150 ms late: the lighthouse HOLDS g0's
+            # quorum handler for that long (min_replicas=2), so the
+            # server-side share of the wait is macroscopic — the loopback
+            # sub-millisecond case rounds to zero in the totals.
+            late = threading.Thread(
+                target=lambda s=step: (
+                    time.sleep(0.15),
+                    peer.quorum("g1:pp", timeout_ms=10000, step=s),
+                )
+            )
+            late.start()
+            t0 = time.monotonic()
+            client.quorum("g0:rr", timeout_ms=10000, step=step, trace_id=tid)
+            dur_ms = (time.monotonic() - t0) * 1e3
+            late.join()
+            logger.emit("span", phase="quorum", step=step, slice_gen=0,
+                        duration_ms=round(dur_ms, 3), trace_id=tid)
+            logger.emit("commit", step=step, committed=True)
+            time.sleep(0.02)
+        logger.close()
+        client.close()
+        peer.close()
+        dump_events = flight_events(lh.flight())
+    finally:
+        lh.shutdown()
+
+    events = obs_report.read_events([str(path)])
+    result = obs_report.attribute(events, flight_events=dump_events)
+    t = result["totals"]
+    assert t["quorum_wait_s"] > 0
+    assert t["quorum_server_s"] > 0, "no server-side time matched by trace id"
+    assert t["quorum_server_s"] <= t["quorum_wait_s"] + 1e-9
+    assert abs(t["quorum_server_s"] + t["quorum_transport_s"]
+               - t["quorum_wait_s"]) < 1e-6
+    # Without flight data the split stays zero (informational default).
+    plain = obs_report.attribute(events)
+    assert plain["totals"]["quorum_server_s"] == 0.0

@@ -356,6 +356,9 @@ def test_trace_export_quick_smoke() -> None:
     summary = json.loads(out.stdout)
     assert summary["ok"] is True and summary["problems"] == []
     assert summary["replicas"] == 2
+    # The control-plane track (lighthouse flight-recorder view) rides in
+    # the same smoke (ISSUE 7) — one synthetic lighthouse source.
+    assert summary["control_plane_tracks"] == 1
     assert summary["trace_events"] > 0
     with open(summary["out"]) as f:
         trace = json.load(f)

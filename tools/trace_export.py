@@ -42,7 +42,15 @@ def main(argv=None) -> int:
     )
     ap.add_argument("paths", nargs="*", help="metrics.jsonl file(s)")
     ap.add_argument(
-        "--workdir", help="collect every *.jsonl under this directory instead"
+        "--workdir", help="collect every *.jsonl (and flight_*.json flight-"
+        "recorder dump) under this directory instead"
+    )
+    ap.add_argument(
+        "--flight",
+        action="append",
+        default=[],
+        metavar="FLIGHT_JSON",
+        help="flight-recorder dump(s) to merge as a control-plane track",
     )
     ap.add_argument("-o", "--out", help="output path (default: trace.json next "
                     "to the first input)")
@@ -60,9 +68,16 @@ def main(argv=None) -> int:
     from torchft_tpu.obs import trace as obs_trace
 
     if args.quick:
+        # Worker stream + the lighthouse's synthetic flight view of the
+        # same run: the smoke covers the control-plane track end to end.
         events = obs_trace.synthetic_stream(n_replicas=2, steps=4)
+        events += obs_trace.synthetic_flight_stream(n_replicas=2, steps=4)
+        events.sort(key=lambda ev: ev["ts"])
         built = obs_trace.build_trace(events, align=not args.no_align)
         problems = obs_trace.validate_trace(built)
+        cp_tracks = built.get("otherData", {}).get("control_plane", {})
+        if not cp_tracks:
+            problems.append("control-plane track missing from --quick trace")
         out = args.out
         if out is None:
             fd, out = tempfile.mkstemp(prefix="tpuft_trace_", suffix=".json")
@@ -77,6 +92,7 @@ def main(argv=None) -> int:
                     "input_events": len(events),
                     "trace_events": len(built["traceEvents"]),
                     "replicas": len(built.get("otherData", {}).get("replicas", {})),
+                    "control_plane_tracks": len(cp_tracks),
                     "problems": problems,
                 }
             )
@@ -84,14 +100,23 @@ def main(argv=None) -> int:
         return 0 if not problems else 1
 
     paths = list(args.paths)
+    flight_paths = list(args.flight)
     if args.workdir:
         paths += sorted(
             glob.glob(os.path.join(args.workdir, "**", "*.jsonl"), recursive=True)
         )
-    if not paths:
-        ap.error("no input: pass metrics.jsonl path(s) or --workdir")
-    out = args.out or os.path.join(os.path.dirname(paths[0]) or ".", "trace.json")
-    summary = obs_trace.export(paths, out, align=not args.no_align)
+        flight_paths += sorted(
+            glob.glob(
+                os.path.join(args.workdir, "**", "flight_*.json"), recursive=True
+            )
+        )
+    if not paths and not flight_paths:
+        ap.error("no input: pass metrics.jsonl path(s), --flight, or --workdir")
+    first = paths[0] if paths else flight_paths[0]
+    out = args.out or os.path.join(os.path.dirname(first) or ".", "trace.json")
+    summary = obs_trace.export(
+        paths, out, align=not args.no_align, flight_paths=flight_paths
+    )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1
 

@@ -117,6 +117,8 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.POINTER(ctypes.c_size_t),
     ]
+    lib.tf_lighthouse_flight_json.restype = ctypes.c_void_p
+    lib.tf_lighthouse_flight_json.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.tf_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_lighthouse_free.argtypes = [ctypes.c_void_p]
     lib.tf_manager_new.restype = ctypes.c_void_p
@@ -140,6 +142,8 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_double,
         ctypes.c_double,
     ]
+    lib.tf_manager_flight_json.restype = ctypes.c_void_p
+    lib.tf_manager_flight_json.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.tf_manager_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_manager_free.argtypes = [ctypes.c_void_p]
     lib.tf_store_new.restype = ctypes.c_void_p
@@ -383,6 +387,24 @@ class LighthouseServer:
     def leader_epoch(self) -> int:
         return int(_lib.tf_lighthouse_leader_epoch(self._ptr)) if self._ptr else 0
 
+    def flight_json(self, limit: int = 0) -> str:
+        """Flight-recorder snapshot as a JSON document string (newest-first
+        events; ``limit`` 0 = all retained).  Same payload as this
+        lighthouse's ``GET /debug/flight.json`` (docs/wire.md "Flight
+        recorder")."""
+        if not self._ptr:
+            return "{}"
+        return _take_string(_lib.tf_lighthouse_flight_json(self._ptr, int(limit)))
+
+    def flight(self, limit: int = 0) -> dict:
+        """Parsed :meth:`flight_json` — ``{"server", "id", "capacity",
+        "recorded", "dropped", "events": [...]}`` with events newest-first.
+        Use :mod:`torchft_tpu.obs.flight` to reconstruct quorum-transition
+        sequences or merge into a Perfetto trace."""
+        import json
+
+        return json.loads(self.flight_json(limit) or "{}")
+
     def snapshot(self) -> bytes:
         """Serialized ``LighthouseReplicateRequest`` of the full replicable
         state (membership, live step/state, straggler-sentinel health,
@@ -506,10 +528,12 @@ class LighthouseClient:
         world_size: int = 1,
         shrink_only: bool = False,
         data: Optional[dict] = None,
+        trace_id: str = "",
     ) -> "pb.Quorum":
         import json
 
         req = pb.LighthouseQuorumRequest()
+        req.trace_id = trace_id
         m = req.requester
         m.replica_id = replica_id
         m.address = address
@@ -533,17 +557,20 @@ class LighthouseClient:
         state: str = "",
         step_time_ms_ewma: float = 0.0,
         step_time_ms_last: float = 0.0,
+        trace_id: str = "",
     ) -> None:
         """One heartbeat; ``step``/``state`` feed the lighthouse's live
         per-replica observability (GET /metrics step lag, /status.json) and
         the step-time fields feed its straggler sentinel (fields 4-5,
-        docs/wire.md)."""
+        docs/wire.md).  ``trace_id`` stamps the causal trace of the step in
+        flight (field 7)."""
         req = pb.LighthouseHeartbeatRequest(
             replica_id=replica_id,
             step=int(step),
             state=state,
             step_time_ms_ewma=float(step_time_ms_ewma),
             step_time_ms_last=float(step_time_ms_last),
+            trace_id=trace_id,
         )
         self._call_failover(LIGHTHOUSE_HEARTBEAT, req.SerializeToString(), timeout_ms)
 
@@ -560,7 +587,11 @@ class LighthouseClient:
         return int(resp.evicted)
 
     def drain(
-        self, replica_prefix: str, deadline_ms: int = 0, timeout_ms: int = 5000
+        self,
+        replica_prefix: str,
+        deadline_ms: int = 0,
+        timeout_ms: int = 5000,
+        trace_id: str = "",
     ) -> int:
         """Cooperative-drain notice over the wire (method 5, docs/wire.md):
         mark the matching replica ids as departing so the next quorum forms
@@ -568,7 +599,9 @@ class LighthouseClient:
         This is what a departing Manager sends the moment its DrainWatcher
         fires (SIGTERM / GCE preemption notice / explicit trigger)."""
         req = pb.LighthouseDrainRequest(
-            replica_prefix=replica_prefix, deadline_ms=int(deadline_ms)
+            replica_prefix=replica_prefix,
+            deadline_ms=int(deadline_ms),
+            trace_id=trace_id,
         )
         resp = pb.LighthouseDrainResponse()
         resp.ParseFromString(
@@ -673,6 +706,20 @@ class ManagerServer:
                 float(allreduce_gb_per_s),
             )
 
+    def flight_json(self, limit: int = 0) -> str:
+        """Flight-recorder snapshot (newest-first JSON document; ``limit``
+        0 = all retained).  Managers serve no HTTP, so this accessor and
+        the ``TPUFT_FLIGHT_DIR`` shutdown dump are the read paths."""
+        if not self._ptr:
+            return "{}"
+        return _take_string(_lib.tf_manager_flight_json(self._ptr, int(limit)))
+
+    def flight(self, limit: int = 0) -> dict:
+        """Parsed :meth:`flight_json` (see ``LighthouseServer.flight``)."""
+        import json
+
+        return json.loads(self.flight_json(limit) or "{}")
+
     def shutdown(self) -> None:
         if self._ptr:
             _lib.tf_manager_shutdown(self._ptr)
@@ -703,6 +750,7 @@ class ManagerClient:
         timeout_ms: int,
         init_sync: bool = True,
         commit_failures: int = 0,
+        trace_id: str = "",
     ) -> QuorumResult:
         req = pb.ManagerQuorumRequest(
             group_rank=group_rank,
@@ -711,6 +759,7 @@ class ManagerClient:
             shrink_only=shrink_only,
             init_sync=init_sync,
             commit_failures=commit_failures,
+            trace_id=trace_id,
         )
         resp = pb.ManagerQuorumResponse()
         resp.ParseFromString(
@@ -742,8 +791,10 @@ class ManagerClient:
             heal=resp.heal,
         )
 
-    def _checkpoint_metadata(self, rank: int, timeout_ms: int) -> str:
-        req = pb.CheckpointMetadataRequest(group_rank=rank)
+    def _checkpoint_metadata(
+        self, rank: int, timeout_ms: int, trace_id: str = ""
+    ) -> str:
+        req = pb.CheckpointMetadataRequest(group_rank=rank, trace_id=trace_id)
         resp = pb.CheckpointMetadataResponse()
         resp.ParseFromString(
             self._client.call(MANAGER_CHECKPOINT_METADATA, req.SerializeToString(), timeout_ms)
@@ -751,10 +802,18 @@ class ManagerClient:
         return resp.checkpoint_metadata
 
     def should_commit(
-        self, group_rank: int, step: int, should_commit: bool, timeout_ms: int
+        self,
+        group_rank: int,
+        step: int,
+        should_commit: bool,
+        timeout_ms: int,
+        trace_id: str = "",
     ) -> bool:
         req = pb.ShouldCommitRequest(
-            group_rank=group_rank, step=step, should_commit=should_commit
+            group_rank=group_rank,
+            step=step,
+            should_commit=should_commit,
+            trace_id=trace_id,
         )
         resp = pb.ShouldCommitResponse()
         resp.ParseFromString(

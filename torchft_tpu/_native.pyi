@@ -77,6 +77,8 @@ class LighthouseServer:
     ) -> None: ...
     def role(self) -> int: ...
     def leader_epoch(self) -> int: ...
+    def flight_json(self, limit: int = ...) -> str: ...
+    def flight(self, limit: int = ...) -> Dict[str, Any]: ...
     def snapshot(self) -> bytes: ...
     def shutdown(self) -> None: ...
 
@@ -94,6 +96,7 @@ class LighthouseClient:
         world_size: int = ...,
         shrink_only: bool = ...,
         data: Optional[Dict[str, Any]] = ...,
+        trace_id: str = ...,
     ) -> Any: ...  # pb.Quorum
     def heartbeat(
         self,
@@ -103,10 +106,15 @@ class LighthouseClient:
         state: str = ...,
         step_time_ms_ewma: float = ...,
         step_time_ms_last: float = ...,
+        trace_id: str = ...,
     ) -> None: ...
     def evict(self, replica_prefix: str, timeout_ms: int = ...) -> int: ...
     def drain(
-        self, replica_prefix: str, deadline_ms: int = ..., timeout_ms: int = ...
+        self,
+        replica_prefix: str,
+        deadline_ms: int = ...,
+        timeout_ms: int = ...,
+        trace_id: str = ...,
     ) -> int: ...
     def status(self, timeout_ms: int = ...) -> Any: ...  # pb.LighthouseStatusResponse
     def leader(self, timeout_ms: int = ...) -> Any: ...  # pb.LighthouseLeaderInfoResponse
@@ -133,6 +141,8 @@ class ManagerServer:
         step_time_ms_last: float = ...,
         allreduce_gb_per_s: float = ...,
     ) -> None: ...
+    def flight_json(self, limit: int = ...) -> str: ...
+    def flight(self, limit: int = ...) -> Dict[str, Any]: ...
     def shutdown(self) -> None: ...
 
 class ManagerClient:
@@ -146,10 +156,18 @@ class ManagerClient:
         timeout_ms: int,
         init_sync: bool = ...,
         commit_failures: int = ...,
+        trace_id: str = ...,
     ) -> QuorumResult: ...
-    def _checkpoint_metadata(self, rank: int, timeout_ms: int) -> str: ...
+    def _checkpoint_metadata(
+        self, rank: int, timeout_ms: int, trace_id: str = ...
+    ) -> str: ...
     def should_commit(
-        self, group_rank: int, step: int, should_commit: bool, timeout_ms: int
+        self,
+        group_rank: int,
+        step: int,
+        should_commit: bool,
+        timeout_ms: int,
+        trace_id: str = ...,
     ) -> bool: ...
     def close(self) -> None: ...
 

@@ -236,6 +236,13 @@ class Manager:
         self._participating_replica_rank: Optional[int] = None
         self._participating_replica_world_size: int = 0
 
+        # Causal trace id of the step in flight (docs/wire.md "Causal trace
+        # ids"): minted once per quorum round and carried on every control
+        # RPC — Quorum (via the native ManagerServer to the lighthouse),
+        # CheckpointMetadata, ShouldCommit, Drain — so the server-side
+        # flight recorders can be joined to this replica's span stream.
+        self._trace_id: str = ""
+
         # Cooperative-drain state (torchft_tpu/drain): set once by
         # begin_drain, observed by the train loop between steps.
         self._drain_notice = None
@@ -382,7 +389,18 @@ class Manager:
             self._checkpoint_transport.metadata() if self._checkpoint_transport else ""
         )
         self._set_status("quorum")
-        with self._spans.span("quorum", step=self._step) as sp_quorum:
+        # Mint this step's causal trace id; the span record carries it so
+        # obs/report.py can join the client-observed quorum wait against
+        # the lighthouse flight recorder's server-side formation span.
+        from torchft_tpu.obs.flight import mint_trace_id
+
+        trace_id = mint_trace_id(
+            self._spans.slice_gen, self._replica_id, self._step
+        )
+        self._trace_id = trace_id
+        with self._spans.span(
+            "quorum", step=self._step, trace_id=trace_id
+        ) as sp_quorum:
             quorum = self._client._quorum(
                 group_rank=self._rank,
                 step=self._step,
@@ -391,6 +409,7 @@ class Manager:
                 timeout_ms=int(quorum_timeout.total_seconds() * 1000),
                 init_sync=self._init_sync,
                 commit_failures=self._commit_failures,
+                trace_id=trace_id,
             )
 
         quorum_id = quorum.quorum_id
@@ -593,6 +612,7 @@ class Manager:
                 return client._checkpoint_metadata(
                     self._rank,
                     timeout_ms=int(self._timeout.total_seconds() * 1000),
+                    trace_id=self._trace_id,
                 )
             finally:
                 client.close()
@@ -874,6 +894,7 @@ class Manager:
                 vote_step,
                 local_should_commit,
                 timeout_ms=int((timeout or self._timeout).total_seconds() * 1000),
+                trace_id=self._trace_id,
             )
         self._logger.info(
             f"should_commit={should_commit} (local={local_should_commit}, "
@@ -1020,6 +1041,7 @@ class Manager:
                                 self._replica_id,
                                 deadline_ms=notice.deadline_ms_from_now(),
                                 timeout_ms=2000,
+                                trace_id=self._trace_id,
                             )
                         finally:
                             client.close()

@@ -28,11 +28,18 @@ Three halves plus the live exposition:
 
       python tools/trace_export.py metrics.jsonl [...]
 
-The live leg — cluster metrics and the straggler sentinel — is served by
-the native lighthouse (``GET /metrics``, ``GET /alerts.json``; see
-docs/wire.md).
+- :mod:`torchft_tpu.obs.flight` — the *control-plane* side.  Registry and
+  consumers for the native servers' flight recorders (bounded RPC-span +
+  state-transition rings, ``GET /debug/flight.json``, ``TPUFT_FLIGHT_DIR``
+  shutdown dumps): causal trace ids, quorum-transition reconstruction,
+  and conversion into the Perfetto control-plane track.
+
+The live leg — cluster metrics, latency histograms, and the straggler
+sentinel — is served by the native lighthouse (``GET /metrics``,
+``GET /alerts.json``, ``GET /debug/flight.json``; see docs/wire.md).
 """
 
+from torchft_tpu.obs.flight import FLIGHT_EVENTS, mint_trace_id
 from torchft_tpu.obs.spans import SpanTracker, StepTimeStats
 
-__all__ = ["SpanTracker", "StepTimeStats"]
+__all__ = ["FLIGHT_EVENTS", "SpanTracker", "StepTimeStats", "mint_trace_id"]

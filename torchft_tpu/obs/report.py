@@ -307,7 +307,52 @@ def _phase_ms(events: Sequence[dict]) -> Dict[Tuple[str, int], Dict[str, float]]
     return spans
 
 
-def attribute(events: Sequence[dict]) -> dict:
+def quorum_server_ms(
+    events: Sequence[dict], flight_events: Sequence[dict]
+) -> Dict[Tuple[str, int], float]:
+    """``{(replica_id, step): server-side quorum ms}`` joining the worker
+    span stream against a lighthouse flight recorder by causal trace id.
+
+    The worker's ``quorum`` span measures the CLIENT-observed wait (RPC
+    transport, failover retries, the blocked server handler).  The flight
+    recorder's ``rpc`` span for the same trace id measures the SERVER-side
+    handling window (which contains the formation wait).  Their difference
+    is client transport/retry cost — the split :func:`attribute` reports.
+    Server spans for one trace id are summed across records (an HA
+    failover records a rejection span on the old leader and the real span
+    on the new one; both are real server-side time the client paid)."""
+    server_ms: Dict[str, float] = {}
+    for ev in flight_events:
+        if ev.get("kind") != "rpc" or ev.get("method") != "Quorum":
+            continue
+        tid = str(ev.get("trace_id", ""))
+        if not tid:
+            continue
+        server_ms[tid] = server_ms.get(tid, 0.0) + max(
+            0.0, float(ev.get("dur_us", 0)) / 1e3
+        )
+    # Each (replica, step) sums its DISTINCT trace ids' server totals, not
+    # one total per worker span: a retried commit re-runs the quorum with
+    # the SAME step-keyed trace id and emits a second worker span — adding
+    # server_ms per span would double the server share and zero out the
+    # transport split on exactly the retried steps.
+    tids_by_key: Dict[Tuple[str, int], set] = {}
+    for ev in events:
+        if ev.get("event") != "span" or ev.get("phase") != "quorum":
+            continue
+        tid = str(ev.get("trace_id", ""))
+        if tid in server_ms:
+            key = (str(ev.get("replica_id", "")), int(ev.get("step", -1)))
+            tids_by_key.setdefault(key, set()).add(tid)
+    return {
+        key: sum(server_ms[tid] for tid in tids)
+        for key, tids in tids_by_key.items()
+    }
+
+
+def attribute(
+    events: Sequence[dict], flight_events: Optional[Sequence[dict]] = None
+) -> dict:
     """Builds the per-step cluster attribution.
 
     Returns ``{"steps": [row...], "totals": {...}, "goodput": {...}}``.
@@ -320,12 +365,22 @@ def attribute(events: Sequence[dict]) -> dict:
     quorum_wait / heal / drain / idle: step intervals split by their phase
     breakdown; gaps between incarnations (or commit gaps containing a
     fault) are idle, or drain when a drain fault falls inside.
+
+    With ``flight_events`` (a lighthouse flight-recorder dump's events,
+    see obs/flight.py), quorum_wait_s is additionally split into
+    ``quorum_server_s`` (the lighthouse's own formation/handling window,
+    matched by causal trace id) and ``quorum_transport_s`` (client
+    transport + failover retries) — informational sub-buckets, not new
+    accounting classes.
     """
     commits = commit_timelines(events)
     faults = fault_times(events)
     dw = deadwindow(commits, faults)
     phase_ms = _phase_ms(events)
     elections = election_windows(events)
+    server_q_ms = (
+        quorum_server_ms(events, flight_events) if flight_events else {}
+    )
 
     # Per-incarnation commit sequences: (rid, [(ts, t_mono, step)...]).
     per_inc: Dict[str, List[Tuple[float, float, int]]] = {}
@@ -356,6 +411,11 @@ def attribute(events: Sequence[dict]) -> dict:
         # worker fault's idle time) — this total just makes the election
         # cost visible on its own line.
         "election_s": 0.0,
+        # Informational split of quorum_wait_s when a flight recorder was
+        # provided: server-side formation/handling vs client transport and
+        # retries.  Zero (not the split) without flight data.
+        "quorum_server_s": 0.0,
+        "quorum_transport_s": 0.0,
     }
     t0 = dw["t0"]
     for rid, seq in per_inc.items():
@@ -386,6 +446,13 @@ def attribute(events: Sequence[dict]) -> dict:
             snapshot_overlap = (
                 sum(phases.get(k, 0.0) for k in _OVERLAPPED) / 1e3
             )
+            # Flight-recorder split of the quorum wait: the server-side
+            # window (clamped to q — clock granularity can make the server
+            # span read microseconds past the client wait) vs the client's
+            # transport/retry remainder.  Only meaningful when the span's
+            # trace id matched a recorded server span.
+            q_server = min(q, server_q_ms.get((rid, step), 0.0) / 1e3)
+            q_transport = q - q_server if (rid, step) in server_q_ms else 0.0
             productive = max(0.0, wall - q - heal - other_ft)
             buckets = {
                 "productive": productive,
@@ -399,6 +466,8 @@ def attribute(events: Sequence[dict]) -> dict:
                     "replica_id": rid,
                     "wall_s": wall,
                     "quorum_wait_s": q,
+                    "quorum_server_s": q_server,
+                    "quorum_transport_s": q_transport,
                     "heal_s": heal,
                     "other_ft_s": other_ft,
                     "snapshot_overlap_s": snapshot_overlap,
@@ -408,6 +477,8 @@ def attribute(events: Sequence[dict]) -> dict:
             )
             totals["productive_s"] += productive
             totals["quorum_wait_s"] += q
+            totals["quorum_server_s"] += q_server
+            totals["quorum_transport_s"] += q_transport
             totals["heal_s"] += heal
             totals["other_ft_s"] += other_ft
             totals["snapshot_overlap_s"] += snapshot_overlap
@@ -472,6 +543,8 @@ def attribute(events: Sequence[dict]) -> dict:
                 "wall_s": round(slowest["wall_s"], 4),
                 "productive_s": round(slowest["productive_s"], 4),
                 "quorum_wait_s": round(slowest["quorum_wait_s"], 4),
+                "quorum_server_s": round(slowest["quorum_server_s"], 4),
+                "quorum_transport_s": round(slowest["quorum_transport_s"], 4),
                 "heal_s": round(slowest["heal_s"], 4),
                 "other_ft_s": round(slowest["other_ft_s"], 4),
                 "snapshot_overlap_s": round(slowest["snapshot_overlap_s"], 4),
@@ -542,17 +615,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("paths", nargs="+", help="metrics.jsonl file(s)")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument(
+        "--flight",
+        action="append",
+        default=[],
+        metavar="FLIGHT_JSON",
+        help="flight-recorder dump(s) (flight_lighthouse_*.json) — splits "
+        "quorum_wait into server-formation vs client-transport by trace id",
+    )
     args = ap.parse_args(argv)
     stats: dict = {}
     events = read_events(args.paths, stats=stats)
     if not events:
         print("no events parsed", file=sys.stderr)
         return 1
-    result = attribute(events)
+    flight: list = []
+    unreadable_flight: list = []
+    for fp in args.flight:
+        try:
+            from torchft_tpu.obs.flight import flight_events as _fes
+            from torchft_tpu.obs.flight import load_flight_dump
+
+            flight.extend(_fes(load_flight_dump(fp)))
+        except (OSError, ValueError):
+            unreadable_flight.append(fp)
+            print(f"warning: {fp}: unreadable flight dump", file=sys.stderr)
+    result = attribute(events, flight_events=flight or None)
     result["input"] = {
         "events": len(events),
         "skipped_lines": stats.get("skipped_lines", 0),
         "unreadable_files": stats.get("unreadable_files", []),
+        "flight_events": len(flight),
+        "unreadable_flight_dumps": unreadable_flight,
     }
     if args.json:
         json.dump(result, sys.stdout)

@@ -37,7 +37,29 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["build_trace", "validate_trace", "synthetic_stream"]
+__all__ = [
+    "PHASE_TRACKS",
+    "build_trace",
+    "validate_trace",
+    "synthetic_stream",
+    "synthetic_flight_stream",
+]
+
+# Track mapping for every registered span phase (obs/spans.py PHASES):
+# "main" renders on the incarnation's sequential phase track, "background"
+# on its overlapped sub-track (tid+1).  Every PHASES entry must appear
+# here — a new phase without a mapping would silently land on the main
+# track and could corrupt its non-overlap clamping.  Grep-pinned by
+# tests/test_flight.py (static registry check).
+PHASE_TRACKS = {
+    "quorum": "main",
+    "configure": "main",
+    "heal": "main",
+    "allreduce_d2h": "main",
+    "allreduce_merge": "main",
+    "commit_vote": "main",
+    "snapshot": "background",
+}
 
 # Events rendered as instant markers on the emitting replica's track (or
 # the global track for the bench driver's fault schedule).
@@ -97,13 +119,13 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
 
     spans = [ev for ev in events if ev.get("event") == "span"]
     instants = [ev for ev in events if ev.get("event") in _INSTANT_EVENTS]
-    if not spans and not instants:
+    # Control-plane stream (obs/flight.py flight_to_stream): RPC spans and
+    # state instants from the native servers' flight recorders, rendered
+    # on their own process next to the worker tracks.
+    cp_rpcs = [ev for ev in events if ev.get("event") == "cp_rpc"]
+    cp_instants = [ev for ev in events if ev.get("event") == "cp_event"]
+    if not spans and not instants and not cp_rpcs and not cp_instants:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-
-    # Track layout: pid per group (sorted), tid per incarnation within the
-    # group (ordered by first appearance), +1 sub-track for overlapped
-    # phases.  The bench driver's fault schedule gets pid 0.
-    from torchft_tpu.obs.spans import OVERLAPPED_PHASES
 
     # Only span-emitting replicas get tracks; instants from anything else
     # (the bench driver's fault schedule, the launcher) render on the
@@ -129,12 +151,51 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
         for i, rid in enumerate(incarnations):
             tid_of[rid] = 1 + 2 * i  # odd = phases, even (tid+1) = background
 
+    # Control-plane processes: one pid per source after the worker groups,
+    # one track per (RPC method, peer) pair — frames on one CONNECTION are
+    # handled strictly sequentially by the server, so per-peer lanes are
+    # genuinely non-overlapping, whereas a per-method-only lane is not
+    # (two groups' Quorum handlers block through the same formation window
+    # concurrently, and the non-overlap clamp would collapse the second
+    # span to zero).  tid 0 carries the state-transition instants.
+    # Control-plane timestamps use the server's wall clock with no
+    # per-replica offset: worker offsets are corrections TOWARD the
+    # cross-replica median, which is the same frame a one-host control
+    # plane's clock sits in.
+    cp_sources = sorted(
+        {str(ev.get("source", "control-plane")) for ev in cp_rpcs + cp_instants}
+    )
+    cp_pid_of = {s: len(groups) + 1 + i for i, s in enumerate(cp_sources)}
+    cp_lanes: Dict[str, List[Tuple[str, str]]] = {
+        s: sorted(
+            {
+                (str(ev.get("method", "?")), str(ev.get("peer", "")))
+                for ev in cp_rpcs
+                if str(ev.get("source", "control-plane")) == s
+            }
+        )
+        for s in cp_sources
+    }
+    cp_tid_of = {
+        (s, m, p): 1 + 2 * i
+        for s in cp_sources
+        for i, (m, p) in enumerate(cp_lanes[s])
+    }
+
     t0 = min(
         min(
             (corrected(ev) - float(ev.get("duration_ms", 0.0)) / 1e3 for ev in spans),
             default=float("inf"),
         ),
         min((corrected(ev) for ev in instants), default=float("inf")),
+        min(
+            (
+                float(ev.get("ts", 0.0)) - float(ev.get("duration_ms", 0.0)) / 1e3
+                for ev in cp_rpcs
+            ),
+            default=float("inf"),
+        ),
+        min((float(ev.get("ts", 0.0)) for ev in cp_instants), default=float("inf")),
     )
 
     def us(ts: float) -> float:
@@ -160,6 +221,26 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
             "args": {"name": "bench driver / faults"},
         }
     )
+    for s in cp_sources:
+        out.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": cp_pid_of[s],
+                "tid": 0,
+                "args": {"name": f"control plane {s}"},
+            }
+        )
+        for m, p in cp_lanes[s]:
+            out.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": cp_pid_of[s],
+                    "tid": cp_tid_of[(s, m, p)],
+                    "args": {"name": f"{s} {m} {p}".rstrip()},
+                }
+            )
     for rid, tid in sorted(tid_of.items()):
         pid = pid_of[_group(rid)]
         out.append(
@@ -180,7 +261,9 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
             continue
         pid = pid_of[_group(rid)]
         phase = str(ev.get("phase", "?"))
-        tid = tid_of[rid] + (1 if phase in OVERLAPPED_PHASES else 0)
+        tid = tid_of[rid] + (
+            1 if PHASE_TRACKS.get(phase, "main") == "background" else 0
+        )
         dur_s = float(ev.get("duration_ms", 0.0)) / 1e3
         end = corrected(ev)
         args = {
@@ -197,6 +280,32 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
                 "tid": tid,
                 "name": phase,
                 "cat": "phase",
+                "_start": end - dur_s,
+                "_end": end,
+                "args": args,
+            }
+        )
+    # Control-plane RPC slices: per (source, method, peer) lane, same
+    # clamping (a no-op within a lane — see the lane-layout comment).
+    for ev in cp_rpcs:
+        s = str(ev.get("source", "control-plane"))
+        m = str(ev.get("method", "?"))
+        pid = cp_pid_of[s]
+        tid = cp_tid_of[(s, m, str(ev.get("peer", "")))]
+        dur_s = float(ev.get("duration_ms", 0.0)) / 1e3
+        end = float(ev.get("ts", 0.0))
+        args = {
+            k: ev[k]
+            for k in ("trace_id", "peer", "status")
+            if ev.get(k) not in (None, "")
+        }
+        per_track.setdefault((pid, tid), []).append(
+            {
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+                "name": m,
+                "cat": "cp_rpc",
                 "_start": end - dur_s,
                 "_end": end,
                 "args": args,
@@ -256,6 +365,27 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
                 }
             )
 
+    # Control-plane state transitions: instants on the source's tid 0.
+    for ev in cp_instants:
+        s = str(ev.get("source", "control-plane"))
+        args = {
+            k: v
+            for k, v in ev.items()
+            if k not in ("ts", "event", "source", "kind") and v not in (None, "")
+        }
+        out.append(
+            {
+                "ph": "i",
+                "s": "t",
+                "pid": cp_pid_of[s],
+                "tid": 0,
+                "name": f"cp:{ev.get('kind', '?')}",
+                "cat": "cp_event",
+                "ts": us(float(ev.get("ts", 0.0))),
+                "args": args,
+            }
+        )
+
     out.sort(key=lambda ev: (ev.get("ts", 0.0), ev.get("pid", 0), ev.get("tid", 0)))
     return {
         "traceEvents": out,
@@ -264,6 +394,7 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
             "generator": "tpu-ft tools/trace_export.py",
             "replicas": {rid: f"pid {pid_of[_group(rid)]} tid {tid}"
                          for rid, tid in tid_of.items()},
+            "control_plane": {s: f"pid {cp_pid_of[s]}" for s in cp_sources},
             "clock_offsets_s": {k: round(v, 6) for k, v in offsets.items()},
         },
     }
@@ -335,6 +466,8 @@ def synthetic_stream(
         for step in range(1, steps + 1):
             end = base_ts + step * step_s + skew
             quorum_ms = 40.0 + 5 * r
+            from torchft_tpu.obs.flight import mint_trace_id
+
             events.append(
                 {
                     "ts": end - 0.5,
@@ -344,6 +477,7 @@ def synthetic_stream(
                     "step": step,
                     "slice_gen": 0,
                     "duration_ms": quorum_ms,
+                    "trace_id": mint_trace_id(0, rid, step),
                 }
             )
             if r == 1 and step == 2:
@@ -411,29 +545,105 @@ def synthetic_stream(
     return events
 
 
+def synthetic_flight_stream(
+    n_replicas: int = 2, steps: int = 4, base_ts: float = 1_700_000_000.0
+) -> List[dict]:
+    """Control-plane companion to :func:`synthetic_stream`: the lighthouse
+    flight recorder's view of the same run — one server-side Quorum RPC
+    span per (replica, step) whose trace id matches the worker stream's
+    quorum span, periodic Heartbeat spans, and a ``quorum_formed``
+    transition when the membership first assembles.  Used by
+    ``tools/trace_export.py --quick`` and the tier-1 trace tests."""
+    from torchft_tpu.obs.flight import mint_trace_id
+
+    source = "lighthouse:29510"
+    events: List[dict] = []
+    members = [f"{r}:{'abcdef'[r % 6]}{r}" for r in range(n_replicas)]
+    events.append(
+        {
+            "event": "cp_event",
+            "source": source,
+            "ts": base_ts + 0.95,
+            "kind": "quorum_formed",
+            "d_quorum_id": 1,
+            "d_members": members,
+            "d_joined": members,
+            "d_left": [],
+            "d_formation_ms": 42.0,
+        }
+    )
+    for r, rid in enumerate(members):
+        for step in range(1, steps + 1):
+            end = base_ts + step * 1.0 + 0.002 * r - 0.46
+            quorum_ms = 38.0 + 5 * r
+            events.append(
+                {
+                    "event": "cp_rpc",
+                    "source": source,
+                    "ts": end,
+                    "method": "Quorum",
+                    "status": 0,
+                    "peer": f"127.0.0.1:5{r}000",
+                    "trace_id": mint_trace_id(0, rid, step),
+                    "duration_ms": quorum_ms,
+                }
+            )
+            events.append(
+                {
+                    "event": "cp_rpc",
+                    "source": source,
+                    "ts": end + 0.1,
+                    "method": "Heartbeat",
+                    "status": 0,
+                    "peer": f"127.0.0.1:5{r}000",
+                    "trace_id": mint_trace_id(0, rid, step),
+                    "duration_ms": 0.05,
+                }
+            )
+    events.sort(key=lambda ev: ev["ts"])
+    return events
+
+
 def export(
     paths: Sequence[str],
     out_path: str,
     align: bool = True,
     stats: Optional[dict] = None,
+    flight_paths: Sequence[str] = (),
 ) -> dict:
-    """Reads JSONL streams, builds the trace, writes ``out_path``.  Returns
-    a summary dict (events, replicas, problems)."""
+    """Reads JSONL streams (plus optional flight-recorder dumps), builds
+    the trace, writes ``out_path``.  Returns a summary dict (events,
+    replicas, control-plane tracks, problems)."""
     from torchft_tpu.obs.report import read_events
 
     read_stats: dict = {}
     events = read_events(paths, stats=read_stats)
+    flight_skipped: List[str] = []
+    for fp in flight_paths:
+        # A torn dump (server killed mid-write never happens — the dump is
+        # atomic — but a foreign/corrupt file can be handed in) is skipped
+        # and counted, like garbage JSONL lines.
+        try:
+            from torchft_tpu.obs.flight import flight_to_stream, load_flight_dump
+
+            events.extend(flight_to_stream(load_flight_dump(fp)))
+        except (OSError, ValueError):
+            flight_skipped.append(fp)
+    events.sort(key=lambda ev: float(ev.get("ts", 0.0)))
     trace = build_trace(events, align=align)
     problems = validate_trace(trace)
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(trace, f)
     replicas = trace.get("otherData", {}).get("replicas", {})
+    control_plane = trace.get("otherData", {}).get("control_plane", {})
     summary = {
         "out": out_path,
         "input_events": len(events),
         "skipped_lines": read_stats.get("skipped_lines", 0),
         "trace_events": len(trace["traceEvents"]),
         "replicas": len(replicas),
+        "control_plane_tracks": len(control_plane),
+        "unreadable_flight_dumps": flight_skipped,
         "problems": problems,
         "ok": not problems,
     }
