@@ -23,7 +23,14 @@ Three sections, written as one JSON artifact (``ALLREDUCE_BENCH.json``):
                    monolithic reference path (one blocking fetch, then pack)
                    on the same shaped link and lane count — steps/s and
                    committed counts, plus the Manager's own
-                   ``allreduce_gb_per_s`` step_summary telemetry.
+                   ``allreduce_gb_per_s`` step_summary telemetry.  The
+                   ``--device-prep`` A/B adds the device-resident wire-prep
+                   trial (on-device bf16 cast: the D2H fetch moves wire
+                   bytes, ~half of f32) and a sharded-fetch trial on a
+                   multi-device worker platform (``--sharded-devices``);
+                   every e2e record carries ``d2h_bytes`` / ``h2d_bytes``
+                   / ``wire_bytes`` / ``fetch_slices`` from the averager's
+                   transfer accounting.
 
   peer_kill      — 3 replica groups, lanes > 1: one group dies mid-step
                    (collective aborted + manager gone).  The survivors'
@@ -313,17 +320,23 @@ def _e2e_group_body(
     bucket_mb: float,
     timeout_s: float,
     compute_iters: int = 0,
+    device_prep: bool = False,
+    sharded: bool = False,
+    wire_dtype: str = "auto",
 ) -> Dict[str, Any]:
     """One replica group's training loop: compute per-leaf grads (when
     ``compute_iters`` > 0) -> start_quorum -> averager.allreduce(grads) ->
     should_commit, `steps` times.  Shared by the threaded (--quick) and
     subprocess drivers; the quorum round itself aligns group start across
-    processes."""
+    processes.  ``device_prep``/``sharded`` select the averager's
+    device-resident wire prep and sharding-aware fetch modes (the A/B the
+    ``--device-prep`` sweep measures); per-step d2h/h2d/wire bytes come
+    from the averager's transfer accounting."""
     from torchft_tpu.collectives import TCPCollective
     from torchft_tpu.ddp import GradientAverager
     from torchft_tpu.manager import Manager
 
-    collective = TCPCollective(timeout=timeout_s, lanes=lanes)
+    collective = TCPCollective(timeout=timeout_s, lanes=lanes, wire_dtype=wire_dtype)
     manager = Manager(
         collective=collective,
         load_state_dict=None,
@@ -340,7 +353,11 @@ def _e2e_group_body(
     )
     try:
         averager = GradientAverager(
-            manager, bucket_bytes=int(bucket_mb * (1 << 20)), pipelined=pipelined
+            manager,
+            bucket_bytes=int(bucket_mb * (1 << 20)),
+            pipelined=pipelined,
+            device_wire_prep=device_prep,
+            sharded_fetch=sharded,
         )
         params = _grad_tree(nbytes, n_leaves)
         grad_fn = _make_grad_fn(compute_iters) if compute_iters else None
@@ -351,6 +368,8 @@ def _e2e_group_body(
             jax.block_until_ready(grad_fn(params, 1.0))
         committed = 0
         gbps = 0.0
+        xfer = {"d2h_bytes": 0, "h2d_bytes": 0, "wire_bytes": 0, "slices": 0}
+        slices_per_bucket = 0
         # First quorum outside the timed window: join/rendezvous cost is
         # startup, not steady-state data-plane throughput.
         manager.start_quorum()
@@ -364,21 +383,42 @@ def _e2e_group_body(
             # path must wait for the whole tree before the first byte moves.
             grads = grad_fn(params, 1.0 + 0.1 * step) if grad_fn else params
             averager.allreduce(grads)
+            for k in xfer:
+                xfer[k] += int(averager.last_stats.get(k, 0))
+            ndev_buckets = int(averager.last_stats.get("device_buckets", 0))
+            if ndev_buckets:
+                # Measured shard factor — slices each bucket actually split
+                # into this step (not the CLI's requested device count).
+                slices_per_bucket = (
+                    int(averager.last_stats.get("slices", 0)) // ndev_buckets
+                )
             if manager.should_commit():
                 committed += 1
             gbps = max(gbps, manager._ar_gbps)
         wall = time.perf_counter() - t0
-        return {"committed": committed, "wall_s": wall, "gbps": gbps}
+        return {"committed": committed, "wall_s": wall, "gbps": gbps,
+                "slices_per_bucket": slices_per_bucket, **xfer}
     finally:
         manager.shutdown()
 
 
 def _e2e_worker(cfg: Dict[str, Any]) -> Dict[str, Any]:
     """Subprocess entry for one e2e replica group (--worker e2e)."""
+    if cfg.get("virtual_devices"):
+        # Must land before the first jax import: the sharded-fetch trial
+        # needs a multi-device CPU platform in each worker process.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={cfg['virtual_devices']}"
+            ).strip()
     return _e2e_group_body(
         cfg["lighthouse"], cfg["gid"], cfg["lanes"], cfg["pipelined"],
         cfg["steps"], cfg["nbytes"], cfg["n_leaves"], cfg["bucket_mb"],
         cfg["timeout_s"], cfg.get("compute_iters", 0),
+        cfg.get("device_prep", False), cfg.get("sharded", False),
+        cfg.get("wire_dtype", "auto"),
     )
 
 
@@ -395,6 +435,10 @@ def bench_e2e(
     procs: bool = True,
     compute_iters: int = 0,
     trials: int = 1,
+    device_prep: bool = False,
+    sharded: bool = False,
+    wire_dtype: str = "auto",
+    virtual_devices: int = 0,
 ) -> Dict[str, Any]:
     """2 replica groups, real lighthouse + Managers; measures committed
     steps/s for the pipelined vs monolithic bucket path.  ``procs=True``
@@ -422,7 +466,10 @@ def bench_e2e(
                          "steps": steps, "nbytes": nbytes,
                          "n_leaves": n_leaves, "bucket_mb": bucket_mb,
                          "timeout_s": timeout_s,
-                         "compute_iters": compute_iters}
+                         "compute_iters": compute_iters,
+                         "device_prep": device_prep, "sharded": sharded,
+                         "wire_dtype": wire_dtype,
+                         "virtual_devices": virtual_devices}
                         for g in range(2)
                     ]
                     attempt = _spawn_workers("e2e", cfgs, timeout_s + 120)
@@ -448,7 +495,7 @@ def bench_e2e(
                         results[gid] = _e2e_group_body(
                             lighthouse.address(), gid, lanes, pipelined,
                             steps, nbytes, n_leaves, bucket_mb, timeout_s,
-                            compute_iters,
+                            compute_iters, device_prep, sharded, wire_dtype,
                         )
                     except BaseException as e:  # noqa: BLE001 — re-raised
                         errors.append(e)
@@ -468,9 +515,27 @@ def bench_e2e(
     wall = max(r["wall_s"] for r in per_group)
     committed = min(r["committed"] for r in per_group)
     gbps_seen = [r["gbps"] for r in per_group if r["gbps"] > 0]
+    mode = "pipelined" if pipelined else "monolithic"
+    if device_prep:
+        mode += "+device_prep"
+    if sharded:
+        mode += "+sharded"
     out = {
         "section": "e2e",
-        "mode": "pipelined" if pipelined else "monolithic",
+        "mode": mode,
+        "device_prep": device_prep,
+        "sharded_fetch": sharded,
+        "wire_dtype": wire_dtype,
+        # Per-host transfer accounting over the whole kept trial (group 0's
+        # view; groups are symmetric): D2H fetch bytes, H2D scatter-back
+        # bytes, and the payload bytes handed to the ring — with device
+        # wire prep the d2h side reads wire (bf16) bytes, the ~2x the
+        # artifact pins.
+        "d2h_bytes": per_group[0].get("d2h_bytes", 0),
+        "h2d_bytes": per_group[0].get("h2d_bytes", 0),
+        "wire_bytes": per_group[0].get("wire_bytes", 0),
+        "fetch_slices": per_group[0].get("slices", 0),
+        "slices_per_bucket": per_group[0].get("slices_per_bucket", 0),
         "lanes": lanes,
         "grads_mb": grads_mb,
         "leaves": n_leaves,
@@ -648,8 +713,12 @@ def bench_peer_kill(
 
 def run_quick() -> Dict[str, Any]:
     """Tier-1 smoke (``--quick``): small payloads, 1 vs 2 lanes at the
-    collective level, pipelined vs monolithic commit counts end to end.
-    Wired into tests/test_bench_contract.py::test_allreduce_quick_smoke."""
+    collective level, pipelined vs monolithic commit counts end to end,
+    plus the device-wire-prep A/B (bf16 wire so the cast has something to
+    halve; sharded fetch engages when the process has multiple local
+    devices, e.g. under the test suite's forced 8-device CPU platform).
+    Wired into tests/test_bench_contract.py::test_allreduce_quick_smoke
+    and ::test_device_prep_quick_smoke."""
     lanes_results = [
         bench_lanes(payload_mb=2.0, lanes=l, mbps=0.0, rtt_ms=0.0,
                     n_buckets=4, timeout=60.0, procs=False)
@@ -661,13 +730,34 @@ def run_quick() -> Dict[str, Any]:
                   procs=False)
         for p in (True, False)
     ]
+    prep_results = [
+        bench_e2e(lanes=2, pipelined=True, steps=3, grads_mb=2.0, n_leaves=8,
+                  mbps=0.0, rtt_ms=0.0, bucket_mb=0.5, timeout_s=60.0,
+                  procs=False, device_prep=prep, sharded=shard,
+                  wire_dtype="bf16")
+        for prep, shard in ((False, False), (True, False), (True, True))
+    ]
     pipe = next(r for r in e2e_results if r["mode"] == "pipelined")
     mono = next(r for r in e2e_results if r["mode"] == "monolithic")
+    host_cast = prep_results[0]
+    dev_prep = prep_results[1]
+    dev_sharded = prep_results[2]
     return {
         "quick": True,
         "lanes": lanes_results,
         "e2e": e2e_results,
+        "device_prep": prep_results,
         "pipelined_commits_ok": pipe["committed"] >= mono["committed"],
+        "device_prep_commits_ok": (
+            dev_prep["committed"] >= host_cast["committed"]
+            and dev_sharded["committed"] >= host_cast["committed"]
+        ),
+        "d2h_reduction": (
+            round(host_cast["d2h_bytes"] / dev_prep["d2h_bytes"], 3)
+            if dev_prep["d2h_bytes"]
+            else None
+        ),
+        "sharded_fetch_slices": dev_sharded["fetch_slices"],
     }
 
 
@@ -706,6 +796,17 @@ def main() -> None:
         "--e2e-compute-iters", type=int, default=10,
         help="per-leaf jitted compute iterations (0 = pre-materialized grads)",
     )
+    parser.add_argument(
+        "--device-prep", choices=["on", "off", "both"], default="both",
+        help="device-resident wire prep for the e2e section: 'both' runs "
+        "the pipelined trial with the on-TPU bf16 cast AND the host-cast "
+        "reference (the A/B the artifact quotes); 'on'/'off' pin one side",
+    )
+    parser.add_argument(
+        "--sharded-devices", type=int, default=4,
+        help="virtual devices per e2e worker for the sharded-fetch trial "
+        "(0 disables the trial)",
+    )
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
@@ -736,12 +837,26 @@ def main() -> None:
         print(json.dumps(r), flush=True)
 
     e2e: List[Dict[str, Any]] = []
-    for pipelined in (True, False):
+    # The e2e matrix: monolithic reference, pipelined host-cast, pipelined
+    # device-prep (same trial setup — only the wire-prep locus moves), and
+    # a sharded-fetch trial on a multi-device worker platform.
+    trial_modes: List[Dict[str, Any]] = [dict(pipelined=False)]
+    if args.device_prep in ("off", "both"):
+        trial_modes.append(dict(pipelined=True, device_prep=False))
+    if args.device_prep in ("on", "both"):
+        trial_modes.append(dict(pipelined=True, device_prep=True))
+        if args.sharded_devices:
+            trial_modes.append(
+                dict(pipelined=True, device_prep=True, sharded=True,
+                     virtual_devices=args.sharded_devices)
+            )
+    for mode_kw in trial_modes:
         r = bench_e2e(
-            lanes=args.e2e_lanes, pipelined=pipelined, steps=args.e2e_steps,
+            lanes=args.e2e_lanes, steps=args.e2e_steps,
             grads_mb=args.e2e_mb, n_leaves=args.e2e_leaves,
             mbps=args.mbps, rtt_ms=args.rtt_ms, bucket_mb=args.e2e_bucket_mb,
             compute_iters=args.e2e_compute_iters, trials=args.trials,
+            **mode_kw,
         )
         e2e.append(r)
         results.append(r)
@@ -751,20 +866,46 @@ def main() -> None:
     results.append(kill)
     print(json.dumps(kill), flush=True)
 
-    pipe = next(r for r in e2e if r["mode"] == "pipelined")
-    mono = next(r for r in e2e if r["mode"] == "monolithic")
+    def find(mode: str) -> Optional[Dict[str, Any]]:
+        return next((r for r in e2e if r["mode"] == mode), None)
+
+    pipe = find("pipelined")
+    mono = find("monolithic")
+    prep = find("pipelined+device_prep")
+    sharded = find("pipelined+device_prep+sharded")
     summary: Dict[str, Any] = {
         "link": {"mbps": args.mbps, "rtt_ms": args.rtt_ms},
         "payload_mb": args.mb,
         "lane_gb_per_s": {str(l): g for l, g in sorted(lane_gbps.items())},
-        "pipelined_steps_per_s": pipe["steps_per_s"],
-        "monolithic_steps_per_s": mono["steps_per_s"],
-        "pipelined_speedup": (
-            round(pipe["steps_per_s"] / mono["steps_per_s"], 3)
-            if mono["steps_per_s"] else None
-        ),
+        "monolithic_steps_per_s": mono["steps_per_s"] if mono else None,
         "peer_kill_ok": kill["ok"],
     }
+    if pipe:
+        summary["pipelined_steps_per_s"] = pipe["steps_per_s"]
+        if mono and mono["steps_per_s"]:
+            summary["pipelined_speedup"] = round(
+                pipe["steps_per_s"] / mono["steps_per_s"], 3
+            )
+    if prep:
+        summary["device_prep_steps_per_s"] = prep["steps_per_s"]
+        summary["device_prep_d2h_bytes"] = prep["d2h_bytes"]
+        if pipe:
+            summary["host_cast_d2h_bytes"] = pipe["d2h_bytes"]
+            if prep["d2h_bytes"]:
+                summary["d2h_reduction"] = round(
+                    pipe["d2h_bytes"] / prep["d2h_bytes"], 3
+                )
+    if sharded:
+        summary["sharded_steps_per_s"] = sharded["steps_per_s"]
+        summary["sharded_fetch_slices"] = sharded["fetch_slices"]
+        if sharded["slices_per_bucket"]:
+            # Per-slice fetch granularity: on a multi-host group each host
+            # pulls only its addressable slices, so per-host bytes shrink
+            # by the shard factor; on this single-host bench the factor
+            # shows up as the MEASURED slice count per bucket (not the
+            # requested --sharded-devices, which an inherited XLA_FLAGS
+            # can override in the workers).
+            summary["shard_factor"] = sharded["slices_per_bucket"]
     if 1 in lane_gbps and 4 in lane_gbps:
         summary["speedup_4_lanes"] = round(lane_gbps[4] / lane_gbps[1], 2)
     if 1 in lane_gbps and 2 in lane_gbps:

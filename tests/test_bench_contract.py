@@ -130,6 +130,47 @@ def test_allreduce_quick_smoke() -> None:
     assert payload["pipelined_commits_ok"]
 
 
+def test_device_prep_quick_smoke() -> None:
+    """Device-resident wire prep e2e gate: a small 2-group run with the
+    on-device bf16 cast (and the sharded fetch, which engages under the
+    suite's forced multi-device platform) must commit at least as many
+    steps as the host-cast reference, halve the D2H fetch bytes, and emit
+    the byte fields the ALLREDUCE_BENCH artifact schema quotes."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_allreduce
+    finally:
+        sys.path.pop(0)
+    trials = {
+        mode: bench_allreduce.bench_e2e(
+            lanes=2, pipelined=True, steps=2, grads_mb=1.0, n_leaves=4,
+            mbps=0.0, rtt_ms=0.0, bucket_mb=0.5, timeout_s=60.0,
+            procs=False, device_prep=prep, sharded=shard, wire_dtype="bf16",
+        )
+        for mode, (prep, shard) in {
+            "host": (False, False),
+            "prep": (True, False),
+            "sharded": (True, True),
+        }.items()
+    }
+    for name, r in trials.items():
+        # Schema contract for the new artifact fields.
+        for field in ("d2h_bytes", "h2d_bytes", "wire_bytes", "fetch_slices",
+                      "device_prep", "sharded_fetch", "wire_dtype"):
+            assert field in r, (name, field)
+        assert r["committed"] == r["steps"], name
+        assert r["d2h_bytes"] > 0 and r["wire_bytes"] > 0
+    assert trials["prep"]["committed"] >= trials["host"]["committed"]
+    assert trials["sharded"]["committed"] >= trials["host"]["committed"]
+    # The headline: device-side bf16 cast halves the fetch bytes.
+    ratio = trials["host"]["d2h_bytes"] / trials["prep"]["d2h_bytes"]
+    assert 1.9 <= ratio <= 2.1, ratio
+    import jax
+
+    if len(jax.local_devices()) > 1:
+        assert trials["sharded"]["fetch_slices"] > 0
+
+
 def test_bench_selftest() -> None:
     """bench.py --selftest verifies its own scenario-call signatures without
     touching the chip or spawning training subprocesses."""

@@ -1,0 +1,545 @@
+"""Device-resident wire prep + sharding-aware fetch parity suite.
+
+The PR 9 data-plane contract, pinned end to end:
+
+  1. The bf16 quantization point moved from the host encode
+     (collectives.py ``_ring_rs_ag``) to the device epilogue
+     (ddp.py ``_DeviceBucket.prep``) — the WIRE BYTES must be BITWISE
+     identical, or replicas on mixed configurations would diverge.
+  2. Sharded fetch + per-slice reduce-scatter/allgather must produce
+     results leaf-for-leaf equal to the replicated-fetch allreduce at the
+     pinned 2-group configuration (one commutative combine per element).
+     At 3+ groups the ring-chunk rotation of fold order plus per-hop bf16
+     re-quantization legitimately separates the modes within bf16
+     rounding; each stays replica-consistent.
+  3. 0-d / Python-scalar / int-dtype leaves bypass compression full-width.
+
+Runs under the suite's forced multi-device CPU platform (conftest.py sets
+``--xla_force_host_platform_device_count=8``); one subprocess case pins the
+ISSUE's exact 4-device configuration.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from typing import Any, Dict, List
+from unittest.mock import MagicMock, create_autospec
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _mock_manager(world: int = 2, wire: str = "bf16") -> MagicMock:
+    """Autospec Manager whose collective declares the given wire dtype and
+    whose allreduce is the identity (copy) — enough to drive the averager's
+    packing/fetch/scatter machinery without a ring."""
+    from torchft_tpu.futures import completed_future
+    from torchft_tpu.manager import Manager
+
+    m = create_autospec(Manager, instance=True)
+    m.num_participants.return_value = world
+    m.timeout = timedelta(seconds=60)
+    col = MagicMock()
+    col.size.return_value = world
+    col.wire_dtype = wire
+    m.collective.return_value = col
+    m.allreduce.side_effect = lambda arr, **kw: completed_future(
+        np.array(np.asarray(arr), copy=True)
+    )
+    return m
+
+
+# -- 1: the quantization point -----------------------------------------------
+
+
+def test_device_cast_wire_bytes_bit_identical_to_host_cast() -> None:
+    """The jitted epilogue's bf16 bytes, fetched to the wire buffer, must be
+    BIT-identical to the host-side ``astype(bfloat16)`` of the same f32
+    leaves — the pin that moving the quantization point onto the device
+    changed WHERE the cast runs, not WHAT lands on the wire."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.ddp import _BucketPlan
+    from torchft_tpu.futures import device_get_into
+
+    bf = _bf16()
+    leaves = [
+        jnp.linspace(-3.0, 3.0, 1023, dtype=jnp.float32),
+        (jnp.arange(517, dtype=jnp.float32) * 0.37).reshape(11, 47),
+    ]
+    metas = [(tuple(l.shape), np.dtype(l.dtype)) for l in leaves]
+    plan = _BucketPlan(
+        metas, 1 << 20, wire_dtype=bf, sharded=False,
+        jax_leaves=[True] * len(leaves),
+    )
+    assert plan.device[0] is not None
+    dev = plan.device[0]
+    assert dev.buffer.dtype == bf
+
+    flat_dev = dev.prep(leaves)
+    device_get_into([(flat_dev, dev.buffer)], 30.0)
+
+    host_cast = np.concatenate(
+        [np.asarray(l).reshape(-1) for l in leaves]
+    ).astype(bf)
+    assert (
+        dev.buffer.view(np.uint16) == host_cast.view(np.uint16)
+    ).all(), "device-cast wire bytes diverge from host-cast"
+
+
+def test_averager_hands_wire_dtype_buffers_to_the_collective() -> None:
+    """With device prep on, what reaches manager.allreduce is the bf16 wire
+    buffer (half the f32 bytes); with it off, the full-width f32 buffer.
+    Same values modulo the quantization the wire would apply anyway."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.ddp import GradientAverager
+
+    grads = {"w": jnp.linspace(0.0, 1.0, 4096, dtype=jnp.float32)}
+
+    m_prep = _mock_manager()
+    GradientAverager(m_prep, device_wire_prep=True).allreduce(grads)
+    (sent_prep,), _ = m_prep.allreduce.call_args
+    assert sent_prep.dtype == _bf16() and sent_prep.nbytes == 4096 * 2
+
+    m_host = _mock_manager()
+    GradientAverager(m_host, device_wire_prep=False).allreduce(grads)
+    (sent_host,), _ = m_host.allreduce.call_args
+    assert sent_host.dtype == np.float32 and sent_host.nbytes == 4096 * 4
+
+    assert (
+        sent_prep.view(np.uint16) == sent_host.astype(_bf16()).view(np.uint16)
+    ).all()
+
+
+def test_device_prep_results_return_on_device_in_leaf_dtype() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from torchft_tpu.ddp import GradientAverager
+
+    m = _mock_manager()
+    avg = GradientAverager(m, device_wire_prep=True)
+    grads = {"w": jnp.linspace(0.0, 1.0, 257, dtype=jnp.float32)}
+    out = avg.allreduce(grads)
+    assert isinstance(out["w"], jax.Array) and out["w"].dtype == jnp.float32
+    # Identity collective: the only transform is the bf16 round-trip.
+    ref = np.asarray(grads["w"]).astype(_bf16()).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(out["w"]), ref)
+    assert avg.last_stats["d2h_bytes"] == 257 * 2
+    assert avg.last_stats["device_buckets"] == 1
+
+
+# -- 2: sharded fetch --------------------------------------------------------
+
+
+def test_sharded_fetch_covers_bucket_and_matches_replicated() -> None:
+    """Per-shard slices must cover the flat bucket disjointly (8 forced CPU
+    devices) and the per-slice RS/AG result must equal the replicated-fetch
+    result leaf-for-leaf, bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchft_tpu.ddp import GradientAverager
+
+    if len(jax.local_devices()) < 2:
+        pytest.skip("needs the forced multi-device CPU platform")
+
+    grads = {
+        "a": jnp.linspace(-1.0, 1.0, 4096, dtype=jnp.float32),
+        "b": (jnp.arange(333, dtype=jnp.float32) * 0.11),
+    }
+
+    m_rep = _mock_manager()
+    out_rep = GradientAverager(m_rep, device_wire_prep=True).allreduce(grads)
+
+    m_sh = _mock_manager()
+    avg_sh = GradientAverager(
+        m_sh, device_wire_prep=True, sharded_fetch=True
+    )
+    out_sh = avg_sh.allreduce(grads)
+
+    ndev = len(jax.local_devices())
+    assert avg_sh.last_stats["slices"] == ndev
+    # One manager.allreduce per slice — the explicit per-slice RS/AG.
+    assert m_sh.allreduce.call_count == ndev
+    # Slice payloads reassemble to exactly the replicated wire buffer.
+    slices = [np.asarray(c.args[0]) for c in m_sh.allreduce.call_args_list]
+    whole = np.concatenate([s.reshape(-1) for s in slices])
+    (rep_buf,), _ = m_rep.allreduce.call_args
+    assert whole[: rep_buf.size].view(np.uint16).tolist() == rep_buf.view(
+        np.uint16
+    ).tolist()
+    for k in grads:
+        a, b = np.asarray(out_rep[k]), np.asarray(out_sh[k])
+        assert a.dtype == b.dtype == np.float32
+        assert (a.view(np.uint32) == b.view(np.uint32)).all(), k
+
+
+def test_sharded_fetch_four_device_subprocess() -> None:
+    """The ISSUE's exact configuration: a fresh process under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=4`` must fetch 4
+    slices per bucket and agree with the replicated fetch bitwise."""
+    script = """
+import numpy as np, json
+import jax
+assert len(jax.local_devices()) == 4, jax.local_devices()
+import jax.numpy as jnp
+from tests.test_device_prep import _mock_manager
+from torchft_tpu.ddp import GradientAverager
+
+grads = {"w": jnp.linspace(0.0, 2.0, 2049, dtype=jnp.float32)}
+m_rep = _mock_manager()
+out_rep = GradientAverager(m_rep, device_wire_prep=True).allreduce(grads)
+m_sh = _mock_manager()
+avg = GradientAverager(m_sh, device_wire_prep=True, sharded_fetch=True)
+out_sh = avg.allreduce(grads)
+a, b = np.asarray(out_rep["w"]), np.asarray(out_sh["w"])
+print(json.dumps({
+    "slices": avg.last_stats["slices"],
+    "d2h_bytes": avg.last_stats["d2h_bytes"],
+    "bitwise": bool((a.view(np.uint32) == b.view(np.uint32)).all()),
+}))
+"""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=REPO,
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["slices"] == 4
+    # 2049 f32 elements pad to 2052 (4-device multiple) bf16 = 4104 bytes.
+    assert payload["d2h_bytes"] == 2052 * 2
+    assert payload["bitwise"] is True
+
+
+# -- 3: bypass edges ---------------------------------------------------------
+
+
+def test_scalar_and_int_leaves_bypass_compression_full_width() -> None:
+    """0-d, Python-scalar, and integer leaves keep the full-width host path
+    (no device cast, exact round-trip) even with device prep on — and they
+    must not drag their f32 bucketmates off the device path."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchft_tpu.ddp import GradientAverager
+
+    m = _mock_manager()
+    avg = GradientAverager(m, device_wire_prep=True)
+    grads = {
+        "ints": jnp.arange(37, dtype=jnp.int32),
+        "f32": jnp.linspace(0.0, 1.0, 64, dtype=jnp.float32),
+        "scalar": 3.141592,  # NOT bf16-representable — must survive exactly
+        "zero_d": jnp.float32(2.5),
+    }
+    out = avg.allreduce(grads)
+
+    calls = [
+        (np.asarray(c.args[0]), c.kwargs) for c in m.allreduce.call_args_list
+    ]
+    by_dtype = {}
+    for s, _kw in calls:
+        by_dtype.setdefault(s.dtype.name, []).append(s)
+    # Integers ride full width.
+    assert by_dtype["int32"][0].nbytes == 37 * 4
+    # The 1-d f32 bucket is the ONLY wire-cast one; the 0-d f32 leaf went
+    # full width in its own split-out bucket.
+    assert [b.nbytes for b in by_dtype["bfloat16"]] == [64 * 2]
+    assert any(s.size == 1 and s.dtype == np.float32 for s, _ in calls)
+    # Full-width is the WIRE contract, not just the fetch path: split-out
+    # 0-d buckets opt out of the collective's lossy encoding too.
+    for s, kw in calls:
+        if s.dtype == np.float32 and s.size == 1:
+            assert kw.get("allow_wire_compression") is False
+    assert avg.last_stats["device_buckets"] == 1
+
+    np.testing.assert_array_equal(np.asarray(out["ints"]), np.arange(37))
+    assert float(np.float32(out["scalar"])) == np.float32(3.141592)
+    assert float(out["zero_d"]) == 2.5
+    assert out["ints"].dtype == jnp.int32
+
+
+def test_numpy_leaves_stay_on_host_path() -> None:
+    """Numpy (host-resident) gradient trees must NOT engage device prep —
+    the epilogue would upload full-width f32 just to fetch bf16 back,
+    strictly more transfer than the host cast it replaces."""
+    from torchft_tpu.ddp import GradientAverager
+
+    m = _mock_manager()
+    avg = GradientAverager(m, device_wire_prep=True, sharded_fetch=True)
+    grads = {"w": np.linspace(0.0, 1.0, 256, dtype=np.float32)}
+    out = avg.allreduce(grads)
+    assert avg.last_stats["device_buckets"] == 0
+    (sent,), _ = m.allreduce.call_args
+    assert sent.dtype == np.float32
+    np.testing.assert_array_equal(np.asarray(out["w"]), grads["w"])
+
+
+def test_no_wire_collective_degrades_to_host_path() -> None:
+    """A collective without a bf16 wire (or without the probe at all) must
+    leave the averager on the full-width host path even with the knob on."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.ddp import GradientAverager
+
+    m = _mock_manager(wire="f32")
+    avg = GradientAverager(m, device_wire_prep=True)
+    avg.allreduce({"w": jnp.ones(128, dtype=jnp.float32)})
+    (sent,), _ = m.allreduce.call_args
+    assert sent.dtype == np.float32
+    assert avg.last_stats["device_buckets"] == 0
+
+
+# -- real-ring parity --------------------------------------------------------
+
+
+def _ring_pair(modes: List[Dict[str, Any]], grads_fn, steps_timeout=60.0):
+    """Runs 2 replica groups (threads, real lighthouse + Managers + bf16-wire
+    TCPCollectives), one committed step per mode entry, every group running
+    the SAME mode sequence.  Returns group 0's per-mode result trees plus
+    its averager byte stats and metrics stream paths."""
+    from torchft_tpu._native import LighthouseServer
+    from torchft_tpu.collectives import TCPCollective
+    from torchft_tpu.ddp import GradientAverager
+    from torchft_tpu.manager import Manager
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=5000,
+        quorum_tick_ms=20,
+    )
+    results: Dict[int, List[Any]] = {}
+    stats: Dict[int, List[Dict[str, int]]] = {}
+    errors: List[BaseException] = []
+    barrier = threading.Barrier(2)
+
+    def group(gid: int) -> None:
+        manager = None
+        try:
+            collective = TCPCollective(timeout=steps_timeout, wire_dtype="bf16")
+            manager = Manager(
+                collective=collective,
+                load_state_dict=None,
+                state_dict=None,
+                min_replica_size=2,
+                use_async_quorum=True,
+                timeout=timedelta(seconds=steps_timeout),
+                quorum_timeout=timedelta(seconds=steps_timeout),
+                rank=0,
+                world_size=1,
+                replica_id=f"dp{gid}",
+                lighthouse_addr=lighthouse.address(),
+                init_sync=False,
+            )
+            averagers = [
+                GradientAverager(
+                    manager,
+                    bucket_bytes=mode.get("bucket_bytes", 1 << 20),
+                    pipelined=mode.get("pipelined", True),
+                    device_wire_prep=mode.get("device_wire_prep", False),
+                    sharded_fetch=mode.get("sharded_fetch", False),
+                )
+                for mode in modes
+            ]
+            barrier.wait(timeout=steps_timeout)
+            outs: List[Any] = []
+            st: List[Dict[str, int]] = []
+            for avg in averagers:
+                manager.start_quorum()
+                outs.append(avg.allreduce(grads_fn(gid)))
+                assert manager.should_commit(), "healthy pair must commit"
+                st.append(dict(avg.last_stats))
+            results[gid] = outs
+            stats[gid] = st
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errors.append(e)
+        finally:
+            if manager is not None:
+                manager.shutdown()
+
+    threads = [threading.Thread(target=group, args=(g,)) for g in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lighthouse.shutdown()
+    if errors:
+        raise errors[0]
+    return results, stats
+
+
+def test_real_ring_device_prep_parity_and_byte_halving() -> None:
+    """2 real groups over the bf16 ring: host-cast vs device-prep vs
+    device-prep+sharded.  Pins (a) sharded == replicated device-prep
+    BITWISE leaf-for-leaf, (b) device-prep d2h bytes are half the
+    host-cast fetch, (c) device-prep result ≈ host-cast result (the
+    quantization point moved, so only closeness holds across those two)."""
+    import jax.numpy as jnp
+
+    def grads_fn(gid: int):
+        base = jnp.linspace(-2.0, 2.0, 6000, dtype=jnp.float32)
+        return {
+            "w": base * (gid + 1),
+            "b": jnp.full((311,), 0.25 * (gid + 1), dtype=jnp.float32),
+            # 0-d loss scalar, NOT bf16-representable: must cross the real
+            # bf16 ring FULL WIDTH and average exactly in f32.
+            "loss": jnp.float32(0.1) * (gid + 1),
+        }
+
+    modes = [
+        {"device_wire_prep": False},
+        {"device_wire_prep": True},
+        {"device_wire_prep": True, "sharded_fetch": True},
+    ]
+    results, stats = _ring_pair(modes, grads_fn)
+
+    host_out, prep_out, shard_out = results[0]
+    # (a) replicated vs sharded: identical quantization point -> bitwise.
+    for k in ("w", "b"):
+        a, b = np.asarray(prep_out[k]), np.asarray(shard_out[k])
+        assert (a.view(np.uint32) == b.view(np.uint32)).all(), k
+    # Groups agree bitwise (the commit protocol's premise).
+    for k in ("w", "b"):
+        a, b = np.asarray(results[0][1][k]), np.asarray(results[1][1][k])
+        assert (a.view(np.uint32) == b.view(np.uint32)).all(), k
+    # The 0-d scalar averaged EXACTLY in f32 across the bf16 ring (the
+    # full-width bypass contract; bf16 wire would round 0.15 to 0.1494…).
+    expected_loss = (np.float32(0.1) + np.float32(0.1) * 2) / np.float32(2)
+    assert np.float32(np.asarray(prep_out["loss"])) == expected_loss
+    # (b) the fetch byte halving for the 1-d f32 buckets; the 0-d scalar
+    # stays full width (4 bytes) on both sides.
+    host_st, prep_st, shard_st = stats[0]
+    n_el = 6000 + 311
+    assert host_st["d2h_bytes"] == n_el * 4 + 4
+    assert prep_st["d2h_bytes"] == n_el * 2 + 4
+    assert shard_st["slices"] >= 2
+    # (c) numerics: averaged grads agree to bf16 precision.
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(prep_out[k]), np.asarray(host_out[k]),
+            rtol=0.02, atol=0.02,
+        )
+
+
+def test_step_summary_carries_transfer_bytes(tmp_path) -> None:
+    """The Manager's step_summary must expose the averager's d2h/h2d byte
+    notes — the round-trip accounting obs.report and the bench read."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.metrics import METRICS_PATH_ENV
+
+    prior = os.environ.get(METRICS_PATH_ENV)
+    os.environ[METRICS_PATH_ENV] = str(tmp_path / "m.jsonl")
+    try:
+
+        def grads_fn(gid: int):
+            return {"w": jnp.ones(512, dtype=jnp.float32) * (gid + 1)}
+
+        _ring_pair([{"device_wire_prep": True}], grads_fn)
+    finally:
+        if prior is None:
+            del os.environ[METRICS_PATH_ENV]
+        else:
+            os.environ[METRICS_PATH_ENV] = prior
+
+    events = []
+    for line in (tmp_path / "m.jsonl").read_text().splitlines():
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            pass
+    summaries = [
+        e for e in events
+        if e.get("event") == "step_summary" and e.get("d2h_bytes")
+    ]
+    assert summaries, "no step_summary carried d2h_bytes"
+    s = summaries[0]
+    assert s["d2h_bytes"] == 512 * 2  # wire (bf16) bytes, not f32
+    assert s["h2d_bytes"] > 0
+    assert "allreduce_h2d" in s["phases"]
+
+
+# -- registries + regression -------------------------------------------------
+
+
+def test_span_names_pinned_against_phases_registry() -> None:
+    """Static grep (the PR 7 pattern): every span phase literal the data
+    plane emits must be a registered PHASES entry — and the new h2d phase
+    must be mapped in PHASE_TRACKS and charged as non-overlapped."""
+    from torchft_tpu.obs.spans import OVERLAPPED_PHASES, PHASES
+    from torchft_tpu.obs.trace import PHASE_TRACKS
+
+    assert "allreduce_h2d" in PHASES
+    assert PHASE_TRACKS["allreduce_h2d"] == "main"
+    assert "allreduce_h2d" not in OVERLAPPED_PHASES
+
+    pat = re.compile(r"""spans\.span\(\s*["']([a-z_0-9]+)["']""")
+    for rel in ("torchft_tpu/ddp.py", "torchft_tpu/manager.py"):
+        src = open(os.path.join(REPO, rel)).read()
+        names = set(pat.findall(src))
+        assert names, f"no span call sites found in {rel}"
+        unregistered = names - set(PHASES)
+        assert not unregistered, f"{rel} emits unregistered spans: {unregistered}"
+
+
+def test_interleaved_striped_ring_stream_no_deadlock() -> None:
+    """Regression for the shared-lane recv deadlock: a bucket stream with
+    3+ ops in flight per lane on a 2-lane bf16 ring stalled roughly once
+    per dozen steps when the peer demux held its mutex across the blocking
+    socket read (frames for a blocked op sat unreachable in the stash).
+    The leader/follower demux must drain this stream every time."""
+    from torchft_tpu._native import StoreServer
+    from torchft_tpu.collectives import TCPCollective
+
+    store = StoreServer(bind="127.0.0.1:0")
+    try:
+        for trial in range(6):
+            cols = [
+                TCPCollective(timeout=20.0, wire_dtype="bf16", lanes=2)
+                for _ in range(2)
+            ]
+
+            def worker(r: int) -> bool:
+                cols[r].configure(f"{store.address()}/dl{trial}", r, 2)
+                try:
+                    for step in range(3):
+                        bufs = [
+                            np.full(64 * 1024, float(r + 1 + i), dtype=np.float32)
+                            for i in range(4)
+                        ]
+                        works = [cols[r].allreduce([b]) for b in bufs]
+                        for i, w in enumerate(works):
+                            out = w.wait(timeout=20)[0]
+                            assert abs(float(out[0]) - (3.0 + 2 * i)) < 0.1
+                    return True
+                finally:
+                    cols[r].shutdown()
+
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                futs = [pool.submit(worker, r) for r in range(2)]
+                assert all(f.result(timeout=45) for f in futs)
+    finally:
+        store.shutdown()

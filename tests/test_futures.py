@@ -67,3 +67,54 @@ def test_then_propagates_error() -> None:
     out = then(failed_future(RuntimeError("x")), lambda v: v)
     with pytest.raises(RuntimeError):
         out.result(timeout=1)
+
+
+# -- device_get_into dtype contract ------------------------------------------
+
+
+def test_device_get_into_same_dtype_fast_path() -> None:
+    import numpy as np
+
+    from torchft_tpu.futures import device_get_into
+
+    src = np.arange(12, dtype=np.float32).reshape(3, 4)
+    dst = np.empty(12, dtype=np.float32)
+    device_get_into([(src, dst.reshape(3, 4))], 5.0)
+    np.testing.assert_array_equal(dst.reshape(3, 4), src)
+
+
+def test_device_get_into_handles_ml_dtypes_bf16_destination() -> None:
+    """bf16 -> bf16 must copy byte-exact even where numpy's casting="no"
+    rejects the ml_dtypes pair — the device wire-prep fetch path."""
+    import ml_dtypes
+    import numpy as np
+
+    from torchft_tpu.futures import device_get_into
+
+    bf = np.dtype(ml_dtypes.bfloat16)
+    src = (np.linspace(-2, 2, 64, dtype=np.float32)).astype(bf)
+    dst = np.empty(64, dtype=bf)
+    device_get_into([(src, dst)], 5.0)
+    assert (dst.view(np.uint16) == src.view(np.uint16)).all()
+
+
+def test_device_get_into_dtype_mismatch_is_a_clear_error() -> None:
+    """A source/destination dtype mismatch must raise a ValueError naming
+    both dtypes (not numpy's bare TypeError) unless cast=True explicitly
+    opts into conversion — a silent f32<->bf16 convert would hide a
+    mis-planned buffer at the wrong D2H byte count."""
+    import ml_dtypes
+    import numpy as np
+    import pytest as _pytest
+
+    from torchft_tpu.futures import device_get_into
+
+    bf = np.dtype(ml_dtypes.bfloat16)
+    src = np.ones(8, dtype=np.float32)
+    dst = np.empty(8, dtype=bf)
+    with _pytest.raises(ValueError, match="float32.*bfloat16|bfloat16.*float32"):
+        device_get_into([(src, dst)], 5.0)
+
+    # Explicit opt-in converts values.
+    device_get_into([(src, dst)], 5.0, cast=True)
+    assert (dst.astype(np.float32) == 1.0).all()

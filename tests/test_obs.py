@@ -74,6 +74,7 @@ def test_phases_registry_is_stable() -> None:
         "configure",
         "heal",
         "allreduce_d2h",
+        "allreduce_h2d",
         "allreduce_merge",
         "commit_vote",
         "snapshot",

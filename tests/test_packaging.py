@@ -1,7 +1,12 @@
 """Packaging sanity: pyproject parses and console-script targets resolve."""
 
 import os
-import tomllib
+
+import pytest
+
+tomllib = pytest.importorskip(
+    "tomllib", reason="tomllib is stdlib from Python 3.11"
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

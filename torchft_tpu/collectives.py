@@ -62,6 +62,16 @@ def _bad_reduce_op(op: str) -> ValueError:
     )
 
 
+def _is_bf16(dtype) -> bool:
+    """True for the ml_dtypes bfloat16 dtype.  bf16 does NOT register under
+    ``np.issubdtype(..., np.floating)`` — every floating-dtype gate in this
+    module that must also admit already-wire-dtype payloads checks this
+    explicitly."""
+    import ml_dtypes
+
+    return np.dtype(dtype) == np.dtype(ml_dtypes.bfloat16)
+
+
 class Work:
     """Handle for an async collective operation (the c10d Work analogue)."""
 
@@ -286,17 +296,30 @@ class _Peer:
 
     Frames arriving out of order (concurrent senders on a thread pool) are
     demultiplexed by tag: a frame for a tag nobody asked for yet is stashed
-    until the matching recv_msg arrives."""
+    until the matching recv_msg arrives.
+
+    The demux is leader/follower: exactly one caller (the leader) reads the
+    socket at a time, but it publishes every non-matching frame to the
+    stash UNDER THE CONDITION and notifies, so a concurrent caller whose
+    frame already landed takes it immediately instead of queuing behind the
+    leader's blocking read.  The previous design held one mutex across the
+    socket read; with three or more ops interleaved on a shared lane the
+    two ring directions could form a hold-and-wait cycle — rank A's lock
+    holder blocked on a frame rank B can only send after B's lock holder
+    receives a frame stashed (unreachable) behind A's holder — a mutual
+    stall the striped bf16 e2e bench hit roughly once per dozen steps."""
 
     def __init__(self, sock: socket.socket, shaper: Optional[LinkShaper] = None) -> None:
         self.sock = sock
         self.send_lock = threading.Lock()
-        self.recv_lock = threading.Lock()
+        self.recv_cond = threading.Condition()
+        self._reading = False
         self.shaper = shaper if shaper is not None else LinkShaper.from_env()
         self._stash: dict[int, "collections.deque[bytearray]"] = {}
         # Wire-byte counters (headers included), always on — the per-lane
         # throughput accounting the GB/s telemetry reads; ints under the
-        # send/recv locks, so the cost is a couple of adds per frame.
+        # send lock / recv condition, so the cost is a couple of adds per
+        # frame.
         self.bytes_out = 0
         self.bytes_in = 0
 
@@ -315,20 +338,37 @@ class _Peer:
             self.bytes_out += total + _HDR.size
 
     def recv_msg(self, expect_tag: int) -> bytearray:
-        with self.recv_lock:
-            q = self._stash.get(expect_tag)
-            if q:
-                payload = q.popleft()
-                if not q:
-                    del self._stash[expect_tag]
-                return payload
+        with self.recv_cond:
+            while True:
+                q = self._stash.get(expect_tag)
+                if q:
+                    payload = q.popleft()
+                    if not q:
+                        del self._stash[expect_tag]
+                    return payload
+                if not self._reading:
+                    self._reading = True
+                    break
+                # A leader is on the socket; it will either hand us our
+                # frame via the stash (notify below) or step down (finally
+                # block), at which point we take over.  The leader's socket
+                # timeout bounds this wait — a dead peer surfaces as its
+                # error, then ours.
+                self.recv_cond.wait()
+        try:
             while True:
                 hdr = self._recv_exact(_HDR.size)
                 tag, nbytes = _HDR.unpack(hdr)
                 payload = self._recv_exact(nbytes)
                 if tag == expect_tag:
                     return payload
-                self._stash.setdefault(tag, collections.deque()).append(payload)
+                with self.recv_cond:
+                    self._stash.setdefault(tag, collections.deque()).append(payload)
+                    self.recv_cond.notify_all()
+        finally:
+            with self.recv_cond:
+                self._reading = False
+                self.recv_cond.notify_all()
 
     def _recv_exact(self, n: int) -> bytearray:
         # Returned as the bytearray itself (writable, no bytes() copy):
@@ -916,23 +956,57 @@ class TCPCollective(Collective):
         sent.result(timeout=self._timeout)
         return received
 
+    @property
+    def wire_dtype(self) -> str:
+        """The resolved wire encoding ("f32" or "bf16").  Public so the
+        data-plane layers above (GradientAverager's device wire prep) can
+        cast payloads to the wire dtype ON DEVICE and fetch half the bytes
+        — planning that cast requires knowing what this collective would
+        put on the wire anyway."""
+        return self._wire_dtype
+
+    def wire_nbytes(self, array, allow_wire_compression: bool = True) -> int:
+        """Bytes ``array`` would occupy PER HOP on the ring wire — the
+        single source of truth for wire-byte telemetry (the Manager's
+        allreduce_gb_per_s gauge), so a change to ``_wire_for``'s gating
+        cannot silently diverge from what the accounting counts."""
+        array = np.asarray(array)
+        wire, _ = self._wire_for([array], array.dtype, allow_wire_compression)
+        if wire is not None:
+            return int(array.size) * wire.itemsize
+        return int(array.nbytes)
+
     def _wire_for(
         self, arrays: Sequence[np.ndarray], flat_dtype, allow_wire_compression: bool
     ):
-        """The wire dtype for one allreduce: bfloat16 when compression is
-        allowed, configured, and EVERY input array is floating (not just the
-        promoted buffer dtype) — a mixed [f32, int64] call promotes flat to
-        float64, and quantizing the integer values would corrupt them."""
-        if (
-            allow_wire_compression
-            and self._wire_dtype == "bf16"
-            and np.issubdtype(flat_dtype, np.floating)
-            and all(np.issubdtype(a.dtype, np.floating) for a in arrays)
-        ):
-            import ml_dtypes
+        """``(wire, acc_dtype)`` for one allreduce.
 
-            return np.dtype(ml_dtypes.bfloat16)
-        return None
+        ``wire`` is bfloat16 when compression is allowed, configured, and
+        EVERY input array is floating (not just the promoted buffer dtype)
+        — a mixed [f32, int64] call promotes flat to float64, and
+        quantizing the integer values would corrupt them.  ``acc_dtype`` is
+        the local accumulation dtype (the input dtype normally).
+
+        Inputs that arrive ALREADY in the wire dtype (a device-wire-prepped
+        bucket fetched as bf16) keep bf16 on the wire but accumulate in
+        float32: per-hop bytes are identical to the host-cast path, and the
+        reduction runs at the same precision — only the quantization point
+        moved from host CPU to the device epilogue.  Without the explicit
+        ``_is_bf16`` branch these payloads would fall through the
+        ``np.issubdtype(..., np.floating)`` gate (bf16 is not a numpy
+        floating subtype) into raw-bytes framing with bf16 accumulation."""
+        if allow_wire_compression and self._wire_dtype == "bf16":
+            if np.issubdtype(flat_dtype, np.floating) and all(
+                np.issubdtype(a.dtype, np.floating) for a in arrays
+            ):
+                import ml_dtypes
+
+                return np.dtype(ml_dtypes.bfloat16), np.dtype(flat_dtype)
+            if _is_bf16(flat_dtype) and all(_is_bf16(a.dtype) for a in arrays):
+                import ml_dtypes
+
+                return np.dtype(ml_dtypes.bfloat16), np.dtype(np.float32)
+        return None, np.dtype(flat_dtype)
 
     def _ring_rs_ag(
         self,
@@ -1047,9 +1121,9 @@ class TCPCollective(Collective):
         combine = _REDUCE_COMBINE[op]
         flat = self._flatten(arrays)
         chunks = np.array_split(flat, n)
-        wire = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+        wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
         chunks = self._ring_rs_ag(
-            chunks, combine, wire, flat.dtype, lane=0, tag_base=self._tag_base(seq)
+            chunks, combine, wire, acc_dtype, lane=0, tag_base=self._tag_base(seq)
         )
         return self._unflatten(np.concatenate(chunks), arrays, op)
 
@@ -1090,7 +1164,7 @@ class TCPCollective(Collective):
         try:
             flat = self._flatten(arrays)
             chunks = np.array_split(flat, n)
-            wire = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+            wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
             nstripes = self._stripe_count(max(c.nbytes for c in chunks))
             # sub[i][s]: stripe s of rank-chunk i.  array_split depends only
             # on sizes derived from the (identical) flat length, so every
@@ -1151,7 +1225,7 @@ class TCPCollective(Collective):
                         [sub[i][s] for i in range(n)],
                         combine,
                         wire,
-                        flat.dtype,
+                        acc_dtype,
                         lane=s % self._lanes,
                         tag_base=self._tag_base(seq, s),
                     )

@@ -12,9 +12,27 @@ that bucket's leaves land — bucket 0 is on the DCN wire while bucket 2 is
 still leaving the device, and with a multi-lane ring collective
 (``TPUFT_RING_LANES``) the buckets overlap each other on the wire too.
 
-The per-bucket D2H wait runs in an ``allreduce_d2h`` span and the final
-drain in ``allreduce_merge`` (both FT time, never charged as productive
-compute — obs/report.py and the straggler sentinel depend on that).
+Wire preparation can run ON DEVICE (``device_wire_prep=True`` /
+``TPUFT_DEVICE_WIRE_PREP=1``): a cached jitted epilogue casts each float
+bucket to the collective's wire dtype (bf16) and lays it out flat in HBM, so
+the D2H fetch moves wire bytes — half the f32 bytes — instead of staging a
+full-width copy through host memory and casting on CPU.  The bf16
+quantization point moves from the host encode to the device epilogue; the
+wire bytes are BITWISE identical (pinned in tests/test_device_prep.py), and
+local ring accumulation stays in float32 (collectives.py treats
+already-wire-dtype payloads as pre-encoded).  ``sharded_fetch=True`` /
+``TPUFT_SHARDED_FETCH=1`` additionally shards the flat bucket across the
+local devices: each shard slice is fetched straight off its device (no XLA
+gather into a replicated host copy — on a multi-host group each host pulls
+only its ``addressable_shards``), ring-reduced as its own tagged op (the
+cross-group allreduce becomes per-slice reduce-scatter + allgather aligned
+with the in-group sharding, ZeRO-style), and scattered back per-shard with
+``jax.device_put`` under the leaf's original sharding.
+
+The per-bucket D2H wait runs in an ``allreduce_d2h`` span, the result
+scatter-back in ``allreduce_h2d``, and the final drain in
+``allreduce_merge`` (all FT time, never charged as productive compute —
+obs/report.py and the straggler sentinel depend on that).
 
 ``PerLeafGradientAverager`` mirrors PureDistributedDataParallel's
 per-parameter variant (torchft/ddp.py:74-97).
@@ -22,8 +40,11 @@ per-parameter variant (torchft/ddp.py:74-97).
 
 from __future__ import annotations
 
+import os
+import threading
 from concurrent.futures import Future
-from typing import Any, Dict, List, Sequence, Tuple
+from contextlib import nullcontext
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -35,6 +56,32 @@ __all__ = [
     "allreduce_pytree",
     "plan_buckets",
 ]
+
+TPUFT_DEVICE_WIRE_PREP_ENV = "TPUFT_DEVICE_WIRE_PREP"
+TPUFT_SHARDED_FETCH_ENV = "TPUFT_SHARDED_FETCH"
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "on", "yes")
+
+
+class _Unresolved:
+    """Sentinel distinguishing "wire target not probed yet" from "probed:
+    no wire cast" (None)."""
+
+
+_UNRESOLVED = _Unresolved()
+
+# Serializes MULTI-DEVICE (sharded) jit executions across averagers in one
+# process.  A sharded epilogue/inverse is an SPMD program with cross-device
+# collectives; when several replica groups share a process (the threaded
+# bench and the test harness — never the deployment shape, which is one
+# process per group), two such programs dispatched concurrently interleave
+# their device rendezvous and deadlock XLA's CPU collective runtime.  The
+# lock holder blocks until its program completes, so executions never
+# overlap; single-device prep (the common case) takes no lock and keeps
+# full async dispatch.
+_SHARDED_EXEC_LOCK = threading.Lock()
 
 
 class _Bucket:
@@ -54,6 +101,11 @@ class _Bucket:
         self.shapes = shapes
         self.sizes = sizes
         self.dtype = np.dtype(dtype)
+        # True for split-out 0-d/scalar buckets under device wire prep:
+        # they must travel FULL WIDTH (allow_wire_compression=False) — the
+        # documented loss-scalar precision contract, not just a fetch-path
+        # choice.
+        self.wire_bypass = False
         self.offsets: List[int] = []
         off = 0
         for size in sizes:
@@ -119,18 +171,198 @@ def plan_buckets(
     return buckets
 
 
+class _DeviceBucket:
+    """Device-resident wire prep for one bucket.
+
+    Holds the cached jitted **epilogue** that lays the bucket's leaves out
+    flat in HBM cast to the fetch dtype (the collective's wire dtype for
+    float buckets — so D2H moves wire bytes), the persistent fetch-dtype
+    host buffer the copy lands in, and the jitted **inverse** that slices,
+    reshapes and casts reduced results back to the leaf dtype on device
+    (so H2D also moves wire bytes and the upcast spends HBM bandwidth, not
+    host CPU).
+
+    With ``sharded=True`` and more than one local device the epilogue's
+    output is laid out sharded across all local devices on the flat axis
+    (padded to a device multiple; the pad reduces zeros and is dropped by
+    the inverse), so the fetch can pull each shard straight off its device
+    via ``addressable_shards`` — on a multi-host replica group each host
+    only holds (and only fetches) its own slice.
+    """
+
+    def __init__(self, bucket: _Bucket, fetch_dtype: Any, sharded: bool) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.bucket = bucket
+        self.fetch_dtype = np.dtype(fetch_dtype)
+        self.pad = 0
+        out_shardings = None
+        if sharded:
+            devs = jax.local_devices()
+            if len(devs) > 1:
+                from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+                self.pad = (-bucket.numel) % len(devs)
+                mesh = Mesh(np.asarray(devs), ("wire",))
+                out_shardings = NamedSharding(mesh, PartitionSpec("wire"))
+        self.numel = bucket.numel + self.pad
+        self.buffer = np.empty(self.numel, dtype=self.fetch_dtype)
+        # Multi-device (sharded) programs must serialize per process — see
+        # _SHARDED_EXEC_LOCK.
+        self.multi_device = out_shardings is not None
+        # The epilogue output's sharding from the LAST prep call — the
+        # scatter-back places results with the same per-device layout.
+        self.last_sharding: Any = None
+
+        fetch = self.fetch_dtype
+        pad = self.pad
+
+        def prep(leaves: List[Any]):
+            flat = (
+                jnp.concatenate([jnp.ravel(l) for l in leaves])
+                if len(leaves) > 1
+                else jnp.ravel(leaves[0])
+            )
+            flat = flat.astype(fetch)
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            return flat
+
+        self.prep = (
+            jax.jit(prep)
+            if out_shardings is None
+            else jax.jit(prep, out_shardings=out_shardings)
+        )
+
+        numel = bucket.numel
+        offsets, sizes, shapes = bucket.offsets, bucket.sizes, bucket.shapes
+        orig_dtype = bucket.dtype
+
+        def unprep(flat):
+            flat = flat[:numel].astype(orig_dtype)
+            return [
+                flat[off : off + size].reshape(shape)
+                for off, size, shape in zip(offsets, sizes, shapes)
+            ]
+
+        self.unprep = jax.jit(unprep)
+
+
+def _shard_slices(flat_dev) -> Optional[List[Tuple[Any, int, int]]]:
+    """``[(shard, start, stop)]`` covering a 1-D device array contiguously,
+    one entry per addressable shard — or None when the layout is not a
+    clean disjoint 1-D partition (single device, replicated across devices,
+    or an exotic index), in which case the caller falls back to one
+    full-width fetch."""
+    try:
+        shards = list(flat_dev.addressable_shards)
+    except Exception:  # noqa: BLE001 — non-jax input (tests, numpy fallback)
+        return None
+    if len(shards) <= 1:
+        return None
+    n = int(flat_dev.shape[0])
+    parts: List[Tuple[int, int, Any]] = []
+    for s in shards:
+        idx = s.index
+        if (
+            len(idx) != 1
+            or not isinstance(idx[0], slice)
+            or idx[0].step not in (None, 1)
+        ):
+            return None
+        start = idx[0].start or 0
+        stop = idx[0].stop if idx[0].stop is not None else n
+        parts.append((start, stop, s))
+    parts.sort(key=lambda t: t[0])
+    pos = 0
+    for start, stop, _ in parts:
+        if start != pos:
+            return None  # replicated or overlapping layout
+        pos = stop
+    if pos != n:
+        return None
+    return [(s, start, stop) for start, stop, s in parts]
+
+
 class _BucketPlan:
     """A bucket layout plus its persistent flat buffers and precomputed
     pack views — allocated once per (treedef, shapes, dtypes) and reused
     every step, so the steady-state data plane does zero per-step
-    concatenate/allocation work on the packing side."""
+    concatenate/allocation work on the packing side.
 
-    def __init__(self, metas: Sequence[Tuple[tuple, Any]], bucket_bytes: int) -> None:
+    When device wire prep / sharded fetch is configured, each eligible
+    bucket additionally carries a :class:`_DeviceBucket` (jitted epilogue +
+    wire-dtype buffer).  Eligibility: every leaf has ndim >= 1 (0-d and
+    Python-scalar leaves keep the full-width host path), and for the wire
+    CAST the bucket dtype must be a real float of >= 4 bytes — integer and
+    sub-f32 buckets ride full width, exactly like the collective's own
+    compression gate."""
+
+    def __init__(
+        self,
+        metas: Sequence[Tuple[tuple, Any]],
+        bucket_bytes: int,
+        wire_dtype: Optional[np.dtype] = None,
+        sharded: bool = False,
+        jax_leaves: Optional[Sequence[bool]] = None,
+    ) -> None:
         self.buckets = plan_buckets(metas, bucket_bytes)
-        self.buffers = [np.empty(b.numel, dtype=b.dtype) for b in self.buckets]
+        if wire_dtype is not None or sharded:
+            # 0-d leaves must bypass wire compression full-width (a loss
+            # scalar's precision matters more than 2 bytes of wire), but
+            # they must not drag an entire f32 gradient bucket back onto
+            # the host-cast path — split them out into their own bucket.
+            split: List[_Bucket] = []
+            for b in self.buckets:
+                zero = [k for k, s in enumerate(b.shapes) if len(s) == 0]
+                if zero and len(zero) < len(b.indices):
+                    keep = [k for k in range(len(b.indices)) if k not in zero]
+                    for sel in (keep, zero):
+                        nb = _Bucket(
+                            [b.indices[k] for k in sel],
+                            [b.shapes[k] for k in sel],
+                            [b.sizes[k] for k in sel],
+                            b.dtype,
+                        )
+                        nb.wire_bypass = sel is zero
+                        split.append(nb)
+                else:
+                    if b.shapes and all(len(s) == 0 for s in b.shapes):
+                        b.wire_bypass = True
+                    split.append(b)
+            self.buckets = split
+        self.device: List[Optional[_DeviceBucket]] = []
+        for b in self.buckets:
+            dev: Optional[_DeviceBucket] = None
+            # Device mode needs leaves that already LIVE on device: running
+            # the epilogue on numpy leaves would upload full-width f32 just
+            # to fetch bf16 back — strictly more transfer than the host
+            # cast it replaces.
+            eligible = all(len(s) > 0 for s in b.shapes) and (
+                jax_leaves is not None
+                and all(jax_leaves[i] for i in b.indices)
+            )
+            cast = (
+                wire_dtype is not None
+                and np.issubdtype(b.dtype, np.floating)
+                and b.dtype.itemsize >= 4
+            )
+            if eligible and (cast or sharded):
+                dev = _DeviceBucket(b, wire_dtype if cast else b.dtype, sharded)
+            self.device.append(dev)
+        # Host-path flat buffers ONLY for host-path buckets: a device-
+        # prepped bucket fetches into its _DeviceBucket.buffer and never
+        # touches these — allocating both would hold a dead full-width f32
+        # copy of every wire-prepped gradient (~3x the feature's memory).
+        self.buffers: List[Optional[np.ndarray]] = [
+            None if d is not None else np.empty(b.numel, dtype=b.dtype)
+            for b, d in zip(self.buckets, self.device)
+        ]
         # views[k]: [(leaf index, writable reshaped view into buffers[k])].
         self.views: List[List[Tuple[int, np.ndarray]]] = [
-            b.unpack(buf) for b, buf in zip(self.buckets, self.buffers)
+            [] if buf is None else b.unpack(buf)
+            for b, buf in zip(self.buckets, self.buffers)
         ]
 
 
@@ -147,6 +379,23 @@ class GradientAverager:
     ``pipelined=False`` is the monolithic reference path — one blocking
     ``device_get_tree`` of every leaf, then pack+issue — kept for A/B
     benchmarking (``bench_allreduce.py``) and debugging.
+
+    ``device_wire_prep`` (default: ``TPUFT_DEVICE_WIRE_PREP``) moves the
+    cast to the collective's wire dtype onto the device as a jitted
+    per-bucket epilogue, halving ``allreduce_d2h`` bytes for f32 gradients
+    when the collective wires bf16; ``sharded_fetch`` (default:
+    ``TPUFT_SHARDED_FETCH``) additionally fetches and ring-reduces each
+    bucket per local-device shard slice (see the module docstring).  Both
+    apply to the pipelined path only — the monolithic path stays the
+    untouched host-cast reference for A/B.  Submission order of the
+    per-slice ring ops is part of the cross-rank tag contract: every
+    replica group must run the same mode, like every other collective
+    knob — and for ``sharded_fetch`` the contract is ENVIRONMENTAL too:
+    every group's process must see the SAME local device count (slice
+    count and pad boundaries derive from it; heterogeneous counts desync
+    the ring-op seq/tag stream exactly like mismatched lane counts or
+    program order would).  Keep sharded fetch off on heterogeneous
+    fleets.
     """
 
     def __init__(
@@ -154,23 +403,83 @@ class GradientAverager:
         manager: Manager,
         bucket_bytes: int = 25 << 20,
         pipelined: bool = True,
+        device_wire_prep: Optional[bool] = None,
+        sharded_fetch: Optional[bool] = None,
     ) -> None:
         self._manager = manager
         self._bucket_bytes = bucket_bytes
         self._pipelined = pipelined
+        if device_wire_prep is None:
+            device_wire_prep = _env_flag(TPUFT_DEVICE_WIRE_PREP_ENV)
+        if sharded_fetch is None:
+            sharded_fetch = _env_flag(TPUFT_SHARDED_FETCH_ENV)
+        self._device_wire_prep = bool(device_wire_prep)
+        self._sharded_fetch = bool(sharded_fetch)
+        self._wire_np: Any = _UNRESOLVED
         self._plans: Dict[Any, _BucketPlan] = {}
+        # Transfer accounting for the LAST allreduce call: d2h/h2d/wire
+        # bytes, bucket/slice counts.  bench_allreduce.py reads this per
+        # step; the same numbers ride the span records (bytes field) and
+        # the Manager's step_summary (note_d2h/note_h2d).
+        self.last_stats: Dict[str, int] = {}
 
     @property
     def manager(self) -> Manager:
         return self._manager
 
-    def _plan_for(self, leaves: List[Any], treedef: Any) -> _BucketPlan:
+    @property
+    def device_wire_prep(self) -> bool:
+        return self._device_wire_prep
+
+    @property
+    def sharded_fetch(self) -> bool:
+        return self._sharded_fetch
+
+    def _wire_target(self) -> Optional[np.dtype]:
+        """The np dtype the collective would put on the wire for float
+        payloads (None = full width).  Resolved once — the wire encoding is
+        fixed at collective construction; a swapped-in collective without
+        the ``wire_dtype`` probe (tests, wrappers) resolves to None and the
+        averager degrades to the host-cast path."""
+        if self._wire_np is not _UNRESOLVED:
+            return self._wire_np
+        wire: Optional[np.dtype] = None
+        try:
+            wd = getattr(self._manager.collective(), "wire_dtype", None)
+        except Exception:  # noqa: BLE001 — mocked managers
+            wd = None
+        if wd == "bf16":
+            import ml_dtypes
+
+            wire = np.dtype(ml_dtypes.bfloat16)
+        self._wire_np = wire
+        return wire
+
+    def _note(self, kind: str, nbytes: int) -> None:
+        """Best-effort transfer-byte note into the Manager's step_summary
+        accounting; a swapped-in manager without the hook is fine."""
+        fn = getattr(
+            self._manager, "note_d2h" if kind == "d2h" else "note_h2d", None
+        )
+        if callable(fn):
+            try:
+                fn(int(nbytes))
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+
+    def _plan_for(
+        self, leaves: List[Any], treedef: Any, jax_leaves: Sequence[bool]
+    ) -> _BucketPlan:
         """The cached plan for this tree signature (treedef + per-leaf
-        shape/dtype); a new signature plans and allocates fresh buffers."""
+        shape/dtype + device-residency); a new signature plans and
+        allocates fresh buffers."""
         metas = [(tuple(l.shape), np.dtype(l.dtype)) for l in leaves]
         # d.name, not d.str: many distinct ml_dtypes (float8 variants, int4)
         # share the opaque '<V1' str and would collide on one cached plan.
-        key = (treedef, tuple((s, d.name) for s, d in metas))
+        # jax-ness is part of the signature: device-bucket eligibility
+        # depends on it, and a tree alternating numpy/jax leaves across
+        # calls must not reuse a plan built for the other residency.
+        key = (treedef, tuple((s, d.name) for s, d in metas), tuple(jax_leaves))
         plan = self._plans.pop(key, None)
         if plan is None:
             if len(self._plans) >= 8:
@@ -180,7 +489,19 @@ class GradientAverager:
                 # IS recency order), keeping a multi-signature workload's
                 # hot plans alive instead of replanning everything.
                 self._plans.pop(next(iter(self._plans)))
-            plan = _BucketPlan(metas, self._bucket_bytes)
+            wire = (
+                self._wire_target()
+                if self._device_wire_prep and self._pipelined
+                else None
+            )
+            sharded = self._sharded_fetch and self._pipelined
+            plan = _BucketPlan(
+                metas,
+                self._bucket_bytes,
+                wire_dtype=wire,
+                sharded=sharded,
+                jax_leaves=jax_leaves,
+            )
         self._plans[key] = plan
         return plan
 
@@ -216,14 +537,69 @@ class GradientAverager:
         leaves = [
             l if hasattr(l, "shape") else np.asarray(l) for l in leaves
         ]
-        plan = self._plan_for(leaves, treedef)
+        plan = self._plan_for(leaves, treedef, is_jax)
         step = self._manager.current_step()
         timeout = self._manager.timeout.total_seconds()
+        stats = {
+            "d2h_bytes": 0,
+            "h2d_bytes": 0,
+            "wire_bytes": 0,
+            "buckets": len(plan.buckets),
+            "device_buckets": sum(1 for d in plan.device if d is not None),
+            "slices": 0,
+        }
+        self.last_stats = stats
+        # Per-hop WIRE bytes a bucket's payload travels as — NOT what this
+        # host hands the collective.  The host-cast path hands f32 buffers
+        # that the ring encodes to bf16 per hop, so counting buf.nbytes
+        # would make the device-prep A/B read as a 2x wire saving that the
+        # encode already provided; both modes must report the same wire
+        # bytes (only d2h_bytes moves).  The collective's own wire_nbytes
+        # probe is the source of truth (same one the Manager's GB/s gauge
+        # consults); the inline gate is only the fallback for swapped-in
+        # collectives without it.
+        wire_target = self._wire_target()
+        try:
+            wire_probe = getattr(
+                self._manager.collective(), "wire_nbytes", None
+            )
+        except Exception:  # noqa: BLE001 — mocked managers
+            wire_probe = None
 
-        # Kick off the device->host DMA for every leaf up front (no-op off
-        # accelerator): by the time bucket k's blocking copy runs, its bytes
-        # are already in flight behind buckets 0..k-1's.
-        for l in leaves:
+        def wire_nbytes(b: _Bucket) -> int:
+            if callable(wire_probe):
+                try:
+                    per_el = int(
+                        wire_probe(
+                            np.empty(1, dtype=b.dtype), not b.wire_bypass
+                        )
+                    )
+                    return per_el * b.numel
+                except Exception:  # noqa: BLE001 — non-conforming mock
+                    pass
+            if (
+                wire_target is not None
+                and not b.wire_bypass
+                and np.issubdtype(b.dtype, np.floating)
+            ):
+                return b.numel * wire_target.itemsize
+            return b.nbytes
+
+        # Kick off the device->host DMA for every HOST-path leaf up front
+        # (no-op off accelerator): by the time bucket k's blocking copy
+        # runs, its bytes are already in flight behind buckets 0..k-1's.
+        # Device-prepped buckets fetch the jitted epilogue's output, not
+        # the raw leaves — hinting those would stage the full-width copy
+        # the epilogue exists to avoid.
+        host_leaf_idx = {
+            i
+            for b, d in zip(plan.buckets, plan.device)
+            if d is None
+            for i in b.indices
+        }
+        for i, l in enumerate(leaves):
+            if i not in host_leaf_idx:
+                continue
             copy_async = getattr(l, "copy_to_host_async", None)
             if copy_async is not None:
                 try:
@@ -231,26 +607,102 @@ class GradientAverager:
                 except Exception:  # noqa: BLE001 — a hint, never load-bearing
                     pass
 
+        # Dispatch EVERY single-device epilogue before the first blocking
+        # fetch — jit dispatch is async, so bucket k+1's cast runs on
+        # device under bucket k's D2H wait (the device-path analogue of the
+        # copy_to_host_async hint above; without this, later epilogues
+        # would not even be dispatched until the earlier fetch returned).
+        # Multi-device (sharded) programs stay lazy: they serialize behind
+        # _SHARDED_EXEC_LOCK with a blocking wait anyway.
+        flat_devs: Dict[int, Any] = {}
+        if self._pipelined:
+            for k, (bucket, dev) in enumerate(zip(plan.buckets, plan.device)):
+                if dev is not None and not dev.multi_device:
+                    flat_devs[k] = dev.prep([leaves[i] for i in bucket.indices])
+
         hosts: List[Any] = []
         if not self._pipelined:
             # Monolithic reference path: one deadline-guarded fetch of the
             # whole tree, then pack+issue every bucket.
-            with self._manager.spans.span("allreduce_d2h", step=step):
+            with self._manager.spans.span("allreduce_d2h", step=step) as sp:
                 try:
                     hosts = device_get_tree(leaves, timeout)
                 except TimeoutError as e:
                     self._manager.report_error(e)
                     return grads
+                d2h = sum(int(getattr(l, "nbytes", 0)) for l in leaves)
+                sp.fields["bytes"] = d2h
+            stats["d2h_bytes"] += d2h
+            self._note("d2h", d2h)
 
-        pending: List[Tuple[_Bucket, np.ndarray, Future]] = []
-        for bucket, buf, views in zip(plan.buckets, plan.buffers, plan.views):
+        # pending: (kind, bucket, dev, buf, payload) where payload is one
+        # future ("host"/"device") or a [(shard, start, stop, view, fut)]
+        # list ("sharded").
+        pending: List[Tuple[str, _Bucket, Any, Any, Any]] = []
+        for k, (bucket, buf, views, dev) in enumerate(
+            zip(plan.buckets, plan.buffers, plan.views, plan.device)
+        ):
+            if dev is not None and self._pipelined:
+                if dev.multi_device:
+                    with _SHARDED_EXEC_LOCK:
+                        flat_dev = dev.prep([leaves[i] for i in bucket.indices])
+                        jax.block_until_ready(flat_dev)
+                else:
+                    flat_dev = flat_devs[k]
+                dev.last_sharding = getattr(flat_dev, "sharding", None)
+                parts = (
+                    _shard_slices(flat_dev) if self._sharded_fetch else None
+                )
+                if parts is not None:
+                    # Sharded fetch: each shard slice comes straight off its
+                    # device and rides the ring as its own tagged op — the
+                    # bucket's cross-group allreduce decomposes into
+                    # per-slice reduce-scatter + allgather, and the slices
+                    # overlap each other on the wire like buckets do.
+                    slice_futs = []
+                    for shard, start, stop in parts:
+                        view = dev.buffer[start:stop]
+                        with self._manager.spans.span(
+                            "allreduce_d2h", step=step, bytes=view.nbytes
+                        ):
+                            try:
+                                device_get_into([(shard.data, view)], timeout)
+                            except TimeoutError as e:
+                                self._manager.report_error(e)
+                                return grads
+                        stats["d2h_bytes"] += view.nbytes
+                        self._note("d2h", view.nbytes)
+                        slice_futs.append(
+                            (shard, start, stop, view, self._manager.allreduce(view))
+                        )
+                    stats["slices"] += len(parts)
+                    stats["wire_bytes"] += wire_nbytes(bucket)
+                    pending.append(("sharded", bucket, dev, buf, slice_futs))
+                else:
+                    with self._manager.spans.span(
+                        "allreduce_d2h", step=step, bytes=dev.buffer.nbytes
+                    ):
+                        try:
+                            device_get_into([(flat_dev, dev.buffer)], timeout)
+                        except TimeoutError as e:
+                            self._manager.report_error(e)
+                            return grads
+                    stats["d2h_bytes"] += dev.buffer.nbytes
+                    self._note("d2h", dev.buffer.nbytes)
+                    stats["wire_bytes"] += wire_nbytes(bucket)
+                    pending.append(
+                        ("device", bucket, dev, buf, self._manager.allreduce(dev.buffer))
+                    )
+                continue
             if self._pipelined:
                 # Deadline-guarded device->host straight into the persistent
                 # buffer: wedged device work latches an error instead of
                 # hanging the step (stream_timeout analogue).  Spanned as
                 # allreduce_d2h — this wait blocks the train thread and must
                 # be attributed as FT time, not productive compute.
-                with self._manager.spans.span("allreduce_d2h", step=step):
+                with self._manager.spans.span(
+                    "allreduce_d2h", step=step, bytes=bucket.nbytes
+                ):
                     try:
                         device_get_into(
                             [(leaves[i], view) for i, view in views], timeout
@@ -258,14 +710,27 @@ class GradientAverager:
                     except TimeoutError as e:
                         self._manager.report_error(e)
                         return grads
+                stats["d2h_bytes"] += bucket.nbytes
+                self._note("d2h", bucket.nbytes)
             else:
                 for i, view in views:
                     np.copyto(view, np.asarray(hosts[i]).reshape(view.shape))
             # Bucket k hits the wire here while bucket k+1 is still copying
             # off the device (and, with ring lanes, while bucket k-1 is still
             # mid-flight — the collective overlaps back-to-back calls).
-            fut = self._manager.allreduce(buf)
-            pending.append((bucket, buf, fut))
+            # Split-out 0-d/scalar buckets opt OUT of the lossy wire
+            # encoding — full-width is the contract, not just full-width
+            # fetch.
+            stats["wire_bytes"] += wire_nbytes(bucket)
+            fut = (
+                # Keyword only on the bypass path: the common case keeps the
+                # bare call signature swapped-in managers (tests, wrappers)
+                # already mock.
+                self._manager.allreduce(buf, allow_wire_compression=False)
+                if bucket.wire_bypass
+                else self._manager.allreduce(buf)
+            )
+            pending.append(("host", bucket, dev, buf, fut))
 
         out: List[Any] = list(leaves)
         # The bucket drain blocks this (train) thread on the ring exchange —
@@ -275,21 +740,93 @@ class GradientAverager:
         # as busy for the whole stall — hiding exactly the straggler the
         # step-time telemetry exists to expose (the commit-time drain of
         # what remains keeps the same phase name; the accumulator sums).
+        resolved: List[Any] = []
         with self._manager.spans.span("allreduce_merge", step=step):
-            for bucket, buf, fut in pending:
-                flat = np.asarray(fut.result())
-                if flat is buf:
-                    # Failure fallback resolved to the input: detach from the
-                    # persistent buffer (reused next step) before handing
-                    # views to the caller.
-                    flat = flat.copy()
-                for idx, arr in bucket.unpack(flat):
-                    out[idx] = arr
+            for kind, bucket, dev, buf, payload in pending:
+                if kind == "sharded":
+                    resolved.append(
+                        [
+                            (shard, start, stop, view, fut.result())
+                            for shard, start, stop, view, fut in payload
+                        ]
+                    )
+                else:
+                    resolved.append(payload.result())
 
-        devices = [
-            jax.device_put(a, leaves[i].sharding) if is_jax[i] else a
-            for i, a in enumerate(out)
-        ]
+        # Scatter-back: device-prepped results go home as wire-dtype bytes
+        # (H2D moves bf16; the upcast to the leaf dtype runs on device in
+        # the jitted inverse).  Spanned as allreduce_h2d — like the fetch,
+        # this is FT time on the train thread, never productive compute.
+        # Collective failures resolve a bucket to its own input buffer
+        # (wrap_future's default); those buckets keep their ORIGINAL leaves
+        # untouched — the error is latched and the commit vote fails.
+        with self._manager.spans.span("allreduce_h2d", step=step) as sp_h2d:
+            h2d_bytes = 0
+            for (kind, bucket, dev, buf, _payload), res in zip(pending, resolved):
+                if kind == "host":
+                    flat = np.asarray(res)
+                    if flat is buf:
+                        # Failure fallback resolved to the input: detach from
+                        # the persistent buffer (reused next step) before
+                        # handing views to the caller.
+                        flat = flat.copy()
+                    for idx, arr in bucket.unpack(flat):
+                        out[idx] = arr
+                elif kind == "device":
+                    if res is dev.buffer:
+                        continue  # latched failure: leaves stay untouched
+                    flat_host = np.asarray(res)
+                    h2d_bytes += flat_host.nbytes
+                    with _SHARDED_EXEC_LOCK if dev.multi_device else nullcontext():
+                        flat_back = (
+                            jax.device_put(flat_host, dev.last_sharding)
+                            if dev.last_sharding is not None
+                            else jax.device_put(flat_host)
+                        )
+                        backs = dev.unprep(flat_back)
+                        if dev.multi_device:
+                            jax.block_until_ready(backs)
+                    for idx, arr in zip(bucket.indices, backs):
+                        out[idx] = arr
+                else:  # sharded
+                    if any(r is view for _, _, _, view, r in res):
+                        continue  # latched failure: leaves stay untouched
+                    flat_host = np.concatenate(
+                        [np.asarray(r).reshape(-1) for _, _, _, _, r in res]
+                    )
+                    h2d_bytes += flat_host.nbytes
+                    # device_put with the epilogue's sharding performs the
+                    # per-shard H2D placement: each slice lands on its own
+                    # device (each host transfers only its addressable
+                    # slices), and the jitted inverse upcasts in HBM.
+                    with _SHARDED_EXEC_LOCK:
+                        flat_back = jax.device_put(flat_host, dev.last_sharding)
+                        backs = dev.unprep(flat_back)
+                        jax.block_until_ready(backs)
+                    for idx, arr in zip(bucket.indices, backs):
+                        out[idx] = arr
+
+            serialize = any(
+                d is not None and d.multi_device for d in plan.device
+            )
+            devices = []
+            with _SHARDED_EXEC_LOCK if serialize else nullcontext():
+                for i, a in enumerate(out):
+                    if is_jax[i]:
+                        if not isinstance(a, jax.Array):
+                            h2d_bytes += int(getattr(a, "nbytes", 0))
+                        devices.append(jax.device_put(a, leaves[i].sharding))
+                    else:
+                        devices.append(
+                            np.asarray(a) if isinstance(a, jax.Array) else a
+                        )
+                if serialize:
+                    jax.block_until_ready(
+                        [d for d in devices if isinstance(d, jax.Array)]
+                    )
+            sp_h2d.fields["bytes"] = h2d_bytes
+        stats["h2d_bytes"] += h2d_bytes
+        self._note("h2d", h2d_bytes)
         return jax.tree.unflatten(treedef, devices)
 
 
@@ -344,6 +881,17 @@ class PerLeafGradientAverager:
         return jax.tree.unflatten(treedef, out)
 
 
-def allreduce_pytree(manager: Manager, tree: Any, bucket_bytes: int = 25 << 20) -> Any:
+def allreduce_pytree(
+    manager: Manager,
+    tree: Any,
+    bucket_bytes: int = 25 << 20,
+    device_wire_prep: Optional[bool] = None,
+    sharded_fetch: Optional[bool] = None,
+) -> Any:
     """Functional one-shot form of GradientAverager.allreduce."""
-    return GradientAverager(manager, bucket_bytes).allreduce(tree)
+    return GradientAverager(
+        manager,
+        bucket_bytes,
+        device_wire_prep=device_wire_prep,
+        sharded_fetch=sharded_fetch,
+    ).allreduce(tree)

@@ -112,13 +112,18 @@ def future_timeout(fut: Future, timeout: float) -> Future:
 
 def future_wait(fut: Future, timeout: float) -> Any:
     """Blocking wait with a deadline (reference: future_wait,
-    torchft/futures.py:225-252)."""
+    torchft/futures.py:225-252).  The deadline surfaces as the BUILTIN
+    TimeoutError: on Python < 3.11 ``Future.result`` raises the distinct
+    ``concurrent.futures.TimeoutError``, which ``except TimeoutError``
+    handlers across the codebase would silently miss."""
+    import concurrent.futures
+
     try:
         return fut.result(timeout=timeout)
-    except TimeoutError:
-        raise
-    except Exception:
-        raise
+    except concurrent.futures.TimeoutError as e:
+        if isinstance(e, TimeoutError):  # 3.11+: already the builtin
+            raise
+        raise TimeoutError(f"future did not complete within {timeout}s") from None
 
 
 @contextmanager
@@ -209,12 +214,19 @@ class _Materializer:
         old.put(None)  # exit signal, honored if the worker ever unwedges
 
     def get(self, fn: Callable[[], T], timeout: float) -> T:
+        import concurrent.futures
+
         fut: Future = Future()
         q = self._get_queue()
         q.put((fn, fut))
         try:
             return fut.result(timeout=timeout)
-        except TimeoutError:
+        except concurrent.futures.TimeoutError:
+            # concurrent.futures.TimeoutError, NOT the builtin: on Python
+            # < 3.11 they are distinct classes, and catching the builtin
+            # here silently skipped the abandon (the wedged worker kept the
+            # queue, poisoning every later transfer) while callers' `except
+            # TimeoutError` error-latching missed the escape entirely.
             self._abandon(q)
             raise TimeoutError(
                 f"device->host materialization did not complete within {timeout}s "
@@ -240,19 +252,59 @@ def device_get_tree(leaves: list, timeout: float) -> list:
     return _MATERIALIZER.get(lambda: [np.asarray(l) for l in leaves], timeout)
 
 
-def device_get_into(pairs: list, timeout: float) -> None:
+def _copy_into(dst, src_host, cast: bool) -> None:
+    """One dtype-checked copy of a materialized source into its destination
+    view.  Same-dtype is the fast path; a mismatch raises a ValueError that
+    names both dtypes (``np.copyto(casting="no")`` raises a bare TypeError
+    the moment a device buffer's dtype diverges from its planned host
+    buffer — e.g. a bf16 wire-prepped bucket landing in an f32 buffer —
+    which reads like a numpy bug, not a planning bug) unless the caller
+    explicitly opted into value conversion with ``cast=True``."""
+    import numpy as np
+
+    src_host = src_host.reshape(dst.shape)
+    if src_host.dtype == dst.dtype:
+        try:
+            np.copyto(dst, src_host, casting="no")
+        except TypeError:
+            # Some numpy/ml_dtypes combinations reject casting="no" even for
+            # identical custom dtypes (bfloat16, float8 variants).  Equal
+            # dtypes make a raw byte copy exactly equivalent.
+            np.copyto(
+                dst.view(np.uint8),
+                np.ascontiguousarray(src_host).view(np.uint8),
+                casting="no",
+            )
+        return
+    if not cast:
+        raise ValueError(
+            f"device_get_into: source dtype {src_host.dtype} does not match "
+            f"destination buffer dtype {dst.dtype}; plan the host buffer in "
+            "the dtype the device hands back (device wire prep fetches the "
+            "wire dtype), or pass cast=True to convert values explicitly"
+        )
+    np.copyto(dst, src_host, casting="unsafe")
+
+
+def device_get_into(pairs: list, timeout: float, cast: bool = False) -> None:
     """Materializes ``(src, dst)`` pairs host-side under one shared deadline,
     landing each source directly in its destination view — the bucket-
     pipelined D2H path: every gradient leaf is copied straight into its slot
     of a persistent flat buffer, with no per-step concatenate or fresh
-    allocation.  ``dst`` must be a writable numpy view shaped like ``src``;
-    dtype mismatches raise (``casting="no"``) rather than silently convert.
+    allocation.  ``dst`` must be a writable numpy view shaped like ``src``.
+
+    Dtypes are checked explicitly: matching dtypes take a fast path (with a
+    byte-copy fallback for ml_dtypes destinations numpy's ``casting="no"``
+    rejects), and a mismatch raises a clear ValueError unless ``cast=True``
+    opts into value conversion — the device wire-prep path fetches bf16
+    bytes into bf16 buffers, and a silent f32<->bf16 convert here would
+    hide a mis-planned buffer at half or double the intended D2H bytes.
     """
     import numpy as np
 
     def run() -> None:
         for src, dst in pairs:
-            np.copyto(dst, np.asarray(src).reshape(dst.shape), casting="no")
+            _copy_into(dst, np.asarray(src), cast)
 
     _MATERIALIZER.get(run, timeout)
 

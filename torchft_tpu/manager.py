@@ -275,6 +275,13 @@ class Manager:
         self._ar_t_first: Optional[float] = None
         self._ar_t_last: Optional[float] = None
         self._ar_gbps = 0.0
+        # Device<->host transfer bytes for the step in flight, noted by the
+        # data-plane layers above (GradientAverager's note_d2h/note_h2d)
+        # and flushed into step_summary — with device wire prep the D2H
+        # side should read ~wire bytes (half of f32), and the H2D side
+        # shows the scatter-back cost the allreduce_h2d span charges.
+        self._d2h_bytes = 0
+        self._h2d_bytes = 0
         self._wire_transport_spans()
 
     def _wire_transport_spans(self) -> None:
@@ -326,6 +333,8 @@ class Manager:
             self._ar_bytes = 0
             self._ar_t_first = None
             self._ar_t_last = None
+            self._d2h_bytes = 0
+            self._h2d_bytes = 0
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -719,10 +728,28 @@ class Manager:
             # Healing replicas / spares contribute zeros (torchft/manager.py:287-288).
             host = np.zeros_like(host)
 
+        # The DCN-throughput gauge counts bytes AS THE WIRE CARRIES THEM:
+        # a bf16-wiring collective encodes float payloads to 2 bytes/elt
+        # per hop regardless of whether the cast ran on device (bf16
+        # buffer handed in) or inside the ring encode (f32 handed in).
+        # Counting the handoff width instead would make the same wire
+        # traffic read 2x apart between those two modes, inverting the
+        # device-prep A/B that bench_allreduce draws from this gauge.  The
+        # collective's own wire_nbytes is the single source of truth;
+        # collectives without the probe count the handoff width.
+        wire_nbytes = getattr(self._collective, "wire_nbytes", None)
+        try:
+            ar_nbytes = (
+                int(wire_nbytes(host, allow_wire_compression))
+                if callable(wire_nbytes)
+                else int(host.nbytes)
+            )
+        except Exception:  # noqa: BLE001 — telemetry only, never fail a step
+            ar_nbytes = int(host.nbytes)
         with self._ar_lock:
             if self._ar_t_first is None:
                 self._ar_t_first = time.monotonic()
-            self._ar_bytes += int(host.nbytes)
+            self._ar_bytes += ar_nbytes
 
         try:
             work = self._collective.allreduce(
@@ -773,6 +800,22 @@ class Manager:
         timed.add_done_callback(settle)
         self._pending_work.append(out)
         return out
+
+    def note_d2h(self, nbytes: int) -> None:
+        """Adds device->host fetch bytes to the step in flight's transfer
+        accounting (flushed into step_summary as ``d2h_bytes``).  Called by
+        the data-plane wrappers (GradientAverager) that stage gradients
+        through host buffers — with device wire prep this reads wire bytes,
+        the ~2x reduction the bench pins."""
+        with self._ar_lock:
+            self._d2h_bytes += int(nbytes)
+
+    def note_h2d(self, nbytes: int) -> None:
+        """Adds host->device scatter-back bytes to the step in flight's
+        transfer accounting (``h2d_bytes`` in step_summary) — the return
+        half of the round-trip the ``allreduce_h2d`` span charges."""
+        with self._ar_lock:
+            self._h2d_bytes += int(nbytes)
 
     @property
     def spans(self):
@@ -858,20 +901,28 @@ class Manager:
         with self._ar_lock:
             ar_bytes, ar_t_first = self._ar_bytes, self._ar_t_first
             ar_t_last = self._ar_t_last
+            d2h_bytes, h2d_bytes = self._d2h_bytes, self._h2d_bytes
             self._ar_bytes, self._ar_t_first = 0, None
             self._ar_t_last = None
+            self._d2h_bytes = 0
+            self._h2d_bytes = 0
         ar_fields: Dict[str, object] = {}
+        if d2h_bytes or h2d_bytes:
+            ar_fields["d2h_bytes"] = d2h_bytes
+            ar_fields["h2d_bytes"] = h2d_bytes
         ar_gbps: Optional[float] = None
         if ar_bytes and ar_t_first is not None:
             if ar_t_last is None or ar_t_last <= ar_t_first:
                 ar_t_last = time.monotonic()
             ar_dur = max(1e-9, ar_t_last - ar_t_first)
             ar_gbps = ar_bytes / 1e9 / ar_dur
-            ar_fields = {
-                "allreduce_bytes": ar_bytes,
-                "allreduce_s": round(ar_dur, 4),
-                "allreduce_gb_per_s": round(ar_gbps, 4),
-            }
+            ar_fields.update(
+                {
+                    "allreduce_bytes": ar_bytes,
+                    "allreduce_s": round(ar_dur, 4),
+                    "allreduce_gb_per_s": round(ar_gbps, 4),
+                }
+            )
             lane_stats = getattr(self._collective, "lane_stats", None)
             if callable(lane_stats):
                 try:

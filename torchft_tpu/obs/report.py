@@ -241,12 +241,13 @@ def deadwindow(
 # from the step interval would fabricate FT cost that the async pipeline
 # specifically does not impose.
 #
-# NOT in this tuple: ``allreduce_d2h``, the GradientAverager's per-bucket
-# device->host wait.  It blocks the train thread (the pipeline overlaps
+# NOT in this tuple: ``allreduce_d2h`` / ``allreduce_h2d``, the
+# GradientAverager's per-bucket device->host fetch and the result
+# scatter-back.  Both block the train thread (the pipeline overlaps
 # bucket k's WIRE time with bucket k+1's copy, but the copy wait itself is
-# serial with compute), so it falls through the generic branch below into
-# ``other_ft`` — FT overhead, never productive.  Moving it here would
-# inflate productive time by exactly the D2H stall and break the
+# serial with compute), so they fall through the generic branch below into
+# ``other_ft`` — FT overhead, never productive.  Moving either here would
+# inflate productive time by exactly the transfer stall and break the
 # dead-window math bench.py reproduces from these streams.
 _OVERLAPPED = ("snapshot",)
 

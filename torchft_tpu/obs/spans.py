@@ -44,7 +44,11 @@ __all__ = [
 # fetch into the persistent flat buffers (blocks the train thread, so it
 # is FT-overhead time, NOT productive compute — report.py charges it to
 # the other-FT bucket and the straggler sentinel subtracts it from busy
-# time); allreduce_merge = drain of pending allreduce futures at commit
+# time); allreduce_h2d = the matching result scatter-back (device_put of
+# reduced buckets onto the leaves' devices/shardings — with device wire
+# prep it moves wire-dtype bytes; charged exactly like allreduce_d2h so
+# the FULL round-trip cost is attributed, not just the fetch);
+# allreduce_merge = drain of pending allreduce futures at commit
 # time; commit_vote = the two-phase commit barrier RPC; snapshot = the
 # donor-side device->host flatten on the HTTP transport's background
 # snapshotter — an OVERLAPPED phase (it runs concurrently with the train
@@ -56,6 +60,7 @@ PHASES = (
     "configure",
     "heal",
     "allreduce_d2h",
+    "allreduce_h2d",
     "allreduce_merge",
     "commit_vote",
     "snapshot",

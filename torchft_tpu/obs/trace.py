@@ -56,6 +56,7 @@ PHASE_TRACKS = {
     "configure": "main",
     "heal": "main",
     "allreduce_d2h": "main",
+    "allreduce_h2d": "main",
     "allreduce_merge": "main",
     "commit_vote": "main",
     "snapshot": "background",
