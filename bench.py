@@ -1768,6 +1768,37 @@ def lighthouse_failover_benchmark() -> dict:
     return payload
 
 
+def scale_benchmark() -> dict:
+    """O(dozens)-group scale scenario (``--scenario scale``): control-plane
+    cells at N in {4, 8, 16, 32} JAX-free Manager groups against one native
+    lighthouse (quorum-formation / heartbeat-fan-in / scrape-cost
+    histograms vs N, with a correlated half-N SIGKILL preemption wave at
+    the largest N asserting quorum reformation, a flight-recorder
+    reconstruction of the wave, and zero leaked fds), plus the
+    flat-ring-vs-ring2d data-plane sweep on a shaped 60 ms-RTT link.  The
+    heavy lifting lives in bench_scale.py (quick mode is tier-1's
+    test_scale_quick_smoke); writes SCALE_BENCH.json."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import bench_scale
+    finally:
+        sys.path.pop(0)
+    payload = bench_scale.run_full(
+        ns=[int(n) for n in os.environ.get(
+            "TPUFT_BENCH_SCALE_NS", "4,8,16,32").split(",")],
+        window_s=float(os.environ.get("TPUFT_BENCH_SCALE_WINDOW_S", "10")),
+        mbps=float(os.environ.get("TPUFT_BENCH_SCALE_MBPS", "200")),
+        rtt_ms=float(os.environ.get("TPUFT_BENCH_SCALE_RTT_MS", "60")),
+        trials=int(os.environ.get("TPUFT_BENCH_SCALE_TRIALS", "2")),
+    )
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "SCALE_BENCH.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return payload
+
+
 def main() -> None:
     # The chip result is computed, assembled, and (on any kill-scenario
     # failure) still printed first: a failure on the subprocess-heavy kill
@@ -1843,6 +1874,7 @@ def selftest() -> None:
     inspect.signature(kill_scenario_benchmark).bind()
     inspect.signature(straggler_benchmark).bind()
     inspect.signature(lighthouse_failover_benchmark).bind()
+    inspect.signature(scale_benchmark).bind()
     plans = _trial_plans(10)
     assert len(plans) == 10
     assert {p["type"] for p in plans} == {
@@ -1860,11 +1892,23 @@ if __name__ == "__main__":
     elif "--scenario" in sys.argv:
         which = sys.argv[sys.argv.index("--scenario") + 1:]
         if not which or which[0] not in (
-            "drain", "kill", "straggler", "lighthouse-failover"
+            "drain", "kill", "straggler", "lighthouse-failover", "scale"
         ):
             print(f"unknown --scenario {which[:1] or '(missing)'}", file=sys.stderr)
             sys.exit(2)
-        if which[0] == "lighthouse-failover":
+        if which[0] == "scale":
+            scale = scale_benchmark()
+            print(
+                json.dumps(
+                    {
+                        "metric": "scale",
+                        "value": scale["summary"].get("ring2d_speedup_by_n"),
+                        "unit": "ring2d_speedup_by_group_count",
+                        "detail": scale["summary"],
+                    }
+                )
+            )
+        elif which[0] == "lighthouse-failover":
             ha = lighthouse_failover_benchmark()
             print(
                 json.dumps(

@@ -91,7 +91,8 @@ def make_buckets(total_bytes: int, n_buckets: int) -> List[np.ndarray]:
 
 
 def _lane_rank_body(
-    collective, rank: int, nbytes: int, n_buckets: int, timeout: float
+    collective, rank: int, nbytes: int, n_buckets: int, timeout: float,
+    world: int = 2,
 ) -> Dict[str, Any]:
     """One rank's bucket stream: issue every bucket, then drain — the
     GradientAverager traffic shape.  Shared by the threaded (--quick) and
@@ -101,22 +102,32 @@ def _lane_rank_body(
     works = [collective.allreduce([b * (rank + 1)], op="sum") for b in buckets]
     outs = [w.wait(timeout=timeout) for w in works]
     wall = time.perf_counter() - t0
+    expected_last = (n_buckets - 1) * world * (world + 1) / 2.0
     assert float(np.asarray(outs[0][0])[0]) == 0.0
-    assert abs(float(np.asarray(outs[-1][0])[0]) - 3.0 * (n_buckets - 1)) < 0.5
-    return {"wall_s": wall, "lane_stats": collective.lane_stats()}
+    # Sanity tolerance scales with the sum: shaped links auto-select the
+    # bf16 wire, whose per-hop quantization ulp grows with the magnitude
+    # (at world 32 the bucket sum is ~5e2 and one bf16 ulp is ~2 — a fixed
+    # 0.5 would flag correct arithmetic).
+    tol = max(0.5, 0.02 * expected_last)
+    assert abs(float(np.asarray(outs[-1][0])[0]) - expected_last) < tol
+    return {"wall_s": wall, "lane_stats": collective.lane_stats(),
+            "topology": collective.topology}
 
 
 def _lane_worker(cfg: Dict[str, Any]) -> Dict[str, Any]:
     """Subprocess entry for one lane-sweep rank (--worker lanes)."""
     from torchft_tpu.collectives import TCPCollective
 
+    world = int(cfg.get("world", 2))
     c = TCPCollective(
-        timeout=cfg["timeout"], wire_dtype=cfg["wire_dtype"], lanes=cfg["lanes"]
+        timeout=cfg["timeout"], wire_dtype=cfg["wire_dtype"], lanes=cfg["lanes"],
+        topology=cfg.get("topology"),
     )
     try:
-        c.configure(cfg["store"], cfg["rank"], 2)
+        c.configure(cfg["store"], cfg["rank"], world)
         return _lane_rank_body(
-            c, cfg["rank"], cfg["nbytes"], cfg["n_buckets"], cfg["timeout"]
+            c, cfg["rank"], cfg["nbytes"], cfg["n_buckets"], cfg["timeout"],
+            world=world,
         )
     finally:
         c.shutdown()
@@ -177,15 +188,19 @@ def bench_lanes(
     timeout: float = 300.0,
     procs: bool = True,
     trials: int = 1,
+    world: int = 2,
+    topology: Optional[str] = None,
 ) -> Dict[str, Any]:
-    """2-rank bucketed allreduce stream at the given lane count under the
-    shaped link.  ``procs=True`` (the artifact path) runs each rank in its
-    own subprocess; ``procs=False`` (--quick) keeps threads for speed.
-    ``trials`` > 1 reports the BEST wall of N runs — the modeled link is
-    deterministic, so the best trial is the one least polluted by OS
-    scheduler noise (the 2-core CI hosts this runs on context-switch a
-    dozen bench threads; a single trial can lose 30% to an unlucky
-    schedule).  Returns wall + GB/s + lane byte counters."""
+    """``world``-rank bucketed allreduce stream at the given lane count and
+    topology under the shaped link.  ``procs=True`` (the artifact path)
+    runs each rank in its own subprocess; ``procs=False`` (--quick) keeps
+    threads for speed.  ``trials`` > 1 reports the BEST wall of N runs —
+    the modeled link is deterministic, so the best trial is the one least
+    polluted by OS scheduler noise (the 2-core CI hosts this runs on
+    context-switch a dozen bench threads; a single trial can lose 30% to an
+    unlucky schedule).  ``topology`` pins the cross-group ring layout
+    ("ring"/"ring2d"); None keeps the collective's default.  Returns wall +
+    GB/s + lane byte counters (per-tier under ring2d)."""
     from torchft_tpu._native import StoreServer
 
     nbytes = int(payload_mb * (1 << 20))
@@ -196,12 +211,16 @@ def bench_lanes(
         with _shaped(mbps, rtt_ms):
             if procs:
                 for trial in range(max(1, trials)):
-                    prefix = f"{store.address()}/lanes{lanes}_{wire_dtype}_t{trial}"
+                    prefix = (
+                        f"{store.address()}/lanes{lanes}_{wire_dtype}"
+                        f"_{topology or 'default'}_w{world}_t{trial}"
+                    )
                     cfgs = [
                         {"store": prefix, "rank": r, "lanes": lanes,
                          "nbytes": nbytes, "n_buckets": n_buckets,
-                         "wire_dtype": wire_dtype, "timeout": timeout}
-                        for r in range(2)
+                         "wire_dtype": wire_dtype, "timeout": timeout,
+                         "world": world, "topology": topology}
+                        for r in range(world)
                     ]
                     attempt = _spawn_workers("lanes", cfgs, timeout + 60)
                     wall = max(r["wall_s"] for r in attempt)
@@ -211,17 +230,23 @@ def bench_lanes(
             else:
                 from torchft_tpu.collectives import TCPCollective
 
-                prefix = f"{store.address()}/lanes{lanes}_{wire_dtype}"
+                prefix = (
+                    f"{store.address()}/lanes{lanes}_{wire_dtype}"
+                    f"_{topology or 'default'}_w{world}"
+                )
                 cols = [
-                    TCPCollective(timeout=timeout, wire_dtype=wire_dtype, lanes=lanes)
-                    for _ in range(2)
+                    TCPCollective(timeout=timeout, wire_dtype=wire_dtype,
+                                  lanes=lanes, topology=topology)
+                    for _ in range(world)
                 ]
                 results: Dict[int, dict] = {}
                 errors: List[BaseException] = []
                 try:
                     threads = [
-                        threading.Thread(target=cols[r].configure, args=(prefix, r, 2))
-                        for r in range(2)
+                        threading.Thread(
+                            target=cols[r].configure, args=(prefix, r, world)
+                        )
+                        for r in range(world)
                     ]
                     for t in threads:
                         t.start()
@@ -231,12 +256,14 @@ def bench_lanes(
                     def run(rank: int) -> None:
                         try:
                             results[rank] = _lane_rank_body(
-                                cols[rank], rank, nbytes, n_buckets, timeout
+                                cols[rank], rank, nbytes, n_buckets, timeout,
+                                world=world,
                             )
                         except BaseException as e:  # noqa: BLE001 — re-raised
                             errors.append(e)
 
-                    rs = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+                    rs = [threading.Thread(target=run, args=(r,))
+                          for r in range(world)]
                     for t in rs:
                         t.start()
                     for t in rs:
@@ -246,7 +273,7 @@ def bench_lanes(
                 finally:
                     for c in cols:
                         c.shutdown()
-                per_rank = [results[r] for r in range(2)]
+                per_rank = [results[r] for r in range(world)]
     finally:
         store.shutdown()
     wall = max(r["wall_s"] for r in per_rank)
@@ -254,6 +281,8 @@ def bench_lanes(
     out = {
         "section": "lanes",
         "lanes": lanes,
+        "world": world,
+        "topology": per_rank[0].get("topology", "ring"),
         "payload_mb": round(actual / (1 << 20), 2),
         "buckets": n_buckets,
         "wire_dtype": wire_dtype,
@@ -264,6 +293,12 @@ def bench_lanes(
         # Per-lane wire bytes from rank 0 (striping balance evidence).
         "lane_bytes_sent": per_rank[0]["lane_stats"].get("sent"),
     }
+    tiers = per_rank[0]["lane_stats"].get("tiers")
+    if tiers:
+        # Per-tier byte attribution under ring2d (row vs column traffic).
+        out["tier_bytes_sent"] = {
+            name: sum(t["sent"]) for name, t in tiers.items()
+        }
     if len(walls) > 1:
         out["trial_walls_s"] = [round(w, 3) for w in walls]
     return out
@@ -781,6 +816,23 @@ def main() -> None:
         help="lane-sweep trials per lane count (best wall wins; scheduler "
         "noise on small shared hosts costs a single trial up to 30%%)",
     )
+    parser.add_argument(
+        "--topology", choices=["ring", "ring2d", "both"], default="both",
+        help="cross-group topology A/B: 'both' adds a flat-vs-ring2d sweep "
+        "at --topo-world ranks on the same shaped link (the per-topology "
+        "records the artifact quotes); 'ring'/'ring2d' pin one side",
+    )
+    parser.add_argument(
+        "--topo-world", type=int, default=4,
+        help="rank count for the topology A/B (ring2d needs a non-prime "
+        "world >= 4; the flat ring's 2(N-1) hop latency is what the 2D "
+        "grid undercuts)",
+    )
+    parser.add_argument(
+        "--topo-mb", type=float, default=8.0,
+        help="payload for the topology A/B (latency-bound regime: small "
+        "enough that per-hop RTT, not serialization, dominates)",
+    )
     parser.add_argument("--e2e-steps", type=int, default=6)
     parser.add_argument("--e2e-mb", type=float, default=12.0)
     parser.add_argument("--e2e-leaves", type=int, default=16)
@@ -833,6 +885,38 @@ def main() -> None:
         r = bench_lanes(args.mb, l, args.mbps, args.rtt_ms, args.buckets,
                         trials=args.trials)
         lane_gbps[l] = r["gb_per_s"]
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    # Topology A/B: the same bucket stream at --topo-world ranks, flat ring
+    # vs 2D ring-of-rings, on the same shaped link.  Paired same-host
+    # best-of-N trials; GB/s from the identical payload/wall arithmetic so
+    # the records compare directly.
+    topo_gbps: Dict[str, float] = {}
+    topo_selection = (
+        ["ring", "ring2d"] if args.topology == "both" else [args.topology]
+    )
+    for topo in topo_selection:
+        r = bench_lanes(args.topo_mb, 2, args.mbps, args.rtt_ms,
+                        n_buckets=max(2, args.buckets // 2),
+                        trials=args.trials, world=args.topo_world,
+                        topology=topo)
+        r["section"] = "topology"
+        r["requested_topology"] = topo
+        if r["topology"] != topo:
+            # ring2d degrades at primes / worlds < 4: the "A/B" would then
+            # be two identical flat-ring trials silently keyed as one —
+            # surface it instead of recording a speedup that never ran.
+            import sys as _sys
+
+            print(
+                f"warning: requested topology {topo!r} resolved to "
+                f"{r['topology']!r} at world {args.topo_world} (no 2D grid)"
+                " — topology A/B skipped for this side",
+                file=_sys.stderr, flush=True,
+            )
+        else:
+            topo_gbps[topo] = r["gb_per_s"]
         results.append(r)
         print(json.dumps(r), flush=True)
 
@@ -910,6 +994,15 @@ def main() -> None:
         summary["speedup_4_lanes"] = round(lane_gbps[4] / lane_gbps[1], 2)
     if 1 in lane_gbps and 2 in lane_gbps:
         summary["speedup_2_lanes"] = round(lane_gbps[2] / lane_gbps[1], 2)
+    if topo_gbps:
+        summary["topology_gb_per_s"] = {
+            t: g for t, g in sorted(topo_gbps.items())
+        }
+        summary["topology_world"] = args.topo_world
+        if "ring" in topo_gbps and "ring2d" in topo_gbps and topo_gbps["ring"]:
+            summary["ring2d_speedup"] = round(
+                topo_gbps["ring2d"] / topo_gbps["ring"], 3
+            )
     print(json.dumps({"summary": summary}), flush=True)
     if args.out:
         with open(args.out, "w") as f:

@@ -1,0 +1,809 @@
+"""O(dozens)-group scale harness: control-plane + data-plane sweeps vs N.
+
+ROADMAP item 2's missing half — everything measured before this ran at 2-3
+replica groups on loopback.  Two sweeps, one artifact (``SCALE_BENCH.json``,
+written by ``bench.py --scenario scale`` / ``python bench_scale.py``):
+
+  control plane  — ONE in-process native lighthouse + N JAX-free worker
+                   subprocesses running the REAL Manager control loop
+                   (quorum -> sleep-step -> two-phase commit vote), N swept
+                   over {4, 8, 16, 32}.  Per cell: per-group commit counts,
+                   quorum-formation latency / heartbeat fan-in cost /
+                   per-method RPC latency / /metrics scrape self-cost, all
+                   read from the PR 7 native histograms on /metrics — the
+                   measurement substrate this sweep exists to exercise.
+                   The largest cell injects a CORRELATED PREEMPTION WAVE:
+                   half the groups SIGKILLed inside one tight window (spot
+                   reclaim).  The cell asserts the surviving half reforms a
+                   quorum and keeps committing, the run leaks zero fds in
+                   the driver, and the lighthouse's flight-recorder dump
+                   reconstructs the wave's quorum transitions (members
+                   N -> N/2 with the victims in ``left``).
+
+  data plane     — flat ring vs hierarchical ring2d allreduce
+                   (TPUFT_RING_TOPOLOGY) at N subprocess ranks on a shaped
+                   link, N swept over the same set.  The flat ring pays
+                   2(N-1) sequential hops of half-RTT each; the 2D
+                   ring-of-rings pays ~4*sqrt(N) — on a 60 ms-RTT link the
+                   crossover shows up well before N=16.  Records reuse
+                   bench_allreduce.bench_lanes (payload/wall GB/s, per-tier
+                   byte counters), reported as paired best-of-N trials with
+                   speedup = ring_wall / ring2d_wall.
+
+Quick mode (``run_quick()``, wired into tier-1 as
+``tests/test_bench_contract.py::test_scale_quick_smoke``): a 4-group cell
+with a 2-victim wave under a pinned ring2d topology (the post-wave 2-group
+world crosses the auto crossover back to the flat ring), an in-process
+topology-parity check, and the full SCALE_BENCH schema.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:  # non-procfs platform: fd accounting unavailable
+        return -1
+
+
+# ---------------------------------------------------------------------------
+# Worker: one replica group's Manager control loop (re-entered subprocess)
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(cfg: Dict) -> None:
+    """One replica group: real Manager + lighthouse quorum + commit votes,
+    no JAX and no gradient traffic.  The cross-group collective still
+    rendezvouses per quorum change, so at N >= the ring2d crossover the
+    workers build (and, across the preemption wave, REBUILD at the new
+    group count) the hierarchical topology's tier sockets.  Counted window
+    ends when the driver's stop file appears; a bounded linger keeps
+    feeding the quorum machine so siblings' last counted quorums can form
+    (see bench_ha.py for the lesson this encodes)."""
+    from datetime import timedelta
+
+    import numpy as np
+
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu.collectives import TCPCollective
+    from torchft_tpu.manager import Manager
+
+    state = {"w": np.zeros(8, dtype=np.float32)}
+    manager = Manager(
+        collective=TCPCollective(timeout=30.0),
+        load_state_dict=lambda sd: state.update(sd),
+        state_dict=lambda: dict(state),
+        min_replica_size=1,
+        rank=0,
+        world_size=1,
+        replica_id=str(cfg["group"]),
+        lighthouse_addr=cfg["lighthouse"],
+        # Budget for a full post-wave reformation (heartbeat staling +
+        # rejoin fan-in) inside one quorum call on a loaded 1-2 core host.
+        quorum_timeout=timedelta(seconds=cfg.get("quorum_timeout_s", 60.0)),
+        timeout=timedelta(seconds=30.0),
+        connect_timeout=timedelta(seconds=15.0),
+        checkpoint_transport=HTTPTransport(timeout=30.0),
+        init_sync=False,
+    )
+    workdir = cfg["workdir"]
+    stop_path = os.path.join(workdir, "stop")
+    end_cap = float(cfg["end_cap_ts"])  # hard ceiling, stop file is the norm
+    step_s = float(cfg.get("step_s", 0.1))
+    groups = int(cfg["groups"])
+    commits = 0
+    failed = 0
+    try:
+        # Ready/go barrier: interpreter startup at N=32 on a small host
+        # spreads worker launch over tens of seconds; without the barrier
+        # the earliest min_replicas workers form a quorum alone and every
+        # late joiner enters through a heal-against-a-moving-cluster (the
+        # bench_ha lesson).  The driver writes "go" once every group is
+        # constructed, so the FIRST quorum contains all N.
+        with open(os.path.join(workdir, f"ready_{cfg['group']}"), "w"):
+            pass
+        go_deadline = time.time() + 180.0
+        go_path = os.path.join(workdir, "go")
+        while time.time() < go_deadline and not os.path.exists(go_path):
+            time.sleep(0.05)
+        while time.time() < end_cap and not os.path.exists(stop_path):
+            # A transient control-plane fault (quorum RPC timeout riding a
+            # CPU-starved tick, a busy donor window mid-heal) must count as
+            # a failed step and RETRY, not kill the worker — worker death
+            # on recoverable faults is exactly what this harness exists to
+            # flush out.
+            try:
+                manager.start_quorum()
+                time.sleep(step_s)  # the "train step"
+                if manager.should_commit():
+                    commits += 1
+                else:
+                    failed += 1
+            except Exception:  # noqa: BLE001
+                failed += 1
+                time.sleep(step_s)
+        # Uncounted linger: siblings' final counted quorums — started a
+        # tick before ours ended — need our join to form.  Bounded because
+        # a preemption wave's victims never write their done files.
+        with open(os.path.join(workdir, f"done_{cfg['group']}"), "w"):
+            pass
+        linger_deadline = time.time() + 12.0
+        while time.time() < linger_deadline:
+            if all(
+                os.path.exists(os.path.join(workdir, f"done_{g}"))
+                for g in range(groups)
+            ):
+                break
+            try:
+                manager.start_quorum()
+                time.sleep(step_s)
+                manager.should_commit()
+            except Exception:  # noqa: BLE001 — teardown races are benign
+                break
+    finally:
+        summary = {"group": cfg["group"], "commits": commits, "failed": failed}
+        print("SCALE_WORKER " + json.dumps(summary), flush=True)
+        manager.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Scrape parsing
+# ---------------------------------------------------------------------------
+
+
+def _scrape(http_address: str, path: str, timeout: float = 5.0) -> Optional[str]:
+    import urllib.request
+
+    url = http_address if http_address.startswith("http") else f"http://{http_address}"
+    try:
+        with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+            return resp.read().decode()
+    except Exception:  # noqa: BLE001 — poller; absence is an answer
+        return None
+
+
+def _hist_stats(text: str, name: str, label: str = "") -> Dict[str, Any]:
+    """``{count, mean_ms}`` for one Prometheus histogram family (``label``
+    filters a labelled series, e.g. ``method="Quorum"``)."""
+    total: Optional[float] = None
+    count: Optional[float] = None
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest.startswith("_sum") and (not label or label in rest):
+            total = float(line.rsplit(" ", 1)[1])
+        elif rest.startswith("_count") and (not label or label in rest):
+            count = float(line.rsplit(" ", 1)[1])
+    if not count:
+        return {"count": 0, "mean_ms": None}
+    return {"count": int(count), "mean_ms": round(1e3 * (total or 0.0) / count, 3)}
+
+
+# ---------------------------------------------------------------------------
+# Control-plane cell
+# ---------------------------------------------------------------------------
+
+
+def run_control_cell(
+    workdir: str,
+    groups: int,
+    window_s: float = 10.0,
+    step_s: float = 0.1,
+    wave: int = 0,
+    # Generous vs the 100 ms-cadence default: on a saturated small host a
+    # worker process can be scheduler-starved for seconds, and a spuriously
+    # staled heartbeat lets a subset quorum form that drags the starved
+    # group through a heal the cell never meant to measure.
+    heartbeat_timeout_ms: int = 3000,
+    quorum_tick_ms: int = 50,
+    worker_env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """One N-group control-plane cell.  ``wave`` > 0 SIGKILLs that many
+    groups (the highest-numbered ones) inside one tight window mid-run and
+    requires the survivors to reform a quorum and keep committing, the
+    flight-recorder dump to reconstruct the transition, and the driver to
+    leak zero fds across the whole cell."""
+    from torchft_tpu._native import LighthouseServer
+    from torchft_tpu.obs import flight as obs_flight
+    from torchft_tpu.obs import report as obs_report
+
+    os.makedirs(workdir, exist_ok=True)
+    metrics_path = os.path.join(workdir, "metrics.jsonl")
+    gc.collect()
+    fd_before = _fd_count()
+    prior_flight = os.environ.get("TPUFT_FLIGHT_DIR")
+    os.environ["TPUFT_FLIGHT_DIR"] = workdir
+    survivors = list(range(groups - wave))
+    victims = list(range(groups - wave, groups))
+    result: Dict[str, Any] = {
+        "section": "scale_control",
+        "groups": groups,
+        "window_s": window_s,
+        "step_s": step_s,
+        "wave": wave,
+        "min_replicas": max(1, groups - wave),
+        "ok": False,
+    }
+    workers: List[subprocess.Popen] = []
+    lighthouse = None
+    try:
+        lighthouse = LighthouseServer(
+            bind="127.0.0.1:0",
+            http_bind="127.0.0.1:0",
+            # A wave cell's floor must be satisfiable by the survivors or
+            # the post-wave quorum can never form; clean cells pin the full
+            # count so the first quorum contains everyone.
+            min_replicas=max(1, groups - wave),
+            # Generous: every worker heartbeats from construction (before
+            # the go barrier), so a long join wait only delays formation
+            # while a LIVE member's join is still in flight — on a
+            # saturated host the unluckiest first join can lag seconds,
+            # and a quorum formed without it drags that group through a
+            # heal this cell never meant to measure.  Post-wave
+            # reformation is unaffected: SIGKILLed victims stop
+            # heartbeating, and once they stale out the all-joined check
+            # forms the survivor quorum without waiting out this timeout.
+            join_timeout_ms=10000 + 500 * groups,
+            quorum_tick_ms=quorum_tick_ms,
+            heartbeat_timeout_ms=heartbeat_timeout_ms,
+        )
+        http = lighthouse.http_address()
+        env = dict(os.environ)
+        env["TPUFT_METRICS_PATH"] = metrics_path
+        if worker_env:
+            env.update(worker_env)
+        # Hard ceiling well past the window: worker startup at N=32 on a
+        # small host serializes ~0.5 s of interpreter+numpy import each,
+        # and a wave cell's counted phase additionally spans the driver's
+        # reformation wait (which can include a straggler-recovery cycle).
+        end_cap = time.time() + window_s + 60.0 + 1.2 * groups + (
+            240.0 if wave > 0 else 0.0
+        )
+        log_paths = []
+        for g in range(groups):
+            cfg = {
+                "group": g,
+                "groups": groups,
+                "lighthouse": lighthouse.address(),
+                "end_cap_ts": end_cap,
+                "workdir": workdir,
+                "step_s": step_s,
+                # Steady-state quorums are sub-second; the budget only has
+                # to ride out a post-wave reformation.  Shorter than the
+                # worker default so ONE unlucky blocked join (a re-register
+                # racing the formed round) costs the lockstep cluster 30 s,
+                # not 60, before the abort-and-retry recovers it.
+                "quorum_timeout_s": 30.0,
+            }
+            log_path = os.path.join(workdir, f"g{g}.log")
+            log_paths.append(log_path)
+            with open(log_path, "ab") as log:
+                workers.append(
+                    subprocess.Popen(
+                        [sys.executable, os.path.abspath(__file__), "--worker",
+                         json.dumps(cfg)],
+                        env=env,
+                        stdout=log,
+                        stderr=subprocess.STDOUT,
+                        cwd=REPO,
+                    )
+                )
+
+        def commits_per_group() -> Dict[str, List[float]]:
+            return obs_report.commit_timelines(
+                obs_report.read_events([metrics_path])
+            )
+
+        # Barrier: wait for every worker's ready file AND for the
+        # lighthouse to have all N heartbeats on file, then release the
+        # workers together.  The heartbeat half is what makes this sound:
+        # the lighthouse's straggler wait and split-brain guard only cover
+        # replicas it can SEE — a constructed-but-not-yet-heartbeating
+        # group is invisible, the all-joined check short-circuits without
+        # it, and the resulting subset quorum drags it through a heal at
+        # step 0.  With all N heartbeats pre-registered, formation
+        # provably waits for every live join (up to join_timeout).
+        ready_deadline = time.time() + 60.0 + 1.5 * groups
+        while time.time() < ready_deadline:
+            if all(
+                os.path.exists(os.path.join(workdir, f"ready_{g}"))
+                for g in range(groups)
+            ):
+                status = _scrape(http, "/status.json") or "{}"
+                try:
+                    seen = json.loads(status).get("heartbeat_age_ms", {})
+                except ValueError:
+                    seen = {}
+                if len({str(k).split(":", 1)[0] for k in seen}) >= groups:
+                    break
+            time.sleep(0.1)
+        with open(os.path.join(workdir, "go"), "w"):
+            pass
+
+        # Warm-up: every group must have a commit timeline before the
+        # counted phenomena (wave, histogram reads) mean anything.
+        t0 = time.time()
+        warm_deadline = t0 + 60.0 + 1.2 * groups
+        while time.time() < warm_deadline:
+            cs = commits_per_group()
+            if all(len(cs.get(str(g), [])) >= 3 for g in range(groups)):
+                break
+            time.sleep(0.25)
+        cs = commits_per_group()
+        result["warmed_groups"] = sum(
+            1 for g in range(groups) if len(cs.get(str(g), [])) >= 3
+        )
+        result["warmup_s"] = round(time.time() - t0, 2)
+
+        # Prime the scrape-cost histogram (self-observed AFTER render: the
+        # cost of scrape k is visible from scrape k+1).
+        for _ in range(3):
+            _scrape(http, "/metrics")
+
+        wave_ts = None
+        if wave > 0:
+            # THE FAULT: a correlated preemption wave — SIGKILL `wave`
+            # groups back-to-back, the spot-reclaim shape where one
+            # maintenance event takes out a whole capacity block.
+            wave_ts = time.time()
+            for g in victims:
+                try:
+                    workers[g].send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+            for g in victims:
+                workers[g].wait()
+            result["wave_ts"] = wave_ts
+            result["wave_kill_span_s"] = round(time.time() - wave_ts, 3)
+            # Reformation evidence: every survivor commits >= 2 more steps
+            # AFTER the wave (requires a formed post-wave quorum).
+            base = {
+                g: len(commits_per_group().get(str(g), [])) for g in survivors
+            }
+            # Generous: covers heartbeat staling + rejoin fan-in, PLUS one
+            # full straggler-recovery cycle — a survivor whose rejoin races
+            # the formed round blocks for its quorum timeout, and the
+            # lockstep cluster (correctly) waits for it before committing
+            # again.  The cell's evidence for "reformed" is every survivor
+            # committing post-wave, which includes riding out that cycle.
+            reform_deadline = time.time() + 90.0 + 2 * 30.0
+            reformed = False
+            while time.time() < reform_deadline and not reformed:
+                cs = commits_per_group()
+                reformed = all(
+                    len([t for t in cs.get(str(g), []) if t > wave_ts]) >= 2
+                    for g in survivors
+                )
+                time.sleep(0.25)
+            result["quorum_reformed"] = reformed
+            if reformed:
+                cs = commits_per_group()
+                # First commit every survivor lands after the wave — an
+                # upper bound on disruption, but it can ride the PRE-wave
+                # quorum; the honest reformation latency comes from the
+                # flight recorder's shrunken-quorum transition below.
+                first_post = max(
+                    min(t for t in cs[str(g)] if t > wave_ts) for g in survivors
+                )
+                result["first_commit_after_wave_s"] = round(first_post - wave_ts, 3)
+            del base
+
+        # Let the counted window run out, then stop everyone together.
+        time.sleep(max(0.0, (t0 + result["warmup_s"] + window_s) - time.time()))
+        with open(os.path.join(workdir, "stop"), "w"):
+            pass
+        for g, w in enumerate(workers):
+            if g in victims:
+                continue
+            try:
+                # Budget for the worst exit path: the LAST lingering worker
+                # can block a full quorum_timeout (60 s) in its final
+                # start_quorum once its siblings exited (min_replicas can
+                # no longer be met), plus the 12 s linger bound.
+                w.wait(timeout=110.0)
+            except subprocess.TimeoutExpired:
+                w.kill()
+                w.wait()
+
+        summaries = []
+        for path in log_paths:
+            with open(path, "rb") as f:
+                for line in f:
+                    if line.startswith(b"SCALE_WORKER "):
+                        summaries.append(json.loads(line[len(b"SCALE_WORKER "):]))
+        result["worker_summaries"] = sorted(summaries, key=lambda s: s["group"])
+        result["survivor_failed_commits"] = sum(
+            s["failed"] for s in summaries if s["group"] in survivors
+        )
+
+        cs = commits_per_group()
+        result["per_group_commits"] = {g: len(ts) for g, ts in sorted(cs.items())}
+        if wave > 0 and wave_ts is not None:
+            result["post_wave_commits"] = {
+                str(g): len([t for t in cs.get(str(g), []) if t > wave_ts])
+                for g in survivors
+            }
+
+        # Control-plane cost vs N, from the PR 7 native histograms.
+        final = _scrape(http, "/metrics") or ""
+        with open(os.path.join(workdir, "final.metrics"), "w") as f:
+            f.write(final)
+        result["quorum_formation"] = _hist_stats(
+            final, "tpuft_quorum_formation_seconds"
+        )
+        result["heartbeat_fanin"] = _hist_stats(
+            final, "tpuft_heartbeat_fanin_seconds"
+        )
+        result["scrape"] = _hist_stats(final, "tpuft_metrics_scrape_seconds")
+        result["rpc"] = {
+            m: _hist_stats(final, "tpuft_rpc_latency_seconds", f'method="{m}"')
+            for m in ("Quorum", "Heartbeat")
+        }
+        result["scrape_bytes"] = len(final)
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        if lighthouse is not None:
+            lighthouse.shutdown()  # writes the flight dump into workdir
+        if prior_flight is None:
+            os.environ.pop("TPUFT_FLIGHT_DIR", None)
+        else:
+            os.environ["TPUFT_FLIGHT_DIR"] = prior_flight
+
+    # Flight-recorder post-mortem: the dump must exist, parse, and (for a
+    # wave cell) reconstruct the wave's quorum transitions.
+    dumps = [
+        os.path.join(workdir, f)
+        for f in os.listdir(workdir)
+        if f.startswith("flight_lighthouse_") and f.endswith(".json")
+    ]
+    result["flight_dump_found"] = bool(dumps)
+    if dumps:
+        dump = obs_flight.load_flight_dump(dumps[0])
+        transitions = obs_flight.quorum_transitions(obs_flight.flight_events(dump))
+        result["flight_transitions"] = len(transitions)
+        if wave > 0 and wave_ts is not None:
+            post = [
+                t for t in transitions
+                if t["ts_ms"] >= int(wave_ts * 1000) - 500
+            ]
+            # Replica ids carry per-incarnation uuid suffixes
+            # ("<group>:<uuid>"); the reconstruction compares group prefixes.
+            group_of = lambda m: str(m).split(":", 1)[0]  # noqa: E731
+            left_union: set = set()
+            for t in post:
+                left_union.update(group_of(m) for m in t["left"])
+            victim_ids = {str(g) for g in victims}
+            survivor_ids = {str(g) for g in survivors}
+            shrunk_ts = next(
+                (t["ts_ms"] for t in post
+                 if {group_of(m) for m in t["members"]} == survivor_ids),
+                None,
+            )
+            result["wave_reconstructed"] = bool(
+                victim_ids <= left_union and shrunk_ts is not None
+            )
+            if shrunk_ts is not None:
+                # Quorum-reformation latency from the server's own record:
+                # wave start to the formation of the survivors-only quorum.
+                result["wave_reform_s"] = round(shrunk_ts / 1000.0 - wave_ts, 3)
+            result["wave_transitions"] = [
+                {k: t[k] for k in ("quorum_id", "members", "joined", "left")}
+                for t in post[:8]
+            ]
+
+    # fd hygiene: everything the cell opened (lighthouse, scrape sockets,
+    # worker pipes, log handles) must be closed.  Settle loop because
+    # socket close under load is not instantaneous.
+    fd_after = _fd_count()
+    settle = time.time() + 5.0
+    while fd_after > fd_before and time.time() < settle:
+        gc.collect()
+        time.sleep(0.2)
+        fd_after = _fd_count()
+    result["fd_before"] = fd_before
+    result["fd_after"] = fd_after
+    result["fd_leaked"] = max(0, fd_after - fd_before) if fd_before >= 0 else None
+
+    # Commit evidence from the METRICS STREAM, not the worker summary
+    # lines: a lingering worker killed at the driver's wait deadline loses
+    # its stdout summary, but its commits are already durably in the
+    # stream.
+    stream_commits = result.get("per_group_commits", {})
+    all_committed = all(
+        stream_commits.get(str(g), 0) > 0 for g in survivors
+    )
+    result["ok"] = bool(
+        result.get("warmed_groups") == groups
+        and all_committed
+        and result.get("flight_dump_found")
+        and (wave == 0 or (result.get("quorum_reformed")
+                           and result.get("wave_reconstructed")))
+        and (result.get("fd_leaked") in (0, None))
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Data-plane sweep (flat ring vs ring2d at N ranks)
+# ---------------------------------------------------------------------------
+
+
+def run_dataplane_sweep(
+    ns: List[int],
+    mbps: float = 200.0,
+    rtt_ms: float = 60.0,
+    payload_mb: float = 2.0,
+    lanes: int = 2,
+    trials: int = 2,
+    timeout: float = 600.0,
+) -> Dict[str, Any]:
+    """Paired flat-vs-ring2d allreduce trials at each N (subprocess ranks,
+    shaped link).  The pinned link models a cross-site hop: at 60 ms RTT
+    the flat ring's 2(N-1) serialized half-RTT hops dominate wall time, so
+    the hierarchical speedup grows with N."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_allreduce
+    finally:
+        sys.path.pop(0)
+    records: List[Dict[str, Any]] = []
+    speedups: Dict[str, float] = {}
+    for n in ns:
+        walls: Dict[str, float] = {}
+        for topo in ("ring", "ring2d"):
+            rec = bench_allreduce.bench_lanes(
+                payload_mb, lanes, mbps, rtt_ms, n_buckets=2,
+                timeout=timeout, procs=True, trials=trials,
+                world=n, topology=topo,
+            )
+            rec["section"] = "scale_dataplane"
+            walls[rec["topology"]] = rec["wall_s"]
+            records.append(rec)
+            print(json.dumps(rec), flush=True)
+        if "ring" in walls and "ring2d" in walls and walls["ring2d"] > 0:
+            speedups[str(n)] = round(walls["ring"] / walls["ring2d"], 3)
+    return {
+        "records": records,
+        "link": {"mbps": mbps, "rtt_ms": rtt_ms},
+        "payload_mb": payload_mb,
+        "lanes": lanes,
+        "trials": trials,
+        "ring2d_speedup_by_n": speedups,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Topology parity (in-process, cheap — the quick smoke's correctness gate)
+# ---------------------------------------------------------------------------
+
+
+def topology_parity_check(world: int = 4) -> Dict[str, Any]:
+    """Same inputs through the flat ring and ring2d at ``world`` in-process
+    thread ranks: results must agree within f32 reassociation tolerance,
+    each topology must be replica-consistent (bitwise across ranks), and
+    int payloads must bypass wire compression on both."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from torchft_tpu._native import StoreServer
+    from torchft_tpu.collectives import TCPCollective
+
+    rng = np.random.default_rng(29)
+    fdata = [rng.standard_normal(4096).astype(np.float32) for _ in range(world)]
+    idata = [np.arange(512, dtype=np.int64) * (r + 1) for r in range(world)]
+    store = StoreServer(bind="127.0.0.1:0")
+    out: Dict[str, Any] = {"world": world}
+    try:
+        def run(topology: str, tag: str):
+            prefix = f"{store.address()}/parity_{tag}"
+            results: Dict[int, Any] = {}
+
+            def worker(rank: int) -> None:
+                c = TCPCollective(timeout=20.0, lanes=2, topology=topology,
+                                  wire_dtype="bf16", chunk_bytes=4 << 10)
+                try:
+                    c.configure(prefix, rank, world)
+                    f = c.allreduce([fdata[rank].copy()], op="sum").wait(timeout=30)[0]
+                    i = c.allreduce([idata[rank].copy()], op="sum").wait(timeout=30)[0]
+                    results[rank] = (f, i, c.topology)
+                finally:
+                    c.shutdown()
+
+            with ThreadPoolExecutor(max_workers=world) as pool:
+                for fut in [pool.submit(worker, r) for r in range(world)]:
+                    fut.result(timeout=60)
+            return results
+
+        ring = run("ring", "ring")
+        r2d = run("ring2d", "ring2d")
+        out["ring2d_active"] = r2d[0][2] == "ring2d"
+        import numpy as np
+
+        int_exact = all(
+            np.array_equal(r2d[r][1], np.arange(512, dtype=np.int64)
+                           * sum(range(1, world + 1)))
+            for r in range(world)
+        )
+        replica_consistent = all(
+            np.array_equal(r2d[r][0], r2d[0][0]) for r in range(world)
+        ) and all(np.array_equal(ring[r][0], ring[0][0]) for r in range(world))
+        # bf16 per-hop re-quantization envelope between topologies.
+        close = np.allclose(
+            np.asarray(r2d[0][0], np.float32), np.asarray(ring[0][0], np.float32),
+            rtol=0.02, atol=0.05 * world,
+        )
+        out["int_bypass_ok"] = bool(int_exact)
+        out["replica_consistent"] = bool(replica_consistent)
+        out["topologies_close"] = bool(close)
+        out["ok"] = bool(out["ring2d_active"] and int_exact
+                         and replica_consistent and close)
+    finally:
+        store.shutdown()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+# ---------------------------------------------------------------------------
+
+
+def run_quick() -> Dict[str, Any]:
+    """Tier-1 smoke shape: topology parity at 4 in-process ranks, then a
+    4-group control cell with a 2-victim preemption wave under a PINNED
+    ring2d topology — the post-wave 2-group world crosses the auto
+    crossover back to the flat ring, so the smoke exercises the
+    reconfigure-across-topologies path end to end."""
+    workdir = tempfile.mkdtemp(prefix="tpuft_scale_quick_")
+    fd_before = _fd_count()
+    parity = topology_parity_check(world=4)
+    cell = run_control_cell(
+        workdir,
+        groups=4,
+        window_s=5.0,
+        step_s=0.1,
+        wave=2,
+        worker_env={"TPUFT_RING_TOPOLOGY": "ring2d"},
+    )
+    gc.collect()
+    fd_after = _fd_count()
+    return {
+        "metric": "scale",
+        "quick": True,
+        "parity": parity,
+        "cells": [cell],
+        "dataplane": [],
+        "workdir": workdir,
+        "fd_leaked_total": (
+            max(0, fd_after - fd_before) if fd_before >= 0 else None
+        ),
+        "ok": bool(parity["ok"] and cell["ok"]),
+    }
+
+
+def run_full(
+    ns: Optional[List[int]] = None,
+    window_s: float = 10.0,
+    mbps: float = 200.0,
+    rtt_ms: float = 60.0,
+    trials: int = 2,
+    wave_n: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The full sweep: control cells at each N (the largest with a half-N
+    preemption wave), plus the flat-vs-ring2d data-plane sweep."""
+    ns = ns or [4, 8, 16, 32]
+    wave_n = wave_n if wave_n is not None else max(ns)
+    base = os.environ.get("TPUFT_BENCH_WORKDIR") or tempfile.mkdtemp(
+        prefix="tpuft_scale_"
+    )
+    cells: List[Dict[str, Any]] = []
+    for n in ns:
+        wave = n // 2 if n == wave_n else 0
+        # Bigger cells slow the step cadence and widen the heartbeat window:
+        # N workers on a 1-2 core host timeshare, and the cell measures
+        # control-plane cost, not the host's scheduler.
+        step_s = 0.1 if n <= 8 else 0.25
+        cell = run_control_cell(
+            os.path.join(base, f"n{n}"),
+            groups=n,
+            window_s=window_s,
+            step_s=step_s,
+            wave=wave,
+            heartbeat_timeout_ms=3000 if n <= 8 else 5000,
+        )
+        cells.append(cell)
+        print(json.dumps(cell), flush=True)
+    dataplane = run_dataplane_sweep(ns, mbps=mbps, rtt_ms=rtt_ms, trials=trials)
+    summary = {
+        "groups_swept": ns,
+        "quorum_formation_ms_by_n": {
+            str(c["groups"]): c.get("quorum_formation", {}).get("mean_ms")
+            for c in cells
+        },
+        "heartbeat_fanin_ms_by_n": {
+            str(c["groups"]): c.get("heartbeat_fanin", {}).get("mean_ms")
+            for c in cells
+        },
+        "scrape_ms_by_n": {
+            str(c["groups"]): c.get("scrape", {}).get("mean_ms") for c in cells
+        },
+        "scrape_bytes_by_n": {
+            str(c["groups"]): c.get("scrape_bytes") for c in cells
+        },
+        "ring2d_speedup_by_n": dataplane["ring2d_speedup_by_n"],
+        "wave": {
+            "groups": wave_n,
+            "killed": wave_n // 2,
+            "reform_s": next(
+                (c.get("wave_reform_s") for c in cells if c["groups"] == wave_n),
+                None,
+            ),
+            "reconstructed": next(
+                (c.get("wave_reconstructed") for c in cells
+                 if c["groups"] == wave_n),
+                None,
+            ),
+            "fd_leaked": next(
+                (c.get("fd_leaked") for c in cells if c["groups"] == wave_n),
+                None,
+            ),
+        },
+        "cells_ok": all(c["ok"] for c in cells),
+    }
+    return {
+        "metric": "scale",
+        "quick": False,
+        "workdir": base,
+        "cells": cells,
+        "dataplane": dataplane,
+        "summary": summary,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", default=None, help=argparse.SUPPRESS)
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--ns", type=int, nargs="*", default=[4, 8, 16, 32])
+    parser.add_argument("--window-s", type=float, default=10.0)
+    parser.add_argument("--mbps", type=float, default=200.0)
+    parser.add_argument("--rtt-ms", type=float, default=60.0)
+    parser.add_argument("--trials", type=int, default=2)
+    parser.add_argument("--out", default=os.path.join(REPO, "SCALE_BENCH.json"))
+    args = parser.parse_args()
+    if args.worker is not None:
+        _worker_main(json.loads(args.worker))
+        return
+    if args.quick:
+        payload = run_quick()
+    else:
+        payload = run_full(
+            ns=args.ns, window_s=args.window_s, mbps=args.mbps,
+            rtt_ms=args.rtt_ms, trials=args.trials,
+        )
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    print(json.dumps(payload.get("summary", payload)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -65,9 +65,11 @@ void AppendEventJson(std::ostringstream& o, const FlightEvent& ev) {
 
 }  // namespace
 
-FlightRecorder::FlightRecorder(size_t capacity)
-    : capacity_(capacity == 0 ? 1 : capacity) {
+FlightRecorder::FlightRecorder(size_t capacity, size_t transition_capacity)
+    : capacity_(capacity == 0 ? 1 : capacity),
+      trans_capacity_(transition_capacity == 0 ? 1 : transition_capacity) {
   ring_.resize(capacity_);
+  trans_ring_.resize(trans_capacity_);
 }
 
 void FlightRecorder::SetIdentity(const std::string& server, const std::string& id) {
@@ -79,10 +81,20 @@ void FlightRecorder::SetIdentity(const std::string& server, const std::string& i
 void FlightRecorder::Record(FlightEvent ev) {
   ev.ts_ms = EpochMsNow();
   ev.mono_us = MonoUsNow();
+  bool is_span = ev.kind == kFlightRpc;
   std::lock_guard<std::mutex> lk(mu_);
   ev.seq = ++seq_;
-  ring_[next_] = std::move(ev);
-  next_ = (next_ + 1) % capacity_;
+  // Spans and transitions retain separately: heartbeat-span volume at
+  // O(dozens) replicas must not evict the (rare) membership history.
+  if (is_span) {
+    ++span_count_;
+    ring_[next_] = std::move(ev);
+    next_ = (next_ + 1) % capacity_;
+  } else {
+    ++trans_count_;
+    trans_ring_[trans_next_] = std::move(ev);
+    trans_next_ = (trans_next_ + 1) % trans_capacity_;
+  }
 }
 
 void FlightRecorder::RecordEvent(const char* kind, std::string detail,
@@ -115,20 +127,40 @@ int64_t FlightRecorder::recorded() const {
 std::string FlightRecorder::Json(size_t limit) const {
   std::ostringstream o;
   std::lock_guard<std::mutex> lk(mu_);
-  size_t retained = seq_ < static_cast<int64_t>(capacity_)
-                        ? static_cast<size_t>(seq_)
+  size_t span_ret = span_count_ < static_cast<int64_t>(capacity_)
+                        ? static_cast<size_t>(span_count_)
                         : capacity_;
+  size_t trans_ret = trans_count_ < static_cast<int64_t>(trans_capacity_)
+                         ? static_cast<size_t>(trans_count_)
+                         : trans_capacity_;
+  size_t retained = span_ret + trans_ret;
   size_t emit = (limit == 0 || limit > retained) ? retained : limit;
   o << "{\"server\":\"" << JsonEscape(server_) << "\",\"id\":\""
-    << JsonEscape(id_) << "\",\"capacity\":" << capacity_
+    << JsonEscape(id_) << "\",\"capacity\":" << (capacity_ + trans_capacity_)
     << ",\"recorded\":" << seq_
     << ",\"dropped\":" << (seq_ - static_cast<int64_t>(retained))
     << ",\"dumped_ts_ms\":" << EpochMsNow() << ",\"events\":[";
-  // Newest first: walk backwards from the slot before next_.
-  for (size_t i = 0; i < emit; ++i) {
-    size_t slot = (next_ + capacity_ - 1 - i) % capacity_;
-    if (i) o << ",";
-    AppendEventJson(o, ring_[slot]);
+  // Newest first, merged across the two rings by seq: walk each ring
+  // backwards from its newest slot and emit the larger seq at each step.
+  size_t i = 0, j = 0, written = 0;
+  while (written < emit && (i < span_ret || j < trans_ret)) {
+    const FlightEvent* span =
+        i < span_ret ? &ring_[(next_ + capacity_ - 1 - i) % capacity_] : nullptr;
+    const FlightEvent* trans =
+        j < trans_ret
+            ? &trans_ring_[(trans_next_ + trans_capacity_ - 1 - j) % trans_capacity_]
+            : nullptr;
+    const FlightEvent* pick;
+    if (span && (!trans || span->seq > trans->seq)) {
+      pick = span;
+      ++i;
+    } else {
+      pick = trans;
+      ++j;
+    }
+    if (written) o << ",";
+    AppendEventJson(o, *pick);
+    ++written;
   }
   o << "]}";
   return o.str();

@@ -56,10 +56,21 @@ struct FlightEvent {
                         // parses these back into dicts)
 };
 
-// Bounded, process-lifetime event ring.  Thread-safe.
+// Bounded, process-lifetime event recorder.  Thread-safe.
+//
+// TWO rings, not one: RPC spans (kind "rpc") and state transitions
+// (everything else) are retained separately.  At O(dozens) of replicas the
+// heartbeat span volume alone is hundreds of events per second — a single
+// shared ring overwrote every quorum transition within seconds of it
+// happening, which destroyed exactly the membership history a
+// preemption-wave post-mortem reconstructs (found by the scale sweep's
+// 32-group wave cell).  Transitions are rare (membership changes, role
+// changes, sentinel moves), so a small dedicated ring holds the full story
+// of a long run regardless of RPC traffic.
 class FlightRecorder {
  public:
-  explicit FlightRecorder(size_t capacity = 2048);
+  explicit FlightRecorder(size_t capacity = 2048,
+                          size_t transition_capacity = 512);
 
   // Identity stamped into Json()/dumps ("lighthouse" / "manager") plus a
   // stable instance id (port / replica id).  Set once at server Start.
@@ -74,8 +85,9 @@ class FlightRecorder {
                  int64_t dur_us, std::string trace_id);
 
   // JSON document: {"server","id","capacity","recorded","dropped",
-  // "dumped_ts_ms","events":[...]} with events NEWEST-FIRST, at most
-  // `limit` of them (0 = all retained).
+  // "dumped_ts_ms","events":[...]} with events NEWEST-FIRST (spans and
+  // transitions merged by seq), at most `limit` of them (0 = all
+  // retained).  "capacity" is the combined ring capacity.
   std::string Json(size_t limit = 0) const;
 
   // Writes Json() to `path` atomically (tmp + rename).  Best-effort:
@@ -91,10 +103,15 @@ class FlightRecorder {
 
  private:
   mutable std::mutex mu_;
-  std::vector<FlightEvent> ring_;
+  std::vector<FlightEvent> ring_;        // RPC spans
+  std::vector<FlightEvent> trans_ring_;  // state transitions
   size_t capacity_;
-  size_t next_ = 0;       // next write slot
-  int64_t seq_ = 0;       // total recorded (dropped = seq_ - min(seq_, cap))
+  size_t trans_capacity_;
+  size_t next_ = 0;        // next span write slot
+  size_t trans_next_ = 0;  // next transition write slot
+  int64_t seq_ = 0;        // total recorded across both rings
+  int64_t span_count_ = 0;
+  int64_t trans_count_ = 0;
   std::string server_ = "server";
   std::string id_;
 };

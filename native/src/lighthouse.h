@@ -243,6 +243,10 @@ class Lighthouse {
   // per-replica step + step lag + heartbeat age, draining/tombstoned
   // counts, heal-in-progress and pending-join gauges (docs/wire.md).
   std::string MetricsText();
+  // Housekeeping sweep (freshness-transition logs + graveyard prunes),
+  // factored out of TickLocked so it can run on a bounded cadence instead
+  // of once per quorum join.  Caller holds mu_.
+  void SweepLocked(TimePoint tick_now, std::chrono::milliseconds hb_timeout);
 
   LighthouseOpt opt_;
   std::unique_ptr<RpcServer> server_;
@@ -265,6 +269,13 @@ class Lighthouse {
   // Replicas observed heartbeat-fresh on the previous tick, for logging
   // healthy<->stale transitions (failure-detection visibility).
   std::map<std::string, bool> last_fresh_;
+  // Last housekeeping sweep (freshness-transition logs + graveyard prunes)
+  // in TickLocked.  The sweep walks every per-replica map, and TickLocked
+  // runs once per quorum JOIN on top of the timer tick — a rejoin wave of
+  // N replicas (mass preemption) used to pay O(N) map scans N times per
+  // round.  Throttled to a bounded cadence; quorum math still runs on
+  // every call.
+  TimePoint last_sweep_{};
   // Live per-replica training status carried on heartbeats (step/state
   // fields, wire method 2): feeds /metrics and /status.json.  Pruned with
   // the heartbeat graveyard so replica-id churn cannot grow them.

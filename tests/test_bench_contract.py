@@ -103,6 +103,54 @@ def test_ha_quick_smoke() -> None:
     assert payload["ok"], payload
 
 
+def test_scale_quick_smoke() -> None:
+    """bench_scale --quick in-process: the O(100)-group scale harness's
+    tier-1 gate.  A 4-rank topology-parity check (ring2d active, results
+    within tolerance of the flat ring, replica-consistent bitwise, int
+    payloads uncompressed) plus a 4-group control cell under a pinned
+    ring2d topology with a 2-victim correlated preemption wave: the
+    surviving half reforms a quorum and keeps committing (the post-wave
+    2-group world crosses the auto crossover back to the flat ring), the
+    lighthouse flight-recorder dump reconstructs the wave's quorum
+    transitions, and the cell leaks zero fds — so the full SCALE_BENCH
+    sweep can stay marked slow without losing CI coverage."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_scale
+    finally:
+        sys.path.pop(0)
+    payload = bench_scale.run_quick()
+    # Schema contract: the keys the full SCALE_BENCH.json artifact is
+    # built from (bench.py --scenario scale writes the same cell dicts).
+    for key in ("metric", "quick", "parity", "cells", "dataplane",
+                "fd_leaked_total", "ok"):
+        assert key in payload, f"SCALE_BENCH schema missing {key}"
+    assert payload["quick"] is True
+    parity = payload["parity"]
+    for key in ("ring2d_active", "int_bypass_ok", "replica_consistent",
+                "topologies_close", "ok"):
+        assert parity[key] is True, (key, parity)
+    (cell,) = payload["cells"]
+    for key in ("groups", "wave", "min_replicas", "warmed_groups",
+                "worker_summaries", "survivor_failed_commits",
+                "per_group_commits", "quorum_reformed", "wave_reconstructed",
+                "quorum_formation", "heartbeat_fanin", "scrape", "rpc",
+                "flight_dump_found", "fd_leaked", "ok"):
+        assert key in cell, f"scale cell schema missing {key}"
+    assert cell["warmed_groups"] == cell["groups"] == 4
+    assert cell["quorum_reformed"], cell
+    assert cell["wave_reconstructed"], cell
+    assert cell["flight_dump_found"]
+    # Zero leaked sockets/fds across the whole cell (driver-side).
+    assert cell["fd_leaked"] == 0, cell
+    assert payload["fd_leaked_total"] == 0
+    # The PR 7 histograms carried real observations.
+    assert cell["quorum_formation"]["count"] > 0
+    assert cell["heartbeat_fanin"]["count"] > 0
+    assert cell["rpc"]["Quorum"]["count"] > 0
+    assert payload["ok"], payload
+
+
 def test_allreduce_quick_smoke() -> None:
     """bench_allreduce --quick in-process: the striped multi-lane ring (1
     vs 2 lanes) and the pipelined-vs-monolithic bucket paths must complete

@@ -584,3 +584,284 @@ def test_lanes_abort_latches_and_reconfigure_rebuilds(store) -> None:
     with ThreadPoolExecutor(max_workers=2) as pool:
         for f in [pool.submit(recover, r) for r in range(2)]:
             np.testing.assert_allclose(f.result(timeout=60), np.full(4, 3.0))
+
+
+# -- topology-aware hierarchical allreduce (TPUFT_RING_TOPOLOGY) -------------
+
+
+def _run_topology(store, world_size: int, topology: str, fn, lanes: int = 1,
+                  wire_dtype: str = "f32", chunk_bytes: int = 4 << 20):
+    """run_ranks with an explicit topology (and lane count / wire dtype)."""
+    prefix = fresh_prefix()
+    collectives = [
+        TCPCollective(timeout=15.0, lanes=lanes, wire_dtype=wire_dtype,
+                      chunk_bytes=chunk_bytes, topology=topology)
+        for _ in range(world_size)
+    ]
+
+    def worker(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, world_size)
+        try:
+            return fn(c, rank)
+        finally:
+            c.shutdown()
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        return [f.result(timeout=90) for f in
+                [pool.submit(worker, r) for r in range(world_size)]]
+
+
+def test_grid_shape_factoring() -> None:
+    """The 2D grid is the squarest EXACT factoring (rows the largest
+    divisor <= sqrt(N)); primes land on (1, N), which degrades to the flat
+    ring — the 'remainder' worlds are handled by grid choice, not padding."""
+    from torchft_tpu.collectives import _grid_shape
+
+    assert _grid_shape(4) == (2, 2)
+    assert _grid_shape(6) == (2, 3)   # non-square
+    assert _grid_shape(8) == (2, 4)
+    assert _grid_shape(9) == (3, 3)
+    assert _grid_shape(12) == (3, 4)
+    assert _grid_shape(16) == (4, 4)
+    assert _grid_shape(32) == (4, 8)
+    for prime in (2, 3, 5, 7, 11):
+        rows, cols = _grid_shape(prime)
+        assert rows == 1 and cols == prime
+
+
+@pytest.mark.parametrize("world_size", [4, 6, 9])
+@pytest.mark.parametrize("lanes", [1, 2])
+def test_ring2d_matches_flat_ring_f32(store, world_size, lanes) -> None:
+    """Hierarchical parity at square (4, 9) and non-square (6) worlds:
+    ring2d results must match the flat ring within f32 reassociation
+    tolerance (row-partial-then-column fold reassociates the sum), be
+    replica-consistent BITWISE across every rank, and carry per-tier byte
+    counters in lane_stats."""
+    rng = np.random.default_rng(17)
+    data = [rng.standard_normal(6000).astype(np.float32)
+            for _ in range(world_size)]
+
+    def body(c, rank):
+        out = c.allreduce([data[rank].copy()], op="sum").wait(timeout=60)[0]
+        return out, c.topology, c.lane_stats()
+
+    flat = _run_topology(store, world_size, "ring", body, lanes=lanes,
+                         chunk_bytes=4 << 10)
+    hier = _run_topology(store, world_size, "ring2d", body, lanes=lanes,
+                         chunk_bytes=4 << 10)
+    expected = np.sum(data, axis=0)
+    for rank in range(world_size):
+        out, topo, stats = hier[rank]
+        assert topo == "ring2d"
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(out, flat[rank][0], rtol=1e-5, atol=1e-5)
+        # Replica consistency (bitwise) within each topology.
+        np.testing.assert_array_equal(out, hier[0][0])
+        np.testing.assert_array_equal(flat[rank][0], flat[0][0])
+        assert stats["topology"] == "ring2d"
+        assert set(stats["tiers"]) == {"row", "col"}
+        for tier in stats["tiers"].values():
+            assert len(tier["sent"]) == lanes and len(tier["recv"]) == lanes
+            assert sum(tier["sent"]) > 0 and sum(tier["recv"]) > 0
+
+
+def test_ring2d_bf16_wire_replica_consistent(store) -> None:
+    """bf16 wire under the 2D topology: per-hop re-quantization moves the
+    result within the documented bf16 envelope of the flat ring, and every
+    rank still decodes BITWISE-identical values — the property the commit
+    protocol actually requires."""
+    world_size = 4
+    rng = np.random.default_rng(23)
+    data = [rng.standard_normal(4096).astype(np.float32)
+            for _ in range(world_size)]
+
+    def body(c, rank):
+        return c.allreduce([data[rank].copy()], op="sum").wait(timeout=60)[0]
+
+    flat = _run_topology(store, world_size, "ring", body, lanes=2,
+                         wire_dtype="bf16", chunk_bytes=4 << 10)
+    hier = _run_topology(store, world_size, "ring2d", body, lanes=2,
+                         wire_dtype="bf16", chunk_bytes=4 << 10)
+    expected = np.sum(data, axis=0)
+    for rank in range(world_size):
+        np.testing.assert_array_equal(hier[rank], hier[0])
+        np.testing.assert_allclose(hier[rank], expected, rtol=0.02,
+                                   atol=0.02 * world_size)
+        np.testing.assert_allclose(hier[rank], flat[rank], rtol=0.02,
+                                   atol=0.02 * world_size)
+
+
+def test_ring2d_device_prepped_bf16_payload(store) -> None:
+    """Device-wire-prep composition: a payload that arrives ALREADY in the
+    bf16 wire dtype (the GradientAverager's on-device cast) keeps bf16 on
+    the wire through BOTH tiers with f32 accumulation — same quantization
+    points as the flat ring — and stays replica-consistent bitwise."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    world_size = 4
+    rng = np.random.default_rng(31)
+    data = [rng.standard_normal(2048).astype(np.float32).astype(bf16)
+            for _ in range(world_size)]
+
+    def body(c, rank):
+        return c.allreduce([data[rank].copy()], op="sum").wait(timeout=60)[0]
+
+    results = _run_topology(store, world_size, "ring2d", body, lanes=2,
+                            wire_dtype="bf16", chunk_bytes=2 << 10)
+    expected = np.sum([np.asarray(d, np.float32) for d in data], axis=0)
+    for out in results:
+        assert out.dtype == bf16, out.dtype
+        np.testing.assert_allclose(np.asarray(out, np.float32), expected,
+                                   rtol=0.02, atol=0.02 * world_size)
+        np.testing.assert_array_equal(out.view(np.uint16),
+                                      results[0].view(np.uint16))
+
+
+def test_ring2d_integer_payload_bypasses_compression(store) -> None:
+    """Int payloads bypass the bf16 wire on the hierarchical topology too
+    (quantizing them would corrupt values): the sum is exact, int64 on
+    every rank, and BOTH tiers moved full-width bytes."""
+    world_size = 6
+    n = 4096
+    payload = np.arange(n, dtype=np.int64)
+
+    def body(c, rank):
+        out = c.allreduce([payload * (rank + 1)], op="sum").wait(timeout=60)[0]
+        return out, c.lane_stats()
+
+    results = _run_topology(store, world_size, "ring2d", body, lanes=2,
+                            wire_dtype="bf16", chunk_bytes=4 << 10)
+    total = sum(range(1, world_size + 1))
+    for out, stats in results:
+        np.testing.assert_array_equal(out, payload * total)
+        assert out.dtype == np.int64
+        # Row tier circulates ~2*(C-1)/C of the payload at FULL width; a
+        # bf16 wire would halve this.
+        row = stats["tiers"]["row"]
+        cols = row["size"]
+        assert sum(row["sent"]) >= payload.nbytes * (cols - 1) // cols, stats
+        assert sum(stats["tiers"]["col"]["sent"]) > 0
+
+
+def test_ring2d_prime_world_degrades_to_flat_ring(store) -> None:
+    """A prime world has no 2D factoring: an explicit topology='ring2d'
+    request degrades to the flat ring (and still reduces correctly) rather
+    than failing or padding."""
+
+    def body(c, rank):
+        out = c.allreduce([np.full(64, float(rank + 1), dtype=np.float32)],
+                          op="sum").wait(timeout=30)[0]
+        return out, c.topology
+
+    for out, topo in _run_topology(store, 5, "ring2d", body):
+        assert topo == "ring"
+        np.testing.assert_allclose(out, np.full(64, 15.0))
+
+
+def test_auto_topology_crossover(store) -> None:
+    """topology='auto' keeps the flat ring below TPUFT_RING2D_MIN_GROUPS
+    and flips to ring2d at the crossover."""
+
+    def body(c, rank):
+        c.allreduce([np.ones(32, dtype=np.float32)]).wait(timeout=30)
+        return c.topology
+
+    assert set(_run_topology(store, 4, "auto", body)) == {"ring"}
+    assert set(_run_topology(store, 8, "auto", body)) == {"ring2d"}
+
+
+def test_tag_space_tier_partition_static_audit() -> None:
+    """Static audit of the per-op tag space: every subtag the module uses
+    fits one stripe's block, the tiers partition that block (flat/row in
+    the low half, nested column tier in the high half), and the largest
+    stripe's tags stay inside the op's _TAGS_PER_OP window — nested-ring
+    tags can never spill into the next op's block."""
+    import re
+
+    from torchft_tpu import collectives as C
+
+    subs = (C._SUB_RS, C._SUB_AG, C._SUB_GATHER, C._SUB_COL_RS, C._SUB_COL_AG)
+    assert len(set(subs)) == len(subs)
+    assert max(subs) < C._TAGS_PER_STRIPE
+    # Tier partition: row/flat subtags strictly below the column tier's.
+    assert max(C._SUB_RS, C._SUB_AG, C._SUB_GATHER) < min(C._SUB_COL_RS,
+                                                          C._SUB_COL_AG)
+    assert C._TAGS_PER_OP == C._TAGS_PER_STRIPE * (C._MAX_STRIPES + 1)
+    # Worst-case stripe: the cap itself (stripe indices < _MAX_STRIPES).
+    worst = (C._MAX_STRIPES - 1) * C._TAGS_PER_STRIPE + max(subs)
+    assert worst < C._TAGS_PER_OP
+    # No literal tag offsets escaped the named constants: every arithmetic
+    # "+ <int>" on a tag_base in the source must be one of the registered
+    # subtags.
+    import inspect
+
+    src = inspect.getsource(C)
+    literal_offsets = {
+        int(m) for m in re.findall(r"tag_base\s*\+\s*(\d+)", src)
+    }
+    assert literal_offsets <= set(subs), literal_offsets
+
+
+def test_ring2d_abort_latches_and_reconfigure_crosses_crossover(store) -> None:
+    """Satellite 4's regression: kill a peer mid-HIERARCHICAL-op.  The
+    survivors latch the error (never raise), every socket of BOTH tiers
+    closes, and the next configure() at the shrunken group count rebuilds
+    the topology — here crossing the ring2d->ring crossover (3 ranks is
+    prime), the exact reconfigure a preemption wave forces."""
+    world_size = 4
+    lanes = 2
+    prefix, prefix2 = fresh_prefix(), fresh_prefix()
+    collectives = [
+        TCPCollective(timeout=5.0, lanes=lanes, topology="ring2d",
+                      chunk_bytes=4 << 10)
+        for _ in range(world_size)
+    ]
+    barrier = threading.Barrier(world_size)
+    old_sockets: Dict[int, List] = {}
+
+    def worker(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix}", rank, world_size)
+        assert c.topology == "ring2d"
+        stats = c.lane_stats()
+        assert set(stats["tiers"]) == {"row", "col"}
+        old = list(c._next_lanes) + list(c._prev_lanes)
+        old += c._row_tier.peers() + c._col_tier.peers()
+        old_sockets[rank] = old
+        x = np.ones(8192, dtype=np.float32)
+        c.allreduce([x]).wait(timeout=20)
+        barrier.wait(timeout=10)
+        if rank == world_size - 1:
+            c.abort()
+            return "dead"
+        work = c.allreduce([x])
+        exc = work.exception(timeout=20)
+        assert exc is not None, "expected failure after peer abort"
+        assert c.errored() is not None
+        return "latched"
+
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        results = [f.result(timeout=90) for f in
+                   [pool.submit(worker, r) for r in range(world_size)]]
+    assert results.count("latched") == world_size - 1
+
+    def recover(rank: int):
+        c = collectives[rank]
+        c.configure(f"{store.address()}/{prefix2}", rank, 3)
+        assert c.errored() is None
+        # 3 is prime: the rebuilt topology crossed back to the flat ring.
+        assert c.topology == "ring"
+        assert c._row_tier is None and c._col_tier is None
+        # Every pre-abort socket — flat AND both tiers — is closed.
+        assert all(p.sock.fileno() == -1 for p in old_sockets[rank])
+        out = c.allreduce([np.full(4, float(rank + 1), dtype=np.float32)]).wait(
+            timeout=20
+        )
+        c.shutdown()
+        return out[0]
+
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        for f in [pool.submit(recover, r) for r in range(3)]:
+            np.testing.assert_allclose(f.result(timeout=90), np.full(4, 6.0))

@@ -541,3 +541,38 @@ def test_report_splits_quorum_wait_with_flight_data(tmp_path) -> None:
     # Without flight data the split stays zero (informational default).
     plain = obs_report.attribute(events)
     assert plain["totals"]["quorum_server_s"] == 0.0
+
+
+def test_flight_transitions_survive_rpc_span_flood() -> None:
+    """Scale regression: state transitions retain in their OWN bounded ring.
+    At O(dozens) of replicas the heartbeat span volume is hundreds of
+    events per second; with one shared ring it overwrote every
+    quorum/membership transition within seconds — destroying exactly the
+    history a preemption-wave post-mortem reconstructs (found by the
+    32-group wave cell of bench_scale)."""
+    from torchft_tpu._native import LighthouseServer
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100,
+        quorum_tick_ms=20, http_bind="127.0.0.1:0",
+    )
+    try:
+        client = LighthouseClient(lh.address())
+        # One membership transition (join + formation), then a span flood
+        # far past the span ring's 2048 capacity.
+        client.quorum("flood:aa", timeout_ms=5000, step=1)
+        for _ in range(2300):
+            client.heartbeat("flood:aa", step=1)
+        client.close()
+        blob = lh.flight()
+        kinds = [ev["kind"] for ev in blob["events"]]
+        assert kinds.count("rpc") >= 2048  # the span ring is full
+        # The transitions from BEFORE the flood are still there.
+        assert "replica_join" in kinds
+        assert "quorum_formed" in kinds
+        # Merged stream stays newest-first by seq.
+        seqs = [ev["seq"] for ev in blob["events"]]
+        assert seqs == sorted(seqs, reverse=True)
+        assert blob["recorded"] >= 2300
+    finally:
+        lh.shutdown()

@@ -663,3 +663,54 @@ def test_manager_server_set_status_feeds_heartbeats() -> None:
         if manager is not None:
             manager.shutdown()
         lighthouse.shutdown()
+
+
+def test_report_data_plane_rollup_across_topologies() -> None:
+    """attribute()'s data_plane section: payload bytes sum per step (the
+    wire_nbytes-based accounting, comparable across topologies), per-tier
+    wire counters take each incarnation's high-water mark (lane_stats
+    snapshots are cumulative — summing them would double count), and the
+    active topology set is surfaced."""
+    from torchft_tpu.obs import report
+
+    def summary(rid, step, nbytes, lanes):
+        return {
+            "event": "step_summary", "replica_id": rid, "step": step,
+            "ts": 100.0 + step, "committed": True, "phases": {},
+            "allreduce_bytes": nbytes, "allreduce_lanes": lanes,
+        }
+
+    events = [
+        summary("g0:u1", 1, 1000, {
+            "lanes": 2, "topology": "ring2d", "sent": [10, 10],
+            "tiers": {"row": {"size": 2, "sent": [300], "recv": [300]},
+                      "col": {"size": 2, "sent": [100], "recv": [100]}},
+        }),
+        summary("g0:u1", 2, 1000, {
+            "lanes": 2, "topology": "ring2d", "sent": [10, 10],
+            "tiers": {"row": {"size": 2, "sent": [600], "recv": [600]},
+                      "col": {"size": 2, "sent": [200], "recv": [200]}},
+        }),
+        summary("g1:u2", 1, 1000, {
+            "lanes": 2, "topology": "ring", "sent": [500, 500],
+        }),
+        # A reconfigure RESET g1's counters (new quorum membership), then
+        # more traffic: the rollup must bank the pre-reset epoch instead
+        # of dropping it to the post-reset max.
+        summary("g1:u2", 2, 1000, {
+            "lanes": 2, "topology": "ring", "sent": [50, 50],
+        }),
+    ]
+    dp = report.data_plane(events)
+    assert dp["allreduce_payload_bytes"] == 4000
+    assert dp["per_replica_payload_bytes"] == {"g0:u1": 2000, "g1:u2": 2000}
+    # High-water mark within an epoch, not sum: g0's row tier reads 600,
+    # not 900.
+    assert dp["tier_wire_bytes"]["row"] == 600
+    assert dp["tier_wire_bytes"]["col"] == 200
+    # Flat counters: g0's 20 + g1's banked 1000 + post-reset 100.
+    assert dp["tier_wire_bytes"]["flat"] == 1120
+    assert dp["topologies"] == ["ring", "ring2d"]
+    # And the full attribute() payload carries the section.
+    out = report.attribute(events)
+    assert out["data_plane"]["allreduce_payload_bytes"] == 4000

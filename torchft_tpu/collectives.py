@@ -22,6 +22,7 @@ Semantics carried over from the reference:
 from __future__ import annotations
 
 import collections
+import math
 import os
 import socket
 import struct
@@ -447,9 +448,36 @@ class _FifoQueue:
 TPUFT_RING_LANES_ENV = "TPUFT_RING_LANES"
 _MAX_LANES = 8
 # Stripes per ring chunk are capped so tag space and frame overhead stay
-# bounded; tags are carved as seq * _TAGS_PER_OP + stripe * 4 + subtag.
+# bounded; tags are carved as seq * _TAGS_PER_OP + stripe * _TAGS_PER_STRIPE
+# + subtag.  The per-stripe block is PARTITIONED BY TIER: the flat ring (and
+# the 2D topology's row tier, which reuses its subtags on its own sockets)
+# takes the low half, the 2D topology's nested column tier the high half —
+# so a hierarchical op's two nested rings can never collide on a tag even
+# if a future topology multiplexes tiers onto shared sockets.  The static
+# audit in tests/test_collectives.py pins every subtag below
+# _TAGS_PER_STRIPE and every stripe block inside its op's _TAGS_PER_OP.
 _MAX_STRIPES = 64
-_TAGS_PER_OP = 4 * (_MAX_STRIPES + 1)
+_TAGS_PER_STRIPE = 8
+_TAGS_PER_OP = _TAGS_PER_STRIPE * (_MAX_STRIPES + 1)
+# Subtags within one stripe's block.
+_SUB_RS = 1  # reduce-scatter hops (flat ring / row tier)
+_SUB_AG = 2  # allgather hops (flat ring / row tier)
+_SUB_GATHER = 3  # whole-object circulation (allgather/broadcast/alltoall)
+_SUB_COL_RS = 4  # nested column-tier reduce-scatter (ring2d)
+_SUB_COL_AG = 5  # nested column-tier allgather (ring2d)
+
+# Cross-group allreduce topology (docs/architecture.md "Topology-aware
+# allreduce").  "ring" is the flat single ring over all N groups (latency
+# grows as 2(N-1) hops); "ring2d" arranges the N groups on an R x C grid
+# (R = largest divisor <= sqrt(N)) and runs reduce-scatter along the row
+# ring, a full allreduce along the column ring, and allgather back along
+# the row — 2(C-1) + 2(R-1) hops, the latency win that keeps step time flat
+# at O(100) groups.  "auto" picks ring2d once the group count reaches
+# TPUFT_RING2D_MIN_GROUPS (and the count factors into a real grid).
+TPUFT_RING_TOPOLOGY_ENV = "TPUFT_RING_TOPOLOGY"
+TPUFT_RING2D_MIN_ENV = "TPUFT_RING2D_MIN_GROUPS"
+_RING2D_DEFAULT_MIN = 8
+_TOPOLOGIES = ("auto", "ring", "ring2d")
 
 
 def _ring_lanes_from_env() -> int:
@@ -458,6 +486,54 @@ def _ring_lanes_from_env() -> int:
     except ValueError:
         return 2
     return max(1, min(_MAX_LANES, lanes))
+
+
+def _topology_from_env() -> str:
+    topo = os.environ.get(TPUFT_RING_TOPOLOGY_ENV, "auto")
+    return topo if topo in _TOPOLOGIES else "auto"
+
+
+def _ring2d_min_from_env() -> int:
+    try:
+        return max(2, int(os.environ.get(TPUFT_RING2D_MIN_ENV, str(_RING2D_DEFAULT_MIN))))
+    except ValueError:
+        return _RING2D_DEFAULT_MIN
+
+
+def _grid_shape(n: int) -> tuple:
+    """``(rows, cols)`` with ``rows * cols == n`` and ``rows`` the largest
+    divisor <= sqrt(n) — the squarest exact factoring, which minimizes the
+    2D hop count 2(C-1) + 2(R-1).  Every rank derives the identical grid
+    from the world size alone (no negotiation), and non-square N lands on
+    its divisor grid (6 -> 2x3, 8 -> 2x4).  Primes return (1, n): no 2D
+    factoring exists, and the caller degrades to the flat ring."""
+    rows = int(math.isqrt(n))
+    while rows > 1 and n % rows:
+        rows -= 1
+    rows = max(1, rows)
+    return rows, n // rows
+
+
+class _TierLinks:
+    """Connections and metadata for one nested ring tier of the 2D topology.
+
+    A tier is a smaller ring over a subset of the world (a grid row or
+    column): ``size`` members, this rank at position ``ring_rank``, one
+    socket per lane per direction, and its own per-lane sender pools so a
+    shaped row send never heads-of-line-blocks a column send on a different
+    physical link."""
+
+    def __init__(self, size: int, ring_rank: int, next_rank: int, prev_rank: int) -> None:
+        self.size = size
+        self.ring_rank = ring_rank
+        self.next_rank = next_rank  # world rank of the tier's next neighbor
+        self.prev_rank = prev_rank  # world rank of the tier's prev neighbor
+        self.next_lanes: List[_Peer] = []
+        self.prev_lanes: List[_Peer] = []
+        self.send_pools: List[object] = []
+
+    def peers(self) -> List[_Peer]:
+        return list(self.next_lanes) + list(self.prev_lanes)
 
 
 class TCPCollective(Collective):
@@ -480,6 +556,24 @@ class TCPCollective(Collective):
     of ring ops must still be identical on every rank (program order), but
     alignment within that order is carried by tags, not timing.
 
+    Topology: ``topology="auto"`` (``TPUFT_RING_TOPOLOGY``) selects between
+    the flat ring and a 2D ring-of-rings per configure().  The flat ring's
+    latency term is 2(N-1) sequential hops; at O(dozens) of groups on a
+    real (high-RTT) DCN link that term IS the step-time floor.  "ring2d"
+    arranges the groups on an R x C grid and runs: reduce-scatter along the
+    ROW ring (C-1 hops), a full allreduce of the owned row chunk along the
+    COLUMN ring (2(R-1) hops), allgather back along the row (C-1 hops) —
+    ~4*sqrt(N) hops total.  Fold order is deterministic per topology (row
+    partials then column fold, each in fixed ring-step order), so results
+    remain BITWISE-identical across every rank — the replica-consistency
+    property the commit protocol depends on — though hierarchical f32/bf16
+    results differ from the flat ring's within reassociation/requantization
+    rounding.  "auto" keeps the flat ring below TPUFT_RING2D_MIN_GROUPS
+    (default 8) and whenever N has no non-trivial divisor (primes).
+    allgather/broadcast/alltoall/barrier always use the flat ring (control
+    traffic, not the gradient hot path); both tiers' sockets are torn down
+    together by abort()/configure().
+
     Reconfiguration: rendezvous through the group store under a caller-chosen
     prefix; every rank publishes "host:port", rank i dials rank (i+1)%n once
     per lane.  abort() closes the sockets, causing in-flight ops to fail
@@ -495,6 +589,7 @@ class TCPCollective(Collective):
         chunk_bytes: int = 4 << 20,
         wire_dtype: str = "auto",
         lanes: Optional[int] = None,
+        topology: Optional[str] = None,
     ) -> None:
         """``wire_dtype="bf16"`` halves allreduce bytes on the wire (DCN is
         the cross-slice bottleneck): ring payloads are cast to bfloat16 per
@@ -526,11 +621,21 @@ class TCPCollective(Collective):
             raise ValueError(
                 f"unsupported wire_dtype {wire_dtype!r}; expected 'f32' or 'auto' or 'bf16'"
             )
+        topology = topology if topology is not None else _topology_from_env()
+        if topology not in _TOPOLOGIES:
+            raise ValueError(
+                f"unsupported topology {topology!r}; expected one of {_TOPOLOGIES}"
+            )
         self._timeout = timeout
         self._chunk_bytes = chunk_bytes
         self._wire_dtype = wire_dtype
         self._lanes = lanes if lanes is not None else _ring_lanes_from_env()
         self._lanes = max(1, min(_MAX_LANES, self._lanes))
+        self._topology = topology  # requested; resolved per configure()
+        self._ring2d_min = _ring2d_min_from_env()
+        self._active_topology = "ring"
+        self._row_tier: Optional[_TierLinks] = None
+        self._col_tier: Optional[_TierLinks] = None
         self._lock = threading.Lock()
         self._executor: Optional[object] = None
         self._ring_executor: Optional[object] = None
@@ -578,6 +683,20 @@ class TCPCollective(Collective):
     def _prev(self) -> Optional[_Peer]:
         return self._prev_lanes[0] if self._prev_lanes else None
 
+    def _resolve_topology(self, world_size: int) -> str:
+        """The topology this configuration actually runs.  ring2d needs a
+        non-trivial grid (primes cannot factor: the "remainder" worlds);
+        auto additionally keeps the flat ring below the crossover group
+        count, where 2(N-1) hops still beats paying two tiers' framing."""
+        if self._topology == "ring" or world_size < 4:
+            return "ring"
+        rows, _cols = _grid_shape(world_size)
+        if rows < 2:
+            return "ring"
+        if self._topology == "ring2d":
+            return "ring2d"
+        return "ring2d" if world_size >= self._ring2d_min else "ring"
+
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         self.abort()
         with self._lock:
@@ -586,6 +705,7 @@ class TCPCollective(Collective):
             self._rank = rank
             self._world_size = world_size
             self._generation += 1
+            self._active_topology = self._resolve_topology(world_size)
             with self._op_seq_lock:
                 self._op_seq = 0
             # Abort may have cancelled queued p2p ops that will never call
@@ -611,6 +731,17 @@ class TCPCollective(Collective):
                 ThreadPoolExecutor(max_workers=1, thread_name_prefix=f"tpuft_send{ln}")
                 for ln in range(self._lanes)
             ]
+            for name, tier in (("row", self._row_tier), ("col", self._col_tier)):
+                if tier is not None:
+                    # Each tier direction gets its own single-worker-per-lane
+                    # sender pool: a shaped row frame must not head-of-line
+                    # block a column frame headed down a different link.
+                    tier.send_pools = [
+                        ThreadPoolExecutor(
+                            max_workers=1, thread_name_prefix=f"tpuft_{name}{ln}"
+                        )
+                        for ln in range(self._lanes)
+                    ]
             if self._lanes > 1:
                 # Depth-2 per lane: a stripe's worker stays occupied through
                 # its link-serialization wait (real or shaped), so with only
@@ -627,13 +758,18 @@ class TCPCollective(Collective):
             )
 
     # Channel ids in the 12-byte connection preamble (rank, channel, lane).
+    # _CH_ROW/_CH_COL are the 2D topology's tier rings — distinct channels
+    # (not just distinct tags) so the accept side can route each socket to
+    # its tier's lane table and shaper.
     _CH_RING = 0
     _CH_P2P = 1
+    _CH_ROW = 2
+    _CH_COL = 3
     _PREAMBLE = struct.Struct("<III")
 
     def _rendezvous(self) -> None:
         listener = socket.create_server(("", 0), family=socket.AF_INET6, dualstack_ipv6=True)
-        listener.listen(16 + 2 * self._lanes)
+        listener.listen(16 + 6 * self._lanes)
         self._listener = listener
         port = listener.getsockname()[1]
         host = socket.gethostname()
@@ -647,15 +783,45 @@ class TCPCollective(Collective):
         gen = self._generation
         # One serialization budget per peer DIRECTION, shared by every lane
         # of that direction: shaped benches cannot widen the modeled link by
-        # adding lanes, and the direction's byte counters stay whole.
+        # adding lanes, and the direction's byte counters stay whole.  Each
+        # 2D tier direction is a DIFFERENT physical peer link, so it gets
+        # its own budget (matching per-neighbor DCN provisioning).
         next_shaper = LinkShaper.from_env()
         prev_shaper = LinkShaper.from_env()
 
+        # 2D grid tiers: rank (r, c) on an R x C grid rendezvouses a row
+        # ring (same r, all c) and a column ring (same c, all r) alongside
+        # the flat ring.  Grid geometry derives from (world_size, rank)
+        # alone, identically on every rank.
+        self._row_tier = None
+        self._col_tier = None
+        tier_specs: List[tuple] = []  # (channel, tier, prev_shaper)
+        if self._active_topology == "ring2d":
+            rows, cols = _grid_shape(n)
+            r, c = divmod(rank, cols)
+            self._row_tier = _TierLinks(
+                size=cols,
+                ring_rank=c,
+                next_rank=r * cols + (c + 1) % cols,
+                prev_rank=r * cols + (c - 1) % cols,
+            )
+            self._col_tier = _TierLinks(
+                size=rows,
+                ring_rank=r,
+                next_rank=((r + 1) % rows) * cols + c,
+                prev_rank=((r - 1) % rows) * cols + c,
+            )
+            tier_specs = [
+                (self._CH_ROW, self._row_tier, LinkShaper.from_env()),
+                (self._CH_COL, self._col_tier, LinkShaper.from_env()),
+            ]
+        tier_prev_shapers = {ch: sh for ch, _t, sh in tier_specs}
+
         # Persistent accept loop: registers the per-lane ring links from
-        # prev and any lazily-dialed point-to-point links (used by
-        # checkpoint transports to move weights between arbitrary replica
-        # pairs, the reference's pg.send/recv path,
-        # torchft/checkpointing/pg_transport.py:197-301).
+        # prev (flat and tier rings, keyed by channel) and any lazily-dialed
+        # point-to-point links (used by checkpoint transports to move
+        # weights between arbitrary replica pairs, the reference's
+        # pg.send/recv path, torchft/checkpointing/pg_transport.py:197-301).
         def accept_loop() -> None:
             while True:
                 try:
@@ -676,11 +842,14 @@ class TCPCollective(Collective):
                         if self._generation != gen:
                             conn.close()
                             return
-                        if channel == self._CH_RING:
-                            peer.shaper = prev_shaper
-                            self._accepted_ring[(their_rank, lane)] = peer
-                        else:
+                        if channel == self._CH_P2P:
                             self._peers[their_rank] = peer
+                        else:
+                            if channel == self._CH_RING:
+                                peer.shaper = prev_shaper
+                            else:
+                                peer.shaper = tier_prev_shapers.get(channel)
+                            self._accepted_ring[(their_rank, channel, lane)] = peer
                         self._accept_cond.notify_all()
                 except Exception:  # noqa: BLE001
                     conn.close()
@@ -689,32 +858,43 @@ class TCPCollective(Collective):
         self._accept_thread = threading.Thread(target=accept_loop, daemon=True)
         self._accept_thread.start()
 
-        # Dial our next ring neighbor, one connection per lane.
+        # Dial our next neighbors, one connection per lane per ring.
         self._next_lanes = [
             self._dial_rank(next_rank, self._CH_RING, lane=lane, shaper=next_shaper)
             for lane in range(lanes)
         ]
+        for channel, tier, _sh in tier_specs:
+            tier_next_shaper = LinkShaper.from_env()
+            tier.next_lanes = [
+                self._dial_rank(tier.next_rank, channel, lane=lane, shaper=tier_next_shaper)
+                for lane in range(lanes)
+            ]
 
-        # Wait for all of prev's ring lanes.
+        # Wait for every prev-direction lane: the flat ring's, plus each
+        # active tier's.
+        expected = [(prev_rank, self._CH_RING, lane) for lane in range(lanes)]
+        for channel, tier, _sh in tier_specs:
+            expected += [(tier.prev_rank, channel, lane) for lane in range(lanes)]
         deadline = self.RENDEZVOUS_TIMEOUT_MS / 1000
         with self._accept_cond:
             ok = self._accept_cond.wait_for(
-                lambda: all(
-                    (prev_rank, lane) in self._accepted_ring for lane in range(lanes)
-                ),
+                lambda: all(key in self._accepted_ring for key in expected),
                 timeout=deadline,
             )
             if not ok:
-                missing = [
-                    lane for lane in range(lanes)
-                    if (prev_rank, lane) not in self._accepted_ring
-                ]
+                missing = [key for key in expected if key not in self._accepted_ring]
                 raise TimeoutError(
-                    f"rendezvous: rank {prev_rank} never connected lanes {missing}"
+                    f"rendezvous: ring peers never connected: {missing}"
                 )
             self._prev_lanes = [
-                self._accepted_ring.pop((prev_rank, lane)) for lane in range(lanes)
+                self._accepted_ring.pop((prev_rank, self._CH_RING, lane))
+                for lane in range(lanes)
             ]
+            for channel, tier, _sh in tier_specs:
+                tier.prev_lanes = [
+                    self._accepted_ring.pop((tier.prev_rank, channel, lane))
+                    for lane in range(lanes)
+                ]
 
     def _dial_rank(
         self,
@@ -816,7 +996,9 @@ class TCPCollective(Collective):
                 self._generation += 1
                 self._dialing = set()
                 self._accept_cond.notify_all()
-            for peer in self._next_lanes + self._prev_lanes + peers:
+            tiers = [t for t in (self._row_tier, self._col_tier) if t is not None]
+            tier_peers = [p for t in tiers for p in t.peers()]
+            for peer in self._next_lanes + self._prev_lanes + tier_peers + peers:
                 if peer is not None:
                     peer.close()
             if self._listener is not None:
@@ -836,6 +1018,14 @@ class TCPCollective(Collective):
             for pool in self._send_pools:
                 pool.shutdown(wait=False, cancel_futures=True)
             self._send_pools = []
+            for tier in tiers:
+                for pool in tier.send_pools:
+                    pool.shutdown(wait=False, cancel_futures=True)
+                tier.send_pools = []
+                tier.next_lanes = []
+                tier.prev_lanes = []
+            self._row_tier = None
+            self._col_tier = None
             if self._store is not None:
                 self._store.close()
                 self._store = None
@@ -898,19 +1088,42 @@ class TCPCollective(Collective):
         return seq
 
     def _tag_base(self, seq: int, stripe: int = 0) -> int:
-        return (seq * _TAGS_PER_OP + stripe * 4) & 0x7FFFFFFF
+        return (seq * _TAGS_PER_OP + stripe * _TAGS_PER_STRIPE) & 0x7FFFFFFF
+
+    @property
+    def topology(self) -> str:
+        """The topology the CURRENT configuration resolved to ("ring" or
+        "ring2d") — "auto" and degenerate worlds (primes, N < crossover)
+        report what actually runs."""
+        return self._active_topology
 
     def lane_stats(self) -> dict:
         """Per-lane wire-byte counters for the current configuration:
-        ``{"lanes": L, "sent": [bytes per next-lane], "recv": [bytes per
-        prev-lane]}``.  Cumulative since the last configure(); feeds the
-        Manager's allreduce GB/s telemetry and the bench artifacts."""
+        ``{"lanes": L, "topology": ..., "sent": [bytes per next-lane],
+        "recv": [bytes per prev-lane]}``, plus a ``"tiers"`` map with the
+        same sent/recv counters per 2D tier ("row"/"col", with each tier's
+        ring size) when the hierarchical topology is active — the per-tier
+        attribution that keeps step_summary's byte accounting comparable
+        across topologies.  Cumulative since the last configure(); feeds
+        the Manager's allreduce GB/s telemetry and the bench artifacts."""
         nexts, prevs = list(self._next_lanes), list(self._prev_lanes)
-        return {
+        out = {
             "lanes": self._lanes,
+            "topology": self._active_topology,
             "sent": [p.bytes_out for p in nexts],
             "recv": [p.bytes_in for p in prevs],
         }
+        tiers = {}
+        for name, tier in (("row", self._row_tier), ("col", self._col_tier)):
+            if tier is not None:
+                tiers[name] = {
+                    "size": tier.size,
+                    "sent": [p.bytes_out for p in list(tier.next_lanes)],
+                    "recv": [p.bytes_in for p in list(tier.prev_lanes)],
+                }
+        if tiers:
+            out["tiers"] = tiers
+        return out
 
     def allreduce(
         self,
@@ -926,24 +1139,39 @@ class TCPCollective(Collective):
         if self._world_size == 1:
             return Work(completed_future(list(arrays)))
         seq = self._next_seq()
+        if self._active_topology == "ring2d":
+            if self._lanes > 1:
+                return self._striped_hier_allreduce(
+                    arrays, op, allow_wire_compression, seq
+                )
+            return self._submit(
+                lambda: self._hier_allreduce(arrays, op, allow_wire_compression, seq)
+            )
         if self._lanes > 1:
             return self._striped_allreduce(arrays, op, allow_wire_compression, seq)
         return self._submit(
             lambda: self._ring_allreduce(arrays, op, allow_wire_compression, seq)
         )
 
-    def _exchange(self, tag: int, payload, lane: int = 0) -> bytes:
+    def _exchange(self, tag: int, payload, lane: int = 0,
+                  tier: Optional[_TierLinks] = None) -> bytes:
         """Sends to the next neighbor while receiving from the previous one,
-        on the given lane's socket pair.  Full-duplex is required: with
+        on the given lane's socket pair (of the flat ring, or of ``tier``
+        when a 2D tier ring is passed).  Full-duplex is required: with
         payloads larger than the kernel socket buffers, blocking
         send-then-recv deadlocks the ring.  The send runs on the lane's
         persistent sender worker — a striped allreduce makes hundreds of
         hops per op, and a fresh thread per hop is pure scheduler churn.
         One worker per lane serializes sends exactly like the peer's
         send_lock already does, so ordering is unchanged."""
-        nxt = self._next_lanes[lane]
-        prv = self._prev_lanes[lane]
-        pools = self._send_pools
+        if tier is not None:
+            nxt = tier.next_lanes[lane]
+            prv = tier.prev_lanes[lane]
+            pools = tier.send_pools
+        else:
+            nxt = self._next_lanes[lane]
+            prv = self._prev_lanes[lane]
+            pools = self._send_pools
         if not pools:
             raise RuntimeError("collective aborted")
         if isinstance(payload, (bytes, bytearray)):
@@ -1008,6 +1236,25 @@ class TCPCollective(Collective):
                 return np.dtype(ml_dtypes.bfloat16), np.dtype(np.float32)
         return None, np.dtype(flat_dtype)
 
+    def _codec(self, wire, acc_dtype):
+        """(encode, decode) for one ring pass: encode casts to the wire
+        dtype and frames raw bytes (as_u8, not memoryview.cast, so
+        ml_dtypes payloads like bfloat16 frame correctly); decode upcasts
+        back to the accumulation dtype."""
+        from torchft_tpu.checkpointing.serialization import as_u8
+
+        def encode(chunk: np.ndarray) -> memoryview:
+            if wire is not None:
+                chunk = chunk.astype(wire)
+            return memoryview(as_u8(chunk))
+
+        def decode(raw: bytes) -> np.ndarray:
+            if wire is not None:
+                return np.frombuffer(raw, dtype=wire).astype(acc_dtype)
+            return np.frombuffer(raw, dtype=acc_dtype)
+
+        return encode, decode
+
     def _ring_rs_ag(
         self,
         chunks: List[np.ndarray],
@@ -1016,12 +1263,17 @@ class TCPCollective(Collective):
         acc_dtype,
         lane: int,
         tag_base: int,
+        tier: Optional[_TierLinks] = None,
+        rs_sub: int = _SUB_RS,
+        ag_sub: int = _SUB_AG,
     ) -> List[np.ndarray]:
         """One complete ring pass (reduce-scatter then allgather) over
-        ``chunks`` — one 1-D array per rank slot — on the given lane.
-        Returns the fully reduced chunk list.  ``tag_base`` reserves two
-        tags (+1 reduce-scatter, +2 allgather) so concurrent stripes and
-        back-to-back ops demux cleanly on shared lane sockets.
+        ``chunks`` — one 1-D array per rank slot — on the given lane, over
+        the flat ring or a 2D ``tier`` ring.  Returns the fully reduced
+        chunk list.  ``tag_base + rs_sub`` / ``+ ag_sub`` pick this pass's
+        tags inside the stripe's block so concurrent stripes, back-to-back
+        ops, AND nested tier rings demux cleanly (the column tier passes
+        its own subtags from the high half of the block).
 
         Wire compression: floating payloads travel as bfloat16 per hop with
         accumulation in ``acc_dtype``; in the allgather phase each rank
@@ -1032,33 +1284,48 @@ class TCPCollective(Collective):
         elementwise in fixed ring-step order, so striping a chunk across
         lanes reproduces the single-lane result BIT FOR BIT.
         """
-        from torchft_tpu.checkpointing.serialization import as_u8
-
-        n = self._world_size
-        rank = self._rank
+        n = tier.size if tier is not None else self._world_size
+        rank = tier.ring_rank if tier is not None else self._rank
         chunks = list(chunks)
-
-        def encode(chunk: np.ndarray) -> memoryview:
-            if wire is not None:
-                chunk = chunk.astype(wire)
-            # as_u8 (not memoryview.cast) so ml_dtypes payloads like
-            # bfloat16 frame correctly.
-            return memoryview(as_u8(chunk))
-
-        def decode(raw: bytes) -> np.ndarray:
-            if wire is not None:
-                return np.frombuffer(raw, dtype=wire).astype(acc_dtype)
-            return np.frombuffer(raw, dtype=acc_dtype)
+        encode, decode = self._codec(wire, acc_dtype)
 
         # Reduce-scatter phase: after n-1 steps, chunk (rank+1)%n holds the
         # full reduction on this rank.
         for step in range(n - 1):
             send_idx = (rank - step) % n
             recv_idx = (rank - step - 1) % n
-            incoming = decode(self._exchange(tag_base + 1, encode(chunks[send_idx]), lane))
+            incoming = decode(
+                self._exchange(tag_base + rs_sub, encode(chunks[send_idx]), lane, tier)
+            )
             chunks[recv_idx] = combine(chunks[recv_idx], incoming)
 
-        # Allgather phase: circulate the reduced chunks.
+        return self._ring_ag_phase(
+            chunks, wire, acc_dtype, lane, tag_base + ag_sub, tier
+        )
+
+    def _ring_ag_phase(
+        self,
+        chunks: List[np.ndarray],
+        wire,
+        acc_dtype,
+        lane: int,
+        tag: int,
+        tier: Optional[_TierLinks] = None,
+    ) -> List[np.ndarray]:
+        """Allgather circulation over a ring (flat or a 2D tier): each rank
+        owns chunk (rank+1)%n and the owned chunks circulate until every
+        rank holds all n.  The ONE implementation of this phase — shared by
+        _ring_rs_ag and the hierarchical pass's row allgather, so the wire
+        framing and replica-consistency mechanics cannot diverge between
+        topologies.  With wire compression each owner quantizes its chunk
+        exactly once and every other rank forwards the received WIRE BYTES
+        untouched, so all ranks decode bitwise-identical values."""
+        from torchft_tpu.checkpointing.serialization import as_u8
+
+        n = tier.size if tier is not None else self._world_size
+        rank = tier.ring_rank if tier is not None else self._rank
+        chunks = list(chunks)
+        encode, decode = self._codec(wire, acc_dtype)
         if wire is not None:
             own = (rank + 1) % n
             raw_chunks: List[Optional[bytes]] = [None] * n
@@ -1067,21 +1334,83 @@ class TCPCollective(Collective):
                 send_idx = (rank - step + 1) % n
                 recv_idx = (rank - step) % n
                 raw_chunks[recv_idx] = self._exchange(
-                    tag_base + 2, memoryview(cast(bytes, raw_chunks[send_idx])), lane
+                    tag, memoryview(cast(bytes, raw_chunks[send_idx])), lane, tier
                 )
-            for i in range(n):
-                chunks[i] = np.frombuffer(
-                    cast(bytes, raw_chunks[i]), dtype=wire
-                ).astype(acc_dtype)
-        else:
-            for step in range(n - 1):
-                send_idx = (rank - step + 1) % n
-                recv_idx = (rank - step) % n
-                payload = encode(chunks[send_idx])
-                chunks[recv_idx] = decode(
-                    self._exchange(tag_base + 2, payload, lane)
-                ).copy()
+            return [
+                np.frombuffer(cast(bytes, raw_chunks[i]), dtype=wire).astype(acc_dtype)
+                for i in range(n)
+            ]
+        for step in range(n - 1):
+            send_idx = (rank - step + 1) % n
+            recv_idx = (rank - step) % n
+            chunks[recv_idx] = decode(
+                self._exchange(tag, encode(chunks[send_idx]), lane, tier)
+            ).copy()
         return chunks
+
+    def _hier_rs_ag_flat(
+        self,
+        flat: np.ndarray,
+        combine,
+        wire,
+        acc_dtype,
+        lane: int,
+        tag_base: int,
+    ) -> np.ndarray:
+        """One hierarchical (2D ring-of-rings) allreduce pass over a flat
+        1-D buffer: reduce-scatter along the ROW ring, full allreduce of
+        the owned row chunk along the COLUMN ring, allgather back along the
+        row.  Returns the fully reduced flat buffer.
+
+        Hops: (C-1) + 2(R-1) + (C-1) versus the flat ring's 2(N-1) — the
+        latency term that keeps step time flat as the group count grows.
+
+        Replica consistency: after the column allreduce every member of a
+        column holds BITWISE-identical bytes for its owned chunk
+        (_ring_rs_ag's allgather forwards the owner's wire bytes), and the
+        row allgather forwards those bytes verbatim (each owner re-encodes
+        a value that is already exactly representable on the wire), so ALL
+        N ranks decode identical results.  Fold order — row partials summed
+        in row-ring-step order, then folded across rows in column-ring-step
+        order — is fixed by (world_size, rank) alone, hence deterministic
+        per topology."""
+        row = cast(_TierLinks, self._row_tier)
+        col = cast(_TierLinks, self._col_tier)
+        C, crank = row.size, row.ring_rank
+        chunks = list(np.array_split(flat, C))
+        encode, decode = self._codec(wire, acc_dtype)
+
+        # Phase 1: row reduce-scatter — after C-1 hops this rank's owned
+        # chunk holds the full reduction over its row.
+        for step in range(C - 1):
+            send_idx = (crank - step) % C
+            recv_idx = (crank - step - 1) % C
+            incoming = decode(
+                self._exchange(tag_base + _SUB_RS, encode(chunks[send_idx]), lane, row)
+            )
+            chunks[recv_idx] = combine(chunks[recv_idx], incoming)
+        own = (crank + 1) % C
+
+        # Phase 2: column allreduce of the owned row chunk, on the column
+        # tier's sockets with the tier partition's subtags.  Every member
+        # of this column ends with bitwise-identical bytes.
+        if col.size > 1:
+            sub = self._ring_rs_ag(
+                list(np.array_split(chunks[own], col.size)),
+                combine, wire, acc_dtype, lane, tag_base,
+                tier=col, rs_sub=_SUB_COL_RS, ag_sub=_SUB_COL_AG,
+            )
+            chunks[own] = np.concatenate(sub) if len(sub) > 1 else sub[0]
+
+        # Phase 3: row allgather of the owned chunks — the SAME shared
+        # circulation as the flat ring's allgather phase (with wire
+        # compression each owner quantizes once; after phase 2 already
+        # decoded wire values that re-encode is an identity, so forwarded
+        # bytes stay bitwise-identical everywhere).
+        chunks = self._ring_ag_phase(
+            chunks, wire, acc_dtype, lane, tag_base + _SUB_AG, tier=row
+        )
+        return np.concatenate(chunks) if C > 1 else chunks[0]
 
     def _flatten(self, arrays: Sequence[np.ndarray]) -> np.ndarray:
         """One contiguous working buffer of the common dtype.  A single
@@ -1127,6 +1456,26 @@ class TCPCollective(Collective):
         )
         return self._unflatten(np.concatenate(chunks), arrays, op)
 
+    def _hier_allreduce(
+        self,
+        arrays: List[np.ndarray],
+        op: str,
+        allow_wire_compression: bool = True,
+        seq: Optional[int] = None,
+    ) -> List[np.ndarray]:
+        """Single-lane hierarchical (ring2d) allreduce — the lanes=1
+        counterpart of _ring_allreduce, running one 2D pass over the whole
+        flattened payload."""
+        if seq is None:
+            seq = self._next_seq()
+        combine = _REDUCE_COMBINE[op]
+        flat = self._flatten(arrays)
+        wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+        out = self._hier_rs_ag_flat(
+            flat, combine, wire, acc_dtype, lane=0, tag_base=self._tag_base(seq)
+        )
+        return self._unflatten(out, arrays, op)
+
     def _stripe_count(self, max_chunk_nbytes: int) -> int:
         """Stripes per ring chunk: enough to keep every lane busy, sized at
         ~chunk_bytes so stripe k's combine overlaps stripe k+1's wire time,
@@ -1140,18 +1489,14 @@ class TCPCollective(Collective):
         # tags past this seq's _TAGS_PER_OP block into the next op's.
         return min(s, _MAX_STRIPES - _MAX_STRIPES % self._lanes)
 
-    def _striped_allreduce(
-        self,
-        arrays: List[np.ndarray],
-        op: str,
-        allow_wire_compression: bool,
-        seq: int,
-    ) -> Work:
-        """Lanes > 1: stripe the ring chunks round-robin across lanes and run
-        each stripe as an independent tagged ring on the per-lane worker
-        pool.  Stripes of one op overlap each other (sum vs wire), and
-        back-to-back ops (gradient buckets) overlap too — the Work future
-        resolves when every stripe lands."""
+    def _run_striped(self, nstripes: int, stripe_body, assemble) -> Work:
+        """Shared striped-op scaffolding (flat and hierarchical topologies):
+        runs ``stripe_body(s)`` for every stripe on the per-lane worker
+        pool, fails the whole op fast on the first stripe error — latch +
+        _fail_ring, which closes the flat lanes AND both 2D tiers' lanes of
+        this generation so sibling stripes blocked on any tier fail
+        immediately — and resolves the returned Work with
+        ``assemble(results)`` when the last stripe lands."""
         with self._lock:
             lane_exec = self._lane_executor
             gen = self._generation
@@ -1159,22 +1504,7 @@ class TCPCollective(Collective):
             err = self._op_error or RuntimeError("collective not configured")
             return Work(failed_future(err))
 
-        n = self._world_size
-        combine = _REDUCE_COMBINE[op]
-        try:
-            flat = self._flatten(arrays)
-            chunks = np.array_split(flat, n)
-            wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
-            nstripes = self._stripe_count(max(c.nbytes for c in chunks))
-            # sub[i][s]: stripe s of rank-chunk i.  array_split depends only
-            # on sizes derived from the (identical) flat length, so every
-            # rank cuts identical stripe boundaries.
-            sub = [np.array_split(c, nstripes) for c in chunks]
-        except Exception as e:  # noqa: BLE001
-            self._latch(e)
-            return Work(failed_future(e))
-
-        results: List[Optional[List[np.ndarray]]] = [None] * nstripes
+        results: List[Optional[object]] = [None] * nstripes
         out: Future = Future()
         state_lock = threading.Lock()
         state = {"pending": nstripes, "failed": False}
@@ -1198,15 +1528,7 @@ class TCPCollective(Collective):
 
         def finish() -> None:
             try:
-                # One concatenate in (chunk, stripe) order — a per-chunk
-                # concat followed by a cross-chunk concat would memcpy the
-                # whole reduced payload twice on the hot path.
-                segs = [
-                    cast(list, results[s])[i]
-                    for i in range(n)
-                    for s in range(nstripes)
-                ]
-                outs = self._unflatten(np.concatenate(segs), arrays, op)
+                outs = assemble(results)
             except Exception as e:  # noqa: BLE001
                 settle_err(e)
                 return
@@ -1221,14 +1543,7 @@ class TCPCollective(Collective):
         def make_stripe(s: int):
             def run() -> None:
                 try:
-                    res = self._ring_rs_ag(
-                        [sub[i][s] for i in range(n)],
-                        combine,
-                        wire,
-                        acc_dtype,
-                        lane=s % self._lanes,
-                        tag_base=self._tag_base(seq, s),
-                    )
+                    res = stripe_body(s)
                 except Exception as e:  # noqa: BLE001
                     with state_lock:
                         first = not state["failed"]
@@ -1252,14 +1567,116 @@ class TCPCollective(Collective):
             settle_err(e)
         return Work(out)
 
+    def _striped_allreduce(
+        self,
+        arrays: List[np.ndarray],
+        op: str,
+        allow_wire_compression: bool,
+        seq: int,
+    ) -> Work:
+        """Lanes > 1: stripe the ring chunks round-robin across lanes and run
+        each stripe as an independent tagged ring on the per-lane worker
+        pool.  Stripes of one op overlap each other (sum vs wire), and
+        back-to-back ops (gradient buckets) overlap too — the Work future
+        resolves when every stripe lands."""
+        n = self._world_size
+        combine = _REDUCE_COMBINE[op]
+        try:
+            flat = self._flatten(arrays)
+            chunks = np.array_split(flat, n)
+            wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+            nstripes = self._stripe_count(max(c.nbytes for c in chunks))
+            # sub[i][s]: stripe s of rank-chunk i.  array_split depends only
+            # on sizes derived from the (identical) flat length, so every
+            # rank cuts identical stripe boundaries.
+            sub = [np.array_split(c, nstripes) for c in chunks]
+        except Exception as e:  # noqa: BLE001
+            self._latch(e)
+            return Work(failed_future(e))
+
+        def stripe_body(s: int) -> List[np.ndarray]:
+            return self._ring_rs_ag(
+                [sub[i][s] for i in range(n)],
+                combine,
+                wire,
+                acc_dtype,
+                lane=s % self._lanes,
+                tag_base=self._tag_base(seq, s),
+            )
+
+        def assemble(results: List[Optional[object]]) -> List[np.ndarray]:
+            # One concatenate in (chunk, stripe) order — a per-chunk
+            # concat followed by a cross-chunk concat would memcpy the
+            # whole reduced payload twice on the hot path.
+            segs = [
+                cast(list, results[s])[i]
+                for i in range(n)
+                for s in range(nstripes)
+            ]
+            return self._unflatten(np.concatenate(segs), arrays, op)
+
+        return self._run_striped(nstripes, stripe_body, assemble)
+
+    def _striped_hier_allreduce(
+        self,
+        arrays: List[np.ndarray],
+        op: str,
+        allow_wire_compression: bool,
+        seq: int,
+    ) -> Work:
+        """Lanes > 1 under the 2D topology: split the flat payload into
+        stripes directly (stripe-major — each stripe runs the COMPLETE
+        hierarchical pass, cutting its own row/column chunks), so stripes
+        overlap on the wire exactly like the flat striped path while tag
+        blocks and lane assignment stay per-stripe.  Stripe boundaries
+        derive from the identical flat length on every rank."""
+        combine = _REDUCE_COMBINE[op]
+        try:
+            flat = self._flatten(arrays)
+            wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+            row_cols = cast(_TierLinks, self._row_tier).size
+            # Size stripes so each stripe's ROW chunk (its per-hop exchange
+            # unit) lands near chunk_bytes, mirroring the flat path's
+            # per-rank-chunk sizing.
+            nstripes = self._stripe_count(-(-flat.nbytes // max(1, row_cols)))
+            stripes = np.array_split(flat, nstripes)
+        except Exception as e:  # noqa: BLE001
+            self._latch(e)
+            return Work(failed_future(e))
+
+        def stripe_body(s: int) -> np.ndarray:
+            return self._hier_rs_ag_flat(
+                stripes[s],
+                combine,
+                wire,
+                acc_dtype,
+                lane=s % self._lanes,
+                tag_base=self._tag_base(seq, s),
+            )
+
+        def assemble(results: List[Optional[object]]) -> List[np.ndarray]:
+            parts = [cast(np.ndarray, r) for r in results]
+            return self._unflatten(
+                np.concatenate(parts) if len(parts) > 1 else parts[0], arrays, op
+            )
+
+        return self._run_striped(nstripes, stripe_body, assemble)
+
     def _fail_ring(self, gen: int) -> None:
-        """Closes this generation's ring lane sockets so every stripe/op
-        blocked on them fails fast.  The generation guard keeps a stale
-        failure from touching the next quorum's fresh lanes."""
+        """Closes this generation's ring lane sockets — flat AND both 2D
+        tiers — so every stripe/op blocked on any of them fails fast: a
+        hierarchical stripe can be mid-hop in either tier when a sibling
+        fails, and a survivor blocked in the column ring must not ride out
+        the full op timeout because only the row sockets died.  The
+        generation guard keeps a stale failure from touching the next
+        quorum's fresh lanes."""
         with self._lock:
             if self._generation != gen:
                 return
             peers = list(self._next_lanes) + list(self._prev_lanes)
+            for tier in (self._row_tier, self._col_tier):
+                if tier is not None:
+                    peers += tier.peers()
         for p in peers:
             p.close()
 
@@ -1268,7 +1685,7 @@ class TCPCollective(Collective):
         if self._world_size == 1:
             return Work(completed_future([array.copy()]))
         seq = self._next_seq()
-        return self._submit(lambda: self._ring_allgather(array, self._tag_base(seq) + 3))
+        return self._submit(lambda: self._ring_allgather(array, self._tag_base(seq) + _SUB_GATHER))
 
     def _ring_allgather(self, array: np.ndarray, tag: int) -> List[np.ndarray]:
         import pickle
@@ -1290,7 +1707,7 @@ class TCPCollective(Collective):
         seq = self._next_seq()
 
         def run() -> np.ndarray:
-            out = self._ring_allgather(array, self._tag_base(seq) + 3)[root]
+            out = self._ring_allgather(array, self._tag_base(seq) + _SUB_GATHER)[root]
             return out
 
         return self._submit(run)
@@ -1335,7 +1752,7 @@ class TCPCollective(Collective):
             # Route through the ring: circulate everyone's full payload list.
             slots: List[Optional[bytes]] = [None] * n
             slots[rank] = pickle.dumps(list(arrays))
-            tag = self._tag_base(seq) + 3
+            tag = self._tag_base(seq) + _SUB_GATHER
             for step in range(n - 1):
                 send_idx = (rank - step) % n
                 recv_idx = (rank - step - 1) % n

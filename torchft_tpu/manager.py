@@ -673,7 +673,23 @@ class Manager:
         if self._pending_state_dict is None:
             # Quorum thread may still be fetching.
             self.wait_quorum()
-        assert self._pending_state_dict is not None, "checkpoint was not fetched"
+        if self._pending_state_dict is None:
+            # The heal FETCH failed (donors died or their serving windows
+            # were busy; the quorum thread latched the error).  Degrade,
+            # never crash: skip the apply, make sure an error is latched so
+            # this step's commit vote fails, and let the NEXT quorum retry
+            # the heal against the then-healthy donor set.  The assert that
+            # used to live here turned a transient donor 503 into the death
+            # of a worker the cluster had already paid to respawn — at
+            # O(100) groups a single busy donor window killed healers
+            # fleet-wide (found by the scale sweep's preemption-wave cell).
+            if self._errored is None:
+                self.report_error(RuntimeError("healing checkpoint was not fetched"))
+            self._logger.warn(
+                "healed state dict was never fetched; failing this step's "
+                "commit and retrying the heal at the next quorum"
+            )
+            return
         self._logger.info("applying healed state dict")
         user = cast(Dict[str, object], self._pending_state_dict["user"])
         for key, value in user.items():

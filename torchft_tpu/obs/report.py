@@ -152,6 +152,60 @@ def fault_times(events: Sequence[dict]) -> List[Tuple[float, str]]:
     ]
 
 
+def data_plane(events: Sequence[dict]) -> dict:
+    """Cross-topology data-plane rollup from step_summary records.
+
+    ``allreduce_payload_bytes`` sums the per-step payload accounting, which
+    the Manager computes through the collective's ``wire_nbytes`` probe —
+    the single telemetry source, so a flat-ring run and a ring2d run of the
+    same workload read comparable totals (and the derived
+    ``tpuft_allreduce_gb_per_s`` gauge stays comparable too).
+    ``tier_wire_bytes`` attributes actual wire traffic per ring tier
+    ("flat" = the flat ring's next-direction lanes; "row"/"col" = the 2D
+    topology's nested tiers) from the lane_stats snapshot each step_summary
+    embeds.  Those counters are CUMULATIVE per configure() — they RESET on
+    every quorum reconfiguration — so the rollup accumulates per
+    (replica, tier) epochs: a snapshot that drops below the previous one
+    closes the old epoch (its high-water mark is banked) and opens a new
+    one; the total is banked epochs plus the live epoch's high-water mark.
+    A plain per-replica max would silently drop all traffic that predates
+    a reconfiguration — precisely the fault runs this report analyzes."""
+    payload: Dict[str, int] = {}
+    # rid -> tier -> [closed-epoch sum, current-epoch high-water mark]
+    tier_acc: Dict[str, Dict[str, List[int]]] = {}
+    topologies: set = set()
+    for ev in events:
+        if ev.get("event") != "step_summary":
+            continue
+        rid = str(ev.get("replica_id", ""))
+        nbytes = ev.get("allreduce_bytes")
+        if nbytes:
+            payload[rid] = payload.get(rid, 0) + int(nbytes)
+        lanes = ev.get("allreduce_lanes")
+        if isinstance(lanes, dict):
+            topologies.add(str(lanes.get("topology", "ring")))
+            tiers = {"flat": sum(lanes.get("sent") or [])}
+            for name, tier in (lanes.get("tiers") or {}).items():
+                tiers[name] = sum(tier.get("sent") or [])
+            acc = tier_acc.setdefault(rid, {})
+            for name, v in tiers.items():
+                slot = acc.setdefault(name, [0, 0])
+                v = int(v)
+                if v < slot[1]:  # counter reset: a reconfigure happened
+                    slot[0] += slot[1]
+                slot[1] = v
+    tier_totals: Dict[str, int] = {}
+    for tiers in tier_acc.values():
+        for name, (closed, cur) in tiers.items():
+            tier_totals[name] = tier_totals.get(name, 0) + closed + cur
+    return {
+        "allreduce_payload_bytes": sum(payload.values()),
+        "per_replica_payload_bytes": dict(sorted(payload.items())),
+        "tier_wire_bytes": dict(sorted(tier_totals.items())),
+        "topologies": sorted(topologies),
+    }
+
+
 def election_windows(events: Sequence[dict]) -> List[Tuple[float, float]]:
     """[(start_ts, end_ts)] of lighthouse leader elections in the stream:
     from a scripted lighthouse fault (``fault`` kind="lighthouse") to the
@@ -566,6 +620,9 @@ def attribute(
         "steps": rows,
         "totals": {k: round(v, 3) for k, v in totals.items()},
         "fractions": fractions,
+        # Byte-level rollup (payload + per-tier wire), comparable across
+        # ring/ring2d topologies — not a time-accounting class.
+        "data_plane": data_plane(events),
         "goodput": {
             "deadwindow_fraction": (
                 round(dw["fraction"], 4) if dw["fraction"] is not None else None
