@@ -1799,6 +1799,35 @@ def scale_benchmark() -> dict:
     return payload
 
 
+def diloco_benchmark() -> dict:
+    """Streaming semi-sync scenario (``--scenario diloco``): 2 replica
+    groups on a shaped 60 ms-RTT link; inner-step throughput with a
+    concurrent background fragment sync (int8+EF wire) vs the blocking
+    port's stall vs a no-sync ceiling, plus the quantization-error-vs-
+    convergence drift cell (int8+EF vs bf16 vs f32 over many rounds).
+    The heavy lifting lives in bench_diloco.py (quick mode is tier-1's
+    test_diloco_quick_smoke); writes DILOCO_BENCH.json."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import bench_diloco
+    finally:
+        sys.path.pop(0)
+    payload = bench_diloco.run_full(
+        rounds=int(os.environ.get("TPUFT_BENCH_DILOCO_ROUNDS", "6")),
+        sync_every=int(os.environ.get("TPUFT_BENCH_DILOCO_SYNC_EVERY", "24")),
+        inner_ms=float(os.environ.get("TPUFT_BENCH_DILOCO_INNER_MS", "50")),
+        model_mb=float(os.environ.get("TPUFT_BENCH_DILOCO_MODEL_MB", "2")),
+        mbps=float(os.environ.get("TPUFT_BENCH_DILOCO_MBPS", "200")),
+        rtt_ms=float(os.environ.get("TPUFT_BENCH_DILOCO_RTT_MS", "60")),
+    )
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "DILOCO_BENCH.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return payload
+
+
 def main() -> None:
     # The chip result is computed, assembled, and (on any kill-scenario
     # failure) still printed first: a failure on the subprocess-heavy kill
@@ -1875,6 +1904,7 @@ def selftest() -> None:
     inspect.signature(straggler_benchmark).bind()
     inspect.signature(lighthouse_failover_benchmark).bind()
     inspect.signature(scale_benchmark).bind()
+    inspect.signature(diloco_benchmark).bind()
     plans = _trial_plans(10)
     assert len(plans) == 10
     assert {p["type"] for p in plans} == {
@@ -1892,11 +1922,30 @@ if __name__ == "__main__":
     elif "--scenario" in sys.argv:
         which = sys.argv[sys.argv.index("--scenario") + 1:]
         if not which or which[0] not in (
-            "drain", "kill", "straggler", "lighthouse-failover", "scale"
+            "drain", "kill", "straggler", "lighthouse-failover", "scale",
+            "diloco",
         ):
             print(f"unknown --scenario {which[:1] or '(missing)'}", file=sys.stderr)
             sys.exit(2)
-        if which[0] == "scale":
+        if which[0] == "diloco":
+            diloco = diloco_benchmark()
+            print(
+                json.dumps(
+                    {
+                        "metric": "diloco_overlap",
+                        "value": diloco["overlap"][
+                            "inner_throughput_ratio_streaming_vs_nosync"
+                        ],
+                        "unit": "inner_throughput_fraction_of_nosync",
+                        "detail": {
+                            "ok": diloco["ok"],
+                            "overlap": diloco["overlap"],
+                            "quant": diloco["quant"],
+                        },
+                    }
+                )
+            )
+        elif which[0] == "scale":
             scale = scale_benchmark()
             print(
                 json.dumps(

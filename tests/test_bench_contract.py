@@ -219,6 +219,53 @@ def test_device_prep_quick_smoke() -> None:
         assert trials["sharded"]["fetch_slices"] > 0
 
 
+def test_diloco_quick_smoke() -> None:
+    """bench_diloco --quick in-process: 2 replica groups, small model,
+    shaped 60 ms-RTT link.  The tier-1 gate on the streaming semi-sync
+    plane: inner-step throughput with a CONCURRENT background fragment
+    sync must meet or beat the blocking port's (whose whole-round stall is
+    measured alongside), both cells must commit every round, the int8+EF
+    wire must cost <= 0.27x the f32 wire, and error feedback must bound
+    the drift plain int8 accumulates — plus the DILOCO_BENCH.json schema
+    the full artifact is built from."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_diloco
+    finally:
+        sys.path.pop(0)
+    payload = bench_diloco.run_quick()
+    # Schema contract: the keys the full DILOCO_BENCH.json artifact is
+    # built from (bench.py --scenario diloco writes the same dict).
+    for key in ("metric", "quick", "overlap", "quant", "ok"):
+        assert key in payload, f"DILOCO_BENCH schema missing {key}"
+    assert payload["quick"] is True
+    overlap = payload["overlap"]
+    for key in ("link", "cells", "inner_throughput_ratio_streaming_vs_nosync",
+                "inner_throughput_ratio_blocking_vs_nosync",
+                "streaming_within_5pct", "streaming_beats_blocking",
+                "blocking_stall_ms_per_round", "streaming_stall_ms_per_round"):
+        assert key in overlap, f"overlap schema missing {key}"
+    cells = overlap["cells"]
+    assert set(cells) == {"nosync", "blocking", "streaming"}
+    for name in ("blocking", "streaming"):
+        # Healthy run: every timed round committed, and the state actually
+        # fragmented + rode the wire.
+        assert cells[name]["committed_rounds"] == overlap["rounds"], cells[name]
+        assert cells[name]["fragments"] >= 2
+        assert cells[name]["wire_bytes"] > 0
+    # The headline gate quick mode enforces: a concurrent outer sync must
+    # not make inner throughput WORSE than the blocking baseline.
+    assert overlap["streaming_beats_blocking"], overlap
+    quant = payload["quant"]
+    for key in ("drift_vs_f32", "ef_bounds_drift", "wire_ratio_int8",
+                "wire_ratio_ok"):
+        assert key in quant, f"quant schema missing {key}"
+    assert set(quant["drift_vs_f32"]) == {"bf16", "int8", "int8_noef"}
+    assert quant["ef_bounds_drift"], quant
+    assert quant["wire_ratio_int8"] <= 0.27, quant
+    assert payload["ok"], payload
+
+
 def test_bench_selftest() -> None:
     """bench.py --selftest verifies its own scenario-call signatures without
     touching the chip or spawning training subprocesses."""

@@ -78,13 +78,14 @@ def test_phases_registry_is_stable() -> None:
         "allreduce_merge",
         "commit_vote",
         "snapshot",
+        "outer_sync",
     )
     from torchft_tpu.obs.spans import OVERLAPPED_PHASES
 
     # Overlapped phases must be a subset of the registry: report.py treats
     # them as concurrent-with-compute (not charged against productive time).
     assert set(OVERLAPPED_PHASES) <= set(PHASES)
-    assert OVERLAPPED_PHASES == ("snapshot",)
+    assert OVERLAPPED_PHASES == ("snapshot", "outer_sync")
 
 
 # ---------------------------------------------------------------------------

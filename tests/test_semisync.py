@@ -1,0 +1,603 @@
+"""Streaming semi-sync (torchft_tpu/semisync) tests.
+
+Covers the three layers of the new subsystem:
+
+  - the int8 + error-feedback wire codec: collective-level replica
+    consistency (every rank decodes bitwise-identical averages) across the
+    flat ring, the striped multi-lane ring, and ring2d; the <= 0.27x f32
+    wire-byte contract; and the EF property itself — the carried residual
+    bounds accumulated quantization drift where plain int8 does not;
+  - fragment planning: plan_buckets reuse, the staggered issue schedule,
+    and the full-width guarantee for lossy-ineligible dtypes;
+  - StreamingDiLoCo end to end: 2 real replica groups (native lighthouse,
+    TCP collective) with background fragment streaming produce
+    bitwise-identical backups/params, and — the heal-consistency pin the
+    old ``register_state_dict_fn`` comment warned about but nothing
+    tested — a group killed MID-ROUND heals backup + outer optimizer
+    state from a donor and re-derives the same pseudogradient base as the
+    survivor.
+"""
+
+import logging
+import threading
+from datetime import timedelta
+from typing import Any, Dict
+
+import numpy as np
+import pytest
+
+from torchft_tpu._native import LighthouseServer, StoreServer
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.collectives import TCPCollective
+from torchft_tpu.manager import Manager
+from torchft_tpu.semisync import (
+    FragmentPlan,
+    SemiSyncMetrics,
+    StreamingDiLoCo,
+    make_codec,
+)
+
+from harness import FailureInjector, Runner, run_replicas
+
+logging.basicConfig(level=logging.INFO)
+
+
+# ---------------------------------------------------------------------------
+# int8 wire codec at the collective level
+# ---------------------------------------------------------------------------
+
+
+def _ring_int8(world: int, lanes: int, topology: str):
+    """Runs one int8-codec allreduce across ``world`` thread-ranks; returns
+    (per-rank inputs, per-rank outputs, per-hop wire bytes)."""
+    store = StoreServer(bind="127.0.0.1:0")
+    inputs: Dict[int, np.ndarray] = {}
+    outputs: Dict[int, np.ndarray] = {}
+    wire: Dict[int, int] = {}
+    errors = []
+
+    def rank_body(rank: int) -> None:
+        c = TCPCollective(
+            timeout=20.0, lanes=lanes, topology=topology, wire_dtype="f32"
+        )
+        try:
+            c.configure(f"{store.address()}/int8_{lanes}_{topology}", rank, world)
+            rng = np.random.default_rng(100 + rank)
+            x = (rng.standard_normal(4096) * (rank + 1)).astype(np.float32)
+            inputs[rank] = x
+            out = c.allreduce([x], op="sum", wire_codec="int8").wait(timeout=20)[0]
+            outputs[rank] = out
+            wire[rank] = c.wire_nbytes(x, True, "int8")
+        except BaseException as e:  # noqa: BLE001 — re-raised by the driver
+            errors.append(e)
+        finally:
+            c.shutdown()
+
+    threads = [threading.Thread(target=rank_body, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    store.shutdown()
+    if errors:
+        raise errors[0]
+    return inputs, outputs, wire
+
+
+@pytest.mark.parametrize(
+    "world,lanes,topology",
+    [(2, 1, "ring"), (3, 2, "ring"), (4, 2, "ring2d")],
+)
+def test_int8_codec_replica_consistent(world, lanes, topology) -> None:
+    inputs, outputs, wire = _ring_int8(world, lanes, topology)
+    exact = np.sum([inputs[r] for r in range(world)], axis=0)
+    # Replica consistency: the commit protocol's premise — every rank
+    # decodes bitwise-identical bytes.
+    for r in range(1, world):
+        np.testing.assert_array_equal(outputs[0], outputs[r])
+    # Accuracy: per-hop symmetric int8 keeps the sum within a few percent
+    # (per-chunk scale bounds the quantization step at amax/127 per hop).
+    rel = np.linalg.norm(outputs[0] - exact) / np.linalg.norm(exact)
+    assert rel < 0.05, rel
+    # The wire contract: <= 0.27x the f32 wire (int8 + one scale per frame).
+    assert wire[0] <= 0.27 * inputs[0].nbytes, wire[0]
+
+
+def test_int8_codec_rejects_integer_payloads() -> None:
+    c = TCPCollective(timeout=5.0, wire_dtype="f32")
+    work = c.allreduce(
+        [np.arange(8, dtype=np.int64)], op="sum", wire_codec="int8"
+    )
+    with pytest.raises(ValueError, match="floating"):
+        work.wait(timeout=5)
+    c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# error feedback: the residual bounds accumulated drift
+# ---------------------------------------------------------------------------
+
+
+def test_int8_error_feedback_bounds_drift() -> None:
+    """Simulated outer loop: transmit a stream of pseudogradients through
+    the int8 codec with and without error feedback and integrate the
+    decoded values.  EF keeps the integrated drift bounded (each round's
+    residual re-enters the next transmission); plain int8 accumulates
+    bias.  This is the property that makes a LOSSY wire safe for
+    pseudogradients."""
+    from torchft_tpu.ddp import plan_buckets
+    from torchft_tpu.semisync.fragments import Fragment
+
+    rng = np.random.default_rng(7)
+    n = 2048
+    frag = Fragment(0, plan_buckets([((n,), np.float32)], 1 << 30)[0])
+    codec = make_codec("int8", frag)
+    backup = np.zeros(n, dtype=np.float32)
+    codec.set_backup(backup)
+
+    acc_ef = np.zeros(n, dtype=np.float64)
+    acc_raw = np.zeros(n, dtype=np.float64)
+    acc_exact = np.zeros(n, dtype=np.float64)
+    # A biased low-magnitude stream — the adversarial case for plain int8
+    # (values far below the chunk amax round toward zero every round).
+    base = rng.standard_normal(n).astype(np.float32)
+    for r in range(60):
+        pg = (0.01 * base + 0.001).astype(np.float32)
+        local = backup - pg  # so codec's (backup - local) == pg
+        deq, _ = codec.encode([local])
+        codec.on_commit()
+        acc_ef += deq
+        # Plain int8 (no residual): quantize the same pg directly.
+        amax = float(np.max(np.abs(pg)))
+        scale = amax / 127.0 if amax > 0 else 1.0
+        acc_raw += np.clip(np.rint(pg / scale), -127, 127).astype(np.float32) * scale
+        acc_exact += pg
+    drift_ef = np.linalg.norm(acc_ef - acc_exact)
+    drift_raw = np.linalg.norm(acc_raw - acc_exact)
+    # EF drift is bounded by ~one quantization step; plain int8's grows
+    # with the round count.
+    assert drift_ef < 0.5 * drift_raw, (drift_ef, drift_raw)
+    # And the carried residual is what explains the difference.
+    assert codec.residual_l2() > 0.0
+
+
+def test_int8_codec_abort_resets_residual() -> None:
+    from torchft_tpu.ddp import plan_buckets
+    from torchft_tpu.semisync.fragments import Fragment
+
+    frag = Fragment(0, plan_buckets([((64,), np.float32)], 1 << 20)[0])
+    codec = make_codec("int8", frag)
+    codec.set_backup(np.zeros(64, dtype=np.float32))
+    # Varied magnitudes: most values sit between quantization levels, so a
+    # nonzero residual is guaranteed (a constant payload quantizes exactly).
+    codec.encode([-np.linspace(0.013, 0.91, 64, dtype=np.float32)])
+    codec.on_commit()
+    assert codec.residual_l2() > 0.0
+    codec.on_abort()
+    assert codec.residual_l2() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fragment planning
+# ---------------------------------------------------------------------------
+
+
+def test_fragment_plan_schedule_staggers() -> None:
+    metas = [((1024,), np.float32) for _ in range(8)]
+    plan = FragmentPlan(metas, fragment_bytes=4096)  # 1 leaf per fragment
+    assert len(plan) == 8
+    sched = plan.schedule(sync_every=8)
+    # Every fragment appears exactly once, slots are within the round and
+    # non-decreasing in fragment order.
+    seen = [f.index for fs in sched.values() for f in fs]
+    assert sorted(seen) == list(range(8))
+    slots = [plan.slot(i, 8) for i in range(8)]
+    assert slots == sorted(slots)
+    assert slots[0] == 1 and slots[-1] <= 8
+    # sync_every=1 degenerates to the blocking shape: everything at slot 1.
+    assert all(plan.slot(i, 1) == 1 for i in range(8))
+
+
+def test_fragment_plan_nonfloat_rides_raw() -> None:
+    plan = FragmentPlan([((16,), np.int64), ((16,), np.float32)], 1 << 20)
+    by_dtype = {f.dtype: f for f in plan.fragments}
+    assert not by_dtype[np.dtype(np.int64)].lossy_ok
+    assert by_dtype[np.dtype(np.float32)].lossy_ok
+    # Requesting int8 for an integer fragment silently degrades to the raw
+    # full-width codec — the same guarantee the DDP wire gate gives ints.
+    codec = make_codec("int8", by_dtype[np.dtype(np.int64)])
+    assert codec.name == "f32" and codec.wire_codec is None
+
+
+def test_codec_zero_payload_matches_encode_dtype() -> None:
+    """A non-participating group's zero placeholder must frame EXACTLY
+    like its peers' encoded payload (the ring's per-hop frame sizes derive
+    from each rank's payload dtype) — for every codec."""
+    from torchft_tpu.ddp import plan_buckets
+    from torchft_tpu.semisync.fragments import Fragment
+
+    frag = Fragment(0, plan_buckets([((32,), np.float32)], 1 << 20)[0])
+    for name in ("f32", "auto", "bf16", "int8"):
+        codec = make_codec(name, frag)
+        codec.set_backup(np.zeros(32, dtype=np.float32))
+        payload, _ = codec.encode([np.linspace(-1, 1, 32, dtype=np.float32)])
+        zeros = codec.zero_payload()
+        assert zeros.dtype == payload.dtype, (name, zeros.dtype, payload.dtype)
+        assert zeros.shape == payload.shape
+    # Non-lossy fragments keep their own dtype.
+    ifrag = Fragment(0, plan_buckets([((8,), np.int64)], 1 << 20)[0])
+    icodec = make_codec("auto", ifrag)
+    assert icodec.zero_payload().dtype == np.dtype(np.int64)
+
+
+def test_semisync_metrics_render() -> None:
+    m = SemiSyncMetrics(codec="int8", replica_id="g0")
+    m.observe_fragment(wire_bytes=1000, d2h_bytes=250)
+    m.observe_round(committed=True)
+    m.observe_round(committed=False)
+    text = m.render_prometheus()
+    assert 'tpuft_semisync_fragments_total{replica="g0",codec="int8"} 1' in text
+    assert 'tpuft_semisync_rounds_total{replica="g0",codec="int8"} 2' in text
+    assert 'tpuft_semisync_commits_total{replica="g0",codec="int8"} 1' in text
+    assert 'tpuft_semisync_aborts_total{replica="g0",codec="int8"} 1' in text
+    assert 'tpuft_semisync_wire_bytes_total{replica="g0",codec="int8"} 1000' in text
+
+
+# ---------------------------------------------------------------------------
+# sync-error cadence (satellite: _local_step must never desync)
+# ---------------------------------------------------------------------------
+
+
+def _mock_manager(commit: bool = True):
+    from datetime import timedelta
+    from unittest.mock import create_autospec
+
+    from torchft_tpu.futures import completed_future
+
+    manager = create_autospec(Manager, instance=True)
+    manager.num_participants.return_value = 2
+    manager.should_commit.return_value = commit
+    manager._use_async_quorum = False
+    manager.timeout = timedelta(seconds=60)
+    manager.allreduce.side_effect = (
+        lambda arr, should_average=True, allow_wire_compression=True: (
+            completed_future(np.asarray(arr))
+        )
+    )
+    return manager
+
+
+def test_sync_error_latches_and_resets_cadence() -> None:
+    """A sync that dies mid-quorum latches on the manager and resets the
+    inner-step counter — the group re-enters the next round on the same
+    cadence as its peers instead of raising into the loop with a stale
+    counter."""
+    import optax
+
+    from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+
+    for make in (
+        lambda m, box: LocalSGD(m, box.get, box.set, sync_every=2),
+        lambda m, box: DiLoCo(m, box.get, box.set, optax.sgd(0.5), sync_every=2),
+    ):
+        manager = _mock_manager()
+        manager.start_quorum.side_effect = RuntimeError("quorum died")
+
+        class Box:
+            params = {"w": np.ones(4, dtype=np.float32)}
+
+            def get(self):
+                return self.params
+
+            def set(self, p):
+                self.params = p
+
+        box = Box()
+        algo = make(manager, box)
+        algo.step()
+        algo.step()  # triggers sync; the quorum failure must NOT raise
+        inner = getattr(algo, "_impl", algo)
+        assert inner._local_step == 0
+        manager.report_error.assert_called()
+
+
+def test_wrapper_outer_tx_sees_whole_tree() -> None:
+    """The legacy DiLoCo wrapper runs ONE outer_tx over the full
+    pseudogradient tree (outer_scope='tree'): cross-leaf-coupled
+    transforms — global-norm clipping — must see every leaf at once, not
+    one fragment at a time."""
+    import optax
+
+    from torchft_tpu.local_sgd import DiLoCo
+
+    seen_structures = []
+
+    def spy_update(updates, state, params=None):
+        import jax
+
+        seen_structures.append(jax.tree.structure(updates))
+        return updates, state
+
+    spy_tx = optax.GradientTransformation(lambda p: (), spy_update)
+    manager = _mock_manager()
+
+    class Box:
+        params = {
+            "a": np.ones(4, dtype=np.float32),
+            "b": np.ones(2, dtype=np.float32),
+        }
+
+        def get(self):
+            return self.params
+
+        def set(self, p):
+            self.params = p
+
+    box = Box()
+    algo = DiLoCo(manager, box.get, box.set, spy_tx, sync_every=1)
+    box.set({"a": np.zeros(4, dtype=np.float32), "b": np.zeros(2, dtype=np.float32)})
+    algo.step()
+    import jax
+
+    # Exactly one update call, over the whole {a, b} tree.
+    assert len(seen_structures) == 1
+    assert seen_structures[0] == jax.tree.structure(box.params)
+
+
+def test_fragment_scope_rejects_tree_state_dict() -> None:
+    """Loading a whole-tree (legacy-format) outer_state into a
+    fragment-scoped instance must fail loudly at load time, not with a
+    confusing optax pytree error at the next apply."""
+    import optax
+
+    from torchft_tpu.semisync import StreamingDiLoCo
+
+    manager = _mock_manager()
+
+    class Box:
+        params = {"w": np.ones(64, dtype=np.float32)}
+
+        def get(self):
+            return self.params
+
+        def set(self, p):
+            self.params = p
+
+    box = Box()
+    algo = StreamingDiLoCo(
+        manager, box.get, box.set, optax.sgd(0.5), sync_every=1, stream=False
+    )
+    tree_state = optax.sgd(0.5).init(box.params)
+    with pytest.raises(ValueError, match="outer_scope"):
+        algo._load_outer_state({"backup": box.params, "outer_state": tree_state})
+
+
+def test_sync_max_retries_still_propagates() -> None:
+    """ExceededMaxRetriesError is the give-up contract, not a sync
+    failure: the latch-and-continue path must not swallow it."""
+    import optax
+    import pytest as _pytest
+
+    from torchft_tpu.local_sgd import DiLoCo
+    from torchft_tpu.manager import ExceededMaxRetriesError
+
+    manager = _mock_manager()
+    manager.should_commit.side_effect = ExceededMaxRetriesError("give up")
+
+    class Box:
+        params = {"w": np.ones(4, dtype=np.float32)}
+
+        def get(self):
+            return self.params
+
+        def set(self, p):
+            self.params = p
+
+    box = Box()
+    algo = DiLoCo(manager, box.get, box.set, optax.sgd(0.5), sync_every=1)
+    with _pytest.raises(ExceededMaxRetriesError):
+        algo.step()
+
+
+# ---------------------------------------------------------------------------
+# StreamingDiLoCo end to end (real lighthouse + TCP collective, threads)
+# ---------------------------------------------------------------------------
+
+
+def _init_params():
+    import jax.numpy as jnp
+
+    return {
+        "w1": jnp.full((16, 8), 0.1, dtype=jnp.float32),
+        "b1": jnp.zeros((8,), dtype=jnp.float32),
+        "w2": jnp.full((8, 4), -0.05, dtype=jnp.float32),
+    }
+
+
+def streaming_train_loop(runner: Runner, rank: int) -> Dict[str, Any]:
+    """One replica group running StreamingDiLoCo with background fragment
+    streaming and the int8+EF codec; kills (when scripted) fire MID-ROUND
+    so in-flight fragment syncs die with the group."""
+    import jax
+    import optax
+
+    total_steps = runner.train_loop_args.get("total_steps", 4)
+    sync_every = runner.train_loop_args.get("sync_every", 3)
+    codec = runner.train_loop_args.get("codec", "int8")
+
+    collective = TCPCollective(timeout=20.0)
+    transport = HTTPTransport(timeout=20.0)
+    state: Dict[str, Any] = {"params": _init_params()}
+
+    manager = Manager(
+        collective=collective,
+        load_state_dict=lambda sd: state.update(params=sd["params"]),
+        state_dict=lambda: {"params": state["params"]},
+        min_replica_size=1,
+        use_async_quorum=False,
+        timeout=timedelta(seconds=20),
+        quorum_timeout=timedelta(seconds=20),
+        rank=0,
+        world_size=1,
+        replica_id=str(runner.replica_id),
+        lighthouse_addr=runner.lighthouse_address,
+        checkpoint_transport=transport,
+    )
+    algo = StreamingDiLoCo(
+        manager,
+        lambda: state["params"],
+        lambda p: state.update(params=p),
+        outer_tx=optax.sgd(0.7, momentum=0.9, nesterov=True),
+        sync_every=sync_every,
+        fragment_bytes=256,  # several fragments from this tiny model
+        codec=codec,
+        stream=True,
+    )
+    history: Dict[int, Dict[str, np.ndarray]] = {}
+    try:
+        with algo:
+            while manager.current_step() < total_steps:
+                outer = manager.current_step()
+                for inner in range(sync_every):
+                    rng = np.random.default_rng(
+                        10000 * outer + 100 * inner + runner.replica_id
+                    )
+                    grads = {
+                        k: np.asarray(
+                            rng.standard_normal(v.shape), dtype=np.float32
+                        )
+                        for k, v in state["params"].items()
+                    }
+                    state["params"] = jax.tree.map(
+                        lambda p, g: p - 0.05 * g, state["params"], grads
+                    )
+                    algo.step()
+                    if inner == 1:
+                        # Mid-round: fragments may be in flight on the
+                        # engine worker when the injector fires.
+                        runner.failure_injector.check(runner.replica_id, outer)
+                if manager.current_step() > outer:
+                    history[manager.current_step()] = {
+                        k: np.asarray(v) for k, v in algo.backup_params.items()
+                    }
+            barrier = runner.train_loop_args.get("barrier")
+            if barrier is not None:
+                barrier.wait(timeout=60)
+            outer_state = algo._save_outer_state()
+            return {
+                "params": {k: np.asarray(v) for k, v in state["params"].items()},
+                "backup": {
+                    k: np.asarray(v) for k, v in algo.backup_params.items()
+                },
+                "outer_state": outer_state["outer_state"],
+                "step": manager.current_step(),
+                "history": history,
+                "fragments": algo.num_fragments,
+                "fragment_rounds": algo.metrics.fragments_total,
+                "wire_bytes": algo.metrics.wire_bytes_total,
+            }
+    finally:
+        manager.shutdown()
+
+
+class _DoneBarrier:
+    def __init__(self, parties: int) -> None:
+        self._parties = parties
+        self._done = 0
+        self._cond = threading.Condition()
+
+    def wait(self, timeout: float = 60) -> None:
+        import time
+
+        with self._cond:
+            self._done += 1
+            self._cond.notify_all()
+            deadline = time.monotonic() + timeout
+            while self._done < self._parties:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._cond.wait(timeout=remaining)
+
+
+@pytest.fixture
+def lighthouse():
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=100)
+    yield lh
+    lh.shutdown()
+
+
+def _run(lighthouse, injectors, **loop_args):
+    barrier = _DoneBarrier(len(injectors))
+    runners = [
+        Runner(
+            replica_id=i,
+            lighthouse_address=lighthouse.address(),
+            failure_injector=inj,
+            train_loop=streaming_train_loop,
+            num_replicas=len(injectors),
+            train_loop_args={"barrier": barrier, **loop_args},
+        )
+        for i, inj in enumerate(injectors)
+    ]
+    return run_replicas(runners)
+
+
+def _assert_equal_trees(a, b):
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_streaming_diloco_healthy(lighthouse) -> None:
+    """Background fragment streaming with the int8+EF wire: both groups'
+    backups and live params are bitwise identical every outer round, the
+    plan actually fragmented the state, and fragment rounds rode the
+    compressed wire."""
+    results = _run(lighthouse, [FailureInjector(), FailureInjector()])
+    a, b = results[0][0], results[1][0]
+    assert a["step"] >= 4 and b["step"] >= 4
+    _assert_equal_trees(a["params"], b["params"])
+    _assert_equal_trees(a["backup"], b["backup"])
+    for outer in set(a["history"]) & set(b["history"]):
+        _assert_equal_trees(a["history"][outer], b["history"][outer])
+    assert a["fragments"] >= 2, "tiny fragment_bytes must fragment the tree"
+    assert a["fragment_rounds"] >= a["fragments"] * 4
+    # int8 wire: strictly under the f32 bytes the same rounds would move.
+    f32_per_round = sum(
+        int(np.prod(v.shape)) * 4 for v in _init_params().values()
+    )
+    assert a["wire_bytes"] < 0.3 * f32_per_round * (a["fragment_rounds"] //
+                                                    a["fragments"])
+
+
+def test_streaming_diloco_heal_consistency_midround_kill(lighthouse) -> None:
+    """The divergence mode the register_state_dict_fn comment warns about,
+    pinned: a group is killed MID-ROUND (fragments in flight), restarts,
+    heals backup + per-fragment outer optimizer state live from the donor,
+    and from then on derives the SAME pseudogradient base as the survivor —
+    post-heal backups, outer states, and final params are all bitwise
+    identical.  A heal that restored only the live params would fail this:
+    the restarted group's next sync would compute pseudogradients against
+    a fresh-init backup and silently diverge."""
+    injector = FailureInjector().fail_at(1, 1)
+    results = _run(
+        lighthouse, [FailureInjector(), injector], total_steps=5
+    )
+    assert injector.count == 1
+    a, b = results[0][0], results[1][0]
+    assert a["step"] >= 5 and b["step"] >= 5
+    _assert_equal_trees(a["params"], b["params"])
+    # The pseudogradient base (the backup) matches bitwise...
+    _assert_equal_trees(a["backup"], b["backup"])
+    # ...and so does every leaf of the per-fragment outer optimizer state
+    # (momentum buffers), which also traveled with the heal.
+    import jax
+
+    leaves_a = jax.tree.flatten(a["outer_state"])[0]
+    leaves_b = jax.tree.flatten(b["outer_state"])[0]
+    assert len(leaves_a) == len(leaves_b) and len(leaves_a) > 0
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # Post-heal rounds converge bitwise too.
+    for outer in set(a["history"]) & set(b["history"]):
+        _assert_equal_trees(a["history"][outer], b["history"][outer])

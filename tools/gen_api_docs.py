@@ -39,6 +39,11 @@ MODULES = [
     "torchft_tpu.ddp",
     "torchft_tpu.optim",
     "torchft_tpu.local_sgd",
+    "torchft_tpu.semisync.diloco",
+    "torchft_tpu.semisync.engine",
+    "torchft_tpu.semisync.fragments",
+    "torchft_tpu.semisync.codec",
+    "torchft_tpu.semisync.metrics",
     "torchft_tpu.data",
     "torchft_tpu.parallel.mesh",
     "torchft_tpu.parallel.trainer",

@@ -21,6 +21,7 @@ from torchft_tpu.ddp import GradientAverager, PerLeafGradientAverager
 from torchft_tpu.local_sgd import DiLoCo, LocalSGD
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import Optimizer
+from torchft_tpu.semisync import StreamingDiLoCo
 
 __version__ = "0.1.0"
 
@@ -35,6 +36,7 @@ __all__ = [
     "PerLeafGradientAverager",
     "DiLoCo",
     "LocalSGD",
+    "StreamingDiLoCo",
     "Manager",
     "WorldSizeMode",
     "Optimizer",

@@ -44,6 +44,8 @@ __all__ = [
     "TCPCollective",
     "ErrorSwallowingCollective",
     "ManagedCollective",
+    "WIRE_CODECS",
+    "quantize_int8",
 ]
 
 # Elementwise combine per reduce op ("avg" divides by world size after the
@@ -61,6 +63,39 @@ def _bad_reduce_op(op: str) -> ValueError:
     return ValueError(
         f"unsupported reduce op {op!r}; expected one of {sorted(_REDUCE_COMBINE)}"
     )
+
+
+# Optional per-call wire codecs (TCPCollective.allreduce(wire_codec=...)).
+# "int8": symmetric linear quantization, per-chunk scale = amax/127,
+# accumulation in float32 — ~0.25x the f32 wire (plus 4 scale bytes per
+# frame).  Lossy per hop exactly like the bf16 wire; meant for payloads
+# with a source-side error-feedback loop (the semisync pseudogradient
+# plane, torchft_tpu/semisync), never for raw weights.
+WIRE_CODECS = ("int8",)
+
+
+def quantize_int8(x: np.ndarray):
+    """``(scale, q)`` — THE symmetric int8 quantizer (host side): scale =
+    amax/127, round-to-nearest, clipped to [-127, 127].  One
+    implementation shared by the ring codec, the semisync EF codec's host
+    path, and the bench's drift cells, so the guard rules cannot drift
+    between them.  Non-finite handling: an inf/NaN amax falls back to
+    scale 1 (a NaN scale would silently zero the whole chunk); inf
+    elements saturate to +/-127; NaN elements encode as 0 EXPLICITLY
+    (np.rint(nan).astype(int8) is 0 only by C-cast accident) — the wire
+    cannot represent NaN, so divergence must be caught by loss/grad-norm
+    monitoring, and the EF codec zeroes those elements' residuals rather
+    than carrying NaN forward.  The jitted device twin lives in
+    torchft_tpu/semisync/codec.py."""
+    x = np.asarray(x)
+    if x.dtype != np.float32:
+        x = x.astype(np.float32)
+    amax = float(np.max(np.abs(x))) if x.size else 0.0
+    scale = amax / 127.0 if (amax > 0.0 and math.isfinite(amax)) else 1.0
+    q = np.clip(
+        np.rint(np.nan_to_num(x / scale, nan=0.0)), -127, 127
+    ).astype(np.int8)
+    return scale, q
 
 
 def _is_bf16(dtype) -> bool:
@@ -181,6 +216,8 @@ class DummyCollective(Collective):
     immediately.  Used to soak init-time collectives and as post-error
     placeholder (reference: ProcessGroupDummy, torchft/process_group.py:730-864)."""
 
+    wire_codecs = WIRE_CODECS  # accepted (and ignored: world size 1)
+
     def __init__(self, rank: int = 0, world_size: int = 1) -> None:
         self._rank = rank
         self._world_size = world_size
@@ -196,6 +233,7 @@ class DummyCollective(Collective):
         arrays: Sequence[np.ndarray],
         op: str = "sum",
         allow_wire_compression: bool = True,
+        wire_codec: Optional[str] = None,
     ) -> Work:
         out = [np.array(a, copy=True) for a in arrays]
         if op == "avg":
@@ -235,6 +273,13 @@ class DummyCollective(Collective):
 # ---------------------------------------------------------------------------
 
 _HDR = struct.Struct("<IQ")  # tag, nbytes
+
+# Per-chunk scale header for the int8 wire codec (see _codec): one f32
+# scale prefixes each quantized frame, so every hop can decode without any
+# out-of-band scale exchange and the allgather phase's byte-forwarding
+# stays self-contained (replica consistency: every rank decodes the same
+# scale+payload bytes).
+_INT8_SCALE = struct.Struct("<f")
 
 
 class LinkShaper:
@@ -1125,16 +1170,47 @@ class TCPCollective(Collective):
             out["tiers"] = tiers
         return out
 
+    # Wire codecs this collective's allreduce accepts (see WIRE_CODECS).
+    wire_codecs = WIRE_CODECS
+
     def allreduce(
         self,
         arrays: Sequence[np.ndarray],
         op: str = "sum",
         allow_wire_compression: bool = True,
+        wire_codec: Optional[str] = None,
     ) -> Work:
         # Validate BEFORE the world-size-1 fast path: a typo'd op must fail
         # on a single-replica config too, not only after scaling up.
         if op not in _REDUCE_COMBINE:
             return Work(failed_future(_bad_reduce_op(op)))
+        if wire_codec is not None:
+            if wire_codec not in WIRE_CODECS:
+                return Work(
+                    failed_future(
+                        ValueError(
+                            f"unsupported wire_codec {wire_codec!r}; expected "
+                            f"one of {WIRE_CODECS}"
+                        )
+                    )
+                )
+            # int8 quantization of integer payloads would corrupt them the
+            # same way the bf16 gate guards against — codecs are float-only.
+            # (_is_bf16: bfloat16 is floating but not an np.floating
+            # subtype — see the helper's docstring.)
+            if not all(
+                np.issubdtype(np.asarray(a).dtype, np.floating)
+                or _is_bf16(np.asarray(a).dtype)
+                for a in arrays
+            ):
+                return Work(
+                    failed_future(
+                        ValueError(
+                            f"wire_codec={wire_codec!r} requires floating "
+                            "inputs"
+                        )
+                    )
+                )
         arrays = [np.ascontiguousarray(a) for a in arrays]
         if self._world_size == 1:
             return Work(completed_future(list(arrays)))
@@ -1142,15 +1218,21 @@ class TCPCollective(Collective):
         if self._active_topology == "ring2d":
             if self._lanes > 1:
                 return self._striped_hier_allreduce(
-                    arrays, op, allow_wire_compression, seq
+                    arrays, op, allow_wire_compression, seq, codec=wire_codec
                 )
             return self._submit(
-                lambda: self._hier_allreduce(arrays, op, allow_wire_compression, seq)
+                lambda: self._hier_allreduce(
+                    arrays, op, allow_wire_compression, seq, codec=wire_codec
+                )
             )
         if self._lanes > 1:
-            return self._striped_allreduce(arrays, op, allow_wire_compression, seq)
+            return self._striped_allreduce(
+                arrays, op, allow_wire_compression, seq, codec=wire_codec
+            )
         return self._submit(
-            lambda: self._ring_allreduce(arrays, op, allow_wire_compression, seq)
+            lambda: self._ring_allreduce(
+                arrays, op, allow_wire_compression, seq, codec=wire_codec
+            )
         )
 
     def _exchange(self, tag: int, payload, lane: int = 0,
@@ -1193,12 +1275,23 @@ class TCPCollective(Collective):
         put on the wire anyway."""
         return self._wire_dtype
 
-    def wire_nbytes(self, array, allow_wire_compression: bool = True) -> int:
+    def wire_nbytes(
+        self,
+        array,
+        allow_wire_compression: bool = True,
+        wire_codec: Optional[str] = None,
+    ) -> int:
         """Bytes ``array`` would occupy PER HOP on the ring wire — the
         single source of truth for wire-byte telemetry (the Manager's
         allreduce_gb_per_s gauge), so a change to ``_wire_for``'s gating
-        cannot silently diverge from what the accounting counts."""
+        cannot silently diverge from what the accounting counts.  With
+        ``wire_codec="int8"`` floating payloads count 1 byte per element
+        plus the per-frame scale header (~0.25x the f32 wire)."""
         array = np.asarray(array)
+        if wire_codec == "int8" and (
+            np.issubdtype(array.dtype, np.floating) or _is_bf16(array.dtype)
+        ):
+            return int(array.size) + _INT8_SCALE.size
         wire, _ = self._wire_for([array], array.dtype, allow_wire_compression)
         if wire is not None:
             return int(array.size) * wire.itemsize
@@ -1236,12 +1329,35 @@ class TCPCollective(Collective):
                 return np.dtype(ml_dtypes.bfloat16), np.dtype(np.float32)
         return None, np.dtype(flat_dtype)
 
-    def _codec(self, wire, acc_dtype):
+    def _codec(self, wire, acc_dtype, codec: Optional[str] = None):
         """(encode, decode) for one ring pass: encode casts to the wire
         dtype and frames raw bytes (as_u8, not memoryview.cast, so
         ml_dtypes payloads like bfloat16 frame correctly); decode upcasts
-        back to the accumulation dtype."""
+        back to the accumulation dtype.
+
+        ``codec="int8"`` supersedes ``wire``: each frame is a 4-byte f32
+        scale followed by int8 values (scale = chunk amax / 127, symmetric
+        round-to-nearest).  Accumulation stays in ``acc_dtype`` — each
+        reduce-scatter hop decodes, sums full-width, and requantizes with
+        its own scale, exactly the bf16 wire's per-hop quantization shape;
+        the allgather phase quantizes each owned chunk once and forwards
+        the scale+payload bytes verbatim, so every rank decodes
+        bitwise-identical results (the commit protocol's premise)."""
         from torchft_tpu.checkpointing.serialization import as_u8
+
+        if codec == "int8":
+            def encode(chunk: np.ndarray):
+                scale, q = quantize_int8(chunk)
+                return [_INT8_SCALE.pack(scale), memoryview(as_u8(q))]
+
+            def decode(raw) -> np.ndarray:
+                (scale,) = _INT8_SCALE.unpack_from(raw, 0)
+                q = np.frombuffer(raw, dtype=np.int8, offset=_INT8_SCALE.size)
+                return (q.astype(np.float32) * np.float32(scale)).astype(
+                    acc_dtype, copy=False
+                )
+
+            return encode, decode
 
         def encode(chunk: np.ndarray) -> memoryview:
             if wire is not None:
@@ -1266,6 +1382,7 @@ class TCPCollective(Collective):
         tier: Optional[_TierLinks] = None,
         rs_sub: int = _SUB_RS,
         ag_sub: int = _SUB_AG,
+        codec: Optional[str] = None,
     ) -> List[np.ndarray]:
         """One complete ring pass (reduce-scatter then allgather) over
         ``chunks`` — one 1-D array per rank slot — on the given lane, over
@@ -1276,18 +1393,24 @@ class TCPCollective(Collective):
         its own subtags from the high half of the block).
 
         Wire compression: floating payloads travel as bfloat16 per hop with
-        accumulation in ``acc_dtype``; in the allgather phase each rank
-        quantizes its OWNED chunk exactly once and every other rank forwards
-        the received WIRE BYTES untouched — no per-hop decode/re-encode, so
-        all ranks decode bitwise-identical values (replica consistency — the
-        commit protocol's premise).  Both quantization and accumulation are
-        elementwise in fixed ring-step order, so striping a chunk across
-        lanes reproduces the single-lane result BIT FOR BIT.
+        accumulation in ``acc_dtype`` (or as scale+int8 frames when
+        ``codec="int8"``); in the allgather phase each rank quantizes its
+        OWNED chunk exactly once and every other rank forwards the received
+        WIRE BYTES untouched — no per-hop decode/re-encode, so all ranks
+        decode bitwise-identical values (replica consistency — the commit
+        protocol's premise).  For the bf16 wire, quantization and
+        accumulation are elementwise in fixed ring-step order, so striping
+        a chunk across lanes reproduces the single-lane result BIT FOR
+        BIT.  The int8 codec's scale is per-FRAME (amax over the encoded
+        chunk), so different lane/stripe configs produce slightly
+        different values — every rank must run the same config (already
+        the collective-wide contract), and a striped run is NOT
+        bit-comparable to a single-lane golden run under int8.
         """
         n = tier.size if tier is not None else self._world_size
         rank = tier.ring_rank if tier is not None else self._rank
         chunks = list(chunks)
-        encode, decode = self._codec(wire, acc_dtype)
+        encode, decode = self._codec(wire, acc_dtype, codec)
 
         # Reduce-scatter phase: after n-1 steps, chunk (rank+1)%n holds the
         # full reduction on this rank.
@@ -1300,7 +1423,7 @@ class TCPCollective(Collective):
             chunks[recv_idx] = combine(chunks[recv_idx], incoming)
 
         return self._ring_ag_phase(
-            chunks, wire, acc_dtype, lane, tag_base + ag_sub, tier
+            chunks, wire, acc_dtype, lane, tag_base + ag_sub, tier, codec=codec
         )
 
     def _ring_ag_phase(
@@ -1311,35 +1434,37 @@ class TCPCollective(Collective):
         lane: int,
         tag: int,
         tier: Optional[_TierLinks] = None,
+        codec: Optional[str] = None,
     ) -> List[np.ndarray]:
         """Allgather circulation over a ring (flat or a 2D tier): each rank
         owns chunk (rank+1)%n and the owned chunks circulate until every
         rank holds all n.  The ONE implementation of this phase — shared by
         _ring_rs_ag and the hierarchical pass's row allgather, so the wire
         framing and replica-consistency mechanics cannot diverge between
-        topologies.  With wire compression each owner quantizes its chunk
-        exactly once and every other rank forwards the received WIRE BYTES
-        untouched, so all ranks decode bitwise-identical values."""
-        from torchft_tpu.checkpointing.serialization import as_u8
-
+        topologies.  With wire compression (bf16 wire or an int8 codec)
+        each owner quantizes its chunk exactly once and every other rank
+        forwards the received WIRE BYTES untouched, so all ranks decode
+        bitwise-identical values."""
         n = tier.size if tier is not None else self._world_size
         rank = tier.ring_rank if tier is not None else self._rank
         chunks = list(chunks)
-        encode, decode = self._codec(wire, acc_dtype)
-        if wire is not None:
+        encode, decode = self._codec(wire, acc_dtype, codec)
+        if wire is not None or codec is not None:
             own = (rank + 1) % n
             raw_chunks: List[Optional[bytes]] = [None] * n
-            raw_chunks[own] = bytes(as_u8(chunks[own].astype(wire)))
+            enc = encode(chunks[own])
+            raw_chunks[own] = (
+                b"".join(bytes(p) for p in enc)
+                if isinstance(enc, (list, tuple))
+                else bytes(enc)
+            )
             for step in range(n - 1):
                 send_idx = (rank - step + 1) % n
                 recv_idx = (rank - step) % n
                 raw_chunks[recv_idx] = self._exchange(
                     tag, memoryview(cast(bytes, raw_chunks[send_idx])), lane, tier
                 )
-            return [
-                np.frombuffer(cast(bytes, raw_chunks[i]), dtype=wire).astype(acc_dtype)
-                for i in range(n)
-            ]
+            return [decode(cast(bytes, raw_chunks[i])) for i in range(n)]
         for step in range(n - 1):
             send_idx = (rank - step + 1) % n
             recv_idx = (rank - step) % n
@@ -1356,6 +1481,7 @@ class TCPCollective(Collective):
         acc_dtype,
         lane: int,
         tag_base: int,
+        codec: Optional[str] = None,
     ) -> np.ndarray:
         """One hierarchical (2D ring-of-rings) allreduce pass over a flat
         1-D buffer: reduce-scatter along the ROW ring, full allreduce of
@@ -1378,7 +1504,7 @@ class TCPCollective(Collective):
         col = cast(_TierLinks, self._col_tier)
         C, crank = row.size, row.ring_rank
         chunks = list(np.array_split(flat, C))
-        encode, decode = self._codec(wire, acc_dtype)
+        encode, decode = self._codec(wire, acc_dtype, codec)
 
         # Phase 1: row reduce-scatter — after C-1 hops this rank's owned
         # chunk holds the full reduction over its row.
@@ -1398,7 +1524,7 @@ class TCPCollective(Collective):
             sub = self._ring_rs_ag(
                 list(np.array_split(chunks[own], col.size)),
                 combine, wire, acc_dtype, lane, tag_base,
-                tier=col, rs_sub=_SUB_COL_RS, ag_sub=_SUB_COL_AG,
+                tier=col, rs_sub=_SUB_COL_RS, ag_sub=_SUB_COL_AG, codec=codec,
             )
             chunks[own] = np.concatenate(sub) if len(sub) > 1 else sub[0]
 
@@ -1408,7 +1534,8 @@ class TCPCollective(Collective):
         # decoded wire values that re-encode is an identity, so forwarded
         # bytes stay bitwise-identical everywhere).
         chunks = self._ring_ag_phase(
-            chunks, wire, acc_dtype, lane, tag_base + _SUB_AG, tier=row
+            chunks, wire, acc_dtype, lane, tag_base + _SUB_AG, tier=row,
+            codec=codec,
         )
         return np.concatenate(chunks) if C > 1 else chunks[0]
 
@@ -1441,6 +1568,7 @@ class TCPCollective(Collective):
         op: str,
         allow_wire_compression: bool = True,
         seq: Optional[int] = None,
+        codec: Optional[str] = None,
     ) -> List[np.ndarray]:
         """Single-lane whole-chunk ring allreduce (the lanes=1 path, and the
         building block reduce_scatter/barrier reuse)."""
@@ -1450,9 +1578,12 @@ class TCPCollective(Collective):
         combine = _REDUCE_COMBINE[op]
         flat = self._flatten(arrays)
         chunks = np.array_split(flat, n)
-        wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+        wire, acc_dtype = self._wire_for(
+            arrays, flat.dtype, allow_wire_compression and codec is None
+        )
         chunks = self._ring_rs_ag(
-            chunks, combine, wire, acc_dtype, lane=0, tag_base=self._tag_base(seq)
+            chunks, combine, wire, acc_dtype, lane=0,
+            tag_base=self._tag_base(seq), codec=codec,
         )
         return self._unflatten(np.concatenate(chunks), arrays, op)
 
@@ -1462,6 +1593,7 @@ class TCPCollective(Collective):
         op: str,
         allow_wire_compression: bool = True,
         seq: Optional[int] = None,
+        codec: Optional[str] = None,
     ) -> List[np.ndarray]:
         """Single-lane hierarchical (ring2d) allreduce — the lanes=1
         counterpart of _ring_allreduce, running one 2D pass over the whole
@@ -1470,9 +1602,12 @@ class TCPCollective(Collective):
             seq = self._next_seq()
         combine = _REDUCE_COMBINE[op]
         flat = self._flatten(arrays)
-        wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+        wire, acc_dtype = self._wire_for(
+            arrays, flat.dtype, allow_wire_compression and codec is None
+        )
         out = self._hier_rs_ag_flat(
-            flat, combine, wire, acc_dtype, lane=0, tag_base=self._tag_base(seq)
+            flat, combine, wire, acc_dtype, lane=0,
+            tag_base=self._tag_base(seq), codec=codec,
         )
         return self._unflatten(out, arrays, op)
 
@@ -1573,6 +1708,7 @@ class TCPCollective(Collective):
         op: str,
         allow_wire_compression: bool,
         seq: int,
+        codec: Optional[str] = None,
     ) -> Work:
         """Lanes > 1: stripe the ring chunks round-robin across lanes and run
         each stripe as an independent tagged ring on the per-lane worker
@@ -1584,7 +1720,9 @@ class TCPCollective(Collective):
         try:
             flat = self._flatten(arrays)
             chunks = np.array_split(flat, n)
-            wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+            wire, acc_dtype = self._wire_for(
+                arrays, flat.dtype, allow_wire_compression and codec is None
+            )
             nstripes = self._stripe_count(max(c.nbytes for c in chunks))
             # sub[i][s]: stripe s of rank-chunk i.  array_split depends only
             # on sizes derived from the (identical) flat length, so every
@@ -1602,6 +1740,7 @@ class TCPCollective(Collective):
                 acc_dtype,
                 lane=s % self._lanes,
                 tag_base=self._tag_base(seq, s),
+                codec=codec,
             )
 
         def assemble(results: List[Optional[object]]) -> List[np.ndarray]:
@@ -1623,6 +1762,7 @@ class TCPCollective(Collective):
         op: str,
         allow_wire_compression: bool,
         seq: int,
+        codec: Optional[str] = None,
     ) -> Work:
         """Lanes > 1 under the 2D topology: split the flat payload into
         stripes directly (stripe-major — each stripe runs the COMPLETE
@@ -1633,7 +1773,9 @@ class TCPCollective(Collective):
         combine = _REDUCE_COMBINE[op]
         try:
             flat = self._flatten(arrays)
-            wire, acc_dtype = self._wire_for(arrays, flat.dtype, allow_wire_compression)
+            wire, acc_dtype = self._wire_for(
+                arrays, flat.dtype, allow_wire_compression and codec is None
+            )
             row_cols = cast(_TierLinks, self._row_tier).size
             # Size stripes so each stripe's ROW chunk (its per-hop exchange
             # unit) lands near chunk_bytes, mirroring the flat path's
@@ -1652,6 +1794,7 @@ class TCPCollective(Collective):
                 acc_dtype,
                 lane=s % self._lanes,
                 tag_base=self._tag_base(seq, s),
+                codec=codec,
             )
 
         def assemble(results: List[Optional[object]]) -> List[np.ndarray]:
@@ -1900,6 +2043,36 @@ class ErrorSwallowingCollective(Collective):
         self._error = None
         self._inner.configure(store_addr, rank, world_size)
 
+    # Wire-policy probes proxy to the wrapped collective: layers above
+    # (GradientAverager's device wire prep, the semisync engine's codec
+    # gate, the Manager's wire-byte telemetry) discover capabilities via
+    # getattr — a wrapper that hides them would silently degrade the wire
+    # and fork the byte accounting.
+
+    @property
+    def wire_codecs(self):
+        return getattr(self._inner, "wire_codecs", ())
+
+    @property
+    def wire_dtype(self):
+        return getattr(self._inner, "wire_dtype", None)
+
+    def wire_nbytes(
+        self,
+        array,
+        allow_wire_compression: bool = True,
+        wire_codec: Optional[str] = None,
+    ) -> int:
+        probe = getattr(self._inner, "wire_nbytes", None)
+        if callable(probe):
+            # Forward the codec arg only when set, like every other call
+            # site — an inner collective with the pre-codec 2-arg probe
+            # signature must keep working for plain calls.
+            if wire_codec is not None:
+                return probe(array, allow_wire_compression, wire_codec)
+            return probe(array, allow_wire_compression)
+        return int(np.asarray(array).nbytes)
+
     def errored(self) -> Optional[Exception]:
         return self._error or self._inner.errored()
 
@@ -1935,9 +2108,14 @@ class ErrorSwallowingCollective(Collective):
         arrays: Sequence[np.ndarray],
         op: str = "sum",
         allow_wire_compression: bool = True,
+        wire_codec: Optional[str] = None,
     ) -> Work:
         return self._guard(
-            lambda: self._inner.allreduce(arrays, op, allow_wire_compression),
+            lambda: self._inner.allreduce(
+                arrays, op, allow_wire_compression, wire_codec=wire_codec
+            )
+            if wire_codec is not None
+            else self._inner.allreduce(arrays, op, allow_wire_compression),
             list(arrays),
         )
 

@@ -61,8 +61,13 @@ TPUFT_DEVICE_WIRE_PREP_ENV = "TPUFT_DEVICE_WIRE_PREP"
 TPUFT_SHARDED_FETCH_ENV = "TPUFT_SHARDED_FETCH"
 
 
-def _env_flag(name: str) -> bool:
-    return os.environ.get(name, "").strip().lower() in ("1", "true", "on", "yes")
+def _env_flag(name: str, default: bool = False) -> bool:
+    """Truthy env-flag parsing, shared with the semisync plane so the
+    accepted token set cannot drift between data planes."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip().lower() in ("1", "true", "on", "yes")
 
 
 class _Unresolved:

@@ -5,13 +5,20 @@ DiLoCo torchft/local_sgd.py:170-320).  Both run many inner optimizer steps
 locally and synchronize across replica groups only every ``sync_every``
 steps, with commit gating so a failed sync never corrupts the model.
 
+As of the streaming semi-sync subsystem (torchft_tpu/semisync), ``DiLoCo``
+here is a THIN WRAPPER: the old constructor, ``step()``/``sync()`` cadence,
+``backup_params`` accessor, and the ``"diloco"`` state-dict channel are
+preserved, but the data plane underneath is
+:class:`torchft_tpu.semisync.StreamingDiLoCo` in blocking mode
+(``stream=False``, ``codec="auto"``) — fragment-bucketed pseudogradient
+rounds through the striped ring instead of the per-leaf host allreduce the
+port started with.  New code that wants background fragment streaming and
+the int8+EF wire should use ``StreamingDiLoCo`` directly.
+
 JAX adaptation: instead of hooking a torch optimizer and mutating
 ``param.data`` in place, these classes own a reference to the training
 state through ``get_params``/``set_params`` callables (pytrees are
 immutable), and ``step()`` is called explicitly after each inner update.
-DiLoCo's device backup of the last-synced params is a host (numpy) pytree —
-the analogue of the reference's pinned-CPU backup tensors
-(torchft/local_sgd.py:205-222).
 
 Note on the pseudogradient sign: the DiLoCo paper (arXiv:2311.08105) defines
 the outer gradient as ``backup - local`` so that an SGD *descent* step moves
@@ -23,7 +30,7 @@ optimizer's configuration to compensate.  We implement the paper sign.
 from __future__ import annotations
 
 from types import TracebackType
-from typing import Any, Callable, List, Optional, Type
+from typing import Any, Callable, Optional, Type
 
 import numpy as np
 
@@ -31,11 +38,23 @@ from torchft_tpu.manager import Manager
 
 __all__ = ["LocalSGD", "DiLoCo"]
 
+# jax module cache: _tree_to_host runs on the sync path every round, and
+# the old per-call ``import jax`` paid an import-machinery lookup per sync
+# (plus one per leaf via np.asarray on trees that were ALREADY host
+# numpy).  Cached module + an isinstance skip make the host conversion
+# free for host trees.
+_jax_mod = None
+
 
 def _tree_to_host(tree: Any) -> Any:
-    import jax
+    global _jax_mod
+    if _jax_mod is None:
+        import jax
 
-    return jax.tree.map(np.asarray, tree)
+        _jax_mod = jax
+    return _jax_mod.tree.map(
+        lambda x: x if isinstance(x, np.ndarray) else np.asarray(x), tree
+    )
 
 
 class LocalSGD:
@@ -63,6 +82,11 @@ class LocalSGD:
         self._set_params = set_params
         self._sync_every = sync_every
         self._local_step = 0
+        # Hoisted out of the sync hot path: the old code constructed a
+        # fresh averager (and re-imported its module) inside every sync.
+        from torchft_tpu.ddp import PerLeafGradientAverager
+
+        self._averager = PerLeafGradientAverager(manager)
 
     def __enter__(self) -> "LocalSGD":
         return self
@@ -84,37 +108,74 @@ class LocalSGD:
 
     def sync(self) -> None:
         """Quorum + weight averaging + commit-gated copy-back
-        (reference: torchft/local_sgd.py:106-135)."""
-        self._manager.start_quorum()
-        averaged = self._average(self._get_params())
-        if self._manager.should_commit():
+        (reference: torchft/local_sgd.py:106-135).
+
+        Errors UP TO the commit vote LATCH on the manager and the step
+        counter resets in a ``finally``: a sync that dies mid-quorum on one
+        group must not leave that group's ``_local_step`` desynced from its
+        peers — all groups re-enter the next round on the same cadence, and
+        the latched error fails this round's commit instead of crashing the
+        loop (a rank that failed before voting still votes False, so
+        sibling local ranks never burn the full barrier timeout).  The
+        post-vote copy-back is OUTSIDE the latch: once peers were told we
+        committed, a failed ``set_params`` must crash (and heal back to the
+        committed weights), never be swallowed into silent divergence."""
+        from torchft_tpu.manager import ExceededMaxRetriesError
+
+        averaged = None
+        committed = False
+        voted = False
+        try:
+            self._manager.start_quorum()
+            averaged = self._average(self._get_params())
+            voted = True
+            committed = bool(self._manager.should_commit())
+        except ExceededMaxRetriesError:
+            # The give-up contract must still propagate: a loop configured
+            # with max_retries relies on this exception to terminate.
+            raise
+        except Exception as e:  # noqa: BLE001 — latch, never desync cadence
+            try:
+                self._manager.report_error(e)
+            except Exception:  # noqa: BLE001 — mocked managers
+                pass
+            if not voted:
+                # Sibling local ranks are already in the two-phase commit
+                # barrier; vote (False, via the latched error) instead of
+                # leaving them to time out round after round.
+                try:
+                    self._manager.should_commit()
+                except Exception:  # noqa: BLE001 — vote itself failing
+                    pass
+        finally:
+            self._local_step = 0
+        if committed and averaged is not None:
             self._set_params(averaged)
-        self._local_step = 0
 
     def _average(self, params: Any) -> Any:
-        from torchft_tpu.ddp import PerLeafGradientAverager
-
         # PARAMETERS, not gradients: opt out of lossy wire encodings —
         # bf16-per-hop rounding of the weights themselves would accumulate
-        # across syncs (gradient noise does not excuse it here).
-        return PerLeafGradientAverager(self._manager).allreduce(
-            params, allow_wire_compression=False
-        )
+        # across syncs (gradient noise does not excuse it here), and the
+        # int8+EF codec is gradient-only by the same argument.
+        return self._averager.allreduce(params, allow_wire_compression=False)
 
 
 class DiLoCo:
     """Inner/outer optimizer synchronization (reference:
     torchft/local_sgd.py:170-320; DiLoCo, arXiv:2311.08105).
 
-    Keeps a host backup of the last globally-committed params.  Every
-    ``sync_every`` inner steps: compute pseudogradients ``backup - local``,
-    allreduce-average them across groups, restore the backup params, and only
-    if the commit vote passes apply the outer optimizer (typically SGD with
-    Nesterov momentum) to the backup using the averaged pseudogradient.
+    Thin wrapper over :class:`torchft_tpu.semisync.StreamingDiLoCo` in
+    BLOCKING mode: the legacy call shape — quorum + pseudogradient
+    allreduce + commit-gated outer step, all inside ``sync()`` — with the
+    fragment-bucketed data plane underneath.  Keeps a host backup of the
+    last globally-committed params; every ``sync_every`` inner steps:
+    compute pseudogradients ``backup - local``, allreduce-average them
+    across groups, and only if the commit vote passes apply the outer
+    optimizer (typically SGD with Nesterov momentum) to the backup.
 
-    Requires synchronous quorum (``use_async_quorum=False``) exactly like the
-    reference (torchft/local_sgd.py:188-192): a healing group must have the
-    committed weights *before* computing its pseudogradient.
+    Requires synchronous quorum (``use_async_quorum=False``) exactly like
+    the reference (torchft/local_sgd.py:188-192): a healing group must have
+    the committed weights *before* computing its pseudogradient.
     """
 
     def __init__(
@@ -125,31 +186,25 @@ class DiLoCo:
         outer_tx: Any,
         sync_every: int,
     ) -> None:
-        if manager._use_async_quorum:
-            raise ValueError(
-                "DiLoCo requires synchronous quorum: construct the Manager "
-                "with use_async_quorum=False"
-            )
-        assert sync_every >= 1, "sync_every must be >= 1"
-        self._manager = manager
-        self._get_params = get_params
-        self._set_params = set_params
-        self._outer_tx = outer_tx
-        self._sync_every = sync_every
-        self._local_step = 0
+        from torchft_tpu.semisync import StreamingDiLoCo
 
-        # Host backup of the last-synced params (torchft/local_sgd.py:205-222).
-        self._backup = _tree_to_host(get_params())
-        self._outer_state = outer_tx.init(self._backup)
-
-        # The outer-loop state must travel with the model when a restarted
-        # group heals from a peer: a fresh-init backup would make the next
-        # sync compute pseudogradients against the wrong base and silently
-        # diverge (the reference's DiLoCo recovery test checkpoints
-        # original_parameters + outer optimizer state for exactly this,
-        # torchft/local_sgd_integ_test.py:124-158).
-        manager.register_state_dict_fn(
-            "diloco", self._load_outer_state, self._save_outer_state
+        # codec="auto" preserves the port's wire behavior (the collective's
+        # own policy: bf16 only on bandwidth-bound links); stream=False
+        # preserves the blocking sync-at-the-boundary cadence and the
+        # quorum/vote call pattern the wrapper tests pin; outer_scope=
+        # "tree" preserves the single whole-tree outer optimizer state —
+        # its exact semantics for cross-leaf-coupled transforms
+        # (global-norm clipping) AND its state-dict format (old durable
+        # checkpoints keep loading).
+        self._impl = StreamingDiLoCo(
+            manager,
+            get_params,
+            set_params,
+            outer_tx,
+            sync_every,
+            codec="auto",
+            stream=False,
+            outer_scope="tree",
         )
 
     def __enter__(self) -> "DiLoCo":
@@ -161,54 +216,21 @@ class DiLoCo:
         exc_value: Optional[BaseException],
         traceback: Optional[TracebackType],
     ) -> bool:
-        return False
+        return self._impl.__exit__(exc_type, exc_value, traceback)
 
     @property
     def backup_params(self) -> Any:
-        return self._backup
+        return self._impl.backup_params
 
     @backup_params.setter
     def backup_params(self, value: Any) -> None:
-        self._backup = _tree_to_host(value)
-
-    def _save_outer_state(self) -> Any:
-        return {
-            "backup": self._backup,
-            "outer_state": _tree_to_host(self._outer_state),
-        }
-
-    def _load_outer_state(self, state: Any) -> None:
-        self.backup_params = state["backup"]
-        self._outer_state = state["outer_state"]
+        self._impl.backup_params = value
 
     def step(self) -> None:
-        self._local_step += 1
-        if self._local_step >= self._sync_every:
-            self.sync()
+        self._impl.step()
 
     def sync(self) -> None:
-        """Pseudogradient sync (reference: torchft/local_sgd.py:277-303)."""
-        self._manager.start_quorum()
-        self._perform_sync()
-        self._local_step = 0
-
-    def _perform_sync(self) -> None:
-        import jax
-        import optax
-
-        from torchft_tpu.ddp import PerLeafGradientAverager
-
-        local = _tree_to_host(self._get_params())
-        pseudograds = jax.tree.map(lambda b, l: b - l, self._backup, local)
-
-        # Average pseudogradients across participating groups.
-        averaged = PerLeafGradientAverager(self._manager).allreduce(pseudograds)
-
-        if self._manager.should_commit():
-            updates, self._outer_state = self._outer_tx.update(
-                averaged, self._outer_state, self._backup
-            )
-            self._backup = optax.apply_updates(self._backup, updates)
-        # Commit or not, the live params are reset to the (possibly updated)
-        # last-committed weights (torchft/local_sgd.py:294-301).
-        self._set_params(self._backup)
+        """Pseudogradient sync (reference: torchft/local_sgd.py:277-303);
+        latches errors and resets the inner-step counter in a ``finally``
+        (see StreamingDiLoCo.sync)."""
+        self._impl.sync()

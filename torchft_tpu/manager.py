@@ -282,6 +282,11 @@ class Manager:
         # shows the scatter-back cost the allreduce_h2d span charges.
         self._d2h_bytes = 0
         self._h2d_bytes = 0
+        # Extra fields wrappers note onto the step in flight's step_summary
+        # (note_summary_fields) — the semisync engine's per-round fragment
+        # counts and wire bytes ride here.  Cleared with the other per-step
+        # accounting at start_quorum and flushed at the commit vote.
+        self._summary_extra: Dict[str, object] = {}
         self._wire_transport_spans()
 
     def _wire_transport_spans(self) -> None:
@@ -335,6 +340,7 @@ class Manager:
             self._ar_t_last = None
             self._d2h_bytes = 0
             self._h2d_bytes = 0
+            self._summary_extra = {}
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -705,6 +711,7 @@ class Manager:
         tensor,
         should_average: bool = True,
         allow_wire_compression: bool = True,
+        wire_codec: Optional[str] = None,
     ) -> Future:
         """Fault-tolerant gradient allreduce across replica groups.
 
@@ -716,6 +723,12 @@ class Manager:
         allow_wire_compression=False exempts this call from lossy wire
         encodings (TCPCollective wire_dtype="bf16") — required when the
         payload is parameters rather than gradients (LocalSGD sync).
+
+        wire_codec selects an explicit per-call wire encoding
+        (collectives.WIRE_CODECS; "int8" = per-chunk-scale symmetric int8,
+        ~0.25x the f32 wire) — the semisync pseudogradient plane's knob.
+        The kwarg is only forwarded when set, so swapped-in collectives
+        (tests, wrappers) keep the bare allreduce signature they mock.
         """
         if self.errored() is not None:
             return completed_future(tensor)
@@ -755,11 +768,14 @@ class Manager:
         # collectives without the probe count the handoff width.
         wire_nbytes = getattr(self._collective, "wire_nbytes", None)
         try:
-            ar_nbytes = (
-                int(wire_nbytes(host, allow_wire_compression))
-                if callable(wire_nbytes)
-                else int(host.nbytes)
-            )
+            if callable(wire_nbytes):
+                ar_nbytes = (
+                    int(wire_nbytes(host, allow_wire_compression, wire_codec))
+                    if wire_codec is not None
+                    else int(wire_nbytes(host, allow_wire_compression))
+                )
+            else:
+                ar_nbytes = int(host.nbytes)
         except Exception:  # noqa: BLE001 — telemetry only, never fail a step
             ar_nbytes = int(host.nbytes)
         with self._ar_lock:
@@ -768,9 +784,17 @@ class Manager:
             self._ar_bytes += ar_nbytes
 
         try:
-            work = self._collective.allreduce(
-                [host], op="sum", allow_wire_compression=allow_wire_compression
-            )
+            if wire_codec is not None:
+                work = self._collective.allreduce(
+                    [host],
+                    op="sum",
+                    allow_wire_compression=allow_wire_compression,
+                    wire_codec=wire_codec,
+                )
+            else:
+                work = self._collective.allreduce(
+                    [host], op="sum", allow_wire_compression=allow_wire_compression
+                )
 
             def normalize(results: List[np.ndarray]):
                 out = results[0]
@@ -832,6 +856,24 @@ class Manager:
         half of the round-trip the ``allreduce_h2d`` span charges."""
         with self._ar_lock:
             self._h2d_bytes += int(nbytes)
+
+    def note_summary_fields(self, **fields: object) -> None:
+        """Merges extra fields into the step in flight's ``step_summary``
+        record (flushed at the commit vote, cleared at start_quorum).
+        Wrappers with their own data plane (the semisync engine) use this
+        to land per-round accounting — fragment counts, codec, wire
+        bytes — in the same record the phase breakdown rides."""
+        with self._ar_lock:
+            self._summary_extra.update(fields)
+
+    @property
+    def metrics(self):
+        """The Manager's :class:`~torchft_tpu.metrics.MetricsLogger`.
+        Public so wrappers that run their own data plane (the semisync
+        engine) can emit registered events into the SAME stream the
+        Manager's spans and lifecycle events ride — one timeline per
+        replica, not a side channel."""
+        return self._metrics
 
     @property
     def spans(self):
@@ -918,11 +960,13 @@ class Manager:
             ar_bytes, ar_t_first = self._ar_bytes, self._ar_t_first
             ar_t_last = self._ar_t_last
             d2h_bytes, h2d_bytes = self._d2h_bytes, self._h2d_bytes
+            summary_extra = self._summary_extra
             self._ar_bytes, self._ar_t_first = 0, None
             self._ar_t_last = None
             self._d2h_bytes = 0
             self._h2d_bytes = 0
-        ar_fields: Dict[str, object] = {}
+            self._summary_extra = {}
+        ar_fields: Dict[str, object] = dict(summary_extra)
         if d2h_bytes or h2d_bytes:
             ar_fields["d2h_bytes"] = d2h_bytes
             ar_fields["h2d_bytes"] = h2d_bytes

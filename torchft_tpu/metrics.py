@@ -70,6 +70,11 @@ EVENTS = {
              "into the stream so trace export and latency accounting see it",
     "straggler_drain": "launcher sentinel rotated a confirmed straggler out "
                        "through the cooperative-drain path",
+    # -- streaming semi-sync (torchft_tpu/semisync) -------------------------
+    "semisync_round": "one outer DiLoCo round finished (committed, "
+                      "fragments, wire_bytes, codec, residual_l2) — the "
+                      "per-round accounting of the background fragment "
+                      "sync plane",
     # -- HA lighthouse (torchft_tpu/ha/replica.py) --------------------------
     "lighthouse_failover": "a standby lighthouse took over leadership "
                            "(leader_epoch = the new lease epoch); "

@@ -37,6 +37,8 @@ import json
 import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from torchft_tpu.obs.spans import OVERLAPPED_PHASES
+
 __all__ = [
     "read_events",
     "commit_timelines",
@@ -290,10 +292,11 @@ def deadwindow(
 
 # Phases that run on background threads CONCURRENT with the train step
 # (torchft_tpu/obs/spans.py OVERLAPPED_PHASES): the donor-side async
-# snapshot flatten.  They are reported (snapshot_overlap_s) but never
-# charged against productive wall time — subtracting an overlapped span
-# from the step interval would fabricate FT cost that the async pipeline
-# specifically does not impose.
+# snapshot flatten and the semisync engine's background fragment rounds
+# (outer_sync).  They are reported (snapshot_overlap_s sums all of them)
+# but never charged against productive wall time — subtracting an
+# overlapped span from the step interval would fabricate FT cost that the
+# async pipeline specifically does not impose.
 #
 # NOT in this tuple: ``allreduce_d2h`` / ``allreduce_h2d``, the
 # GradientAverager's per-bucket device->host fetch and the result
@@ -303,7 +306,10 @@ def deadwindow(
 # ``other_ft`` — FT overhead, never productive.  Moving either here would
 # inflate productive time by exactly the transfer stall and break the
 # dead-window math bench.py reproduces from these streams.
-_OVERLAPPED = ("snapshot",)
+# Aliased from the one registry (obs/spans.py), not duplicated: a phase
+# added to OVERLAPPED_PHASES but missed here would be charged against
+# productive wall time — fabricated FT cost.
+_OVERLAPPED = OVERLAPPED_PHASES
 
 # Phase ms a legacy (pre-span) stream carries on its lifecycle events,
 # mapped onto span phase names so old recordings still attribute.

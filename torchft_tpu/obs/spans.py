@@ -54,7 +54,11 @@ __all__ = [
 # snapshotter — an OVERLAPPED phase (it runs concurrently with the train
 # step, so report.py shows it but does not charge it against productive
 # time; a snapshot span on the critical path is exactly the regression the
-# async pipeline exists to prevent).
+# async pipeline exists to prevent); outer_sync = one fragment's
+# background pseudogradient round on the semisync engine's worker
+# (torchft_tpu/semisync) — OVERLAPPED for the same reason: it runs
+# concurrent with inner steps, and only the round-end drain (charged as
+# allreduce_merge) ever blocks the train thread.
 PHASES = (
     "quorum",
     "configure",
@@ -64,11 +68,12 @@ PHASES = (
     "allreduce_merge",
     "commit_vote",
     "snapshot",
+    "outer_sync",
 )
 
 # Phases that run on background threads concurrent with compute: report.py
 # excludes these from per-step critical-path attribution.
-OVERLAPPED_PHASES = ("snapshot",)
+OVERLAPPED_PHASES = ("snapshot", "outer_sync")
 
 
 class Span:

@@ -60,6 +60,9 @@ PHASE_TRACKS = {
     "allreduce_merge": "main",
     "commit_vote": "main",
     "snapshot": "background",
+    # The semisync engine's fragment rounds run on its worker thread,
+    # concurrent with inner compute — same sub-track as the snapshotter.
+    "outer_sync": "background",
 }
 
 # Events rendered as instant markers on the emitting replica's track (or

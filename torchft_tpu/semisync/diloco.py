@@ -1,0 +1,535 @@
+"""Streaming semi-sync DiLoCo: fragment-synced outer rounds that overlap
+inner steps.
+
+The blocking DiLoCo port synchronized like DDP: at every ``sync_every``-th
+inner step the whole pytree was hosted, pushed through a synchronous
+allreduce, and the train loop stalled for the full cross-region
+round-trip.  This class is the WAN-native rebuild (DiLoCo,
+arXiv:2311.08105; Streaming DiLoCo, arXiv:2501.18512):
+
+  - the outer state is fragmented on the shared bucket planner
+    (semisync/fragments.py — ``ddp.plan_buckets`` underneath);
+  - each round's quorum is started at the ROUND boundary (sync quorum:
+    a healing group has the committed weights before any pseudogradient);
+  - each fragment's pseudogradient round — codec encode (int8+EF / bf16 /
+    f32, semisync/codec.py) then a quorum-scoped reduce-scatter+allgather
+    over the striped multi-lane ring (``ring2d`` at high group counts) —
+    runs on the engine's background worker at a staggered inner-step slot,
+    so wire time hides behind the remaining inner compute;
+  - the per-fragment outer optimizer (one optax state per fragment) is
+    applied ONLY after the round's commit vote passes, so a failed sync
+    never corrupts the model, the backup, or the outer state — and the
+    backup + outer states travel with heals through the same
+    ``register_state_dict_fn`` channel the blocking port used.
+
+``torchft_tpu.local_sgd.DiLoCo`` remains as a thin wrapper (stream=False,
+codec="auto"): the old API and blocking semantics, now running on this
+engine.
+
+Knobs (all overridable per-instance):
+  TPUFT_SEMISYNC_CODEC           int8 | bf16 | f32 | auto   (default int8)
+  TPUFT_SEMISYNC_FRAGMENT_BYTES  fragment size              (default 4 MiB)
+  TPUFT_SEMISYNC_STREAM          1 = background streaming   (default 1)
+  TPUFT_SEMISYNC_METRICS_PORT    serve tpuft_semisync_* /metrics (unset=off)
+"""
+
+from __future__ import annotations
+
+import os
+from types import TracebackType
+from typing import Any, Callable, Dict, List, Optional, Type
+
+import numpy as np
+
+from torchft_tpu.ddp import _env_flag
+from torchft_tpu.semisync.codec import (
+    CODECS,
+    TPUFT_SEMISYNC_CODEC_ENV,
+    make_codec,
+)
+from torchft_tpu.semisync.engine import SyncEngine
+from torchft_tpu.semisync.fragments import FragmentPlan
+from torchft_tpu.semisync.metrics import SemiSyncMetrics
+
+__all__ = ["StreamingDiLoCo", "TPUFT_SEMISYNC_STREAM_ENV"]
+
+TPUFT_SEMISYNC_STREAM_ENV = "TPUFT_SEMISYNC_STREAM"
+
+
+def _codec_from_env(explicit: Optional[str]) -> str:
+    if explicit is not None:
+        if explicit not in CODECS:
+            raise ValueError(
+                f"unknown semisync codec {explicit!r}; expected one of {CODECS}"
+            )
+        return explicit
+    raw = os.environ.get(TPUFT_SEMISYNC_CODEC_ENV, "").strip().lower()
+    if not raw:
+        return "int8"
+    if raw not in CODECS:
+        # Unlike a numeric tuning knob, a typo'd codec name must NOT fall
+        # back silently: the default is LOSSY, so "fp32" quietly becoming
+        # int8 would be the exact encoding the user tried to disable.
+        # Construction time, not step time — failing loud here is safe.
+        raise ValueError(
+            f"${TPUFT_SEMISYNC_CODEC_ENV}={raw!r} is not a semisync codec; "
+            f"expected one of {CODECS}"
+        )
+    return raw
+
+
+class StreamingDiLoCo:
+    """Fragment-streamed DiLoCo (see module docstring).
+
+    Usage matches the blocking port::
+
+        with StreamingDiLoCo(manager, get_params, set_params,
+                             outer_tx=optax.sgd(0.7, momentum=0.9,
+                                                nesterov=True),
+                             sync_every=100) as diloco:
+            for batch in data:
+                params = inner_update(params, batch)
+                diloco.step()        # counts, streams fragments, maybe syncs
+
+    Requires synchronous quorum (``use_async_quorum=False``) exactly like
+    the blocking port: a healing group must hold the committed weights
+    before computing its pseudogradient.
+    """
+
+    def __init__(
+        self,
+        manager,
+        get_params: Callable[[], Any],
+        set_params: Callable[[Any], None],
+        outer_tx: Any,
+        sync_every: int,
+        fragment_bytes: Optional[int] = None,
+        codec: Optional[str] = None,
+        stream: Optional[bool] = None,
+        outer_scope: str = "fragment",
+        state_dict_key: str = "diloco",
+    ) -> None:
+        """``outer_scope``: "fragment" (default) keeps one optax state per
+        fragment and applies the outer update fragment-locally — the
+        Streaming DiLoCo shape, required so fragments can eventually apply
+        independently.  "tree" runs ONE outer_tx over the whole
+        pseudogradient tree at the round boundary — the blocking port's
+        exact semantics (and its state-dict format), which outer
+        transforms with CROSS-LEAF coupling (global-norm clipping) depend
+        on; the legacy ``DiLoCo`` wrapper uses this."""
+        if manager._use_async_quorum:
+            raise ValueError(
+                "StreamingDiLoCo requires synchronous quorum: construct the "
+                "Manager with use_async_quorum=False"
+            )
+        assert sync_every >= 1, "sync_every must be >= 1"
+        self._manager = manager
+        self._get_params = get_params
+        self._set_params = set_params
+        self._outer_tx = outer_tx
+        self._sync_every = sync_every
+        self._local_step = 0
+        self._armed = False
+        self._issued: set = set()
+        self._arm_attempted = False
+        self._round_closed = False
+        self._voted = False
+        self._vote_passed = False
+
+        self._codec_name = _codec_from_env(codec)
+        self._stream = (
+            bool(stream)
+            if stream is not None
+            else _env_flag(TPUFT_SEMISYNC_STREAM_ENV, True)
+        )
+
+        # Host backup of the last-synced params; the flat leaf list is the
+        # canonical copy, the tree is derived.  The one jax import here is
+        # construction-time, not hot-path.
+        import jax
+
+        self._jax = jax
+        leaves, self._treedef = jax.tree.flatten(get_params())
+        self._leaves: List[np.ndarray] = [
+            l if isinstance(l, np.ndarray) else np.asarray(l) for l in leaves
+        ]
+        metas = [(tuple(l.shape), np.dtype(l.dtype)) for l in self._leaves]
+        self._plan = FragmentPlan(metas, fragment_bytes)
+        self._schedule = self._plan.schedule(sync_every)
+
+        self._codecs = [
+            make_codec(self._codec_name, f) for f in self._plan.fragments
+        ]
+        for frag, c in zip(self._plan.fragments, self._codecs):
+            c.set_backup(frag.pack(self._leaves))
+
+        # One outer optimizer state PER FRAGMENT (a fragment's leaf list is
+        # its own optax pytree) in "fragment" scope: the outer update
+        # applies fragment-locally after the commit vote, so a
+        # partially-failed round can never leave the optimizer state
+        # half-advanced.  "tree" scope keeps the blocking port's single
+        # whole-tree state.
+        if outer_scope not in ("fragment", "tree"):
+            raise ValueError(
+                f"outer_scope must be 'fragment' or 'tree', got {outer_scope!r}"
+            )
+        self._outer_scope = outer_scope
+        if outer_scope == "fragment":
+            self._outer_states: Any = [
+                outer_tx.init([self._leaves[i] for i in f.bucket.indices])
+                for f in self._plan.fragments
+            ]
+        else:
+            self._outer_states = outer_tx.init(self.backup_params)
+
+        replica_id = ""
+        try:
+            replica_id = manager.replica_id()
+        except Exception:  # noqa: BLE001 — mocked managers
+            pass
+        self.metrics = SemiSyncMetrics(
+            codec=self._codec_name, replica_id=str(replica_id)
+        )
+        self.metrics.serve()
+        self._engine = SyncEngine(
+            manager, self._codecs, stream=self._stream, metrics=self.metrics
+        )
+
+        # The outer-loop state must travel with the model when a restarted
+        # group heals from a peer: a fresh-init backup would make the next
+        # sync compute pseudogradients against the wrong base and silently
+        # diverge (the divergence mode tests/test_semisync.py pins with a
+        # mid-round kill).
+        manager.register_state_dict_fn(
+            state_dict_key, self._load_outer_state, self._save_outer_state
+        )
+
+    # -- context manager ----------------------------------------------------
+
+    def __enter__(self) -> "StreamingDiLoCo":
+        return self
+
+    def __exit__(
+        self,
+        exc_type: Optional[Type[BaseException]],
+        exc_value: Optional[BaseException],
+        traceback: Optional[TracebackType],
+    ) -> bool:
+        self._engine.shutdown()
+        self.metrics.close()
+        return False
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def backup_params(self) -> Any:
+        return self._jax.tree.unflatten(self._treedef, list(self._leaves))
+
+    @backup_params.setter
+    def backup_params(self, value: Any) -> None:
+        leaves, _ = self._jax.tree.flatten(value)
+        self._leaves = [
+            l if isinstance(l, np.ndarray) else np.asarray(l) for l in leaves
+        ]
+        self._refresh_codec_backups()
+
+    @property
+    def codec_name(self) -> str:
+        return self._codec_name
+
+    @property
+    def num_fragments(self) -> int:
+        return len(self._plan)
+
+    @property
+    def plan(self) -> FragmentPlan:
+        return self._plan
+
+    def _refresh_codec_backups(self) -> None:
+        for frag, c in zip(self._plan.fragments, self._codecs):
+            c.set_backup(frag.pack(self._leaves))
+
+    # -- heal-consistency state ---------------------------------------------
+
+    def _save_outer_state(self) -> Any:
+        from torchft_tpu.local_sgd import _tree_to_host
+
+        return {
+            "backup": self.backup_params,
+            "outer_state": _tree_to_host(self._outer_states),
+            # Explicit format marker: a heuristic over the state's pytree
+            # shape cannot distinguish a whole-tree optax tuple from a
+            # per-fragment list reliably (a 2-transform chain state IS a
+            # 2-tuple).  Absent key = a legacy (pre-semisync) checkpoint,
+            # which was always whole-tree.
+            "outer_scope": self._outer_scope,
+        }
+
+    def _load_outer_state(self, state: Any) -> None:
+        # Validate BEFORE mutating anything: a mismatched format indexed by
+        # the other scope's apply path would raise a confusing optax pytree
+        # error at the NEXT commit, after the vote already passed — and a
+        # half-applied load (new backup, old outer states) must never be
+        # left behind.  The raise latches at the heal site, fails every
+        # commit until the deployment mismatch is fixed (or max_retries
+        # terminates the loop) — degraded-loud, never silently divergent.
+        saved_scope = state.get("outer_scope", "tree")
+        if saved_scope != self._outer_scope:
+            raise ValueError(
+                f"diloco state dict carries outer_scope={saved_scope!r} "
+                f"outer state but this instance runs "
+                f"outer_scope={self._outer_scope!r}; construct with the "
+                "matching scope (the legacy DiLoCo wrapper is 'tree') or "
+                "re-checkpoint"
+            )
+        self.backup_params = state["backup"]
+        self._outer_states = state["outer_state"]
+        # EF residuals are replica-local transmission state, not model
+        # state: a healed group starts with clean residuals (its peers'
+        # residuals describe THEIR untransmitted remainders).
+        for c in self._codecs:
+            c.on_abort()
+
+    # -- train-loop API -----------------------------------------------------
+
+    def step(self) -> None:
+        """Call after each inner optimizer step.  In stream mode this arms
+        the round's quorum at the first inner step and issues fragments at
+        their scheduled slots; the final step of the round runs
+        :meth:`sync`."""
+        if (
+            self._stream
+            and not self._armed
+            and not self._arm_attempted
+            and len(self._plan)
+        ):
+            # Arm the round before any fragment leaves: sync quorum applies
+            # heals eagerly, so every pseudogradient this round is computed
+            # against committed weights.  Latched like every other
+            # sync-path error — a transient quorum failure here must not
+            # crash the train loop when the same failure at sync() time
+            # would not.  ONE attempt per round (_arm_attempted): retrying
+            # on every inner step would turn a lighthouse outage into up
+            # to sync_every x quorum_timeout of train-thread stall per
+            # round; sync() makes the round's second (and last) attempt
+            # inside its own latch.
+            self._arm_attempted = True
+            try:
+                self._manager.start_quorum()
+                self._armed = True
+                self._engine.begin_round()
+            except Exception as e:  # noqa: BLE001 — latch, keep cadence
+                try:
+                    self._manager.report_error(e)
+                except Exception:  # noqa: BLE001 — mocked managers
+                    pass
+        self._local_step += 1
+        if self._stream and self._armed:
+            due = [
+                f
+                for f in self._schedule.get(self._local_step, ())
+                if f.index not in self._issued
+            ]
+            if due:
+                # One flatten per slot, however many fragments share it —
+                # this runs on the train-thread hot path.
+                leaves = self._jax.tree.flatten(self._get_params())[0]
+                for frag in due:
+                    self._issued.add(frag.index)
+                    self._engine.submit(frag, leaves)
+        if self._local_step >= self._sync_every:
+            self.sync()
+
+    def sync(self) -> None:
+        """Finishes the round: drains in-flight fragments, votes, and
+        applies the per-fragment outer updates only on a passed vote.
+        Errors anywhere in the round LATCH on the manager and the counter
+        resets in a ``finally`` — every group re-enters the next round on
+        the same cadence even when a sync dies mid-quorum."""
+        from torchft_tpu.manager import ExceededMaxRetriesError
+
+        self._round_closed = False
+        self._voted = False
+        self._vote_passed = False
+        try:
+            self._sync_inner()
+        except ExceededMaxRetriesError:
+            # The give-up contract must still propagate: a loop configured
+            # with max_retries relies on this exception to terminate.
+            raise
+        except Exception as e:  # noqa: BLE001 — latch, never desync cadence
+            if self._vote_passed:
+                # Peers were already told this round committed; swallowing
+                # a post-vote apply failure would leave THIS group on
+                # different weights with every later vote passing — crash
+                # instead, and heal back to the committed state.
+                raise
+            try:
+                self._manager.report_error(e)
+            except Exception:  # noqa: BLE001 — mocked managers
+                pass
+            # Quiesce the worker BEFORE touching round state: an in-flight
+            # fragment round re-sets pending residuals and writes results;
+            # aborting under it would race, and a stale result could bleed
+            # into the next round's result map.
+            try:
+                self._engine.drain()
+            except Exception:  # noqa: BLE001 — mocked managers
+                pass
+            if not self._voted:
+                # Sibling local ranks are already in the two-phase commit
+                # barrier; vote (False, via the latched error) instead of
+                # leaving them to time out round after round.
+                try:
+                    self._manager.should_commit()
+                except Exception:  # noqa: BLE001 — vote itself failing
+                    pass
+            if not self._round_closed:
+                self._engine.end_round(committed=False)
+            try:
+                self._set_params(self.backup_params)
+            except Exception:  # noqa: BLE001 — leave local params standing
+                pass
+        finally:
+            self._local_step = 0
+            self._armed = False
+            self._arm_attempted = False
+            self._issued = set()
+
+    def _sync_inner(self) -> None:
+        if not self._armed:
+            self._manager.start_quorum()
+            self._armed = True
+            self._engine.begin_round()
+        # Any fragment not yet streamed goes now (all of them in blocking
+        # mode; stragglers whose slot never ticked in stream mode).
+        leaves = None
+        for frag in self._plan.fragments:
+            if frag.index not in self._issued:
+                self._issued.add(frag.index)
+                if leaves is None:
+                    leaves = self._jax.tree.flatten(self._get_params())[0]
+                self._engine.submit(frag, leaves)
+
+        results = self._engine.drain()
+        # Summary fields must land BEFORE the vote: should_commit flushes
+        # this step's step_summary record.  The round's step is captured
+        # here too — a committed vote advances current_step(), and the
+        # semisync_round event must join against the SAME step the round's
+        # spans and commit records carry.
+        stats = self._engine.round_stats()
+        self._note_summary(stats)
+        try:
+            round_step = int(self._manager.current_step())
+        except (TypeError, ValueError):  # mocked managers
+            round_step = -1
+        self._voted = True
+        committed = bool(self._manager.should_commit())
+        self._vote_passed = committed
+        if committed:
+            self._apply(results)
+        self._engine.end_round(committed=committed)
+        self._round_closed = True
+        self._emit_round(stats, committed, round_step)
+        # Commit or not, the live params reset to the (possibly updated)
+        # last-committed weights — the blocking port's contract.
+        self._set_params(self.backup_params)
+
+    def _apply(self, results: Dict[int, np.ndarray]) -> None:
+        """Outer optimizer step on the averaged pseudogradients —
+        per-fragment or whole-tree per ``outer_scope``.  Deterministic
+        given identical inputs, and the ring guarantees bitwise-identical
+        averages on every group — so all groups land bitwise-identical
+        backups (the replica-consistency property the integration tests
+        pin)."""
+        import optax
+
+        if self._outer_scope == "tree":
+            # Assemble the full pseudogradient tree and run ONE update —
+            # the blocking port's semantics; outer transforms with
+            # cross-leaf coupling (global-norm clipping) need this.
+            pg_leaves: List[np.ndarray] = [
+                np.zeros_like(l) for l in self._leaves
+            ]
+            for frag in self._plan.fragments:
+                flat = results.get(frag.index)
+                if flat is None:
+                    continue
+                for i, arr in frag.unpack(flat):
+                    pg_leaves[i] = np.ascontiguousarray(arr)
+            pg_tree = self._jax.tree.unflatten(self._treedef, pg_leaves)
+            backup_tree = self.backup_params
+            updates, self._outer_states = self._outer_tx.update(
+                pg_tree, self._outer_states, backup_tree
+            )
+            new_tree = optax.apply_updates(backup_tree, updates)
+            self._leaves = [
+                np.asarray(l) for l in self._jax.tree.flatten(new_tree)[0]
+            ]
+            self._refresh_codec_backups()
+            return
+        for k, frag in enumerate(self._plan.fragments):
+            flat = results.get(frag.index)
+            if flat is None:
+                continue
+            pg_leaves = [
+                np.ascontiguousarray(arr) for _i, arr in frag.unpack(flat)
+            ]
+            backup_leaves = [self._leaves[i] for i in frag.bucket.indices]
+            updates, self._outer_states[k] = self._outer_tx.update(
+                pg_leaves, self._outer_states[k], backup_leaves
+            )
+            new_leaves = optax.apply_updates(backup_leaves, updates)
+            for i, nl in zip(frag.bucket.indices, new_leaves):
+                self._leaves[i] = np.asarray(nl)
+        self._refresh_codec_backups()
+
+    def _note_summary(self, stats: Dict[str, int]) -> None:
+        """Round accounting into the step in flight's step_summary — must
+        run before the commit vote flushes that record."""
+        note = getattr(self._manager, "note_summary_fields", None)
+        if callable(note):
+            try:
+                note(
+                    semisync_fragments=stats["fragments"],
+                    semisync_wire_bytes=stats["wire_bytes"],
+                    semisync_codec=self._codec_name,
+                )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+
+    def _emit_round(
+        self, stats: Dict[str, int], committed: bool, round_step: int
+    ) -> None:
+        """The per-round metrics event; the int8 residual norm rides as a
+        gauge."""
+        manager = self._manager
+        residual_l2 = 0.0
+        # The residual norm costs a per-fragment device reduction; only
+        # pay it when somebody can actually read it (the JSONL stream or
+        # the Prometheus endpoint).
+        want_residual = self.metrics.serving
+        try:
+            want_residual = want_residual or bool(manager.metrics.enabled)
+        except Exception:  # noqa: BLE001 — mocked managers
+            pass
+        if want_residual:
+            for c in self._codecs:
+                fn = getattr(c, "residual_l2", None)
+                if callable(fn):
+                    residual_l2 += float(fn())
+            self.metrics.observe_residual(residual_l2)
+        try:
+            manager.metrics.emit(
+                "semisync_round",
+                step=round_step,
+                committed=committed,
+                fragments=stats["fragments"],
+                wire_bytes=stats["wire_bytes"],
+                d2h_bytes=stats["d2h_bytes"],
+                codec=self._codec_name,
+                streamed=self._stream,
+                residual_l2=round(residual_l2, 6),
+            )
+        except Exception:  # noqa: BLE001 — mocked managers / telemetry only
+            pass

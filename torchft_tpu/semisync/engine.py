@@ -1,0 +1,257 @@
+"""The background fragment-sync engine of the streaming semi-sync plane.
+
+One engine per :class:`~torchft_tpu.semisync.diloco.StreamingDiLoCo`: the
+train loop hands it (fragment, live-leaf snapshot) pairs at the fragment's
+scheduled inner-step slot, and the engine runs the fragment's
+pseudogradient round — device/host encode through the fragment's codec,
+then a quorum-scoped cross-group allreduce via ``Manager.allreduce`` (so
+participation zeroing, participant averaging, deadline guarding, error
+LATCHING, and the commit-vote drain all behave exactly like the gradient
+plane) — on a single background worker thread while inner steps keep
+running.
+
+Ordering contract: the one-worker executor serializes fragment rounds in
+submission order, and the fragment schedule is derived identically on
+every group from (tree signature, sync_every) — so each group issues the
+same sequence of ring ops in the same order, which is the cross-rank tag
+alignment the striped ring requires (same contract as DDP bucket order).
+
+Observability: each fragment round runs inside an ``outer_sync`` span —
+an OVERLAPPED phase (obs/spans.py): it lives on the worker thread,
+concurrent with inner compute, so report.py shows it without charging it
+against productive time.  The round-end drain (the only part that blocks
+the train thread) is charged as ``allreduce_merge``.  Per-round fragment
+counts/bytes land in step_summary via ``Manager.note_summary_fields`` and
+as a ``semisync_round`` metrics event.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu.semisync.codec import FragmentCodec
+from torchft_tpu.semisync.fragments import Fragment
+from torchft_tpu.semisync.metrics import SemiSyncMetrics
+
+__all__ = ["SyncEngine"]
+
+
+class SyncEngine:
+    """Streams fragment pseudogradient rounds in the background.
+
+    ``stream=False`` runs every fragment inline on the caller's thread
+    (the blocking legacy-port shape — still fragment-bucketed, still
+    codec-encoded, just not overlapped); this is what the thin ``DiLoCo``
+    wrapper uses, and what keeps the engine fully functional against
+    mocked managers in unit tests.
+    """
+
+    def __init__(
+        self,
+        manager,
+        codecs: Sequence[FragmentCodec],
+        stream: bool,
+        metrics: Optional[SemiSyncMetrics] = None,
+    ) -> None:
+        self._manager = manager
+        self._codecs = list(codecs)
+        self._stream = bool(stream)
+        self.metrics = metrics if metrics is not None else SemiSyncMetrics()
+        self._worker: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix="tpuft_semisync")
+            if self._stream
+            else None
+        )
+        self._lock = threading.Lock()
+        self._futures: List[Future] = []
+        self._results: Dict[int, np.ndarray] = {}
+        self._round_wire_bytes = 0
+        self._round_d2h_bytes = 0
+        self._round_fragments = 0
+        self._round_overlap_ms = 0.0
+
+    # -- round lifecycle ----------------------------------------------------
+
+    def begin_round(self) -> None:
+        with self._lock:
+            self._futures = []
+            self._results = {}
+            self._round_wire_bytes = 0
+            self._round_d2h_bytes = 0
+            self._round_fragments = 0
+            self._round_overlap_ms = 0.0
+
+    def submit(self, fragment: Fragment, leaves: Sequence[Any]) -> None:
+        """Issues one fragment's pseudogradient round.  ``leaves`` is the
+        full live leaf list at the fragment's slot (jax arrays are
+        immutable, so holding the refs IS the snapshot; host numpy leaves
+        are COPIED here — a train loop mutating them in place must not
+        race the worker's encode into a torn pseudogradient).  Returns
+        immediately in stream mode; runs inline otherwise."""
+        if self._worker is not None:
+            snap = list(leaves)
+            for i in fragment.bucket.indices:
+                if isinstance(snap[i], np.ndarray):
+                    snap[i] = np.array(snap[i], copy=True)
+            fut = self._worker.submit(self._sync_fragment, fragment, snap)
+            with self._lock:
+                self._futures.append(fut)
+        else:
+            self._sync_fragment(fragment, leaves)
+
+    def _sync_fragment(self, fragment: Fragment, leaves: Sequence[Any]) -> None:
+        manager = self._manager
+        codec = self._codecs[fragment.index]
+        # Phase attribution follows the THREAD, not the feature: on the
+        # worker the round is overlapped with inner compute (outer_sync,
+        # never charged); inline (blocking mode) the same work stalls the
+        # TRAIN thread and must be charged as FT time — outer_sync here
+        # would hide the blocking port's whole stall from report.py and
+        # inflate the straggler sentinel's busy-time by exactly that
+        # stall.  allreduce_merge is the phase the old blocking port's
+        # drain charged.
+        phase = "outer_sync" if self._worker is not None else "allreduce_merge"
+        with manager.spans.span(
+            phase,
+            step=manager.current_step(),
+            fragment=fragment.index,
+            codec=codec.name,
+        ) as sp:
+            participating = bool(manager.is_participating())
+            if participating:
+                payload, d2h = codec.encode(leaves)
+            else:
+                # Healing / spare groups must still ride the ring (the op
+                # count AND each rank's payload dtype are part of the
+                # cross-rank frame contract — hence the codec's dtype, not
+                # a hardcoded f32) but contribute zeros and keep their EF
+                # state untouched.
+                payload, d2h = codec.zero_payload(), 0
+            wire_codec = codec.wire_codec
+            if wire_codec is not None and not self._collective_supports(wire_codec):
+                # Source-side quantization (+ error feedback) already
+                # happened in the codec; the ring just won't re-encode —
+                # degrade to the collective's own wire policy.
+                wire_codec = None
+            if wire_codec is not None:
+                fut = manager.allreduce(
+                    payload,
+                    allow_wire_compression=codec.allow_wire_compression,
+                    wire_codec=wire_codec,
+                )
+            else:
+                fut = manager.allreduce(
+                    payload,
+                    allow_wire_compression=codec.allow_wire_compression,
+                )
+            # Block the WORKER (not the train thread) until the averaged
+            # fragment lands; failures resolve to the input with the error
+            # latched on the manager — the commit vote turns that into a
+            # discarded round, never a crash.
+            res = fut.result()
+            wire = self._wire_nbytes(payload, codec, wire_codec)
+            sp.fields["bytes"] = wire
+            with self._lock:
+                self._results[fragment.index] = np.asarray(res)
+                self._round_wire_bytes += wire
+                self._round_d2h_bytes += int(d2h)
+                self._round_fragments += 1
+            if d2h:
+                note = getattr(manager, "note_d2h", None)
+                if callable(note):
+                    try:
+                        note(int(d2h))
+                    except Exception:  # noqa: BLE001 — telemetry only
+                        pass
+            self.metrics.observe_fragment(wire_bytes=wire, d2h_bytes=int(d2h))
+        # duration_ms is valid once the span's `with` block exits; the sum
+        # over the round feeds the tpuft_semisync_round_overlap_ms gauge —
+        # sync time that ran CONCURRENT with inner steps, so only the
+        # worker path counts (an inline blocking stall is train-thread
+        # time, the opposite of overlap).
+        if self._worker is not None:
+            try:
+                with self._lock:
+                    self._round_overlap_ms += float(sp.duration_ms)
+            except (TypeError, ValueError):  # mocked span trackers
+                pass
+
+    def _collective_supports(self, wire_codec: str) -> bool:
+        try:
+            return wire_codec in getattr(
+                self._manager.collective(), "wire_codecs", ()
+            )
+        except Exception:  # noqa: BLE001 — mocked managers
+            return False
+
+    def _wire_nbytes(self, payload, codec: FragmentCodec, wire_codec) -> int:
+        """Per-hop wire bytes of one fragment payload, from the
+        collective's own probe where available (the same source of truth
+        the GB/s gauge uses)."""
+        try:
+            probe = getattr(self._manager.collective(), "wire_nbytes", None)
+            if callable(probe):
+                n = (
+                    probe(payload, codec.allow_wire_compression, wire_codec)
+                    if wire_codec is not None
+                    else probe(payload, codec.allow_wire_compression)
+                )
+                return int(n)
+        except Exception:  # noqa: BLE001 — mocked managers
+            pass
+        return int(np.asarray(payload).nbytes)
+
+    def drain(self) -> Dict[int, np.ndarray]:
+        """Blocks the TRAIN thread until every issued fragment round lands;
+        returns {fragment index: averaged flat pseudogradient}.  Charged as
+        ``allreduce_merge`` — this wait is the streaming plane's only
+        train-thread cost, and exactly what the bench's overlap headline
+        measures."""
+        with self._lock:
+            futures = list(self._futures)
+        with self._manager.spans.span(
+            "allreduce_merge", step=self._manager.current_step()
+        ):
+            for fut in futures:
+                try:
+                    fut.result()
+                except Exception as e:  # noqa: BLE001 — latch, never raise
+                    try:
+                        self._manager.report_error(e)
+                    except Exception:  # noqa: BLE001 — mocked managers
+                        pass
+        with self._lock:
+            return dict(self._results)
+
+    def round_stats(self) -> Dict[str, int]:
+        """The round-so-far accounting.  Read AFTER drain() but BEFORE the
+        commit vote when the caller wants the numbers in the same step's
+        step_summary (the vote flushes that record)."""
+        with self._lock:
+            return {
+                "fragments": self._round_fragments,
+                "wire_bytes": self._round_wire_bytes,
+                "d2h_bytes": self._round_d2h_bytes,
+            }
+
+    def end_round(self, committed: bool) -> Dict[str, int]:
+        """Round bookkeeping: promotes or discards every codec's pending
+        state and reports the round's accounting."""
+        for codec in self._codecs:
+            if committed:
+                codec.on_commit()
+            else:
+                codec.on_abort()
+        self.metrics.observe_round(committed=committed)
+        with self._lock:
+            self.metrics.observe_overlap_ms(self._round_overlap_ms)
+        return self.round_stats()
+
+    def shutdown(self) -> None:
+        if self._worker is not None:
+            self._worker.shutdown(wait=True)
+            self._worker = None

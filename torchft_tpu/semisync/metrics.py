@@ -1,0 +1,209 @@
+"""Prometheus-style exposition for the semi-sync plane: ``tpuft_semisync_*``.
+
+The lighthouse's native ``GET /metrics`` covers the control plane; the
+semi-sync data plane is per-worker and Python-side, so it exposes its own
+gauges the same text-format way: a :class:`SemiSyncMetrics` accumulates
+counters from the engine, ``render_prometheus`` produces the exposition,
+and ``serve`` (opt-in: ``TPUFT_SEMISYNC_METRICS_PORT``) publishes it on a
+tiny stdlib HTTP endpoint at ``/metrics`` for the same scraper that
+already hits the lighthouse.
+
+Counters are monotonic since construction (restart = reset, standard
+Prometheus counter semantics); gauges are last-observation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+__all__ = [
+    "SemiSyncMetrics",
+    "TPUFT_SEMISYNC_METRICS_PORT_ENV",
+    "TPUFT_SEMISYNC_METRICS_BIND_ENV",
+]
+
+TPUFT_SEMISYNC_METRICS_PORT_ENV = "TPUFT_SEMISYNC_METRICS_PORT"
+TPUFT_SEMISYNC_METRICS_BIND_ENV = "TPUFT_SEMISYNC_METRICS_BIND"
+
+
+class SemiSyncMetrics:
+    """Thread-safe counter/gauge set for one StreamingDiLoCo instance."""
+
+    def __init__(self, codec: str = "", replica_id: str = "") -> None:
+        self.codec = codec
+        self.replica_id = replica_id
+        self._lock = threading.Lock()
+        self.fragments_total = 0
+        self.rounds_total = 0
+        self.commits_total = 0
+        self.aborts_total = 0
+        self.wire_bytes_total = 0
+        self.d2h_bytes_total = 0
+        self.last_residual_l2 = 0.0
+        self.last_round_overlap_ms = 0.0
+        self._server = None
+
+    def observe_fragment(self, wire_bytes: int, d2h_bytes: int) -> None:
+        with self._lock:
+            self.fragments_total += 1
+            self.wire_bytes_total += int(wire_bytes)
+            self.d2h_bytes_total += int(d2h_bytes)
+
+    def observe_round(self, committed: bool) -> None:
+        with self._lock:
+            self.rounds_total += 1
+            if committed:
+                self.commits_total += 1
+            else:
+                self.aborts_total += 1
+
+    @property
+    def serving(self) -> bool:
+        """True while the HTTP exposition is up — consumers can use this
+        to skip gauge computations nobody will scrape."""
+        return self._server is not None
+
+    def observe_residual(self, l2: float) -> None:
+        with self._lock:
+            self.last_residual_l2 = float(l2)
+
+    def observe_overlap_ms(self, ms: float) -> None:
+        with self._lock:
+            self.last_round_overlap_ms = float(ms)
+
+    def render_prometheus(self) -> str:
+        """The ``tpuft_semisync_*`` exposition (Prometheus text format)."""
+        with self._lock:
+            label = ""
+            if self.replica_id or self.codec:
+                parts = []
+                if self.replica_id:
+                    parts.append(f'replica="{self.replica_id}"')
+                if self.codec:
+                    parts.append(f'codec="{self.codec}"')
+                label = "{" + ",".join(parts) + "}"
+            lines = []
+
+            def metric(name: str, kind: str, help_: str, value) -> None:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {kind}")
+                lines.append(f"{name}{label} {value}")
+
+            metric(
+                "tpuft_semisync_fragments_total", "counter",
+                "fragment pseudogradient rounds completed",
+                self.fragments_total,
+            )
+            metric(
+                "tpuft_semisync_rounds_total", "counter",
+                "outer sync rounds finished (committed + aborted)",
+                self.rounds_total,
+            )
+            metric(
+                "tpuft_semisync_commits_total", "counter",
+                "outer sync rounds that passed the commit vote",
+                self.commits_total,
+            )
+            metric(
+                "tpuft_semisync_aborts_total", "counter",
+                "outer sync rounds discarded (error latched / vote lost)",
+                self.aborts_total,
+            )
+            metric(
+                "tpuft_semisync_wire_bytes_total", "counter",
+                "per-hop wire bytes of fragment payloads (codec-encoded)",
+                self.wire_bytes_total,
+            )
+            metric(
+                "tpuft_semisync_d2h_bytes_total", "counter",
+                "device->host fetch bytes of fragment payloads",
+                self.d2h_bytes_total,
+            )
+            metric(
+                "tpuft_semisync_residual_l2", "gauge",
+                "L2 norm of the carried int8 error-feedback residual",
+                self.last_residual_l2,
+            )
+            metric(
+                "tpuft_semisync_round_overlap_ms", "gauge",
+                "last round's background sync time overlapped with inner "
+                "steps",
+                self.last_round_overlap_ms,
+            )
+            return "\n".join(lines) + "\n"
+
+    # -- optional HTTP exposition -------------------------------------------
+
+    def serve(
+        self, port: Optional[int] = None, bind: Optional[str] = None
+    ) -> Optional[int]:
+        """Starts a daemon HTTP server answering ``GET /metrics`` with the
+        exposition.  ``port=None`` reads ``TPUFT_SEMISYNC_METRICS_PORT``
+        (unset/empty = disabled, 0 = ephemeral); ``bind=None`` reads
+        ``TPUFT_SEMISYNC_METRICS_BIND`` and defaults to loopback (``::1``
+        — the server is the repo-wide dual-stack v6 class) — the endpoint
+        is unauthenticated, so listening on every interface must be an
+        explicit operator choice (``::``), not the default.  Returns the
+        bound port, or None when disabled.  Never raises — metrics must
+        not be able to fail training."""
+        if port is None:
+            raw = os.environ.get(TPUFT_SEMISYNC_METRICS_PORT_ENV, "")
+            if not raw.strip():
+                return None
+            try:
+                port = int(raw)
+            except ValueError:
+                return None
+        if bind is None:
+            bind = os.environ.get(
+                TPUFT_SEMISYNC_METRICS_BIND_ENV, ""
+            ).strip() or "::1"
+        try:
+            from http.server import BaseHTTPRequestHandler
+
+            # The repo's one dual-stack server class (torchft_tpu/http.py)
+            # — every HTTP endpoint here shares it, so v6 handling and
+            # accept-queue fixes apply uniformly.
+            from torchft_tpu.http import ThreadingHTTPServerV6
+
+            metrics = self
+
+            class Handler(BaseHTTPRequestHandler):
+                def do_GET(self):  # noqa: N802 — stdlib API
+                    if self.path != "/metrics":
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def log_message(self, *args):  # silence per-scrape stderr
+                    pass
+
+            server = ThreadingHTTPServerV6((bind, port), Handler)
+            threading.Thread(
+                target=server.serve_forever,
+                name="tpuft_semisync_metrics",
+                daemon=True,
+            ).start()
+            self._server = server
+            return server.server_address[1]
+        except Exception:  # noqa: BLE001 — see docstring
+            return None
+
+    def close(self) -> None:
+        server, self._server = self._server, None
+        if server is not None:
+            try:
+                server.shutdown()
+                server.server_close()
+            except Exception:  # noqa: BLE001
+                pass
