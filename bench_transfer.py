@@ -33,9 +33,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
+import subprocess
+import sys
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -315,6 +319,537 @@ def bench_shaped_link(mbps: float = 200.0, rtt_ms: float = 20.0) -> Dict[str, An
     }
 
 
+# ---------------------------------------------------------------------------
+# Erasure-coded peer state (torchft_tpu/ec): donor-free healing cells
+# ---------------------------------------------------------------------------
+
+
+def bench_ec_encode_overhead(
+    state: Dict[str, np.ndarray],
+    nbytes: int,
+    k: int,
+    m: int,
+    steps: int = 30,
+    step_s: float = 0.05,
+) -> Dict[str, Any]:
+    """Donor-side encode overhead: committed-step-time impact of feeding
+    every step to the erasure encoder, A/B'd against the identical loop
+    with no EC hook.
+
+    The step is modeled as a fixed-latency DEVICE step (sleep): on a TPU
+    host the train thread spends the step blocked on device compute, so
+    the donor-side cost that matters is train-THREAD blocking — which the
+    EC design adds none of (the enqueue is ~µs; flatten + encode + push
+    ride the background snapshotter, charged to the overlapped
+    snapshot/ec_encode spans).  ``cpu_contention_ratio`` reports the same
+    A/B with a busy numpy step instead, which is the upper bound for a
+    host whose cores are already saturated by the train process."""
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu.ec.store import ECConfig, ECPlane
+
+    def run_arm(with_ec: bool, busy: bool) -> Dict[str, Any]:
+        src = HTTPTransport(timeout=120.0)
+        peer = HTTPTransport(timeout=120.0)
+        plane: Optional[ECPlane] = None
+        if with_ec:
+            plane = ECPlane(ECConfig(k=k, m=m), push_timeout=120.0)
+            src.attach_shard_store(plane.store)
+            src.set_snapshot_hook(plane.on_snapshot)
+            from torchft_tpu.ec.store import ShardStore
+
+            peer_store = ShardStore(retain=2)
+            peer.attach_shard_store(peer_store)
+            plane.set_peers([0, 1], ["self", peer.metadata()], 0)
+        try:
+            walls: List[float] = []
+            spin = np.ones((256, 256), np.float32)
+            for i in range(1, steps + 1):
+                t0 = time.perf_counter()
+                if busy:
+                    deadline = t0 + step_s
+                    while time.perf_counter() < deadline:
+                        spin = np.tanh(spin @ spin.T * 1e-3)
+                else:
+                    time.sleep(step_s)
+                src.enqueue_snapshot(i, state, serve=False)
+                walls.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            src.wait_snapshot(300.0)
+            drain_s = time.perf_counter() - t0
+            return {
+                "step_wall_s": round(float(np.mean(walls)), 5),
+                "drain_s": round(drain_s, 3),
+                "generations": (
+                    len(plane.store.have(plane.store.latest_step()))
+                    if with_ec and plane.store.latest_step() >= 0
+                    else 0
+                ),
+            }
+        finally:
+            src.shutdown()
+            peer.shutdown()
+
+    off = run_arm(with_ec=False, busy=False)
+    on = run_arm(with_ec=True, busy=False)
+    busy_off = run_arm(with_ec=False, busy=True)
+    busy_on = run_arm(with_ec=True, busy=True)
+    return {
+        "op": "ec_encode",
+        "k": k,
+        "m": m,
+        "steps": steps,
+        "step_s": step_s,
+        "step_wall_ec_off_s": off["step_wall_s"],
+        "step_wall_ec_on_s": on["step_wall_s"],
+        # The headline: train-thread inflation with device-bound steps.
+        "overhead_ratio": round(on["step_wall_s"] / off["step_wall_s"], 4),
+        "cpu_contention_ratio": round(
+            busy_on["step_wall_s"] / busy_off["step_wall_s"], 4
+        ),
+        # Background pipeline cost of the LAST enqueued generation
+        # (flatten + CRC + encode + push), off the critical path.
+        "encode_pipeline_s": on["drain_s"],
+    }
+
+
+def bench_ec_reconstruct(
+    state: Dict[str, np.ndarray],
+    nbytes: int,
+    k: int,
+    m: int,
+    shaped_mbps: float = 0.0,
+    striped_fetch_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Reconstruction latency: any-k-of-(k+m) shard fetch + decode vs the
+    striped multi-donor checkpoint fetch, each holder's serving link shaped
+    like a donor's.  ``bitwise`` pins that the reconstructed buffers equal
+    the donor stream byte-for-byte."""
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu.checkpointing.serialization import flatten_state_dict
+    from torchft_tpu.ec.encoder import encode_stream
+    from torchft_tpu.ec.placement import shard_holder
+    from torchft_tpu.ec.store import ShardStore, reconstruct
+
+    step = 1
+    meta, bufs = flatten_state_dict(state, step=step)
+    t0 = time.perf_counter()
+    shards = encode_stream(meta, bufs, k, m, step=step)
+    encode_s = time.perf_counter() - t0
+
+    prior = os.environ.get("TPUFT_HTTP_SHAPED_MBPS")
+    if shaped_mbps > 0:
+        os.environ["TPUFT_HTTP_SHAPED_MBPS"] = str(shaped_mbps)
+    try:
+        holders = [HTTPTransport(timeout=300.0) for _ in range(k + m)]
+    finally:
+        if shaped_mbps > 0:
+            if prior is None:
+                del os.environ["TPUFT_HTTP_SHAPED_MBPS"]
+            else:
+                os.environ["TPUFT_HTTP_SHAPED_MBPS"] = prior
+    try:
+        ranks = list(range(k + m))
+        stores = [ShardStore(retain=2) for _ in holders]
+        for h, s in zip(holders, stores):
+            h.attach_shard_store(s)
+        for shard in shards:
+            stores[shard_holder(step, shard.idx, ranks)].put(shard)
+        urls = [h.metadata() for h in holders]
+        t0 = time.perf_counter()
+        meta2, bufs2, stats = reconstruct(urls, step, timeout=600.0)
+        reconstruct_s = time.perf_counter() - t0
+        bitwise = all(
+            x.tobytes() == y.tobytes() for x, y in zip(bufs, bufs2)
+        ) and len(bufs) == len(bufs2)
+        out: Dict[str, Any] = {
+            "op": "ec_reconstruct",
+            "k": k,
+            "m": m,
+            "holders": k + m,
+            "holder_link_mbps": shaped_mbps if shaped_mbps > 0 else None,
+            "encode_s": round(encode_s, 3),
+            "reconstruct_s": round(reconstruct_s, 3),
+            "reconstruct_gb_per_s": round(_gb(nbytes) / reconstruct_s, 3),
+            "shards_used": stats.get("shards_used"),
+            "bitwise": bool(bitwise),
+        }
+        if striped_fetch_s:
+            out["striped_donor_fetch_s"] = striped_fetch_s
+            out["vs_striped_ratio"] = round(reconstruct_s / striped_fetch_s, 3)
+        return out
+    finally:
+        for h in holders:
+            h.shutdown()
+
+
+def _spawn_wave_worker(role: str, out_path: str, extra: List[str]) -> subprocess.Popen:
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--_wave-role", role,
+        "--_out", out_path, *extra,
+    ]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+    )
+
+
+def _wave_role_main(args) -> None:
+    """Subprocess body for the donor-dead-wave cell: serve a checkpoint
+    (donor) or a shard-store slice (holder) until killed."""
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu.checkpointing.serialization import flatten_state_dict
+    from torchft_tpu.ec.encoder import encode_shards
+    from torchft_tpu.ec.store import ShardStore
+
+    state = make_state_dict(int(args.gb * 1e9), args.buffers)
+    transport = HTTPTransport(timeout=300.0)
+    if args.wave_role == "donor":
+        transport.send_checkpoint([1], step=args.wstep, state_dict=state,
+                                  timeout=300.0)
+        transport.wait_snapshot(300.0)
+    else:
+        meta, bufs = flatten_state_dict(state, step=args.wstep)
+        want = [int(i) for i in args.shards.split(",") if i != ""]
+        shards = encode_shards(meta, bufs, args.wk, args.wm, args.wstep, want)
+        store = ShardStore(retain=2)
+        for s in shards.values():
+            store.put(s)
+        transport.attach_shard_store(store)
+    with open(args.out + ".tmp", "w") as f:
+        f.write(transport.metadata())
+    os.replace(args.out + ".tmp", args.out)
+    while True:  # parent SIGKILLs us
+        time.sleep(1.0)
+
+
+def bench_ec_wave(
+    gb: float,
+    buffers: int,
+    k: int,
+    m: int,
+    n_donors: int = 2,
+    workdir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """The donor-dead wave: REAL subprocess donors serving the max-step
+    checkpoint are all SIGKILLed; the recovering side's striped donor
+    fetch fails, and reconstruction completes from the k+m surviving
+    shard-holder processes — bitwise-equal to the donor stream."""
+    import tempfile
+
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu.checkpointing.serialization import flatten_state_dict
+    from torchft_tpu.ec.placement import shards_for_holder
+    from torchft_tpu.ec.store import reconstruct
+
+    step = 1
+    workdir = workdir or tempfile.mkdtemp(prefix="tpuft_ec_wave_")
+    procs: List[subprocess.Popen] = []
+    donor_procs: List[subprocess.Popen] = []
+    try:
+        paths: List[str] = []
+        common = ["--gb", str(gb), "--buffers", str(buffers),
+                  "--_k", str(k), "--_m", str(m), "--_step", str(step)]
+        for d in range(n_donors):
+            path = os.path.join(workdir, f"donor_{d}.url")
+            paths.append(path)
+            p = _spawn_wave_worker("donor", path, common)
+            procs.append(p)
+            donor_procs.append(p)
+        holder_ranks = list(range(k + m))
+        for h in holder_ranks:
+            own = shards_for_holder(step, h, holder_ranks, k + m)
+            path = os.path.join(workdir, f"holder_{h}.url")
+            paths.append(path)
+            procs.append(
+                _spawn_wave_worker(
+                    "holder", path,
+                    common + ["--_shards", ",".join(map(str, own))],
+                )
+            )
+
+        def await_url(path: str, timeout: float = 120.0) -> str:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if os.path.exists(path):
+                    with open(path) as f:
+                        return f.read().strip()
+                time.sleep(0.1)
+            raise RuntimeError(f"worker never published {path}")
+
+        donor_urls = [await_url(p) for p in paths[:n_donors]]
+        holder_urls = [await_url(p) for p in paths[n_donors:]]
+
+        # The wave: every donor SIGKILLed, then the heal is attempted.
+        for p in donor_procs:
+            p.send_signal(signal.SIGKILL)
+        for p in donor_procs:
+            p.wait(timeout=30)
+        receiver = HTTPTransport(timeout=10.0)
+        donor_fetch_failed = False
+        t0 = time.perf_counter()
+        try:
+            receiver.recv_checkpoint(0, donor_urls, step=step, timeout=5.0)
+        except Exception:  # noqa: BLE001 — the expected outcome
+            donor_fetch_failed = True
+        donor_fail_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        meta2, bufs2, stats = reconstruct(holder_urls, step, timeout=600.0)
+        reconstruct_s = time.perf_counter() - t0
+        receiver.shutdown()
+        state = make_state_dict(int(gb * 1e9), buffers)
+        nbytes = sum(a.nbytes for a in state.values())
+        meta, bufs = flatten_state_dict(state, step=step)
+        bitwise = len(bufs) == len(bufs2) and all(
+            x.tobytes() == y.tobytes() for x, y in zip(bufs, bufs2)
+        )
+        return {
+            "op": "ec_wave",
+            "state_dict_gb": round(_gb(nbytes), 3),
+            "k": k,
+            "m": m,
+            "donors_sigkilled": n_donors,
+            "donor_fetch_failed": donor_fetch_failed,
+            "donor_fail_s": round(donor_fail_s, 3),
+            "holders": k + m,
+            "reconstruct_s": round(reconstruct_s, 3),
+            "shards_used": stats.get("shards_used"),
+            "bitwise": bool(bitwise),
+            "ok": bool(donor_fetch_failed and bitwise),
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _ec_manager_worker_main(args) -> None:
+    """Subprocess body for the manager-level wave: one real Manager in a
+    JAX-light control loop committing steps until the shared absolute
+    deadline, erasure plane on (mode from env)."""
+    import hashlib
+    from datetime import timedelta
+
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu.collectives import TCPCollective
+    from torchft_tpu.manager import Manager
+
+    state = {"w": np.zeros(256, np.float32)}
+
+    def save():
+        return {"w": state["w"]}
+
+    def load(sd):
+        state["w"] = np.asarray(sd["w"]).copy()
+
+    manager = Manager(
+        collective=TCPCollective(timeout=15.0),
+        load_state_dict=load,
+        state_dict=save,
+        # 1, not groups: step 0 only commits with participant 0 alone (the
+        # init-sync collapse makes every other group non-participating).
+        min_replica_size=1,
+        use_async_quorum=True,
+        timeout=timedelta(seconds=15),
+        quorum_timeout=timedelta(seconds=30),
+        rank=0,
+        world_size=1,
+        replica_id=args.replica,
+        checkpoint_transport=HTTPTransport(timeout=15.0),
+    )
+    commits = failed = 0
+    healed_step = None
+    while time.time() < args.end_ts:
+        manager.start_quorum()
+        fut = manager.allreduce(np.ones(64, np.float32))
+        fut.result()
+        if manager._healing and healed_step is None:
+            healed_step = manager.current_step()
+        if manager.should_commit():
+            commits += 1
+            state["w"] = state["w"] + 1.0
+        else:
+            failed += 1
+        time.sleep(args.step_s)
+    payload = {
+        "replica": args.replica,
+        "commits": commits,
+        "failed_commits": failed,
+        "final_step": manager.current_step(),
+        "healed_step": healed_step,
+        "sha": hashlib.sha256(state["w"].tobytes()).hexdigest(),
+    }
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(args.out + ".tmp", args.out)
+    manager.shutdown()
+
+
+def bench_ec_manager_wave(
+    groups: int = 4,
+    k: int = 2,
+    m: int = 1,
+    run_s: float = 22.0,
+    kill_at_s: float = 8.0,
+    respawn_after_s: float = 1.5,
+    step_s: float = 0.05,
+    workdir: Optional[str] = None,
+    survivor_failed_budget: int = 0,
+) -> Dict[str, Any]:
+    """Manager-level donor-free wave: G real-Manager worker subprocesses
+    with TPUFT_EC_MODE=prefer (heals NEVER touch the donor path — no
+    serving window ever opens on a survivor).  One group is SIGKILLed and
+    respawned; its heal must complete via erasure reconstruction from the
+    surviving shard holders while every survivor keeps committing with
+    ZERO failed commits."""
+    import tempfile
+
+    from torchft_tpu._native import LighthouseServer
+
+    workdir = workdir or tempfile.mkdtemp(prefix="tpuft_ec_mwave_")
+    lighthouse = LighthouseServer(
+        bind="[::]:0",
+        min_replicas=groups,
+        join_timeout_ms=2000,
+        heartbeat_timeout_ms=1500,
+    )
+    end_ts = time.time() + run_s
+    procs: Dict[str, subprocess.Popen] = {}
+    metrics_paths: Dict[str, str] = {}
+
+    def spawn(idx: int, incarnation: int) -> None:
+        replica = f"ecw{idx}"
+        out = os.path.join(workdir, f"{replica}_{incarnation}.json")
+        metrics = os.path.join(workdir, f"{replica}_{incarnation}.jsonl")
+        metrics_paths[f"{replica}_{incarnation}"] = metrics
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "TPUFT_LIGHTHOUSE": lighthouse.address(),
+                "TPUFT_METRICS_PATH": metrics,
+                "TPUFT_EC_K": str(k),
+                "TPUFT_EC_M": str(m),
+                "TPUFT_EC_MODE": "prefer",
+                "TPUFT_HEAL_BACKOFF_BASE_S": "0.1",
+                "TPUFT_HEAL_BACKOFF_CAP_S": "0.5",
+            }
+        )
+        procs[f"{replica}_{incarnation}"] = subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--_wave-role", "manager",
+                "--_out", out,
+                "--_replica", replica,
+                "--_end-ts", str(end_ts),
+                "--_step-s", str(step_s),
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    try:
+        for i in range(groups):
+            spawn(i, 0)
+        time.sleep(kill_at_s)
+        victim = f"ecw{groups - 1}"
+        procs[f"{victim}_0"].send_signal(signal.SIGKILL)
+        procs[f"{victim}_0"].wait(timeout=30)
+        time.sleep(respawn_after_s)
+        spawn(groups - 1, 1)
+        deadline = end_ts + 60
+        for key, p in procs.items():
+            timeout = max(1.0, deadline - time.time())
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+        results: Dict[str, Any] = {}
+        for key in procs:
+            out = os.path.join(workdir, f"{key}.json")
+            if os.path.exists(out):
+                with open(out) as f:
+                    results[key] = json.load(f)
+        survivors = [
+            r for key, r in results.items()
+            if not key.startswith(victim)
+        ]
+        victim_2 = results.get(f"{victim}_1")
+        recon_events = 0
+        for key, path in metrics_paths.items():
+            if not key.startswith(victim) or not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") == "ec_reconstruct":
+                        recon_events += 1
+        survivor_failed = sum(r["failed_commits"] for r in survivors)
+        # survivor_failed_budget: the HEAL path never touches survivors in
+        # prefer mode, but the SIGKILL itself can land mid-allreduce and
+        # fail one survivor round — CI smokes pass a budget of 1 for that
+        # independent race; the pinned artifact keeps the strict 0.
+        ok = (
+            len(survivors) == groups - 1
+            and victim_2 is not None
+            and victim_2["commits"] > 0
+            and recon_events > 0
+            and survivor_failed <= survivor_failed_budget
+        )
+        return {
+            "op": "ec_manager_wave",
+            "groups": groups,
+            "k": k,
+            "m": m,
+            "mode": "prefer",
+            "survivor_failed_commits": survivor_failed,
+            "survivor_commits": [r["commits"] for r in survivors],
+            "victim_post_heal_commits": (
+                victim_2["commits"] if victim_2 else None
+            ),
+            "victim_healed_step": victim_2.get("healed_step") if victim_2 else None,
+            "ec_reconstructions": recon_events,
+            "ok": bool(ok),
+        }
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        lighthouse.shutdown()
+
+
+def run_ec_quick(gb: float = 0.008, buffers: int = 8, k: int = 2, m: int = 1) -> Dict[str, Any]:
+    """Small-size EC smoke for CI tier-1 (``--quick`` includes it): the
+    encode-overhead, reconstruction (bitwise-pinned), subprocess
+    donor-dead wave, and manager-level prefer-mode wave cells."""
+    nbytes = int(gb * 1e9)
+    state = make_state_dict(nbytes, buffers)
+    actual = sum(a.nbytes for a in state.values())
+    encode = bench_ec_encode_overhead(state, actual, k, m, steps=8, step_s=0.02)
+    recon = bench_ec_reconstruct(state, actual, k, m)
+    wave = bench_ec_wave(gb, buffers, k, m, n_donors=2)
+    manager_wave = bench_ec_manager_wave(
+        groups=3, k=k, m=m, run_s=14.0, kill_at_s=5.0, step_s=0.05,
+        survivor_failed_budget=1,
+    )
+    return {
+        "quick": True,
+        "state_dict_gb": round(_gb(actual), 4),
+        "ec": [encode, recon, wave, manager_wave],
+    }
+
+
 def run_quick(gb: float = 0.064, buffers: int = 16) -> Dict[str, Any]:
     """Smoke sweep for CI tier-1 (``--quick``): small dict, 1 vs 2 donors
     plus a mid-fetch donor kill — transfer-path regressions (stripe
@@ -353,14 +888,53 @@ def main() -> None:
     parser.add_argument("--shaped-rtt-ms", type=float, default=20.0)
     parser.add_argument("--no-shaped", action="store_true")
     parser.add_argument(
+        "--ec-k", type=int, default=4,
+        help="erasure data shards for the EC cells",
+    )
+    parser.add_argument(
+        "--ec-m", type=int, default=2,
+        help="erasure parity shards for the EC cells",
+    )
+    parser.add_argument("--no-ec", action="store_true",
+                        help="skip the erasure-coded healing cells")
+    parser.add_argument(
         "--quick", action="store_true",
-        help="small-dict smoke: 1 vs 2 donors + mid-fetch donor kill",
+        help="small-dict smoke: 1 vs 2 donors + mid-fetch donor kill + EC cells",
     )
     parser.add_argument("--out", default=None, help="also write results JSON here")
+    # Hidden subprocess-worker plumbing for the EC wave cells.
+    parser.add_argument("--_wave-role", dest="wave_role", default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--_out", dest="out_path", default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--_k", dest="wk", type=int, default=2,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--_m", dest="wm", type=int, default=1,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--_step", dest="wstep", type=int, default=1,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--_shards", dest="shards", default="",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--_replica", dest="replica", default="",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--_end-ts", dest="end_ts", type=float, default=0.0,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--_step-s", dest="step_s", type=float, default=0.05,
+                        help=argparse.SUPPRESS)
     args = parser.parse_args()
+    args.out = args.out_path if args.wave_role else args.out
+
+    if args.wave_role == "manager":
+        _ec_manager_worker_main(args)
+        return
+    if args.wave_role:
+        _wave_role_main(args)
+        return
 
     if args.quick:
         payload = run_quick()
+        if not args.no_ec:
+            payload["ec"] = run_ec_quick()["ec"]
         print(json.dumps(payload), flush=True)
         if args.out:
             with open(args.out, "w") as f:
@@ -407,12 +981,39 @@ def main() -> None:
         results.append(r)
         print(json.dumps(r), flush=True)
 
+    # Erasure-coded healing cells (docs/architecture.md "Donor-free
+    # healing"): donor-side encode overhead inside the overlapped snapshot
+    # pipeline, reconstruction latency vs the striped donor fetch at the
+    # same per-link shaping, a SIGKILLed-donor-set wave, and the
+    # manager-level prefer-mode wave (zero survivor failed commits).
+    ec_cells: List[Dict[str, Any]] = []
+    if not args.no_ec:
+        striped4 = shaped_results.get(4, {}).get("fetch_s")
+        r = bench_ec_encode_overhead(state, actual, args.ec_k, args.ec_m)
+        ec_cells.append(r)
+        print(json.dumps(r), flush=True)
+        r = bench_ec_reconstruct(
+            state, actual, args.ec_k, args.ec_m,
+            shaped_mbps=args.donor_link_mbps, striped_fetch_s=striped4,
+        )
+        ec_cells.append(r)
+        print(json.dumps(r), flush=True)
+        # Wave at a RAM-bounded size: every donor/holder subprocess carries
+        # its own copy of the state.
+        r = bench_ec_wave(min(args.gb, 0.25), args.buffers, args.ec_k, args.ec_m)
+        ec_cells.append(r)
+        print(json.dumps(r), flush=True)
+        r = bench_ec_manager_wave(k=2, m=1)
+        ec_cells.append(r)
+        print(json.dumps(r), flush=True)
+        results.extend(ec_cells)
+
     r = bench_collective(state, actual)
     results.append(r)
     print(json.dumps(r), flush=True)
 
     best_http = max(
-        (x for x in results if x["transport"] == "http" and "num_chunks" in x),
+        (x for x in results if x.get("transport") == "http" and "num_chunks" in x),
         key=lambda x: x["fetch_gb_per_s"],
     )
     summary = {
@@ -438,6 +1039,23 @@ def main() -> None:
             shaped_results[2]["fetch_gb_per_s"] / shaped_results[1]["fetch_gb_per_s"],
             2,
         )
+    if ec_cells:
+        by_op = {c["op"]: c for c in ec_cells}
+        summary["ec"] = {
+            "k": args.ec_k,
+            "m": args.ec_m,
+            "encode_overhead_ratio": by_op["ec_encode"]["overhead_ratio"],
+            "reconstruct_gb_per_s": by_op["ec_reconstruct"][
+                "reconstruct_gb_per_s"
+            ],
+            "reconstruct_bitwise": by_op["ec_reconstruct"]["bitwise"],
+            "vs_striped_ratio": by_op["ec_reconstruct"].get("vs_striped_ratio"),
+            "wave_ok": by_op["ec_wave"]["ok"],
+            "manager_wave_ok": by_op["ec_manager_wave"]["ok"],
+            "survivor_failed_commits": by_op["ec_manager_wave"][
+                "survivor_failed_commits"
+            ],
+        }
     shaped = None
     if not args.no_shaped:
         shaped = bench_shaped_link(args.shaped_mbps, args.shaped_rtt_ms)

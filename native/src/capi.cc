@@ -139,10 +139,12 @@ char* tf_manager_address(void* p) { return CopyString(static_cast<ManagerServer*
 
 void tf_manager_set_status(void* p, int64_t step, const char* state,
                            double step_time_ms_ewma, double step_time_ms_last,
-                           double allreduce_gb_per_s) {
+                           double allreduce_gb_per_s, int64_t ec_shards_held,
+                           int64_t ec_shard_step) {
   static_cast<ManagerServer*>(p)->SetStatus(step, state ? state : "",
                                             step_time_ms_ewma, step_time_ms_last,
-                                            allreduce_gb_per_s);
+                                            allreduce_gb_per_s, ec_shards_held,
+                                            ec_shard_step);
 }
 
 // Manager-side flight recorder (no HTTP server on managers — this is the

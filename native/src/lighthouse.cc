@@ -239,6 +239,11 @@ std::string Lighthouse::SnapshotState() {
     if (lc != last_commit_ms_.end()) r->set_last_commit_ms(lc->second);
     auto gbps = allreduce_gbps_.find(id);
     if (gbps != allreduce_gbps_.end()) r->set_allreduce_gb_per_s(gbps->second);
+    auto ec = ec_shards_.find(id);
+    if (ec != ec_shards_.end()) {
+      r->set_ec_shard_step(ec->second.first);
+      r->set_ec_shards_held(ec->second.second);
+    }
     auto h = health_.find(id);
     if (h != health_.end()) {
       r->set_step_time_ms_ewma(h->second.ewma_ms);
@@ -312,6 +317,7 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
   hb_state_.clear();
   last_commit_ms_.clear();
   allreduce_gbps_.clear();
+  ec_shards_.clear();
   health_.clear();
   auto now = Clock::now();
   for (const auto& r : req.replicas()) {
@@ -323,6 +329,9 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
     if (!r.state().empty()) hb_state_[id] = r.state();
     if (r.last_commit_ms() > 0) last_commit_ms_[id] = r.last_commit_ms();
     allreduce_gbps_[id] = r.allreduce_gb_per_s();
+    if (r.ec_shards_held() > 0 || r.ec_shard_step() > 0) {
+      ec_shards_[id] = {r.ec_shard_step(), r.ec_shards_held()};
+    }
     if (r.step_time_ms_ewma() > 0.0 || r.straggler_state() != 0) {
       ReplicaHealth& h = health_[id];
       h.ewma_ms = r.step_time_ms_ewma();
@@ -718,6 +727,15 @@ Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
   // healing, spare): letting it through is what stops a stale healthy
   // GB/s from masking a replica that moved zero gradient bytes for hours.
   allreduce_gbps_[req.replica_id()] = req.allreduce_gb_per_s();
+  // Create an entry only on a nonzero report (proto3 cannot distinguish a
+  // pre-EC sender from an authoritative empty store — both wire 0/0), but
+  // UPDATE an existing entry unconditionally: a respawned incarnation's
+  // empty store must clear its stale coverage out of the gauges, not keep
+  // overstating redundancy at exactly the below-k moment they exist for.
+  if (req.ec_shards_held() > 0 || req.ec_shard_step() > 0 ||
+      ec_shards_.count(req.replica_id())) {
+    ec_shards_[req.replica_id()] = {req.ec_shard_step(), req.ec_shards_held()};
+  }
   // Straggler sentinel: keep the rolling step-time telemetry fresh on every
   // heartbeat, but run a state-machine OBSERVATION only when the replica's
   // reported step advances past the sentinel's own cursor — the hysteresis
@@ -1261,6 +1279,7 @@ void Lighthouse::SweepLocked(TimePoint tick_now,
   prune_with_heartbeats(hb_state_);
   prune_with_heartbeats(last_commit_ms_);
   prune_with_heartbeats(allreduce_gbps_);
+  prune_with_heartbeats(ec_shards_);
   // Sentinel health follows the graveyard too, and a pruned replica's
   // active alert resolves here: a process that is gone (crashed, drained
   // out, auto-drained straggler that exited) can never post the recovery
@@ -1354,6 +1373,7 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
   erase_matching(hb_state_);
   erase_matching(last_commit_ms_);
   erase_matching(allreduce_gbps_);
+  erase_matching(ec_shards_);
   erase_matching(health_);
   // An evicted incarnation's straggler alert resolves with it (the
   // supervisor already replaced the process; the alert described a corpse).
@@ -1499,6 +1519,8 @@ std::string Lighthouse::MetricsText() {
     std::vector<std::pair<std::string, double>> gbps;
     std::vector<std::pair<std::string, double>> ratio;
     std::vector<std::pair<std::string, int64_t>> sentinel_state;
+    std::vector<std::pair<std::string, int64_t>> ec_held;
+    int64_t ec_step = 0, ec_coverage = 0;
   } s;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -1565,6 +1587,18 @@ std::string Lighthouse::MetricsText() {
     }
     s.gbps.reserve(allreduce_gbps_.size());
     for (const auto& [id, g] : allreduce_gbps_) s.gbps.emplace_back(id, g);
+    // Per-step shard coverage: shards held at the NEWEST reported encode
+    // generation, summed over the replicas reporting that generation —
+    // the redundancy a donor-free reconstruction at the max step can
+    // actually draw on (needs >= k to succeed; alert below k + 1).
+    s.ec_held.reserve(ec_shards_.size());
+    for (const auto& [id, sc] : ec_shards_) {
+      s.ec_held.emplace_back(id, sc.second);
+      s.ec_step = std::max(s.ec_step, sc.first);
+    }
+    for (const auto& [id, sc] : ec_shards_) {
+      if (sc.first == s.ec_step) s.ec_coverage += sc.second;
+    }
     for (const auto& a : alerts_) {
       if (a.resolved_ms == 0) ++s.alerts_active;
     }
@@ -1637,6 +1671,21 @@ std::string Lighthouse::MetricsText() {
     o << "tpuft_allreduce_gb_per_s{replica=\"" << PromEscape(id) << "\"} "
       << g << "\n";
   }
+
+  // Erasure-coded peer state (docs/wire.md "Erasure shard endpoints").
+  gauge("tpuft_ec_shards_held",
+        "erasure shards held per replica at its newest encode generation");
+  for (const auto& [id, n] : s.ec_held) {
+    o << "tpuft_ec_shards_held{replica=\"" << PromEscape(id) << "\"} " << n
+      << "\n";
+  }
+  gauge("tpuft_ec_shard_step",
+        "newest erasure encode generation reported by any replica");
+  o << "tpuft_ec_shard_step " << s.ec_step << "\n";
+  gauge("tpuft_ec_shard_coverage",
+        "shards held at the newest encode generation across replicas "
+        "(donor-free reconstruction needs >= k of these reachable)");
+  o << "tpuft_ec_shard_coverage " << s.ec_coverage << "\n";
   gauge("tpuft_replica_slowness_ratio",
         "replica step-time EWMA over the cluster median (1.0 = on pace)");
   for (const auto& [id, r] : s.ratio) {

@@ -287,6 +287,11 @@ class Lighthouse {
   // Per-replica allreduce payload GB/s from heartbeat field 6 (last
   // committed step's data-plane throughput; 0 = never reported).
   std::map<std::string, double> allreduce_gbps_;
+  // Per-replica erasure-shard inventory from heartbeat fields 8-9:
+  // (newest encode generation step, shards held at it).  Feeds the
+  // tpuft_ec_shards_held gauge and the per-step tpuft_ec_shard_coverage
+  // count (docs/wire.md "Erasure shard endpoints").
+  std::map<std::string, std::pair<int64_t, int64_t>> ec_shards_;
   // Tombstones for supervisor-evicted incarnations (id -> evict time): a
   // dead incarnation's still-blocked quorum handler or in-flight heartbeat
   // must not re-register the corpse after EvictReplica dropped it.  Pruned

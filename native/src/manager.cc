@@ -56,6 +56,13 @@ bool ComputeQuorumResults(const std::string& replica_id, int64_t group_rank, con
 
   resp->set_quorum_id(quorum.quorum_id());
   resp->set_max_step(max_step);
+  // Full participant membership (fields 15-16): the erasure-shard
+  // placement and donor-free reconstruction operate over EVERY live
+  // participant, not just the max-step donor set.
+  for (size_t i = 0; i < members.size(); ++i) {
+    resp->add_participant_replica_ranks(static_cast<int64_t>(i));
+    resp->add_participant_manager_addresses(members[i].address());
+  }
   resp->set_max_world_size(static_cast<int64_t>(up_to_date.size()));
   resp->set_replica_rank(replica_rank);
   resp->set_replica_world_size(static_cast<int64_t>(members.size()));
@@ -192,7 +199,8 @@ std::string ManagerServer::address() const { return server_ ? server_->address()
 
 void ManagerServer::SetStatus(int64_t step, const std::string& state,
                               double step_time_ms_ewma, double step_time_ms_last,
-                              double allreduce_gb_per_s) {
+                              double allreduce_gb_per_s, int64_t ec_shards_held,
+                              int64_t ec_shard_step) {
   std::lock_guard<std::mutex> lk(mu_);
   status_step_ = step;
   status_state_ = state;
@@ -208,6 +216,13 @@ void ManagerServer::SetStatus(int64_t step, const std::string& state,
   // zeroes it), so only a negative value means "keep the prior reading".
   if (allreduce_gb_per_s >= 0.0) {
     status_allreduce_gbps_ = allreduce_gb_per_s;
+  }
+  // Shard-inventory coverage (heartbeat fields 8-9): like the gauge above,
+  // 0 is an authoritative report (store empty / pruned) and a negative
+  // value means "keep the prior reading" for status-only pushes.
+  if (ec_shards_held >= 0) {
+    status_ec_shards_ = ec_shards_held;
+    status_ec_step_ = ec_shard_step;
   }
 }
 
@@ -250,6 +265,8 @@ void ManagerServer::HeartbeatLoop() {
       req.set_step_time_ms_ewma(status_step_time_ewma_ms_);
       req.set_step_time_ms_last(status_step_time_last_ms_);
       req.set_allreduce_gb_per_s(status_allreduce_gbps_);
+      req.set_ec_shards_held(status_ec_shards_);
+      req.set_ec_shard_step(status_ec_step_);
       req.set_trace_id(status_trace_id_);
       req.SerializeToString(&payload);
     }

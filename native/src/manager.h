@@ -70,9 +70,13 @@ class ManagerServer {
   // for which 0 IS a report (a committed step that moved no gradient
   // bytes) and only a negative value means "keep the prior reading", so
   // phase-only pushes must use the default.
+  // ec_shards_held/ec_shard_step (heartbeat fields 8-9, the erasure-shard
+  // inventory) follow the gauge convention: 0 is an authoritative report,
+  // negative means "keep the prior reading".
   void SetStatus(int64_t step, const std::string& state,
                  double step_time_ms_ewma = 0.0, double step_time_ms_last = 0.0,
-                 double allreduce_gb_per_s = -1.0);
+                 double allreduce_gb_per_s = -1.0, int64_t ec_shards_held = -1,
+                 int64_t ec_shard_step = -1);
 
   // RPC handlers (public for in-process tests).
   Status HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
@@ -126,6 +130,10 @@ class ManagerServer {
   double status_step_time_ewma_ms_ = 0.0;
   double status_step_time_last_ms_ = 0.0;
   double status_allreduce_gbps_ = 0.0;
+  // Erasure-shard inventory (heartbeat fields 8-9): shards held at the
+  // newest encode generation + that generation's step.
+  int64_t status_ec_shards_ = 0;
+  int64_t status_ec_step_ = 0;
   // Causal trace id of the last quorum round this manager aggregated —
   // stamped onto every lighthouse heartbeat (proto field 7) so the
   // lighthouse's RPC spans correlate with the step in flight.

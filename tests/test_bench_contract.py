@@ -178,6 +178,58 @@ def test_allreduce_quick_smoke() -> None:
     assert payload["pipelined_commits_ok"]
 
 
+def test_ec_quick_smoke() -> None:
+    """Erasure-coded healing tier-1 gate (bench_transfer.run_ec_quick at a
+    small state size): the encode-overhead cell must show the donor-side
+    encode off the train-thread critical path, the reconstruction cell
+    must be BITWISE-equal to the donor stream, the SIGKILLed-donor-set
+    wave must reconstruct from surviving shard holders, and the
+    manager-level prefer-mode wave must heal with zero survivor failed
+    commits.  Also pins the committed TRANSFER_BENCH.json artifact schema
+    for the same cells."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_transfer
+    finally:
+        sys.path.pop(0)
+    payload = bench_transfer.run_ec_quick(gb=0.008, buffers=8)
+    cells = {c["op"]: c for c in payload["ec"]}
+    assert set(cells) == {"ec_encode", "ec_reconstruct", "ec_wave",
+                          "ec_manager_wave"}
+    # Donor-side overhead: the train thread must not pay for the encode
+    # (generous bound — CI hosts are noisy; the pinned artifact number is
+    # the honest one).
+    assert cells["ec_encode"]["overhead_ratio"] < 1.25
+    assert cells["ec_encode"]["encode_pipeline_s"] >= 0
+    assert cells["ec_reconstruct"]["bitwise"] is True
+    assert cells["ec_reconstruct"]["reconstruct_s"] > 0
+    wave = cells["ec_wave"]
+    assert wave["ok"] and wave["donor_fetch_failed"] and wave["bitwise"]
+    assert wave["donors_sigkilled"] >= 2
+    mwave = cells["ec_manager_wave"]
+    assert mwave["ok"], mwave
+    # The heal path never touches survivors in prefer mode; the SIGKILL
+    # itself racing mid-allreduce may fail ONE survivor round (the same
+    # one-failed-round cost every crash pays) — the live smoke budgets
+    # that, the pinned artifact below stays strict at zero.
+    assert mwave["survivor_failed_commits"] <= 1
+    assert mwave["ec_reconstructions"] >= 1
+    assert mwave["victim_post_heal_commits"] > 0
+
+    # The committed artifact carries the same cell set at the pinned size.
+    import json as _json
+
+    with open(os.path.join(REPO, "TRANSFER_BENCH.json")) as f:
+        artifact = _json.load(f)
+    ops = {r.get("op") for r in artifact.get("results", [])}
+    assert {"ec_encode", "ec_reconstruct", "ec_wave", "ec_manager_wave"} <= ops
+    art = {r["op"]: r for r in artifact["results"] if "op" in r}
+    assert art["ec_reconstruct"]["bitwise"] is True
+    assert art["ec_wave"]["ok"] is True
+    assert art["ec_manager_wave"]["survivor_failed_commits"] == 0
+    assert artifact["summary"]["ec"]["encode_overhead_ratio"] < 1.05
+
+
 def test_device_prep_quick_smoke() -> None:
     """Device-resident wire prep e2e gate: a small 2-group run with the
     on-device bf16 cast (and the sharded fetch, which engages under the

@@ -73,11 +73,13 @@ def test_phases_registry_is_stable() -> None:
         "quorum",
         "configure",
         "heal",
+        "ec_reconstruct",
         "allreduce_d2h",
         "allreduce_h2d",
         "allreduce_merge",
         "commit_vote",
         "snapshot",
+        "ec_encode",
         "outer_sync",
     )
     from torchft_tpu.obs.spans import OVERLAPPED_PHASES
@@ -85,7 +87,7 @@ def test_phases_registry_is_stable() -> None:
     # Overlapped phases must be a subset of the registry: report.py treats
     # them as concurrent-with-compute (not charged against productive time).
     assert set(OVERLAPPED_PHASES) <= set(PHASES)
-    assert OVERLAPPED_PHASES == ("snapshot", "outer_sync")
+    assert OVERLAPPED_PHASES == ("snapshot", "ec_encode", "outer_sync")
 
 
 # ---------------------------------------------------------------------------

@@ -36,6 +36,11 @@ MODULES = [
     "torchft_tpu.checkpointing.collective_transport",
     "torchft_tpu.checkpointing.disk",
     "torchft_tpu.checkpointing.serialization",
+    "torchft_tpu.checkpointing.integrity",
+    "torchft_tpu.ec.gf",
+    "torchft_tpu.ec.encoder",
+    "torchft_tpu.ec.placement",
+    "torchft_tpu.ec.store",
     "torchft_tpu.ddp",
     "torchft_tpu.optim",
     "torchft_tpu.local_sgd",

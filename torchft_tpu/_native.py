@@ -141,6 +141,8 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_double,
         ctypes.c_double,
         ctypes.c_double,
+        ctypes.c_int64,
+        ctypes.c_int64,
     ]
     lib.tf_manager_flight_json.restype = ctypes.c_void_p
     lib.tf_manager_flight_json.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -293,6 +295,12 @@ class QuorumResult:
     # healing path can always iterate these.
     recover_src_replica_ranks: List[int] = field(default_factory=list)
     recover_src_manager_addresses: List[str] = field(default_factory=list)
+    # Full sorted participant membership (fields 15-16; ALWAYS filled by
+    # servers of this generation): shard holders for the erasure-coded
+    # recovery fallback are any live participant, not just max-step donors.
+    # Empty against pre-EC servers (the EC plane then keeps its last view).
+    participant_replica_ranks: List[int] = field(default_factory=list)
+    participant_manager_addresses: List[str] = field(default_factory=list)
     store_address: str = ""
     max_step: int = 0
     max_replica_rank: Optional[int] = None
@@ -684,6 +692,8 @@ class ManagerServer:
         step_time_ms_ewma: float = 0.0,
         step_time_ms_last: float = 0.0,
         allreduce_gb_per_s: float = -1.0,
+        ec_shards_held: int = -1,
+        ec_shard_step: int = -1,
     ) -> None:
         """Pushes live (step, state) into the heartbeat payload so the
         lighthouse's ``GET /metrics`` and ``/status.json`` show per-replica
@@ -695,7 +705,11 @@ class ManagerServer:
         data-plane throughput) feeds its ``tpuft_allreduce_gb_per_s``
         gauge — there 0 is an authoritative reading (a committed step that
         moved no gradient bytes) and only a negative value keeps the prior
-        one, so status-only pushes must leave the default."""
+        one, so status-only pushes must leave the default.
+        ``ec_shards_held``/``ec_shard_step`` (heartbeat fields 8-9, the
+        erasure-shard inventory feeding ``tpuft_ec_shard_coverage``)
+        follow the same convention: 0 is an authoritative empty-store
+        report, negative keeps the prior reading."""
         if self._ptr:
             _lib.tf_manager_set_status(
                 self._ptr,
@@ -704,6 +718,8 @@ class ManagerServer:
                 float(step_time_ms_ewma),
                 float(step_time_ms_last),
                 float(allreduce_gb_per_s),
+                int(ec_shards_held),
+                int(ec_shard_step),
             )
 
     def flight_json(self, limit: int = 0) -> str:
@@ -784,6 +800,8 @@ class ManagerClient:
             ),
             recover_src_replica_ranks=donor_ranks if resp.heal else [],
             recover_src_manager_addresses=donor_addrs if resp.heal else [],
+            participant_replica_ranks=list(resp.participant_replica_ranks),
+            participant_manager_addresses=list(resp.participant_manager_addresses),
             store_address=resp.store_address,
             max_step=resp.max_step,
             max_replica_rank=resp.max_replica_rank if resp.max_replica_rank >= 0 else None,

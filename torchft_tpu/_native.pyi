@@ -30,6 +30,8 @@ class QuorumResult:
     recover_dst_replica_ranks_all: List[int]
     recover_src_replica_ranks: List[int]
     recover_src_manager_addresses: List[str]
+    participant_replica_ranks: List[int]
+    participant_manager_addresses: List[str]
     store_address: str
     max_step: int
     max_replica_rank: Optional[int]
@@ -140,6 +142,8 @@ class ManagerServer:
         step_time_ms_ewma: float = ...,
         step_time_ms_last: float = ...,
         allreduce_gb_per_s: float = ...,
+        ec_shards_held: int = ...,
+        ec_shard_step: int = ...,
     ) -> None: ...
     def flight_json(self, limit: int = ...) -> str: ...
     def flight(self, limit: int = ...) -> Dict[str, Any]: ...

@@ -24,6 +24,24 @@ Two performance structures on top of the reference design:
   preallocated buffer, and fails a stripe over to the next donor on
   error/timeout — so heal bandwidth scales with the donor count and a donor
   dying mid-heal degrades instead of aborting.
+
+Two integrity/redundancy structures on top (this PR):
+
+- **Per-buffer CRC32C**: the background snapshotter checksums every flat
+  buffer once per snapshot (meta.crcs); receivers verify each buffer as it
+  lands — on the /full path, the striped path, and the shard endpoints — so
+  a torn or corrupted stream mid-heal FAILS the fetch (stripe failover,
+  then latched error + retry) instead of installing garbage.
+
+- **Erasure-shard endpoints** (torchft_tpu/ec): the same server also hosts
+  the group's :class:`~torchft_tpu.ec.store.ShardStore` at
+  ``GET/POST /ec/shard/<step>/<idx>`` + ``GET /ec/have/<step>`` — static
+  self-verifying bytes served WITHOUT the checkpoint RWLock or a serving
+  window, which is what makes reconstruction donor-free.  The snapshotter
+  additionally accepts non-serving snapshot enqueues (``enqueue_snapshot``
+  with serve=False): the flatten runs and the EC hook fires, but the
+  served ``(meta, buffers, step)`` slot is NOT flipped, so per-commit
+  encode generations can never 404 a healer mid-fetch.
 """
 
 from __future__ import annotations
@@ -39,7 +57,7 @@ import urllib.parse
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler
-from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -100,11 +118,28 @@ class HTTPTransport(CheckpointTransport):
         self._snap_cond = threading.Condition()
         self._state: Optional[Tuple[StateDictMeta, List[np.ndarray]]] = None
         self._step = -1
-        self._snap_pending: Optional[Tuple[int, Any]] = None
+        # Pending snapshots keyed by serve flag: a per-commit EC enqueue
+        # (serve=False) must never overwrite a pending SERVING enqueue in
+        # the single drop-stale slot, and vice versa.  Serving entries are
+        # flattened first (a healer is waiting on that flip).
+        self._snap_pending: Dict[bool, Tuple[int, Any]] = {}
         self._pending_step = -1
-        self._snap_error: Optional[Exception] = None
+        self._snap_busy = False
+        # Flatten errors latched PER KIND: a successful EC (serve=False)
+        # flatten must not clear a failed SERVING snapshot's error out of
+        # wait_snapshot (and an EC failure must not mark a servable donor
+        # failed) — the two pipelines share a worker, not an outcome.
+        self._snap_error: Dict[bool, Optional[Exception]] = {}
         self._shutdown = False
         self._spans = None  # optional obs SpanTracker (set_span_tracker)
+        # Erasure-shard plane (torchft_tpu/ec): a ShardStore served at
+        # /ec/shard/<step>/<idx>, and a hook the background snapshotter
+        # calls with every flattened snapshot (the EC encode entry point).
+        self._shard_store = None
+        self._snapshot_hook: Optional[Callable[[int, StateDictMeta, List[np.ndarray]], None]] = None
+        # Per-buffer CRCs on served snapshots (TPUFT_HTTP_CRC=0 disables
+        # computing them; receivers verify whenever the header carries them).
+        self._crc_enabled = os.environ.get("TPUFT_HTTP_CRC", "1") != "0"
         # Optional serving-side bandwidth cap shared by ALL connections of
         # this transport (TPUFT_HTTP_SHAPED_MBPS, read at construction):
         # emulates a donor-NIC link for benchmarking the link-bound regime
@@ -121,6 +156,13 @@ class HTTPTransport(CheckpointTransport):
             def do_GET(self) -> None:
                 path, _, query = self.path.partition("?")
                 parts = path.strip("/").split("/")
+                # /ec/shard/<step>/<idx> and /ec/have/<step>: the erasure
+                # shard plane — static self-verifying bytes served straight
+                # from the ShardStore, WITHOUT the checkpoint RWLock or a
+                # serving window (the donor-free property).
+                if parts and parts[0] == "ec":
+                    transport._handle_ec_get(self, parts)
+                    return
                 # /checkpoint/<step>/<what>[?n=<stripes>]
                 if len(parts) != 3 or parts[0] != "checkpoint":
                     self.send_error(404, "unknown path")
@@ -222,6 +264,13 @@ class HTTPTransport(CheckpointTransport):
                 except TimeoutError:
                     self.send_error(503, "checkpoint lock busy")
 
+            def do_POST(self) -> None:
+                parts = self.path.partition("?")[0].strip("/").split("/")
+                if parts and parts[0] == "ec":
+                    transport._handle_ec_post(self, parts)
+                    return
+                self.send_error(404, "unknown path")
+
         self._server = ThreadingHTTPServerV6(("", 0), Handler)
         self._port = self._server.server_address[1]
         self._thread = threading.Thread(
@@ -242,43 +291,88 @@ class HTTPTransport(CheckpointTransport):
         instead of sitting on its critical path."""
         self._spans = spans
 
+    def attach_shard_store(self, store) -> None:
+        """Attaches a :class:`~torchft_tpu.ec.store.ShardStore` so this
+        server also serves/accepts erasure shards on ``/ec/...`` (see
+        docs/wire.md "Erasure shard endpoints")."""
+        self._shard_store = store
+
+    def set_snapshot_hook(
+        self, hook: Callable[[int, StateDictMeta, List[np.ndarray]], None]
+    ) -> None:
+        """Registers a callable run on the BACKGROUND snapshotter after
+        every successful flatten — the EC plane's encode entry point
+        (:meth:`~torchft_tpu.ec.store.ECPlane.on_snapshot`).  The hook runs
+        off the train loop by construction and must not raise."""
+        self._snapshot_hook = hook
+
     def _snapshot_loop(self) -> None:
         """Worker: flatten the newest enqueued pytree into the inactive
-        buffer slot, then atomically flip the served snapshot."""
+        buffer slot, then atomically flip the served snapshot (serving
+        enqueues) and fire the snapshot hook (all enqueues)."""
         while True:
             with self._snap_cond:
-                while self._snap_pending is None and not self._shutdown:
+                while not self._snap_pending and not self._shutdown:
                     self._snap_cond.wait()
                 if self._shutdown:
                     return
-                step, state_dict = self._snap_pending
-                self._snap_pending = None
+                # Serving enqueues first: a healer is blocked on that flip,
+                # while an EC encode generation only has to land eventually.
+                serve = True in self._snap_pending
+                step, state_dict = self._snap_pending.pop(serve)
+                self._snap_busy = True
             try:
                 # Device->host copies happen HERE, off the train loop.  The
                 # old snapshot keeps serving from the active slot until the
                 # flip below (double buffering).
                 if self._spans is not None:
                     with self._spans.span("snapshot", step=step):
-                        meta, buffers = flatten_state_dict(state_dict, step=step)
+                        meta, buffers = self._flatten_with_crcs(state_dict, step)
                 else:
-                    meta, buffers = flatten_state_dict(state_dict, step=step)
+                    meta, buffers = self._flatten_with_crcs(state_dict, step)
             except Exception as e:  # noqa: BLE001 — a failed snapshot must
                 # not kill the worker; healers see 404 and retry next round.
                 logger.exception("async snapshot for step %s failed: %s", step, e)
                 with self._snap_cond:
-                    self._snap_error = e
-                    if self._pending_step == step:
+                    self._snap_error[serve] = e
+                    self._snap_busy = False
+                    if serve and self._pending_step == step:
                         self._pending_step = -1
                     self._snap_cond.notify_all()
                 continue
             with self._snap_cond:
-                if step >= self._step:
+                if serve and step >= self._step:
                     self._state = (meta, buffers)
                     self._step = step
-                self._snap_error = None
-                if self._pending_step == step:
+                self._snap_error[serve] = None
+                if serve and self._pending_step == step:
                     self._pending_step = -1
+                # The flip is visible NOW (_await_flip wakes here); the
+                # busy flag stays up through the hook so wait_snapshot
+                # covers the full pipeline including the EC encode.
                 self._snap_cond.notify_all()
+            hook = self._snapshot_hook
+            if hook is not None:
+                try:
+                    hook(step, meta, buffers)
+                except Exception as e:  # noqa: BLE001 — EC encode is
+                    # best-effort; a failure degrades to donor-only healing.
+                    logger.exception("snapshot hook for step %s failed: %s", step, e)
+            with self._snap_cond:
+                self._snap_busy = False
+                self._snap_cond.notify_all()
+
+    def _flatten_with_crcs(self, state_dict: Any, step: int):
+        """flatten_state_dict + per-buffer CRCs stamped into the header —
+        computed ONCE here on the background thread, verified by every
+        receiver (full, striped, shard endpoints)."""
+        meta, buffers = flatten_state_dict(state_dict, step=step)
+        if self._crc_enabled:
+            from torchft_tpu.checkpointing.integrity import checksum_buffers
+
+            meta.crc_algo, crcs = checksum_buffers(buffers)
+            meta.crcs = tuple(crcs)
+        return meta, buffers
 
     def _await_flip(self, step: int) -> None:
         """Blocks while a snapshot for ``step`` is enqueued/flattening, until
@@ -302,12 +396,17 @@ class HTTPTransport(CheckpointTransport):
         a bench/test treat an unservable donor as ready."""
         deadline = time.monotonic() + (timeout if timeout is not None else self._timeout)
         with self._snap_cond:
-            while (self._snap_pending is not None or self._pending_step >= 0) and not self._shutdown:
+            while (
+                self._snap_pending or self._snap_busy or self._pending_step >= 0
+            ) and not self._shutdown:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._snap_cond.wait(remaining)
-            return self._snap_error is None
+            # Servability is the SERVING pipeline's outcome only: an EC
+            # (serve=False) flatten failure degrades the shard plane, not
+            # the donor's checkpoint window.
+            return self._snap_error.get(True) is None
 
     # -- serving ------------------------------------------------------------
 
@@ -355,6 +454,95 @@ class HTTPTransport(CheckpointTransport):
             return 1
         return max(1, min(self._num_chunks, len(buffers)))
 
+    # -- erasure shard endpoints (torchft_tpu/ec) ----------------------------
+
+    def _handle_ec_get(self, handler, parts: List[str]) -> None:
+        """GET /ec/shard/<step>/<idx> (one self-verifying shard frame) and
+        GET /ec/have/<step> (JSON inventory + geometry).  Served straight
+        from the ShardStore — no RWLock, no serving window."""
+        store = self._shard_store
+        if store is None:
+            handler.send_error(404, "no shard store attached")
+            return
+        try:
+            if len(parts) == 4 and parts[1] == "shard":
+                step, idx = int(parts[2]), int(parts[3])
+                shard = store.get(step, idx)
+                if shard is None:
+                    handler.send_error(404, f"shard {idx} for step {step} not held")
+                    return
+                from torchft_tpu.ec.encoder import write_shard
+
+                body = write_shard(shard)
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/octet-stream")
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                # Shares the donor-NIC pacer: shard serving rides the same
+                # physical link as checkpoint serving in the shaped regime.
+                _paced(handler.wfile, self._pacer).write(body)
+                return
+            if len(parts) == 3 and parts[1] == "have":
+                import json
+
+                body = json.dumps(store.inventory(int(parts[2]))).encode()
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                handler.wfile.write(body)
+                return
+        except ValueError:
+            handler.send_error(400, "bad step/shard index")
+            return
+        handler.send_error(404, "unknown ec path")
+
+    def _handle_ec_post(self, handler, parts: List[str]) -> None:
+        """POST /ec/shard/<step>/<idx>: a peer pushing a parity shard.  The
+        frame's CRC is verified BEFORE storing — a torn push is refused
+        (400), never served onward."""
+        store = self._shard_store
+        if store is None:
+            handler.send_error(404, "no shard store attached")
+            return
+        if len(parts) != 4 or parts[1] != "shard":
+            handler.send_error(404, "unknown ec path")
+            return
+        try:
+            step, idx = int(parts[2]), int(parts[3])
+            length = int(handler.headers.get("Content-Length", "0"))
+        except ValueError:
+            handler.send_error(400, "bad step/shard index")
+            return
+        if length <= 0:
+            handler.send_error(400, "missing body")
+            return
+        try:
+            from torchft_tpu.checkpointing.serialization import read_exact
+            from torchft_tpu.ec.encoder import read_shard
+
+            shard = read_shard(bytes(read_exact(handler.rfile, length)))
+            if shard.step != step or shard.idx != idx:
+                raise IOError(
+                    f"shard header ({shard.step},{shard.idx}) != path ({step},{idx})"
+                )
+        except Exception as e:  # noqa: BLE001 — corrupt push -> 400, not a 500
+            # ascii-sanitized: the HTTP status line is latin-1 encoded and
+            # error text may carry wider characters.
+            msg = f"bad shard frame: {e}".encode("ascii", "replace").decode()
+            handler.send_error(400, msg)
+            return
+        store.put(shard)
+        handler.send_response(204)
+        handler.send_header("Content-Length", "0")
+        handler.end_headers()
+
+    def materialize(self, meta: StateDictMeta, buffers: List[np.ndarray]) -> Any:
+        """(meta, buffers) -> the live pytree, through the same sharding
+        restorer the donor-fetch path uses — the final leg of an erasure
+        reconstruction, shared so the two heal paths cannot diverge."""
+        return unflatten_state_dict(meta, buffers, self._restore_sharding)
+
     def metadata(self) -> str:
         return f"http://{socket.gethostname()}:{self._port}"
 
@@ -371,12 +559,25 @@ class HTTPTransport(CheckpointTransport):
         state-dict tree per call; a caller passing mutable numpy leaves must
         not mutate them in place before the snapshot lands (wait_snapshot).
         """
-        with self._snap_cond:
-            # Drop-stale: only the newest enqueued snapshot matters.
-            self._snap_pending = (step, state_dict)
-            self._pending_step = max(self._pending_step, step)
-            self._snap_cond.notify_all()
+        self.enqueue_snapshot(step, state_dict, serve=True)
         self.allow_checkpoint(step)
+
+    def enqueue_snapshot(self, step: int, state_dict: Any, serve: bool = True) -> None:
+        """Enqueues a snapshot for the background flatten pipeline.
+
+        ``serve=True`` is the send_checkpoint path: the result flips the
+        served ``(meta, buffers, step)`` slot.  ``serve=False`` runs the
+        SAME pipeline — flatten + CRCs + the EC snapshot hook — but never
+        touches the served slot, so the Manager can feed every committed
+        step to the erasure encoder without racing a healer's in-flight
+        fetch off its step (the serving flip stays quorum-paced).
+        Drop-stale per kind: only the newest enqueue of each kind matters.
+        """
+        with self._snap_cond:
+            self._snap_pending[serve] = (step, state_dict)
+            if serve:
+                self._pending_step = max(self._pending_step, step)
+            self._snap_cond.notify_all()
 
     def allow_checkpoint(self, step: int) -> None:
         if self._checkpoint_lock.w_locked():
@@ -521,6 +722,8 @@ class HTTPTransport(CheckpointTransport):
         order = [(assigned + k) % len(donors) for k in range(len(donors))]
         candidates = [d for d in order if d not in dead] or order
         last: Optional[Exception] = None
+        crcs = getattr(meta, "crcs", None)
+        crc_algo = getattr(meta, "crc_algo", None)
         # Single-donor chunked fetches omit the ?n= query: n already equals
         # the chunk count the server advertised on /metadata, and a pre-PR
         # donor's handler cannot parse a query string (rolling-upgrade
@@ -538,6 +741,17 @@ class HTTPTransport(CheckpointTransport):
                         )
                     for i in got_sel:
                         read_exact_into(resp, views[i])
+                        if crcs is not None:
+                            # Verify the buffer AS IT LANDS: a corrupt/torn
+                            # stripe raises here and fails over to the next
+                            # donor — the re-fetch simply overwrites the
+                            # same preallocated view.
+                            from torchft_tpu.checkpointing.integrity import verify
+
+                            verify(
+                                views[i], crcs[i], crc_algo,
+                                f"stripe {idx}/{n} buffer {i} from {donors[d]}",
+                            )
                 return
             except Exception as e:  # noqa: BLE001 — stripe failover
                 last = e

@@ -83,6 +83,14 @@ class StateDictMeta:
     # ("obj", the pickled-inline python value).
     leaves: List[Tuple[str, Any]] = field(default_factory=list)
     tensor_metas: List[TensorMeta] = field(default_factory=list)
+    # Per-buffer integrity checksums (torchft_tpu/checkpointing/integrity):
+    # filled by the HTTP transport's background snapshotter, verified by
+    # every receiver that sees them — a torn/corrupted stream fails the
+    # fetch instead of installing garbage.  None from pre-integrity
+    # producers (also what pickles from before these fields existed resolve
+    # to, via the dataclass class-level defaults), which skips the check.
+    crc_algo: Optional[str] = None
+    crcs: Optional[Tuple[int, ...]] = None
 
 
 def _spec_of(arr: Any) -> Optional[Any]:
@@ -244,12 +252,22 @@ def write_state_dict(
 
 
 def read_state_dict(stream: io.RawIOBase) -> Tuple[StateDictMeta, List[np.ndarray]]:
-    """Reads one write_state_dict frame: (header, raw host buffers)."""
+    """Reads one write_state_dict frame: (header, raw host buffers).
+
+    When the header carries per-buffer checksums (``meta.crcs``), every
+    buffer is verified as it lands; a mismatch raises IOError so the caller
+    fails the fetch — never installs a torn stream."""
     header_len = int.from_bytes(read_exact(stream, 8), "little")
     meta: StateDictMeta = pickle.loads(read_exact(stream, header_len))
+    crcs = getattr(meta, "crcs", None)
+    algo = getattr(meta, "crc_algo", None)
     buffers: List[np.ndarray] = []
-    for tm in meta.tensor_metas:
+    for i, tm in enumerate(meta.tensor_metas):
         raw = read_exact(stream, tm.nbytes)
+        if crcs is not None:
+            from torchft_tpu.checkpointing.integrity import verify
+
+            verify(memoryview(raw), crcs[i], algo, f"checkpoint buffer {i}")
         buffers.append(np.frombuffer(raw, dtype=np.uint8).view(tm.dtype).reshape(tm.shape))
     return meta, buffers
 

@@ -1,0 +1,42 @@
+"""Erasure-coded peer state: donor-free healing (docs/architecture.md
+"Donor-free healing").
+
+After each committed step the checkpoint snapshotter's background thread
+additionally encodes the canonical serialized state stream into ``k + m``
+systematic Reed-Solomon shards over GF(256) and spreads them across the
+replica groups (deterministic placement rotated per step, parity pushed
+over integrity-checked HTTP).  A recovering group whose assigned donors
+are unreachable — or whose donor fetch fails mid-stream — reconstructs the
+max-step state from ANY ``k`` surviving shard holders instead: no donor on
+the recovery critical path, no serving window, no rotation, tolerant of
+``m`` simultaneous group losses (Gemini SOSP '23, ECRM HPCA '21; see
+PAPERS.md).
+
+Modules:
+  - :mod:`~torchft_tpu.ec.gf` — vectorized GF(256) arithmetic (log/exp +
+    full multiplication tables) and Gauss-Jordan inversion;
+  - :mod:`~torchft_tpu.ec.encoder` — systematic Cauchy-matrix Reed-Solomon
+    encode/decode over byte streams, bitwise-exact;
+  - :mod:`~torchft_tpu.ec.placement` — deterministic shard -> peer-group
+    placement, rotated per step;
+  - :mod:`~torchft_tpu.ec.store` — in-memory bounded shard store, the
+    integrity-checked HTTP push/fetch client, the any-k reconstruction
+    client, and :class:`~torchft_tpu.ec.store.ECPlane` (the Manager-facing
+    coordinator).
+"""
+
+from torchft_tpu.ec.encoder import Shard, decode_stream, encode_stream
+from torchft_tpu.ec.placement import shard_holder, shards_for_holder
+from torchft_tpu.ec.store import ECConfig, ECPlane, ShardStore, reconstruct
+
+__all__ = [
+    "ECConfig",
+    "ECPlane",
+    "Shard",
+    "ShardStore",
+    "decode_stream",
+    "encode_stream",
+    "reconstruct",
+    "shard_holder",
+    "shards_for_holder",
+]

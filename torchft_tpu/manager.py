@@ -58,6 +58,13 @@ TPUFT_MANAGER_PORT_ENV: str = "TPUFT_MANAGER_PORT"
 # connections per heal; 4 saturates typical host NICs long before the donor
 # pool does.  0 = no cap.
 TPUFT_MAX_HEAL_DONORS_ENV: str = "TPUFT_MAX_HEAL_DONORS"
+# Heal-retry pacing (docs/api.md): after a FAILED heal fetch the next
+# quorum's retry waits a decorrelated-jitter backoff (ha/backoff.py) so a
+# flapping donor — or a donor whose serving window is briefly busy — cannot
+# turn every quorum round into a zero-delay heal storm.  base/cap seconds;
+# reset on the first successful fetch.
+TPUFT_HEAL_BACKOFF_BASE_ENV: str = "TPUFT_HEAL_BACKOFF_BASE_S"
+TPUFT_HEAL_BACKOFF_CAP_ENV: str = "TPUFT_HEAL_BACKOFF_CAP_S"
 
 
 class WorldSizeMode(Enum):
@@ -287,15 +294,87 @@ class Manager:
         # counts and wire bytes ride here.  Cleared with the other per-step
         # accounting at start_quorum and flushed at the commit vote.
         self._summary_extra: Dict[str, object] = {}
+
+        # Erasure-coded peer state (torchft_tpu/ec, docs/architecture.md
+        # "Donor-free healing"): when TPUFT_EC_K > 0 and the checkpoint
+        # transport can host a shard store, every committed step's state is
+        # additionally encoded into k+m Reed-Solomon shards on the
+        # transport's background snapshotter, and a heal whose donors are
+        # unreachable reconstructs from any k surviving shard holders.
+        self._ec = None
+        from torchft_tpu.ec import ECConfig
+
+        ec_cfg = ECConfig.from_env()
+        if (
+            ec_cfg.enabled
+            and self._checkpoint_transport is not None
+            and hasattr(self._checkpoint_transport, "attach_shard_store")
+        ):
+            from torchft_tpu.ec import ECPlane
+
+            self._ec = ECPlane(
+                ec_cfg,
+                spans=self._spans,
+                metrics=self._metrics,
+                resolve_peer=self._dial_peer_transport,
+                push_timeout=self._timeout.total_seconds(),
+            )
+
+        # Heal-retry pacing: decorrelated jitter between consecutive heal
+        # attempts after a failure (satellite of the EC work; see the env
+        # docs above).  _heal_failures counts consecutive failed fetches.
+        from torchft_tpu.ha.backoff import DecorrelatedBackoff
+
+        heal_base_s = _env_float(TPUFT_HEAL_BACKOFF_BASE_ENV, 0.2)
+        if heal_base_s <= 0:
+            # Same loud-but-safe policy as _env_float: a bad tuning value
+            # must never abort recovery (DecorrelatedBackoff rejects <= 0).
+            self._logger.warn(
+                f"ignoring non-positive {TPUFT_HEAL_BACKOFF_BASE_ENV}="
+                f"{heal_base_s}; using default 0.2"
+            )
+            heal_base_s = 0.2
+        self._heal_backoff = DecorrelatedBackoff(
+            base_s=heal_base_s,
+            cap_s=_env_float(TPUFT_HEAL_BACKOFF_CAP_ENV, 5.0),
+        )
+        self._heal_failures = 0
+        self._ec_enqueued_step = -1
+
         self._wire_transport_spans()
 
     def _wire_transport_spans(self) -> None:
         """Hands the span tracker to transports that emit their own spans —
         the HTTP transport's background snapshotter emits ``snapshot`` spans
-        so obs.report can show the flatten overlapping the train step."""
+        so obs.report can show the flatten overlapping the train step — and
+        wires the EC plane's shard store + encode hook onto the transport."""
         transport = self._checkpoint_transport
         if transport is not None and hasattr(transport, "set_span_tracker"):
             transport.set_span_tracker(self._spans)
+        if (
+            self._ec is not None
+            and transport is not None
+            and hasattr(transport, "attach_shard_store")
+        ):
+            transport.attach_shard_store(self._ec.store)
+            transport.set_snapshot_hook(self._ec.on_snapshot)
+
+    def _dial_peer_transport(self, manager_addr: str) -> str:
+        """Resolves a peer manager's checkpoint-transport base URL for this
+        local rank (the shard endpoints live on the same server).  Used by
+        the EC plane — cached there per address."""
+        client = self._manager_client_factory(
+            manager_addr,
+            connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
+        )
+        try:
+            return client._checkpoint_metadata(
+                self._rank,
+                timeout_ms=int(self._timeout.total_seconds() * 1000),
+                trace_id=self._trace_id,
+            )
+        finally:
+            client.close()
 
     # -- registration -------------------------------------------------------
 
@@ -341,6 +420,24 @@ class Manager:
             self._d2h_bytes = 0
             self._h2d_bytes = 0
             self._summary_extra = {}
+
+        # Feed the erasure encoder: at the top of a step the user state IS
+        # the last committed step's state (a failed vote discarded its
+        # speculative update), so enqueue it for the background snapshotter
+        # as a NON-serving snapshot — the flatten + k+m encode + parity
+        # push all run off the train thread (the enqueue itself is ~µs),
+        # and the serving slot stays quorum-paced.  Deduped per step so
+        # failed-commit retries don't re-flatten identical state.
+        if (
+            self._ec is not None
+            and self._step != self._ec_enqueued_step
+            and self._ec.wants_snapshot(self._step)
+            and hasattr(self._checkpoint_transport, "enqueue_snapshot")
+        ):
+            self._checkpoint_transport.enqueue_snapshot(
+                self._step, self._manager_state_dict(), serve=False
+            )
+            self._ec_enqueued_step = self._step
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -434,6 +531,19 @@ class Manager:
         store_address = quorum.store_address
         max_step = quorum.max_step
         heal = quorum.heal
+
+        if self._ec is not None:
+            # Refresh the EC plane's placement membership from the full
+            # participant list (fields 15-16).  Empty against a pre-EC
+            # server — the plane then keeps its previous view, which still
+            # probes correctly (placement is a hint; reconstruction sweeps
+            # holder inventories regardless).
+            p_ranks = list(getattr(quorum, "participant_replica_ranks", []) or [])
+            p_addrs = list(
+                getattr(quorum, "participant_manager_addresses", []) or []
+            )
+            if p_ranks and len(p_ranks) == len(p_addrs):
+                self._ec.set_peers(p_ranks, p_addrs, replica_rank)
 
         # Participation bookkeeping (torchft/manager.py:480-500): with async
         # quorum (or healing disabled) only the up-to-date groups participate
@@ -547,8 +657,13 @@ class Manager:
                 self._healing = True
                 src_rank = cast(int, recover_src_replica_rank)
                 donor_ranks = list(quorum.recover_src_replica_ranks) or [src_rank]
-                donor_addrs = list(quorum.recover_src_manager_addresses) or [
-                    quorum.recover_src_manager_address
+                donor_addrs = [
+                    a
+                    for a in (
+                        list(quorum.recover_src_manager_addresses)
+                        or [quorum.recover_src_manager_address]
+                    )
+                    if a
                 ]
                 max_donors = _max_heal_donors()
                 if max_donors > 0:
@@ -562,43 +677,49 @@ class Manager:
                     # the next quorum.
                     donor_ranks = donor_ranks[:1]
                     donor_addrs = donor_addrs[:1]
-                # "healing from replica" is a grep contract with bench.py's
-                # log-fallback heal counter (tests/test_bench_contract.py).
-                self._logger.info(
-                    f"healing from replica {src_rank} at step {max_step} via "
-                    f"{len(donor_addrs)} donor(s) {list(zip(donor_ranks, donor_addrs))}"
-                )
-                self._metrics.emit(
-                    "heal_start",
-                    src_rank=src_rank,
-                    max_step=max_step,
-                    n_donors=len(donor_addrs),
-                )
+                if self._heal_failures > 0:
+                    # Heal-retry backoff: consecutive failed fetches pace
+                    # their retries with decorrelated jitter so a flapping
+                    # donor cannot make every quorum round a heal storm.
+                    delay = self._heal_backoff.next()
+                    self._logger.warn(
+                        f"heal retry #{self._heal_failures}: backing off "
+                        f"{delay:.2f}s before re-fetching"
+                    )
+                    time.sleep(delay)
                 self._set_status("heal")
-                with self._spans.span(
-                    "heal", step=max_step, src_rank=src_rank
-                ) as sp_heal:
-                    donor_metas, donor_used = self._resolve_donor_metadatas(
-                        donor_ranks, donor_addrs
+                prefer_ec = self._ec is not None and self._ec.config.mode == "prefer"
+                state: Optional[Dict[str, object]] = None
+                fetch_err: Optional[Exception] = None
+                if not prefer_ec and donor_addrs:
+                    state, fetch_err = self._heal_from_donors(
+                        src_rank, max_step, donor_ranks, donor_addrs
                     )
-                    state = self._checkpoint_transport.recv_checkpoint(
-                        src_rank=donor_used[0],
-                        metadata=(
-                            donor_metas if len(donor_metas) > 1 else donor_metas[0]
-                        ),
-                        step=max_step,
-                        timeout=self._timeout.total_seconds(),
+                elif not donor_addrs:
+                    fetch_err = RuntimeError(
+                        "quorum response names no reachable donor"
                     )
-                    self._pending_state_dict = cast(Dict[str, object], state)
-                    # Fast-forward to the healed step (torchft/manager.py:562-568).
-                    self._step = max_step
-                self._metrics.emit(
-                    "heal_fetched",
-                    src_rank=donor_used[0],
-                    step=max_step,
-                    heal_ms=sp_heal.duration_ms,
-                    n_donors=len(donor_metas),
-                )
+                if state is None and self._ec is not None:
+                    # Donor-free fallback (or "prefer" mode's first choice):
+                    # reconstruct the max-step state from any k surviving
+                    # shard holders — no serving window, no donor rotation.
+                    state = self._heal_from_shards(max_step, fetch_err)
+                if state is None and prefer_ec and donor_addrs:
+                    # prefer mode degrades to the donor path when coverage
+                    # is short (fresh cluster, EC disabled on peers).
+                    state, fetch_err = self._heal_from_donors(
+                        src_rank, max_step, donor_ranks, donor_addrs
+                    )
+                if state is None:
+                    self._heal_failures += 1
+                    raise fetch_err if fetch_err is not None else RuntimeError(
+                        "heal failed with no donors and no shard coverage"
+                    )
+                self._heal_failures = 0
+                self._heal_backoff.reset()
+                self._pending_state_dict = state
+                # Fast-forward to the healed step (torchft/manager.py:562-568).
+                self._step = max_step
         elif heal:
             self._healing = True
 
@@ -606,6 +727,112 @@ class Manager:
         # the commit vote — without this the async-quorum overlap leaves the
         # replica labeled "quorum"/"heal" for the whole compute phase.
         self._set_status("step")
+
+    def _heal_from_donors(
+        self,
+        src_rank: int,
+        max_step: int,
+        donor_ranks: List[int],
+        donor_addrs: List[str],
+    ) -> tuple:
+        """The striped multi-donor fetch path: (state, None) on success,
+        (None, error) on failure — the caller decides whether an erasure
+        reconstruction can still save this quorum round."""
+        # "healing from replica" is a grep contract with bench.py's
+        # log-fallback heal counter (tests/test_bench_contract.py).
+        self._logger.info(
+            f"healing from replica {src_rank} at step {max_step} via "
+            f"{len(donor_addrs)} donor(s) {list(zip(donor_ranks, donor_addrs))}"
+        )
+        self._metrics.emit(
+            "heal_start",
+            src_rank=src_rank,
+            max_step=max_step,
+            n_donors=len(donor_addrs),
+        )
+        try:
+            with self._spans.span(
+                "heal", step=max_step, src_rank=src_rank
+            ) as sp_heal:
+                donor_metas, donor_used = self._resolve_donor_metadatas(
+                    donor_ranks, donor_addrs
+                )
+                state = self._checkpoint_transport.recv_checkpoint(
+                    src_rank=donor_used[0],
+                    metadata=(
+                        donor_metas if len(donor_metas) > 1 else donor_metas[0]
+                    ),
+                    step=max_step,
+                    timeout=self._timeout.total_seconds(),
+                )
+            self._metrics.emit(
+                "heal_fetched",
+                src_rank=donor_used[0],
+                step=max_step,
+                heal_ms=sp_heal.duration_ms,
+                n_donors=len(donor_metas),
+            )
+            return cast(Dict[str, object], state), None
+        except Exception as e:  # noqa: BLE001 — the caller may still
+            # reconstruct from erasure shards this same round
+            self._logger.warn(f"donor heal fetch failed: {e}")
+            return None, e
+
+    def _heal_from_shards(
+        self, max_step: int, fetch_err: Optional[Exception]
+    ) -> Optional[Dict[str, object]]:
+        """Donor-free reconstruction: any k surviving shard holders ->
+        the max-step state, installed through the exact same
+        materialization the donor path uses (bitwise-equal by
+        construction).  Returns None when coverage never reached k — the
+        caller then latches the donor error and the next quorum retries."""
+        assert self._ec is not None
+        if max_step <= 0:
+            # Step-0 init sync collapses the source set to participant 0's
+            # (random-init) weights; no shard generation exists for it by
+            # design (pre-sync states diverge) — donor path only.
+            return None
+        if fetch_err is not None:
+            self._logger.warn(
+                f"donor path exhausted ({fetch_err}); reconstructing step "
+                f"{max_step} from erasure shards"
+            )
+        try:
+            with self._spans.span("ec_reconstruct", step=max_step) as sp:
+                meta, buffers, stats = self._ec.reconstruct_state(
+                    max_step, timeout=self._timeout.total_seconds()
+                )
+                transport = self._checkpoint_transport
+                if hasattr(transport, "materialize"):
+                    state = transport.materialize(meta, buffers)
+                else:
+                    from torchft_tpu.checkpointing.serialization import (
+                        unflatten_state_dict,
+                    )
+
+                    state = unflatten_state_dict(meta, buffers)
+            self._metrics.emit(
+                "ec_reconstruct",
+                step=max_step,
+                reconstruct_ms=sp.duration_ms,
+                **{
+                    k: v
+                    for k, v in stats.items()
+                    if k in ("holders", "probes", "corrupt", "fetch_errors",
+                             "shards_used", "parity_used")
+                },
+            )
+            self._logger.info(
+                f"reconstructed step {max_step} from erasure shards "
+                f"{stats.get('shards_used')} ({stats['holders']} holders, "
+                f"{stats.get('parity_used', 0)} parity)"
+            )
+            return cast(Dict[str, object], state)
+        except Exception as e:  # noqa: BLE001 — reconstruction is the
+            # fallback; its failure must surface as a latched step error,
+            # not a dead worker.
+            self._logger.warn(f"erasure reconstruction failed: {e}")
+            return None
 
     def _resolve_donor_metadatas(
         self, donor_ranks: List[int], donor_addrs: List[str]
@@ -618,19 +845,7 @@ class Manager:
         only when NO donor is reachable."""
 
         def dial(pair) -> str:
-            rank_i, addr_i = pair
-            client = self._manager_client_factory(
-                addr_i,
-                connect_timeout_ms=int(self._connect_timeout.total_seconds() * 1000),
-            )
-            try:
-                return client._checkpoint_metadata(
-                    self._rank,
-                    timeout_ms=int(self._timeout.total_seconds() * 1000),
-                    trace_id=self._trace_id,
-                )
-            finally:
-                client.close()
+            return self._dial_peer_transport(pair[1])
 
         pairs = list(zip(donor_ranks, donor_addrs))
         metas: List[str] = []
@@ -908,12 +1123,20 @@ class Manager:
         if srv is None:
             return
         try:
+            ec_held, ec_step = -1, -1
+            if self._ec is not None:
+                step, count = self._ec.coverage()
+                # (-1, 0) while empty -> an authoritative zero report so a
+                # pruned/fresh store never shows stale coverage.
+                ec_held, ec_step = count, max(0, step)
             srv.set_status(
                 self._step,
                 state,
                 self._step_stats.ewma_ms,
                 self._step_stats.last_ms,
                 self._ar_gbps,
+                ec_held,
+                ec_step,
             )
         except Exception:  # noqa: BLE001
             pass
@@ -1260,6 +1483,18 @@ class Manager:
             self._manager_server.shutdown()
         if self._store_server is not None:
             self._store_server.shutdown()
+
+
+def _env_float(name: str, default: float) -> float:
+    """Float env knob with a loud-but-safe fallback: a malformed tuning
+    value must never abort recovery itself."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        logging.getLogger("torchft_tpu.manager").warning(
+            "ignoring malformed %s", name
+        )
+        return default
 
 
 def _max_heal_donors() -> int:

@@ -70,6 +70,13 @@ EVENTS = {
              "into the stream so trace export and latency accounting see it",
     "straggler_drain": "launcher sentinel rotated a confirmed straggler out "
                        "through the cooperative-drain path",
+    # -- erasure-coded peer state (torchft_tpu/ec) --------------------------
+    "ec_push": "one committed step's shard generation encoded + placed "
+               "(k, m, encode_ms, held, pushed parity count, push_errors) "
+               "— emitted from the background snapshotter, one per encode",
+    "ec_reconstruct": "donor-free heal: max-step state reassembled from "
+                      "surviving shard holders (shards_used, parity_used, "
+                      "corrupt = shards excluded by checksum)",
     # -- streaming semi-sync (torchft_tpu/semisync) -------------------------
     "semisync_round": "one outer DiLoCo round finished (committed, "
                       "fragments, wire_bytes, codec, residual_l2) — the "

@@ -499,8 +499,13 @@ def attribute(
             election = min(election, wall)
             if election > q:
                 q = election
-            heal = phases.get("heal", 0.0) / 1e3
-            skip = ("quorum", "heal") + _OVERLAPPED
+            # ec_reconstruct is healing by another path (the donor-free
+            # shard fallback) — same class, so a cluster that heals via
+            # reconstruction reads comparably to one that heals via donors.
+            heal = (
+                phases.get("heal", 0.0) + phases.get("ec_reconstruct", 0.0)
+            ) / 1e3
+            skip = ("quorum", "heal", "ec_reconstruct") + _OVERLAPPED
             other_ft = (
                 sum(v for k, v in phases.items() if k not in skip) / 1e3
             )
@@ -554,7 +559,11 @@ def attribute(
             continue
         ts_first, _, step_first = seq[0]
         if t0 is not None and ts_first >= t0:
-            h = phase_ms.get((rid, step_first), {}).get("heal", 0.0) / 1e3
+            first_phases = phase_ms.get((rid, step_first), {})
+            h = (
+                first_phases.get("heal", 0.0)
+                + first_phases.get("ec_reconstruct", 0.0)
+            ) / 1e3
             if h:
                 g = _group(rid)
                 first_commit_heal[g] = first_commit_heal.get(g, 0.0) + h

@@ -58,22 +58,30 @@ __all__ = [
 # background pseudogradient round on the semisync engine's worker
 # (torchft_tpu/semisync) — OVERLAPPED for the same reason: it runs
 # concurrent with inner steps, and only the round-end drain (charged as
-# allreduce_merge) ever blocks the train thread.
+# allreduce_merge) ever blocks the train thread; ec_encode = the k+m
+# Reed-Solomon shard encode on the same background snapshotter
+# (torchft_tpu/ec) — OVERLAPPED like snapshot, and the bench's
+# donor-side-overhead cell exists to keep it that way; ec_reconstruct =
+# the donor-free heal fallback assembling max-step state from surviving
+# shard holders — blocks the healing group's quorum thread exactly like
+# heal, and report.py folds it into the heal class.
 PHASES = (
     "quorum",
     "configure",
     "heal",
+    "ec_reconstruct",
     "allreduce_d2h",
     "allreduce_h2d",
     "allreduce_merge",
     "commit_vote",
     "snapshot",
+    "ec_encode",
     "outer_sync",
 )
 
 # Phases that run on background threads concurrent with compute: report.py
 # excludes these from per-step critical-path attribution.
-OVERLAPPED_PHASES = ("snapshot", "outer_sync")
+OVERLAPPED_PHASES = ("snapshot", "ec_encode", "outer_sync")
 
 
 class Span:

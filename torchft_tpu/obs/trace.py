@@ -63,6 +63,10 @@ PHASE_TRACKS = {
     # The semisync engine's fragment rounds run on its worker thread,
     # concurrent with inner compute — same sub-track as the snapshotter.
     "outer_sync": "background",
+    # Erasure-shard encode rides the snapshotter thread (background); the
+    # reconstruction fallback blocks the healing quorum thread (main).
+    "ec_encode": "background",
+    "ec_reconstruct": "main",
 }
 
 # Events rendered as instant markers on the emitting replica's track (or
