@@ -99,7 +99,13 @@ def _lane_rank_body(
     subprocess drivers."""
     buckets = make_buckets(nbytes, n_buckets)
     t0 = time.perf_counter()
-    works = [collective.allreduce([b * (rank + 1)], op="sum") for b in buckets]
+    # The scaled bucket is a temporary — donate it so the native engine
+    # reduces in place over the caller's buffer (zero working-buffer copy);
+    # the Python engine ignores the hint, so the A/B stays same-workload.
+    works = [
+        collective.allreduce([b * (rank + 1)], op="sum", donate=True)
+        for b in buckets
+    ]
     outs = [w.wait(timeout=timeout) for w in works]
     wall = time.perf_counter() - t0
     expected_last = (n_buckets - 1) * world * (world + 1) / 2.0
@@ -121,7 +127,7 @@ def _lane_worker(cfg: Dict[str, Any]) -> Dict[str, Any]:
     world = int(cfg.get("world", 2))
     c = TCPCollective(
         timeout=cfg["timeout"], wire_dtype=cfg["wire_dtype"], lanes=cfg["lanes"],
-        topology=cfg.get("topology"),
+        topology=cfg.get("topology"), engine=cfg.get("engine"),
     )
     try:
         c.configure(cfg["store"], cfg["rank"], world)
@@ -190,6 +196,7 @@ def bench_lanes(
     trials: int = 1,
     world: int = 2,
     topology: Optional[str] = None,
+    engine: Optional[str] = None,
 ) -> Dict[str, Any]:
     """``world``-rank bucketed allreduce stream at the given lane count and
     topology under the shaped link.  ``procs=True`` (the artifact path)
@@ -199,8 +206,11 @@ def bench_lanes(
     polluted by OS scheduler noise (the 2-core CI hosts this runs on
     context-switch a dozen bench threads; a single trial can lose 30% to an
     unlucky schedule).  ``topology`` pins the cross-group ring layout
-    ("ring"/"ring2d"); None keeps the collective's default.  Returns wall +
-    GB/s + lane byte counters (per-tier under ring2d)."""
+    ("ring"/"ring2d"); None keeps the collective's default.  ``engine``
+    pins the ring hot-loop engine ("py"/"native" — the A/B the engine
+    sweep records); None keeps the collective's default (auto).  Returns
+    wall + GB/s + lane byte counters (per-tier under ring2d) + the engine
+    the configuration actually resolved to."""
     from torchft_tpu._native import StoreServer
 
     nbytes = int(payload_mb * (1 << 20))
@@ -213,13 +223,15 @@ def bench_lanes(
                 for trial in range(max(1, trials)):
                     prefix = (
                         f"{store.address()}/lanes{lanes}_{wire_dtype}"
-                        f"_{topology or 'default'}_w{world}_t{trial}"
+                        f"_{topology or 'default'}_{engine or 'auto'}"
+                        f"_w{world}_t{trial}"
                     )
                     cfgs = [
                         {"store": prefix, "rank": r, "lanes": lanes,
                          "nbytes": nbytes, "n_buckets": n_buckets,
                          "wire_dtype": wire_dtype, "timeout": timeout,
-                         "world": world, "topology": topology}
+                         "world": world, "topology": topology,
+                         "engine": engine}
                         for r in range(world)
                     ]
                     attempt = _spawn_workers("lanes", cfgs, timeout + 60)
@@ -230,50 +242,58 @@ def bench_lanes(
             else:
                 from torchft_tpu.collectives import TCPCollective
 
-                prefix = (
-                    f"{store.address()}/lanes{lanes}_{wire_dtype}"
-                    f"_{topology or 'default'}_w{world}"
-                )
-                cols = [
-                    TCPCollective(timeout=timeout, wire_dtype=wire_dtype,
-                                  lanes=lanes, topology=topology)
-                    for _ in range(world)
-                ]
-                results: Dict[int, dict] = {}
-                errors: List[BaseException] = []
-                try:
-                    threads = [
-                        threading.Thread(
-                            target=cols[r].configure, args=(prefix, r, world)
-                        )
-                        for r in range(world)
+                for trial in range(max(1, trials)):
+                    prefix = (
+                        f"{store.address()}/lanes{lanes}_{wire_dtype}"
+                        f"_{topology or 'default'}_{engine or 'auto'}"
+                        f"_w{world}_t{trial}"
+                    )
+                    cols = [
+                        TCPCollective(timeout=timeout, wire_dtype=wire_dtype,
+                                      lanes=lanes, topology=topology,
+                                      engine=engine)
+                        for _ in range(world)
                     ]
-                    for t in threads:
-                        t.start()
-                    for t in threads:
-                        t.join()
-
-                    def run(rank: int) -> None:
-                        try:
-                            results[rank] = _lane_rank_body(
-                                cols[rank], rank, nbytes, n_buckets, timeout,
-                                world=world,
+                    results: Dict[int, dict] = {}
+                    errors: List[BaseException] = []
+                    try:
+                        threads = [
+                            threading.Thread(
+                                target=cols[r].configure, args=(prefix, r, world)
                             )
-                        except BaseException as e:  # noqa: BLE001 — re-raised
-                            errors.append(e)
+                            for r in range(world)
+                        ]
+                        for t in threads:
+                            t.start()
+                        for t in threads:
+                            t.join()
 
-                    rs = [threading.Thread(target=run, args=(r,))
-                          for r in range(world)]
-                    for t in rs:
-                        t.start()
-                    for t in rs:
-                        t.join()
-                    if errors:
-                        raise errors[0]
-                finally:
-                    for c in cols:
-                        c.shutdown()
-                per_rank = [results[r] for r in range(world)]
+                        def run(rank: int, cols=cols, results=results,
+                                errors=errors) -> None:
+                            try:
+                                results[rank] = _lane_rank_body(
+                                    cols[rank], rank, nbytes, n_buckets,
+                                    timeout, world=world,
+                                )
+                            except BaseException as e:  # noqa: BLE001
+                                errors.append(e)
+
+                        rs = [threading.Thread(target=run, args=(r,))
+                              for r in range(world)]
+                        for t in rs:
+                            t.start()
+                        for t in rs:
+                            t.join()
+                        if errors:
+                            raise errors[0]
+                    finally:
+                        for c in cols:
+                            c.shutdown()
+                    attempt = [results[r] for r in range(world)]
+                    wall = max(r["wall_s"] for r in attempt)
+                    if not per_rank or wall < max(r["wall_s"] for r in per_rank):
+                        per_rank = attempt
+                    walls.append(wall)
     finally:
         store.shutdown()
     wall = max(r["wall_s"] for r in per_rank)
@@ -283,6 +303,10 @@ def bench_lanes(
         "lanes": lanes,
         "world": world,
         "topology": per_rank[0].get("topology", "ring"),
+        # The ring hot-loop engine this configuration RESOLVED to ("py" or
+        # "native") — requested "native" on a stale .so degrades to "py"
+        # and the record says so, per the no-silent-fallback contract.
+        "engine": per_rank[0]["lane_stats"].get("engine", "py"),
         "payload_mb": round(actual / (1 << 20), 2),
         "buckets": n_buckets,
         "wire_dtype": wire_dtype,
@@ -301,6 +325,123 @@ def bench_lanes(
         }
     if len(walls) > 1:
         out["trial_walls_s"] = [round(w, 3) for w in walls]
+    return out
+
+
+def check_engine_parity(
+    n_elems: int = 1 << 14, lanes: int = 2, timeout: float = 60.0
+) -> Optional[bool]:
+    """Bitwise engine parity on live rings: the SAME deterministic payload
+    allreduced by a 2-rank py-engine pair and a 2-rank native-engine pair
+    (f32 raw, bf16 wire, and the int8 codec) must produce IDENTICAL bits —
+    the contract that lets "auto" switch engines without a numerics review.
+    Returns None when the native engine is unavailable (nothing to
+    compare), else the parity verdict.  The exhaustive topology x codec x
+    lanes matrix lives in tests/test_ring_engine.py; this is the live
+    artifact-level pin."""
+    from torchft_tpu._native import StoreServer, ring_engine_available
+    from torchft_tpu.collectives import TCPCollective
+
+    if not ring_engine_available():
+        return None
+    rng = np.random.default_rng(1234)
+    data = [
+        (rng.standard_normal(n_elems) * (r + 1)).astype(np.float32)
+        for r in range(2)
+    ]
+    outs: Dict[str, List[np.ndarray]] = {}
+    store = StoreServer(bind="127.0.0.1:0")
+    try:
+        for engine in ("py", "native"):
+            cols = [
+                TCPCollective(timeout=timeout, wire_dtype="bf16", lanes=lanes,
+                              engine=engine)
+                for _ in range(2)
+            ]
+            results: Dict[int, List[np.ndarray]] = {}
+            errors: List[BaseException] = []
+
+            def run(rank: int, cols=cols, results=results, errors=errors,
+                    engine=engine) -> None:
+                try:
+                    c = cols[rank]
+                    c.configure(f"{store.address()}/parity_{engine}", rank, 2)
+                    got: List[np.ndarray] = []
+                    # f32 raw framing (compression off), the bf16 wire, and
+                    # the int8 codec — one output set per hop codec.
+                    got.append(c.allreduce(
+                        [data[rank]], op="sum", allow_wire_compression=False
+                    ).wait(timeout=timeout)[0])
+                    got.append(c.allreduce(
+                        [data[rank]], op="avg"
+                    ).wait(timeout=timeout)[0])
+                    got.append(c.allreduce(
+                        [data[rank]], op="sum", wire_codec="int8"
+                    ).wait(timeout=timeout)[0])
+                    results[rank] = got
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # Read BEFORE shutdown — abort clears the engine handle, so a
+            # post-shutdown ring_engine always reports "py".
+            resolved = cols[0].ring_engine
+            for c in cols:
+                c.shutdown()
+            if errors:
+                raise errors[0]
+            if resolved != engine:
+                return False  # requested engine did not run — not a parity proof
+            outs[engine] = results[0]
+    finally:
+        store.shutdown()
+    return all(
+        a.dtype == b.dtype
+        and a.shape == b.shape
+        and bool((a.view(np.uint32) == b.view(np.uint32)).all())
+        for a, b in zip(outs["py"], outs["native"])
+    )
+
+
+def run_engine_quick(
+    payload_mb: float = 8.0, lanes: int = 2, trials: int = 3
+) -> Dict[str, Any]:
+    """The engine A/B smoke (``--engine both`` at a small unshaped-loopback
+    cell, threads): one py cell, one native cell, plus the live bitwise
+    parity pin.  Wired into
+    tests/test_bench_contract.py::test_ring_engine_quick_smoke."""
+    from torchft_tpu._native import ring_engine_available
+
+    cells = [
+        bench_lanes(payload_mb=payload_mb, lanes=lanes, mbps=0.0, rtt_ms=0.0,
+                    n_buckets=4, timeout=120.0, procs=False, trials=trials,
+                    engine="py")
+    ]
+    native_available = ring_engine_available()
+    if native_available:
+        cells.append(
+            bench_lanes(payload_mb=payload_mb, lanes=lanes, mbps=0.0,
+                        rtt_ms=0.0, n_buckets=4, timeout=120.0, procs=False,
+                        trials=trials, engine="native")
+        )
+    by_engine = {c["engine"]: c for c in cells}
+    out: Dict[str, Any] = {
+        "section": "ring_engine",
+        "native_available": native_available,
+        "cells": cells,
+        "parity_bitwise": check_engine_parity(),
+    }
+    if "py" in by_engine and "native" in by_engine:
+        out["native_loopback_ok"] = (
+            by_engine["native"]["gb_per_s"] >= by_engine["py"]["gb_per_s"]
+        )
+        out["native_loopback_speedup"] = round(
+            by_engine["native"]["gb_per_s"] / by_engine["py"]["gb_per_s"], 2
+        )
     return out
 
 
@@ -817,6 +958,13 @@ def main() -> None:
         "noise on small shared hosts costs a single trial up to 30%%)",
     )
     parser.add_argument(
+        "--engine", choices=["py", "native", "both"], default="both",
+        help="ring hot-loop engine A/B: 'both' runs every lane cell under "
+        "the Python engine AND the native GIL-free engine (plus an "
+        "unshaped-loopback engine section and a live bitwise parity pin); "
+        "'py'/'native' pin one side",
+    )
+    parser.add_argument(
         "--topology", choices=["ring", "ring2d", "both"], default="both",
         help="cross-group topology A/B: 'both' adds a flat-vs-ring2d sweep "
         "at --topo-world ranks on the same shaped link (the per-topology "
@@ -880,13 +1028,37 @@ def main() -> None:
         return
 
     results: List[Dict[str, Any]] = []
-    lane_gbps: Dict[int, float] = {}
+    engines = ["py", "native"] if args.engine == "both" else [args.engine]
+    # lane_gbps[engine][lanes]; the flat summary keys quote the engine the
+    # deployment default (auto) runs — native when available.
+    lane_gbps: Dict[str, Dict[int, float]] = {e: {} for e in engines}
     for l in args.lanes:
-        r = bench_lanes(args.mb, l, args.mbps, args.rtt_ms, args.buckets,
-                        trials=args.trials)
-        lane_gbps[l] = r["gb_per_s"]
-        results.append(r)
-        print(json.dumps(r), flush=True)
+        for eng in engines:
+            r = bench_lanes(args.mb, l, args.mbps, args.rtt_ms, args.buckets,
+                            trials=args.trials, engine=eng)
+            # Key by the engine that actually RAN: a stale .so degrades a
+            # requested native cell to py (one warning) and the record must
+            # land under the truth, not crash the sweep.
+            lane_gbps.setdefault(r["engine"], {})[l] = r["gb_per_s"]
+            results.append(r)
+            print(json.dumps(r), flush=True)
+
+    # Engine loopback A/B: the same bucket stream UNSHAPED (mbps=0) — no
+    # modeled link, so the wall is pure engine cost: GIL + per-stripe
+    # copies for the Python engine, scatter-gather C++ for the native one.
+    # This is the ceiling every shaped number saturates against.
+    engine_loopback: Dict[str, float] = {}
+    if args.engine == "both":
+        for eng in engines:
+            r = bench_lanes(args.mb, 4, 0.0, 0.0, args.buckets,
+                            trials=args.trials, engine=eng)
+            r["section"] = "engine_loopback"
+            engine_loopback[r["engine"]] = r["gb_per_s"]
+            results.append(r)
+            print(json.dumps(r), flush=True)
+        parity = check_engine_parity()
+        results.append({"section": "engine_parity", "parity_bitwise": parity})
+        print(json.dumps(results[-1]), flush=True)
 
     # Topology A/B: the same bucket stream at --topo-world ranks, flat ring
     # vs 2D ring-of-rings, on the same shaped link.  Paired same-host
@@ -957,13 +1129,44 @@ def main() -> None:
     mono = find("monolithic")
     prep = find("pipelined+device_prep")
     sharded = find("pipelined+device_prep+sharded")
+    # The flat lane keys quote what the deployment default (auto) runs:
+    # the native engine when its cells exist, the Python engine otherwise.
+    main_engine = (
+        "native" if lane_gbps.get("native") else
+        next(e for e in engines if lane_gbps.get(e))
+    )
+    main_lanes = lane_gbps[main_engine]
     summary: Dict[str, Any] = {
         "link": {"mbps": args.mbps, "rtt_ms": args.rtt_ms},
         "payload_mb": args.mb,
-        "lane_gb_per_s": {str(l): g for l, g in sorted(lane_gbps.items())},
+        "engine": main_engine,
+        "lane_gb_per_s": {str(l): g for l, g in sorted(main_lanes.items())},
         "monolithic_steps_per_s": mono["steps_per_s"] if mono else None,
         "peer_kill_ok": kill["ok"],
     }
+    if "py" in lane_gbps and main_engine != "py":
+        # The Python-engine reference cells (comparable to the pre-native
+        # artifacts) plus the shaped native-over-py ceiling ratio.
+        summary["lane_gb_per_s_py"] = {
+            str(l): g for l, g in sorted(lane_gbps["py"].items())
+        }
+        shared = [
+            l for l in main_lanes
+            if l in lane_gbps["py"] and lane_gbps["py"][l]
+        ]
+        if shared:
+            top = max(shared)
+            summary["shaped_native_over_py"] = round(
+                main_lanes[top] / lane_gbps["py"][top], 3
+            )
+    if engine_loopback:
+        summary["engine_loopback_gb_per_s"] = dict(sorted(engine_loopback.items()))
+        if engine_loopback.get("py"):
+            summary["native_loopback_speedup"] = round(
+                engine_loopback.get("native", 0.0) / engine_loopback["py"], 2
+            )
+    if args.engine == "both":
+        summary["engine_parity_bitwise"] = parity
     if pipe:
         summary["pipelined_steps_per_s"] = pipe["steps_per_s"]
         if mono and mono["steps_per_s"]:
@@ -990,10 +1193,10 @@ def main() -> None:
             # requested --sharded-devices, which an inherited XLA_FLAGS
             # can override in the workers).
             summary["shard_factor"] = sharded["slices_per_bucket"]
-    if 1 in lane_gbps and 4 in lane_gbps:
-        summary["speedup_4_lanes"] = round(lane_gbps[4] / lane_gbps[1], 2)
-    if 1 in lane_gbps and 2 in lane_gbps:
-        summary["speedup_2_lanes"] = round(lane_gbps[2] / lane_gbps[1], 2)
+    if 1 in main_lanes and 4 in main_lanes:
+        summary["speedup_4_lanes"] = round(main_lanes[4] / main_lanes[1], 2)
+    if 1 in main_lanes and 2 in main_lanes:
+        summary["speedup_2_lanes"] = round(main_lanes[2] / main_lanes[1], 2)
     if topo_gbps:
         summary["topology_gb_per_s"] = {
             t: g for t, g in sorted(topo_gbps.items())

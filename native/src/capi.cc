@@ -11,6 +11,7 @@
 
 #include "lighthouse.h"
 #include "manager.h"
+#include "ring.h"
 #include "store.h"
 #include "wire.h"
 
@@ -140,11 +141,11 @@ char* tf_manager_address(void* p) { return CopyString(static_cast<ManagerServer*
 void tf_manager_set_status(void* p, int64_t step, const char* state,
                            double step_time_ms_ewma, double step_time_ms_last,
                            double allreduce_gb_per_s, int64_t ec_shards_held,
-                           int64_t ec_shard_step) {
+                           int64_t ec_shard_step, int64_t ec_k) {
   static_cast<ManagerServer*>(p)->SetStatus(step, state ? state : "",
                                             step_time_ms_ewma, step_time_ms_last,
                                             allreduce_gb_per_s, ec_shards_held,
-                                            ec_shard_step);
+                                            ec_shard_step, ec_k);
 }
 
 // Manager-side flight recorder (no HTTP server on managers — this is the
@@ -211,5 +212,78 @@ int tf_client_call(void* p, uint16_t method, const uint8_t* req, size_t req_len,
 }
 
 void tf_client_free(void* p) { delete static_cast<RpcClient*>(p); }
+
+// ---------------------------------------------------------------------------
+// Ring engine (GIL-free data plane, native/src/ring.h)
+//
+// Status codes mirror RingStatus: 0 ok, 1 timeout, 2 peer/engine closed,
+// 3 other error — the bindings map them to TimeoutError / ConnectionError /
+// RuntimeError.  These symbols double as the Python side's capability
+// probe: a libtpuft.so missing tf_ring_new is a stale build and the
+// collective logs one warning and runs the Python engine instead.
+// ---------------------------------------------------------------------------
+
+void* tf_ring_new(int32_t lanes, double shaper_mbps, double shaper_rtt_ms) {
+  return new RingEngine(lanes, shaper_mbps, shaper_rtt_ms);
+}
+
+int tf_ring_set_tier(void* p, int32_t tier, int32_t nlanes, const int32_t* next_fds,
+                     const int32_t* prev_fds, char** err) {
+  std::string e;
+  if (!static_cast<RingEngine*>(p)->SetTier(tier, nlanes, next_fds, prev_fds, &e)) {
+    SetErr(err, e);
+    return 3;
+  }
+  return 0;
+}
+
+void tf_ring_close(void* p) { static_cast<RingEngine*>(p)->Close(); }
+
+void tf_ring_free(void* p) { delete static_cast<RingEngine*>(p); }
+
+int tf_ring_open_fds(void* p) { return static_cast<RingEngine*>(p)->OpenFds(); }
+
+int tf_ring_exchange(void* p, int32_t tier, int32_t lane, uint32_t tag,
+                     const uint8_t* buf, size_t len, uint8_t** out, size_t* out_len,
+                     double timeout_s, char** err) {
+  std::string recv, e;
+  RingStatus st = static_cast<RingEngine*>(p)->Exchange(tier, lane, tag, buf, len,
+                                                        &recv, timeout_s, &e);
+  if (st != RingStatus::kOk) {
+    SetErr(err, e);
+    return static_cast<int>(st);
+  }
+  *out = static_cast<uint8_t*>(malloc(recv.size() ? recv.size() : 1));
+  memcpy(*out, recv.data(), recv.size());
+  *out_len = recv.size();
+  return 0;
+}
+
+int tf_ring_pass(void* p, int32_t tier, int32_t lane, int32_t n, int32_t rank,
+                 uint32_t tag_base, uint32_t rs_sub, uint32_t ag_sub, int32_t mode,
+                 int32_t op, int32_t wire, const uint64_t* chunk_ptrs,
+                 const uint64_t* chunk_elems, double timeout_s, char** err) {
+  std::string e;
+  RingStatus st = static_cast<RingEngine*>(p)->RingPass(
+      tier, lane, n, rank, tag_base, rs_sub, ag_sub, mode, op, wire,
+      reinterpret_cast<float* const*>(const_cast<uint64_t*>(chunk_ptrs)),
+      chunk_elems, timeout_s, &e);
+  if (st != RingStatus::kOk) SetErr(err, e);
+  return static_cast<int>(st);
+}
+
+int tf_ring_counters(void* p, int32_t tier, uint64_t* sent, uint64_t* recv,
+                     int32_t cap) {
+  return static_cast<RingEngine*>(p)->Counters(tier, sent, recv, cap);
+}
+
+void tf_ring_shaper_counters(void* p, int32_t tier, int32_t direction,
+                             uint64_t* bytes, uint64_t* frames) {
+  static_cast<RingEngine*>(p)->ShaperCounters(tier, direction, bytes, frames);
+}
+
+uint64_t tf_ring_link_bytes(void* p, int32_t tier, int32_t direction, int32_t lane) {
+  return static_cast<RingEngine*>(p)->LinkBytes(tier, direction, lane);
+}
 
 }  // extern "C"

@@ -243,6 +243,9 @@ std::string Lighthouse::SnapshotState() {
     if (ec != ec_shards_.end()) {
       r->set_ec_shard_step(ec->second.first);
       r->set_ec_shards_held(ec->second.second);
+      // The latched geometry rides each EC record so a promoted standby's
+      // coverage sentinel keeps the same k + 1 threshold.
+      r->set_ec_k(ec_k_);
     }
     auto h = health_.find(id);
     if (h != health_.end()) {
@@ -271,6 +274,8 @@ std::string Lighthouse::SnapshotState() {
     out->set_ratio(a.ratio);
     out->set_step_time_ms(a.step_time_ms);
     out->set_auto_drained(a.auto_drained);
+    out->set_coverage(a.coverage);
+    out->set_threshold(a.threshold);
   }
   req.set_alert_seq(alert_seq_);
   std::string out;
@@ -331,6 +336,8 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
     allreduce_gbps_[id] = r.allreduce_gb_per_s();
     if (r.ec_shards_held() > 0 || r.ec_shard_step() > 0) {
       ec_shards_[id] = {r.ec_shard_step(), r.ec_shards_held()};
+      if (r.ec_shards_held() > 0) ec_seen_ = true;
+      if (r.ec_k() > 0) ec_k_ = r.ec_k();
     }
     if (r.step_time_ms_ewma() > 0.0 || r.straggler_state() != 0) {
       ReplicaHealth& h = health_[id];
@@ -363,6 +370,8 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
     rec.ratio = a.ratio();
     rec.step_time_ms = a.step_time_ms();
     rec.auto_drained = a.auto_drained();
+    rec.coverage = a.coverage();
+    rec.threshold = a.threshold();
     alerts_.push_back(std::move(rec));
   }
   if (req.alert_seq() > alert_seq_) alert_seq_ = req.alert_seq();
@@ -735,6 +744,9 @@ Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
   if (req.ec_shards_held() > 0 || req.ec_shard_step() > 0 ||
       ec_shards_.count(req.replica_id())) {
     ec_shards_[req.replica_id()] = {req.ec_shard_step(), req.ec_shards_held()};
+    if (req.ec_shards_held() > 0) ec_seen_ = true;
+    if (req.ec_k() > 0) ec_k_ = req.ec_k();
+    CheckEcCoverageLocked();
   }
   // Straggler sentinel: keep the rolling step-time telemetry fresh on every
   // heartbeat, but run a state-machine OBSERVATION only when the replica's
@@ -881,9 +893,14 @@ void Lighthouse::RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth*
        id.c_str(), h->ewma_ms, h->ratio,
        static_cast<long long>(straggler_grace_), static_cast<long long>(a.id));
   a.auto_drained = MaybeAutoDrainLocked(id, /*log_skip=*/true);
+  PushAlertLocked(std::move(a));
+}
+
+void Lighthouse::PushAlertLocked(AlertRecord a) {
   alerts_.push_back(std::move(a));
   // Bounded history: drop the oldest RESOLVED record first; active alerts
-  // are never evicted (there can be at most one per live replica id).
+  // are never evicted (there can be at most one per live replica id, plus
+  // one cluster-scope record per cluster-level kind).
   const size_t kMaxAlerts = 64;
   if (alerts_.size() > kMaxAlerts) {
     for (auto it = alerts_.begin(); it != alerts_.end(); ++it) {
@@ -893,6 +910,81 @@ void Lighthouse::RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth*
       }
     }
   }
+}
+
+bool Lighthouse::HeartbeatFreshLocked(const std::string& id,
+                                      TimePoint now) const {
+  auto hb = state_.heartbeats.find(id);
+  return hb != state_.heartbeats.end() &&
+         now - hb->second < std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
+}
+
+void Lighthouse::CheckEcCoverageLocked() {
+  if (ec_k_ <= 0 || !ec_seen_) return;
+  // Only heartbeat-FRESH holders count: a dead holder's inventory stays
+  // in ec_shards_ until the 10x graveyard prune, but its shards are
+  // unreachable the moment its heartbeats stop — redundancy the page
+  // exists to notice losing.  (Same freshness rule the /metrics gauge
+  // uses, so the alert fires exactly when the dashboard reads < k + 1.)
+  auto now = Clock::now();
+  auto fresh = [&](const std::string& id) { return HeartbeatFreshLocked(id, now); };
+  int64_t ec_step = 0, coverage = 0;
+  for (const auto& [id, sc] : ec_shards_) {
+    if (fresh(id)) ec_step = std::max(ec_step, sc.first);
+  }
+  for (const auto& [id, sc] : ec_shards_) {
+    if (fresh(id) && sc.first == ec_step) coverage += sc.second;
+  }
+  int64_t threshold = ec_k_ + 1;
+  AlertRecord* active = nullptr;
+  for (auto& a : alerts_) {
+    if (a.kind == "ec_coverage" && a.resolved_ms == 0) {
+      active = &a;
+      break;
+    }
+  }
+  int64_t now_ms = NowEpochMs();
+  if (coverage >= threshold) {
+    ec_low_since_ms_ = 0;
+    if (active != nullptr) {
+      active->coverage = coverage;
+      active->resolved_ms = now_ms;
+      LOGI("lighthouse: EC shard coverage recovered to %lld (>= k + 1 = %lld) "
+           "— alert %lld resolved",
+           static_cast<long long>(coverage), static_cast<long long>(threshold),
+           static_cast<long long>(active->id));
+    }
+    return;
+  }
+  if (active != nullptr) {
+    active->coverage = coverage;  // keep the live reading on the record
+    return;
+  }
+  // Grace: each holder re-reports its count at a NEW encode generation as
+  // its own heartbeats land, so coverage at the newest step legitimately
+  // dips for up to a heartbeat interval per encode.  Only a dip that
+  // outlives a full heartbeat timeout is a real redundancy loss.
+  if (ec_low_since_ms_ == 0) {
+    ec_low_since_ms_ = now_ms;
+    return;
+  }
+  if (now_ms - ec_low_since_ms_ <
+      static_cast<int64_t>(opt_.heartbeat_timeout_ms)) {
+    return;
+  }
+  AlertRecord a;
+  a.id = ++alert_seq_;
+  a.kind = "ec_coverage";
+  a.replica_id = "cluster";
+  a.raised_ms = now_ms;
+  a.coverage = coverage;
+  a.threshold = threshold;
+  LOGW("lighthouse: EC shard coverage %lld at encode step %lld is below "
+       "k + 1 = %lld — one more holder loss makes the newest generation "
+       "unreconstructable; alert %lld raised",
+       static_cast<long long>(coverage), static_cast<long long>(ec_step),
+       static_cast<long long>(threshold), static_cast<long long>(a.id));
+  PushAlertLocked(std::move(a));
 }
 
 void Lighthouse::ResolveAlertsLocked(const std::string& id) {
@@ -1292,6 +1384,9 @@ void Lighthouse::SweepLocked(TimePoint tick_now,
       ++it;
     }
   }
+  // Coverage sentinel: the sweep is what notices holders DYING (their
+  // freshness lapses without any heartbeat to trigger the check).
+  CheckEcCoverageLocked();
 }
 
 void Lighthouse::FillStatus(LighthouseStatusResponse* resp) {
@@ -1592,12 +1687,19 @@ std::string Lighthouse::MetricsText() {
     // the redundancy a donor-free reconstruction at the max step can
     // actually draw on (needs >= k to succeed; alert below k + 1).
     s.ec_held.reserve(ec_shards_.size());
+    auto hb_fresh = [&](const std::string& id) {
+      return HeartbeatFreshLocked(id, now);
+    };
     for (const auto& [id, sc] : ec_shards_) {
       s.ec_held.emplace_back(id, sc.second);
-      s.ec_step = std::max(s.ec_step, sc.first);
+      // Coverage counts heartbeat-FRESH holders only (a dead holder's
+      // inventory lingers until the graveyard prune but its shards are
+      // unreachable) — the same rule the ec_coverage alert pages on, so
+      // gauge and alert cannot disagree.
+      if (hb_fresh(id)) s.ec_step = std::max(s.ec_step, sc.first);
     }
     for (const auto& [id, sc] : ec_shards_) {
-      if (sc.first == s.ec_step) s.ec_coverage += sc.second;
+      if (hb_fresh(id) && sc.first == s.ec_step) s.ec_coverage += sc.second;
     }
     for (const auto& a : alerts_) {
       if (a.resolved_ms == 0) ++s.alerts_active;
@@ -1756,6 +1858,8 @@ std::string Lighthouse::AlertsJson() {
       << ",\"ratio\":" << a.ratio
       << ",\"step_time_ms\":" << a.step_time_ms
       << ",\"auto_drained\":" << (a.auto_drained ? "true" : "false")
+      << ",\"coverage\":" << a.coverage
+      << ",\"threshold\":" << a.threshold
       << ",\"active\":" << (a.resolved_ms == 0 ? "true" : "false") << "}";
   }
   o << "]}";

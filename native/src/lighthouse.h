@@ -76,13 +76,17 @@ struct ReplicaHealth {
 // while active.
 struct AlertRecord {
   int64_t id = 0;
-  std::string kind;        // "straggler"
-  std::string replica_id;
+  std::string kind;        // "straggler" | "ec_coverage"
+  std::string replica_id;  // "cluster" for cluster-scope kinds
   int64_t raised_ms = 0;   // epoch ms
   int64_t resolved_ms = 0;
   double ratio = 0.0;        // slowness ratio at raise time
   double step_time_ms = 0.0; // EWMA at raise time
   bool auto_drained = false; // the sentinel rotated the replica out itself
+  // kind == "ec_coverage": live shards at the newest encode generation
+  // (kept current while active) and the k + 1 paging threshold.
+  int64_t coverage = 0;
+  int64_t threshold = 0;
 };
 
 // Pure quorum math, unit-testable without sockets.
@@ -227,6 +231,20 @@ class Lighthouse {
   // Raise/resolve the straggler alert for one replica.  Caller holds mu_.
   void RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth* h);
   void ResolveAlertsLocked(const std::string& id);
+  // EC coverage sentinel (docs/wire.md "Erasure shard endpoints"): pages
+  // via /alerts.json + tpuft_alerts_active when the newest encode
+  // generation's shard coverage stays below k + 1 for a heartbeat
+  // timeout — one more holder loss from unreconstructable.  Runs on every
+  // heartbeat carrying EC fields and on the housekeeping sweep (which is
+  // what notices holders DYING — their entries leave ec_shards_ by
+  // heartbeat-staleness pruning, not by a report).  Caller holds mu_.
+  void CheckEcCoverageLocked();
+  // THE heartbeat-freshness rule, shared by the ec_coverage alert and the
+  // tpuft_ec_shard_coverage gauge so the two can never disagree.  Caller
+  // holds mu_.
+  bool HeartbeatFreshLocked(const std::string& id, TimePoint now) const;
+  // Bounded alert history push shared by every alert kind.
+  void PushAlertLocked(AlertRecord a);
   // Flight-records a sentinel hysteresis transition when prev != h.state.
   void RecordSentinelLocked(const std::string& id, int prev,
                             const ReplicaHealth& h);
@@ -312,6 +330,17 @@ class Lighthouse {
   // Alert history (newest last, bounded); active = resolved_ms == 0.
   std::vector<AlertRecord> alerts_;
   int64_t alert_seq_ = 0;
+  // EC coverage sentinel state: the data-shard count k latched off
+  // heartbeats (0 until any replica reports one), whether a nonzero shard
+  // inventory was EVER reported (gates the alert so a pre-first-encode
+  // cluster with EC configured never pages), and when coverage first
+  // dipped below k + 1 (0 = not low) — the raise waits out one heartbeat
+  // timeout so the per-holder rollover to a new encode generation (each
+  // holder re-reports its count at the new step as its heartbeats land)
+  // cannot flap an alert per encode.
+  int64_t ec_k_ = 0;
+  bool ec_seen_ = false;
+  int64_t ec_low_since_ms_ = 0;
   // Knobs, read from the environment at Start:
   //   TPUFT_STRAGGLER_RATIO        slowness ratio threshold (default 1.5)
   //   TPUFT_STRAGGLER_GRACE_STEPS  consecutive step observations over/under

@@ -200,7 +200,7 @@ std::string ManagerServer::address() const { return server_ ? server_->address()
 void ManagerServer::SetStatus(int64_t step, const std::string& state,
                               double step_time_ms_ewma, double step_time_ms_last,
                               double allreduce_gb_per_s, int64_t ec_shards_held,
-                              int64_t ec_shard_step) {
+                              int64_t ec_shard_step, int64_t ec_k) {
   std::lock_guard<std::mutex> lk(mu_);
   status_step_ = step;
   status_state_ = state;
@@ -223,6 +223,9 @@ void ManagerServer::SetStatus(int64_t step, const std::string& state,
   if (ec_shards_held >= 0) {
     status_ec_shards_ = ec_shards_held;
     status_ec_step_ = ec_shard_step;
+  }
+  if (ec_k >= 0) {
+    status_ec_k_ = ec_k;
   }
 }
 
@@ -267,6 +270,7 @@ void ManagerServer::HeartbeatLoop() {
       req.set_allreduce_gb_per_s(status_allreduce_gbps_);
       req.set_ec_shards_held(status_ec_shards_);
       req.set_ec_shard_step(status_ec_step_);
+      req.set_ec_k(status_ec_k_);
       req.set_trace_id(status_trace_id_);
       req.SerializeToString(&payload);
     }

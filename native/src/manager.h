@@ -72,11 +72,13 @@ class ManagerServer {
   // phase-only pushes must use the default.
   // ec_shards_held/ec_shard_step (heartbeat fields 8-9, the erasure-shard
   // inventory) follow the gauge convention: 0 is an authoritative report,
-  // negative means "keep the prior reading".
+  // negative means "keep the prior reading".  ec_k (field 10) is the EC
+  // geometry's data-shard count, the lighthouse coverage sentinel's
+  // paging threshold input; same negative-keeps convention.
   void SetStatus(int64_t step, const std::string& state,
                  double step_time_ms_ewma = 0.0, double step_time_ms_last = 0.0,
                  double allreduce_gb_per_s = -1.0, int64_t ec_shards_held = -1,
-                 int64_t ec_shard_step = -1);
+                 int64_t ec_shard_step = -1, int64_t ec_k = -1);
 
   // RPC handlers (public for in-process tests).
   Status HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
@@ -134,6 +136,7 @@ class ManagerServer {
   // newest encode generation + that generation's step.
   int64_t status_ec_shards_ = 0;
   int64_t status_ec_step_ = 0;
+  int64_t status_ec_k_ = 0;
   // Causal trace id of the last quorum round this manager aggregated —
   // stamped onto every lighthouse heartbeat (proto field 7) so the
   // lighthouse's RPC spans correlate with the step in flight.

@@ -1,0 +1,966 @@
+// GIL-free ring engine implementation.  See ring.h for the contract; the
+// guiding invariant throughout is BITWISE parity with the Python engine in
+// collectives.py — identical frame bytes, identical hop order, identical
+// codec arithmetic — so the two engines interoperate on one ring and every
+// existing parity/commit-protocol test pins this code for free.
+#include "ring.h"
+
+#include <errno.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cfloat>
+#include <cstring>
+#include <thread>
+
+#if defined(__SSE2__)
+#include <emmintrin.h>
+#endif
+
+#include "log.h"
+
+namespace tpuft {
+
+namespace {
+
+constexpr size_t kHdrSize = 12;  // struct.Struct("<IQ"): u32 tag, u64 nbytes
+
+double NowS() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int ModN(int a, int n) { return ((a % n) + n) % n; }
+
+// The one sanctioned writer of RingLink::{dead, dead_reason}: the reason
+// lands under dead_mu before dead's release-store, so readers that observe
+// dead == true read the (now immutable) reason without a lock.
+void PoisonLink(RingLink* l, const std::string& why) {
+  std::lock_guard<std::mutex> lk(l->dead_mu);
+  if (l->dead.load(std::memory_order_relaxed)) return;
+  l->dead_reason = why;
+  l->dead.store(true, std::memory_order_release);
+}
+
+void PutHdr(uint8_t* hdr, uint32_t tag, uint64_t nbytes) {
+  memcpy(hdr, &tag, 4);
+  memcpy(hdr + 4, &nbytes, 8);
+}
+
+// f32 -> bfloat16, round-to-nearest-even — the exact ml_dtypes/Eigen RTNE
+// cast the Python engine's `.astype(ml_dtypes.bfloat16)` performs, so wire
+// bytes match bit for bit.  Branchless (ternary, not early-return) so the
+// encode loop auto-vectorizes — the scalar branchy form made the bf16
+// wire SLOWER than raw f32 despite moving half the bytes.
+inline uint16_t F32ToBf16(float f) {
+  uint32_t input;
+  memcpy(&input, &f, 4);
+  // NaN: quiet, sign preserved (ml_dtypes keeps the sign bit).
+  uint16_t nan_out = static_cast<uint16_t>(((input >> 16) & 0x8000u) | 0x7fc0u);
+  uint32_t lsb = (input >> 16) & 1u;
+  uint16_t rtne = static_cast<uint16_t>((input + 0x7fffu + lsb) >> 16);
+  return ((input & 0x7fffffffu) > 0x7f800000u) ? nan_out : rtne;
+}
+
+inline float Bf16ToF32(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline float CombineOne(int op, float a, float b) {
+  // np.add / np.maximum / np.minimum semantics (NaN-propagating min/max).
+  switch (op) {
+    case kOpMax:
+      if (a != a) return a;
+      if (b != b) return b;
+      return a > b ? a : b;
+    case kOpMin:
+      if (a != a) return a;
+      if (b != b) return b;
+      return a < b ? a : b;
+    default:
+      return a + b;
+  }
+}
+
+// collectives.quantize_int8, bit for bit: scale = amax/127 computed in
+// double then narrowed to f32 (both the frame header pack and numpy's weak
+// scalar promotion narrow the same way); round-to-nearest-even; NaN -> 0,
+// inf saturates via the nan_to_num + clip pair.
+inline float Int8Scale(const float* x, size_t n) {
+  float amax = 0.0f;
+  int has_nan = 0;
+  size_t i = 0;
+#if defined(__SSE2__)
+  // GCC 10 won't if-convert the mixed float/int reduction, so the SIMD
+  // form is spelled out: NaN lanes are masked to 0 before the max (maxps
+  // would otherwise propagate the NaN) and recorded separately — numpy's
+  // np.max propagates NaN, and a NaN amax means scale 1.0 below, so the
+  // two forms agree on every input.
+  __m128 vamax = _mm_setzero_ps();
+  __m128 vnan = _mm_setzero_ps();
+  const __m128 abs_mask = _mm_castsi128_ps(_mm_set1_epi32(0x7fffffff));
+  for (; i + 4 <= n; i += 4) {
+    __m128 a = _mm_and_ps(_mm_loadu_ps(x + i), abs_mask);
+    __m128 ord = _mm_cmpord_ps(a, a);
+    vnan = _mm_or_ps(vnan, _mm_cmpunord_ps(a, a));
+    vamax = _mm_max_ps(vamax, _mm_and_ps(a, ord));
+  }
+  float lanes[4];
+  _mm_storeu_ps(lanes, vamax);
+  for (float l : lanes) amax = (l > amax) ? l : amax;
+  has_nan = _mm_movemask_ps(vnan) != 0;
+#endif
+  for (; i < n; ++i) {
+    float a = std::fabs(x[i]);
+    has_nan |= (a != a);
+    amax = (a > amax) ? a : amax;
+  }
+  if (has_nan || !(amax > 0.0f) || !std::isfinite(amax)) return 1.0f;
+  return static_cast<float>(static_cast<double>(amax) / 127.0);
+}
+
+inline void Int8Encode(const float* x, size_t n, uint8_t* dst) {
+  float s = Int8Scale(x, n);
+  memcpy(dst, &s, 4);
+  int8_t* q = reinterpret_cast<int8_t*>(dst + 4);
+  // Same arithmetic as quantize_int8's nan_to_num + rint + clip chain,
+  // restructured as clamp-then-round: the clamp bounds are integers, so
+  // rint(clamp(v)) == clip(rint(v)), an inf clamps to +/-127 exactly like
+  // the FLT_MAX + clip pair, and the ordered-mask AND zeroes NaN.
+  size_t i = 0;
+#if defined(__SSE2__)
+  // Hand-rolled because GCC 10 keeps the select chain as branches.
+  // cvtps2dq rounds per MXCSR — round-to-nearest-even by default, the
+  // same mode std::rint and np.rint use, so lanes match the scalar tail
+  // bit for bit; packs saturation never fires (values already clamped).
+  const __m128 vs = _mm_set1_ps(s);
+  const __m128 hi = _mm_set1_ps(127.0f);
+  const __m128 lo = _mm_set1_ps(-127.0f);
+  for (; i + 16 <= n; i += 16) {
+    __m128i iv[4];
+    for (int k = 0; k < 4; ++k) {
+      __m128 v = _mm_div_ps(_mm_loadu_ps(x + i + 4 * k), vs);
+      v = _mm_and_ps(v, _mm_cmpord_ps(v, v));  // NaN -> 0
+      v = _mm_min_ps(v, hi);
+      v = _mm_max_ps(v, lo);
+      iv[k] = _mm_cvtps_epi32(v);
+    }
+    __m128i w0 = _mm_packs_epi32(iv[0], iv[1]);
+    __m128i w1 = _mm_packs_epi32(iv[2], iv[3]);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(q + i), _mm_packs_epi16(w0, w1));
+  }
+#endif
+  for (; i < n; ++i) {
+    float v = x[i] / s;
+    v = (v != v) ? 0.0f : v;
+    v = v > 127.0f ? 127.0f : v;
+    v = v < -127.0f ? -127.0f : v;
+    q[i] = static_cast<int8_t>(std::rint(v));
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Shaper — LinkShaper's shared virtual-time serialization budget.
+// ---------------------------------------------------------------------------
+
+void RingShaper::OnSend(size_t nbytes) {
+  bytes_sent += nbytes;
+  frames_sent += 1;
+  if (!enabled) return;
+  double wake;
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    double now = NowS();
+    double start = std::max(now, busy_until_s);
+    busy_until_s = start + static_cast<double>(nbytes) / bytes_per_s;
+    wake = busy_until_s + half_rtt_s;
+  }
+  // Sliced sleep: a multi-MB frame at single-digit modeled Mbps pays tens
+  // of seconds here, and Close() must not have to wait that out before it
+  // can safely recycle fd numbers — the pacer is the one blocking state
+  // the socket shutdown cannot interrupt.
+  for (double remaining = wake - NowS(); remaining > 0;
+       remaining = wake - NowS()) {
+    if (closed != nullptr && closed->load()) return;
+    std::this_thread::sleep_for(
+        std::chrono::duration<double>(std::min(remaining, 0.05)));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Send jobs + sender threads
+// ---------------------------------------------------------------------------
+
+struct RingSendJob {
+  uint8_t hdr[kHdrSize];
+  const uint8_t* a = nullptr;  // caller-owned; stable until the job is done
+  size_t alen = 0;
+  const uint8_t* b = nullptr;
+  size_t blen = 0;
+  double timeout_s = 0;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  RingStatus status = RingStatus::kOk;
+  std::string err;
+
+  void Finish(RingStatus st, const std::string& e) {
+    std::lock_guard<std::mutex> lk(mu);
+    status = st;
+    err = e;
+    done = true;
+    cv.notify_all();
+  }
+};
+
+namespace {
+
+// Writes the full iovec set with MSG_DONTWAIT + poll, refreshing the
+// progress deadline on every advance (the Python socket-timeout model).
+RingStatus WriteAll(RingLink* l, struct iovec* iov, int iovcnt, double timeout_s,
+                    std::string* err) {
+  double deadline = NowS() + timeout_s;
+  int idx = 0;
+  while (idx < iovcnt) {
+    if (iov[idx].iov_len == 0) {
+      ++idx;
+      continue;
+    }
+    struct msghdr msg;
+    memset(&msg, 0, sizeof(msg));
+    msg.msg_iov = iov + idx;
+    msg.msg_iovlen = static_cast<size_t>(iovcnt - idx);
+    ssize_t r = ::sendmsg(l->fd, &msg, MSG_DONTWAIT | MSG_NOSIGNAL);
+    if (r > 0) {
+      l->bytes += static_cast<uint64_t>(r);
+      size_t left = static_cast<size_t>(r);
+      while (left > 0 && idx < iovcnt) {
+        if (left >= iov[idx].iov_len) {
+          left -= iov[idx].iov_len;
+          iov[idx].iov_len = 0;
+          ++idx;
+        } else {
+          iov[idx].iov_base = static_cast<uint8_t*>(iov[idx].iov_base) + left;
+          iov[idx].iov_len -= left;
+          left = 0;
+        }
+      }
+      deadline = NowS() + timeout_s;
+      continue;
+    }
+    if (r < 0 && errno == EINTR) continue;
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      double left_s = deadline - NowS();
+      if (left_s <= 0) {
+        *err = "send timed out";
+        return RingStatus::kTimeout;
+      }
+      struct pollfd p = {l->fd, POLLOUT, 0};
+      int pr = ::poll(&p, 1, static_cast<int>(std::min(left_s * 1000.0, 1e8)));
+      if (pr < 0 && errno != EINTR) {
+        *err = std::string("poll: ") + strerror(errno);
+        return RingStatus::kError;
+      }
+      continue;
+    }
+    if (r < 0 && (errno == EPIPE || errno == ECONNRESET || errno == EBADF ||
+                  errno == ENOTCONN)) {
+      *err = std::string("peer connection closed: ") + strerror(errno);
+      return RingStatus::kClosed;
+    }
+    *err = std::string("send: ") + strerror(errno);
+    return RingStatus::kError;
+  }
+  return RingStatus::kOk;
+}
+
+RingStatus ReadExact(RingLink* l, uint8_t* dst, size_t n, double timeout_s,
+                     std::string* err, size_t* got_out = nullptr) {
+  double deadline = NowS() + timeout_s;
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(l->fd, dst + got, n - got, MSG_DONTWAIT);
+    if (r > 0) {
+      got += static_cast<size_t>(r);
+      l->bytes += static_cast<uint64_t>(r);
+      deadline = NowS() + timeout_s;
+      continue;
+    }
+    if (r == 0) {
+      if (got_out) *got_out = got;
+      *err = "peer connection closed";
+      return RingStatus::kClosed;
+    }
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      double left_s = deadline - NowS();
+      if (left_s <= 0) {
+        if (got_out) *got_out = got;
+        *err = "recv timed out";
+        return RingStatus::kTimeout;
+      }
+      struct pollfd p = {l->fd, POLLIN, 0};
+      int pr = ::poll(&p, 1, static_cast<int>(std::min(left_s * 1000.0, 1e8)));
+      if (pr < 0 && errno != EINTR) {
+        if (got_out) *got_out = got;
+        *err = std::string("poll: ") + strerror(errno);
+        return RingStatus::kError;
+      }
+      continue;
+    }
+    if (got_out) *got_out = got;
+    if (errno == ECONNRESET || errno == EBADF || errno == ENOTCONN) {
+      *err = std::string("peer connection closed: ") + strerror(errno);
+      return RingStatus::kClosed;
+    }
+    *err = std::string("recv: ") + strerror(errno);
+    return RingStatus::kError;
+  }
+  if (got_out) *got_out = got;
+  return RingStatus::kOk;
+}
+
+}  // namespace
+
+void RingEngine::SenderLoop(RingLink* l) {
+  for (;;) {
+    std::shared_ptr<RingSendJob> job;
+    {
+      std::unique_lock<std::mutex> lk(l->qmu);
+      l->qcv.wait(lk, [&] { return l->stop || !l->queue.empty(); });
+      if (l->queue.empty()) return;  // stop && drained
+      job = l->queue.front();
+      l->queue.pop_front();
+    }
+    if (l->dead.load() || closed_.load()) {
+      job->Finish(RingStatus::kClosed,
+                  l->dead_reason.empty() ? "ring engine closed" : l->dead_reason);
+      continue;
+    }
+    size_t total = kHdrSize + job->alen + job->blen;
+    if (l->shaper) l->shaper->OnSend(total);
+    struct iovec iov[3];
+    iov[0].iov_base = job->hdr;
+    iov[0].iov_len = kHdrSize;
+    iov[1].iov_base = const_cast<uint8_t*>(job->a);
+    iov[1].iov_len = job->alen;
+    iov[2].iov_base = const_cast<uint8_t*>(job->b);
+    iov[2].iov_len = job->blen;
+    std::string err;
+    RingStatus st = WriteAll(l, iov, 3, job->timeout_s, &err);
+    if (st != RingStatus::kOk) PoisonLink(l, err);
+    job->Finish(st, err);
+  }
+}
+
+std::shared_ptr<RingSendJob> RingEngine::EnqueueSend(RingLink* l, uint32_t tag,
+                                                     const uint8_t* a, size_t alen,
+                                                     const uint8_t* b, size_t blen,
+                                                     double timeout_s) {
+  auto job = std::make_shared<RingSendJob>();
+  PutHdr(job->hdr, tag, static_cast<uint64_t>(alen + blen));
+  job->a = a;
+  job->alen = alen;
+  job->b = b;
+  job->blen = blen;
+  job->timeout_s = timeout_s;
+  {
+    std::lock_guard<std::mutex> lk(l->qmu);
+    if (l->stop) {
+      job->Finish(RingStatus::kClosed, "ring engine closed");
+      return job;
+    }
+    l->queue.push_back(job);
+  }
+  l->qcv.notify_one();
+  return job;
+}
+
+RingStatus RingEngine::WaitSend(const std::shared_ptr<RingSendJob>& job,
+                                double timeout_s, std::string* err) {
+  std::unique_lock<std::mutex> lk(job->mu);
+  if (!job->cv.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                        [&] { return job->done; })) {
+    *err = "send timed out waiting for lane sender";
+    return RingStatus::kTimeout;
+  }
+  if (job->status != RingStatus::kOk) *err = job->err;
+  return job->status;
+}
+
+void RingEngine::AbandonSend(RingLink* nl,
+                             const std::shared_ptr<RingSendJob>& job,
+                             const std::string& why) {
+  // The job holds raw pointers into caller-owned buffers (the op's stack
+  // scratch, or Python bytes alive only for the ctypes call), so an op
+  // CANNOT return while its send is still queued or in flight.  Poison
+  // the link — shutdown() makes a mid-write sendmsg fail immediately and
+  // SenderLoop fails queued jobs on the dead flag — then the wait is
+  // bounded in practice (Close() finishes queued jobs the same way).
+  PoisonLink(nl, why.empty() ? "ring op abandoned" : why);
+  if (nl->fd >= 0) ::shutdown(nl->fd, SHUT_RDWR);
+  std::unique_lock<std::mutex> lk(job->mu);
+  job->cv.wait(lk, [&] { return job->done; });
+}
+
+// ---------------------------------------------------------------------------
+// Demux (leader/follower reader, PR 8's design natively)
+// ---------------------------------------------------------------------------
+
+RingStatus RingEngine::ReadPayload(RingLink* l, uint64_t nbytes, uint32_t tag,
+                                   uint32_t expect_tag, uint8_t* dst,
+                                   size_t dst_len, std::string* out,
+                                   double timeout_s, std::string* err) {
+  if (tag == expect_tag) {
+    if (dst != nullptr) {
+      if (nbytes != dst_len) {
+        *err = "frame length mismatch for tag";
+        return RingStatus::kError;
+      }
+      return ReadExact(l, dst, dst_len, timeout_s, err);
+    }
+    out->resize(nbytes);
+    return ReadExact(l, reinterpret_cast<uint8_t*>(out->empty() ? nullptr : &(*out)[0]),
+                     nbytes, timeout_s, err);
+  }
+  // Someone else's frame: stash it and notify so its waiter takes it
+  // without queuing behind this leader's next blocking read.
+  std::string stashed;
+  stashed.resize(nbytes);
+  RingStatus st = ReadExact(
+      l, reinterpret_cast<uint8_t*>(stashed.empty() ? nullptr : &stashed[0]),
+      nbytes, timeout_s, err);
+  if (st != RingStatus::kOk) return st;
+  {
+    std::lock_guard<std::mutex> lk(l->rmu);
+    l->stash[tag].push_back(std::move(stashed));
+  }
+  l->rcv.notify_all();
+  return RingStatus::kOk;
+}
+
+RingStatus RingEngine::RecvFrame(RingLink* l, uint32_t tag, uint8_t* dst,
+                                 size_t dst_len, std::string* out,
+                                 double timeout_s, std::string* err) {
+  {
+    std::unique_lock<std::mutex> lk(l->rmu);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                        std::chrono::duration<double>(timeout_s));
+    for (;;) {
+      auto it = l->stash.find(tag);
+      if (it != l->stash.end() && !it->second.empty()) {
+        std::string payload = std::move(it->second.front());
+        it->second.pop_front();
+        if (it->second.empty()) l->stash.erase(it);
+        if (dst != nullptr) {
+          if (payload.size() != dst_len) {
+            *err = "frame length mismatch for tag";
+            return RingStatus::kError;
+          }
+          memcpy(dst, payload.data(), payload.size());
+        } else {
+          *out = std::move(payload);
+        }
+        return RingStatus::kOk;
+      }
+      if (l->dead.load()) {
+        *err = l->dead_reason.empty() ? "peer connection closed" : l->dead_reason;
+        return RingStatus::kClosed;
+      }
+      if (!l->reading) {
+        l->reading = true;
+        break;
+      }
+      if (l->rcv.wait_until(lk, deadline) == std::cv_status::timeout) {
+        *err = "recv timed out waiting for demux leader";
+        return RingStatus::kTimeout;
+      }
+    }
+  }
+  // We are the leader on this socket.
+  RingStatus st = RingStatus::kOk;
+  bool got_ours = false;
+  while (!got_ours) {
+    uint8_t hdr[kHdrSize];
+    size_t got = 0;
+    st = ReadExact(l, hdr, kHdrSize, timeout_s, err, &got);
+    if (st != RingStatus::kOk) {
+      // A clean timeout at a frame boundary leaves the stream intact (the
+      // Python engine's per-recv socket timeout behaves the same); any
+      // other failure — or a mid-frame timeout — poisons the link.
+      if (!(st == RingStatus::kTimeout && got == 0)) PoisonLink(l, *err);
+      break;
+    }
+    uint32_t ftag;
+    uint64_t nbytes;
+    memcpy(&ftag, hdr, 4);
+    memcpy(&nbytes, hdr + 4, 8);
+    st = ReadPayload(l, nbytes, ftag, tag, dst, dst_len, out, timeout_s, err);
+    if (st != RingStatus::kOk) {
+      PoisonLink(l, *err);
+      break;
+    }
+    got_ours = (ftag == tag);
+  }
+  {
+    std::lock_guard<std::mutex> lk(l->rmu);
+    l->reading = false;
+  }
+  l->rcv.notify_all();
+  return st;
+}
+
+// ---------------------------------------------------------------------------
+// Engine lifecycle
+// ---------------------------------------------------------------------------
+
+RingEngine::RingEngine(int lanes, double shaper_mbps, double shaper_rtt_ms)
+    : lanes_(lanes), mbps_(shaper_mbps), rtt_ms_(shaper_rtt_ms) {}
+
+RingEngine::~RingEngine() { Close(); }
+
+bool RingEngine::SetTier(int tier, int nlanes, const int32_t* next_fds,
+                         const int32_t* prev_fds, std::string* err) {
+  if (tier < 0 || tier >= kNumTiers) {
+    *err = "bad tier";
+    return false;
+  }
+  if (closed_.load()) {
+    *err = "ring engine closed";
+    return false;
+  }
+  Tier* t = &tiers_[tier];
+  if (t->present) {
+    *err = "tier already registered";
+    return false;
+  }
+  auto init_shaper = [&](RingShaper* s) {
+    s->closed = &closed_;
+    if (mbps_ > 0) {
+      s->enabled = true;
+      s->bytes_per_s = mbps_ * 1e6 / 8.0;
+      s->half_rtt_s = rtt_ms_ / 2000.0;
+    }
+  };
+  init_shaper(&t->next_shaper);
+  init_shaper(&t->prev_shaper);
+  for (int i = 0; i < nlanes; ++i) {
+    for (int dir = 0; dir < 2; ++dir) {
+      int fd = ::dup(dir == kDirNext ? next_fds[i] : prev_fds[i]);
+      if (fd < 0) {
+        *err = std::string("dup: ") + strerror(errno);
+        // Unwind this call's partial registration: stop + JOIN the sender
+        // threads already spawned (destroying a RingLink with a joinable
+        // thread is std::terminate), then close the dup'd fds.
+        for (auto& l : t->next) {
+          {
+            std::lock_guard<std::mutex> qlk(l->qmu);
+            l->stop = true;
+          }
+          l->qcv.notify_all();
+          if (l->sender.joinable()) l->sender.join();
+          if (l->fd >= 0) ::close(l->fd);
+        }
+        for (auto& l : t->prev) {
+          if (l->fd >= 0) ::close(l->fd);
+        }
+        t->next.clear();
+        t->prev.clear();
+        return false;
+      }
+      auto link = std::make_unique<RingLink>();
+      link->fd = fd;
+      link->shaper = dir == kDirNext ? &t->next_shaper : &t->prev_shaper;
+      if (dir == kDirNext) {
+        RingLink* raw = link.get();
+        link->sender = std::thread([this, raw] { SenderLoop(raw); });
+        t->next.push_back(std::move(link));
+      } else {
+        t->prev.push_back(std::move(link));
+      }
+    }
+  }
+  t->present = true;
+  return true;
+}
+
+void RingEngine::Close() {
+  std::lock_guard<std::mutex> lk(close_mu_);
+  if (closed_.exchange(true)) {
+    // Already closed; nothing left to do (idempotent).
+    return;
+  }
+  // Phase 1: shut the sockets down (wakes every blocked op on both ends)
+  // and stop the senders.  The fd numbers stay valid through the drain so
+  // a racing reader can never touch a recycled descriptor.
+  for (auto& t : tiers_) {
+    if (!t.present) continue;
+    for (auto& l : t.next) {
+      {
+        std::lock_guard<std::mutex> qlk(l->qmu);
+        l->stop = true;
+        for (auto& job : l->queue) {
+          job->Finish(RingStatus::kClosed, "ring engine closed");
+        }
+        l->queue.clear();
+      }
+      l->qcv.notify_all();
+      PoisonLink(l.get(), "ring engine closed");
+      if (l->fd >= 0) ::shutdown(l->fd, SHUT_RDWR);
+    }
+    for (auto& l : t.prev) {
+      PoisonLink(l.get(), "ring engine closed");
+      if (l->fd >= 0) ::shutdown(l->fd, SHUT_RDWR);
+      l->rcv.notify_all();
+    }
+  }
+  // Phase 2: wait (bounded) for in-flight ops to drain, join senders,
+  // close the dup'd fds.
+  double deadline = NowS() + 2.0;
+  while (active_ops_.load() > 0 && NowS() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+  for (auto& t : tiers_) {
+    if (!t.present) continue;
+    for (auto& l : t.next) {
+      if (l->sender.joinable()) l->sender.join();
+      if (l->fd >= 0) {
+        ::close(l->fd);
+        l->fd = -1;
+      }
+    }
+    for (auto& l : t.prev) {
+      if (l->fd >= 0) {
+        ::close(l->fd);
+        l->fd = -1;
+      }
+    }
+  }
+}
+
+int RingEngine::OpenFds() const {
+  int n = 0;
+  for (const auto& t : tiers_) {
+    if (!t.present) continue;
+    for (const auto& l : t.next) {
+      if (l->fd >= 0) ++n;
+    }
+    for (const auto& l : t.prev) {
+      if (l->fd >= 0) ++n;
+    }
+  }
+  return n;
+}
+
+RingLink* RingEngine::link(int tier, int direction, int lane) {
+  if (tier < 0 || tier >= kNumTiers || !tiers_[tier].present) return nullptr;
+  auto& v = direction == kDirNext ? tiers_[tier].next : tiers_[tier].prev;
+  if (lane < 0 || lane >= static_cast<int>(v.size())) return nullptr;
+  return v[static_cast<size_t>(lane)].get();
+}
+
+bool RingEngine::CheckOpEntry(int tier, int lane, std::string* err) {
+  if (closed_.load()) {
+    *err = "ring engine closed";
+    return false;
+  }
+  if (link(tier, kDirNext, lane) == nullptr || link(tier, kDirPrev, lane) == nullptr) {
+    *err = "no such tier/lane";
+    return false;
+  }
+  return true;
+}
+
+namespace {
+// RAII in-flight guard so Close() can drain before closing fd numbers.
+struct OpGuard {
+  std::atomic<int>* c;
+  explicit OpGuard(std::atomic<int>* counter) : c(counter) { ++*c; }
+  ~OpGuard() { --*c; }
+};
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Ops
+// ---------------------------------------------------------------------------
+
+RingStatus RingEngine::Hop(Tier* t, int lane, uint32_t tag, const uint8_t* a,
+                           size_t alen, const uint8_t* b, size_t blen,
+                           uint8_t* rdst, size_t rlen, double timeout_s,
+                           std::string* err) {
+  // Zero-length frames are real traffic (a striped pass over a payload
+  // smaller than the stripe count produces empty chunks — the Python
+  // engine frames them as header-only too), but rdst may then be a null
+  // vector-data pointer; RecvFrame treats a null dst as "return via
+  // string", so give the empty frame a real landing address.
+  uint8_t zero = 0;
+  if (rdst == nullptr && rlen == 0) rdst = &zero;
+  RingLink* nl = t->next[static_cast<size_t>(lane)].get();
+  RingLink* pl = t->prev[static_cast<size_t>(lane)].get();
+  auto job = EnqueueSend(nl, tag, a, alen, b, blen, timeout_s);
+  std::string recv_err;
+  RingStatus rst = RecvFrame(pl, tag, rdst, rlen, nullptr, timeout_s, &recv_err);
+  if (rst != RingStatus::kOk) {
+    // The op is failing; the send may be stuck behind a full socket with
+    // no reader.  Never return with the job holding our buffers.
+    AbandonSend(nl, job, recv_err);
+    *err = recv_err;
+    return rst;
+  }
+  std::string send_err;
+  RingStatus sst = WaitSend(job, timeout_s, &send_err);
+  if (sst == RingStatus::kTimeout) AbandonSend(nl, job, send_err);
+  if (sst != RingStatus::kOk) {
+    *err = send_err;
+    return sst;
+  }
+  return RingStatus::kOk;
+}
+
+RingStatus RingEngine::Exchange(int tier, int lane, uint32_t tag,
+                                const uint8_t* buf, size_t len, std::string* out,
+                                double timeout_s, std::string* err) {
+  if (!CheckOpEntry(tier, lane, err)) {
+    return closed_.load() ? RingStatus::kClosed : RingStatus::kError;
+  }
+  OpGuard guard(&active_ops_);
+  Tier* t = &tiers_[tier];
+  RingLink* nl = t->next[static_cast<size_t>(lane)].get();
+  RingLink* pl = t->prev[static_cast<size_t>(lane)].get();
+  auto job = EnqueueSend(nl, tag, buf, len, nullptr, 0, timeout_s);
+  std::string recv_err;
+  RingStatus rst = RecvFrame(pl, tag, nullptr, 0, out, timeout_s, &recv_err);
+  if (rst != RingStatus::kOk) {
+    // `buf` is Python-owned bytes alive only for this ctypes call — the
+    // send job must release it before we return (see AbandonSend).
+    AbandonSend(nl, job, recv_err);
+    *err = recv_err;
+    return rst;
+  }
+  std::string send_err;
+  RingStatus sst = WaitSend(job, timeout_s, &send_err);
+  if (sst == RingStatus::kTimeout) AbandonSend(nl, job, send_err);
+  if (sst != RingStatus::kOk) {
+    *err = send_err;
+    return sst;
+  }
+  return RingStatus::kOk;
+}
+
+RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
+                                uint32_t tag_base, uint32_t rs_sub,
+                                uint32_t ag_sub, int mode, int op, int wire,
+                                float* const* chunk_ptrs,
+                                const uint64_t* chunk_elems, double timeout_s,
+                                std::string* err) {
+  if (!CheckOpEntry(tier, lane, err)) {
+    return closed_.load() ? RingStatus::kClosed : RingStatus::kError;
+  }
+  if (n < 1) {
+    *err = "bad ring size";
+    return RingStatus::kError;
+  }
+  OpGuard guard(&active_ops_);
+  Tier* t = &tiers_[tier];
+
+  auto enc_len = [&](uint64_t elems) -> size_t {
+    switch (wire) {
+      case kWireBf16:
+        return static_cast<size_t>(elems) * 2;
+      case kWireInt8:
+        return 4 + static_cast<size_t>(elems);
+      default:
+        return static_cast<size_t>(elems) * 4;
+    }
+  };
+  size_t max_enc = 0;
+  for (int i = 0; i < n; ++i) max_enc = std::max(max_enc, enc_len(chunk_elems[i]));
+
+  // Encode into `dst` (wire != raw only); returns the frame length.
+  auto encode = [&](const float* src, uint64_t elems, uint8_t* dst) -> size_t {
+    if (wire == kWireBf16) {
+      uint16_t* o = reinterpret_cast<uint16_t*>(dst);
+      for (uint64_t i = 0; i < elems; ++i) o[i] = F32ToBf16(src[i]);
+      return static_cast<size_t>(elems) * 2;
+    }
+    Int8Encode(src, static_cast<size_t>(elems), dst);
+    return 4 + static_cast<size_t>(elems);
+  };
+  // decode(raw) elementwise, combined into dst (dst = combine(dst, in)).
+  // kOpSum (the data plane's op — "avg" divides in Python) gets explicit
+  // plain-add loops: the runtime `op` switch inside the generic loop
+  // defeats the vectorizer, and the sum path is where every gradient
+  // byte goes.
+  auto decode_combine = [&](const uint8_t* raw, uint64_t elems, float* dst) {
+    if (wire == kWireBf16) {
+      const uint16_t* in = reinterpret_cast<const uint16_t*>(raw);
+      if (op == kOpSum) {
+        for (uint64_t i = 0; i < elems; ++i) dst[i] += Bf16ToF32(in[i]);
+      } else {
+        for (uint64_t i = 0; i < elems; ++i) {
+          dst[i] = CombineOne(op, dst[i], Bf16ToF32(in[i]));
+        }
+      }
+    } else if (wire == kWireInt8) {
+      float s;
+      memcpy(&s, raw, 4);
+      const int8_t* q = reinterpret_cast<const int8_t*>(raw + 4);
+      if (op == kOpSum) {
+        for (uint64_t i = 0; i < elems; ++i) {
+          dst[i] += static_cast<float>(q[i]) * s;
+        }
+      } else {
+        for (uint64_t i = 0; i < elems; ++i) {
+          dst[i] = CombineOne(op, dst[i], static_cast<float>(q[i]) * s);
+        }
+      }
+    } else {
+      const float* in = reinterpret_cast<const float*>(raw);
+      if (op == kOpSum) {
+        for (uint64_t i = 0; i < elems; ++i) dst[i] += in[i];
+      } else {
+        for (uint64_t i = 0; i < elems; ++i) {
+          dst[i] = CombineOne(op, dst[i], in[i]);
+        }
+      }
+    }
+  };
+  auto decode_assign = [&](const uint8_t* raw, uint64_t elems, float* dst) {
+    if (wire == kWireBf16) {
+      const uint16_t* in = reinterpret_cast<const uint16_t*>(raw);
+      for (uint64_t i = 0; i < elems; ++i) dst[i] = Bf16ToF32(in[i]);
+    } else {
+      float s;
+      memcpy(&s, raw, 4);
+      const int8_t* q = reinterpret_cast<const int8_t*>(raw + 4);
+      for (uint64_t i = 0; i < elems; ++i) dst[i] = static_cast<float>(q[i]) * s;
+    }
+  };
+
+  // Per-thread persistent scratch: RingPass runs on the collective's
+  // long-lived per-lane worker threads, and a fresh vector here would pay
+  // mmap + page-fault + zero-fill for multi-MB scratch on EVERY pass (the
+  // allocator mmaps anything past ~128KB).  Grown monotonically, touched
+  // once, reused for the thread's lifetime.
+  auto grow = [](std::vector<uint8_t>* v, size_t n) -> uint8_t* {
+    if (v->size() < n) v->resize(n);
+    return v->data();
+  };
+  static thread_local std::vector<uint8_t> sendbuf_tl, recvbuf_tl;
+  uint8_t* recvbuf = grow(&recvbuf_tl, max_enc);
+  uint8_t* sendbuf = wire != kWireRaw ? grow(&sendbuf_tl, max_enc) : nullptr;
+  RingStatus st = RingStatus::kOk;
+
+  if (mode != kPassAllgather) {
+    // Reduce-scatter: after n-1 hops chunk (rank+1)%n holds the full
+    // reduction on this rank.  Hop order and combine order are the Python
+    // engine's, so f32 sums reassociate identically.
+    uint32_t tag = tag_base + rs_sub;
+    for (int step = 0; step < n - 1; ++step) {
+      int send_idx = ModN(rank - step, n);
+      int recv_idx = ModN(rank - step - 1, n);
+      uint64_t selems = chunk_elems[send_idx];
+      uint64_t relems = chunk_elems[recv_idx];
+      if (wire == kWireRaw) {
+        st = Hop(t, lane, tag,
+                 reinterpret_cast<const uint8_t*>(chunk_ptrs[send_idx]),
+                 static_cast<size_t>(selems) * 4, nullptr, 0, recvbuf,
+                 static_cast<size_t>(relems) * 4, timeout_s, err);
+        if (st != RingStatus::kOk) return st;
+        decode_combine(recvbuf, relems, chunk_ptrs[recv_idx]);
+      } else {
+        size_t slen = encode(chunk_ptrs[send_idx], selems, sendbuf);
+        st = Hop(t, lane, tag, sendbuf, slen, nullptr, 0, recvbuf,
+                 enc_len(relems), timeout_s, err);
+        if (st != RingStatus::kOk) return st;
+        decode_combine(recvbuf, relems, chunk_ptrs[recv_idx]);
+      }
+    }
+  }
+
+  if (mode == kPassReduceScatter) return RingStatus::kOk;
+
+  // Allgather circulation: each rank owns chunk (rank+1)%n.  With a wire
+  // codec the owner encodes ONCE and every rank forwards the received wire
+  // bytes untouched (replica consistency: all ranks decode identical
+  // values, including the owner decoding its own encode — requantization
+  // is part of the contract).  Raw frames land straight in the destination
+  // chunk views: no stash, no reassembly copies.
+  uint32_t tag = tag_base + ag_sub;
+  if (wire == kWireRaw) {
+    for (int step = 0; step < n - 1; ++step) {
+      int send_idx = ModN(rank - step + 1, n);
+      int recv_idx = ModN(rank - step, n);
+      st = Hop(t, lane, tag,
+               reinterpret_cast<const uint8_t*>(chunk_ptrs[send_idx]),
+               static_cast<size_t>(chunk_elems[send_idx]) * 4, nullptr, 0,
+               reinterpret_cast<uint8_t*>(chunk_ptrs[recv_idx]),
+               static_cast<size_t>(chunk_elems[recv_idx]) * 4, timeout_s, err);
+      if (st != RingStatus::kOk) return st;
+    }
+    return RingStatus::kOk;
+  }
+  // One arena for all n encoded chunk frames (same persistent per-thread
+  // scratch policy as sendbuf/recvbuf above).
+  std::vector<size_t> off(static_cast<size_t>(n) + 1, 0);
+  for (int i = 0; i < n; ++i) off[static_cast<size_t>(i) + 1] = off[i] + enc_len(chunk_elems[i]);
+  static thread_local std::vector<uint8_t> arena_tl;
+  uint8_t* arena = grow(&arena_tl, off[static_cast<size_t>(n)]);
+  int own = (rank + 1) % n;
+  encode(chunk_ptrs[own], chunk_elems[own], arena + off[own]);
+  for (int step = 0; step < n - 1; ++step) {
+    int send_idx = ModN(rank - step + 1, n);
+    int recv_idx = ModN(rank - step, n);
+    st = Hop(t, lane, tag, arena + off[send_idx],
+             enc_len(chunk_elems[send_idx]), nullptr, 0,
+             arena + off[recv_idx], enc_len(chunk_elems[recv_idx]),
+             timeout_s, err);
+    if (st != RingStatus::kOk) return st;
+  }
+  for (int i = 0; i < n; ++i) {
+    decode_assign(arena + off[i], chunk_elems[i], chunk_ptrs[i]);
+  }
+  return RingStatus::kOk;
+}
+
+int RingEngine::Counters(int tier, uint64_t* sent, uint64_t* recv, int cap) {
+  if (tier < 0 || tier >= kNumTiers || !tiers_[tier].present) return 0;
+  Tier* t = &tiers_[tier];
+  int nl = static_cast<int>(t->next.size());
+  for (int i = 0; i < nl && i < cap; ++i) {
+    sent[i] = t->next[static_cast<size_t>(i)]->bytes.load();
+    recv[i] = t->prev[static_cast<size_t>(i)]->bytes.load();
+  }
+  return std::min(nl, cap);
+}
+
+void RingEngine::ShaperCounters(int tier, int direction, uint64_t* bytes,
+                                uint64_t* frames) {
+  *bytes = 0;
+  *frames = 0;
+  if (tier < 0 || tier >= kNumTiers || !tiers_[tier].present) return;
+  RingShaper* s = direction == kDirNext ? &tiers_[tier].next_shaper
+                                        : &tiers_[tier].prev_shaper;
+  *bytes = s->bytes_sent.load();
+  *frames = s->frames_sent.load();
+}
+
+uint64_t RingEngine::LinkBytes(int tier, int direction, int lane) {
+  RingLink* l = link(tier, direction, lane);
+  return l ? l->bytes.load() : 0;
+}
+
+}  // namespace tpuft

@@ -1,0 +1,214 @@
+// GIL-free ring data plane: the hot loop of TCPCollective's striped
+// multi-lane ring allreduce, moved out of Python threads.
+//
+// The Python layer keeps everything slow-path and contractual — rendezvous,
+// the 12-byte connection preamble, tag allocation, topology resolution,
+// stripe/chunk boundary math (np.array_split), abort/reconfigure semantics —
+// and hands this engine the established lane sockets (dup'd fds) plus, per
+// op, the chunk views of a contiguous float32 working buffer.  Everything
+// per-hop runs here without the interpreter: scatter-gather writev/readv-
+// style socket I/O over the caller's buffers, the leader/follower tag-demux
+// reader, the per-direction virtual-time link pacing (LinkShaper's model),
+// and the bf16 / int8 wire codecs.
+//
+// Wire format is IDENTICAL to the Python engine (same `<IQ` frame header,
+// same per-hop codec bytes, same combine order), so the two engines are
+// bitwise-interoperable: a native rank and a Python rank on one ring decode
+// the same results, and the parity tests pin native == python bit for bit.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace tpuft {
+
+// Error classes surfaced to Python (mapped to TimeoutError /
+// ConnectionError / RuntimeError by the bindings).
+enum class RingStatus : int {
+  kOk = 0,
+  kTimeout = 1,
+  kClosed = 2,   // peer gone / engine closed mid-op
+  kError = 3,    // anything else (bad args, syscall failure)
+};
+
+// Ring tiers, matching TCPCollective's channel layout: the flat ring plus
+// the 2D topology's row/column tier rings.
+enum RingTier : int { kTierFlat = 0, kTierRow = 1, kTierCol = 2, kNumTiers = 3 };
+
+enum RingDir : int { kDirNext = 0, kDirPrev = 1 };
+
+// Ring-pass modes: a full reduce-scatter + allgather pass, or one phase
+// (the hierarchical pass runs row-RS, column-FULL, row-AG as three calls).
+enum RingPassMode : int { kPassFull = 0, kPassReduceScatter = 1, kPassAllgather = 2 };
+
+// Reduce ops ("avg" divides in Python after the pass, so it is kOpSum here).
+enum RingOp : int { kOpSum = 0, kOpMax = 1, kOpMin = 2 };
+
+// Wire encodings per hop.  kWireRaw frames the f32 chunk bytes unchanged;
+// kWireBf16 casts f32 -> bfloat16 (round-to-nearest-even, ml_dtypes
+// bit-compatible) per hop with f32 accumulation; kWireInt8 frames a 4-byte
+// f32 scale followed by symmetric int8 values (scale = amax/127), matching
+// collectives.quantize_int8 bit for bit.
+enum RingWire : int { kWireRaw = 0, kWireBf16 = 1, kWireInt8 = 2 };
+
+// Shared virtual-time pacer for one tier-direction (LinkShaper's model):
+// concurrent lanes queue on the modeled link, so lanes can only win by
+// overlapping propagation and host work with serialization.
+struct RingShaper {
+  bool enabled = false;
+  double bytes_per_s = 0;
+  double half_rtt_s = 0;
+  // Engine-wide close flag: the pacer sleeps in short slices against it so
+  // Close()'s drain never waits out a multi-second modeled serialization.
+  const std::atomic<bool>* closed = nullptr;
+  std::mutex mu;
+  double busy_until_s = 0;  // steady-clock seconds
+  std::atomic<uint64_t> bytes_sent{0};
+  std::atomic<uint64_t> frames_sent{0};
+
+  void OnSend(size_t nbytes);
+};
+
+struct RingSendJob;
+
+// One lane socket of one tier-direction.  `next` links own a sender thread
+// draining a FIFO job queue (the per-lane single-worker sender pool,
+// natively); `prev` links own the leader/follower demux state.
+struct RingLink {
+  int fd = -1;
+  RingShaper* shaper = nullptr;
+  std::atomic<uint64_t> bytes{0};  // wire bytes incl. headers (out for next, in for prev)
+  std::atomic<bool> dead{false};
+  // Written exactly once, under dead_mu, BEFORE dead's release-store flips
+  // true (PoisonLink) — so any thread that observes dead == true may read
+  // it lock-free.  Concurrent failure paths (op thread, sender, Close)
+  // race to poison; dead_mu picks one winner.
+  std::mutex dead_mu;
+  std::string dead_reason;
+
+  // Sender (next links).
+  std::thread sender;
+  std::mutex qmu;
+  std::condition_variable qcv;
+  std::deque<std::shared_ptr<RingSendJob>> queue;
+  bool stop = false;
+
+  // Demux (prev links): exactly one reader on the socket at a time; it
+  // publishes non-matching frames to the stash under the condition and
+  // notifies, so a follower whose frame already landed takes it without
+  // queuing behind the leader's blocking read.
+  std::mutex rmu;
+  std::condition_variable rcv;
+  bool reading = false;
+  std::map<uint32_t, std::deque<std::string>> stash;
+};
+
+class RingEngine {
+ public:
+  // lanes: lane count every registered tier uses.  mbps <= 0 disables the
+  // shaped-link pacer (counters still tick).
+  RingEngine(int lanes, double shaper_mbps, double shaper_rtt_ms);
+  ~RingEngine();
+
+  // Registers one tier's lane sockets.  The fds are dup()'d — the Python
+  // side keeps (and closes) its own socket objects; Close() closes the
+  // dups.  Must be called before any op on that tier.
+  bool SetTier(int tier, int nlanes, const int32_t* next_fds,
+               const int32_t* prev_fds, std::string* err);
+
+  // Shuts down + closes every dup'd fd and joins the sender threads.
+  // Idempotent; safe to call while ops are in flight (they fail with
+  // kClosed).  This is what abort()/_fail_ring latch onto.
+  void Close();
+
+  // Dup'd fds still open (the fd-leak sweep's native counterpart).
+  int OpenFds() const;
+
+  // Full-duplex whole-frame exchange on (tier, lane): sends `len` bytes
+  // under `tag` to the next neighbor while receiving the same tag from the
+  // previous one.  The received payload is returned in *out.  This is what
+  // the Python-orchestrated ops (allgather/broadcast/alltoall/barrier and
+  // non-f32 payload fallbacks) ride, so ALL reads of a lane socket go
+  // through one demux.
+  RingStatus Exchange(int tier, int lane, uint32_t tag, const uint8_t* buf,
+                      size_t len, std::string* out, double timeout_s,
+                      std::string* err);
+
+  // One ring pass over `n` chunk views of the caller's f32 working buffer,
+  // in place: mode selects reduce-scatter / allgather / both, `op` the
+  // combine, `wire` the per-hop codec.  rank is this rank's position on
+  // the tier ring; tags are tag_base + rs_sub / + ag_sub (the caller's
+  // stripe block).  Hop order, combine order, and codec arithmetic are
+  // bit-exact mirrors of the Python engine.
+  RingStatus RingPass(int tier, int lane, int n, int rank, uint32_t tag_base,
+                      uint32_t rs_sub, uint32_t ag_sub, int mode, int op,
+                      int wire, float* const* chunk_ptrs,
+                      const uint64_t* chunk_elems, double timeout_s,
+                      std::string* err);
+
+  // Per-lane wire-byte counters of one tier (lane_stats' feed).  Returns
+  // the lane count written (0 for an unregistered tier).
+  int Counters(int tier, uint64_t* sent, uint64_t* recv, int cap);
+
+  // Shared shaper counters of one tier-direction (LinkShaper.bytes_sent /
+  // frames_sent parity for shaped-link byte accounting tests).
+  void ShaperCounters(int tier, int direction, uint64_t* bytes, uint64_t* frames);
+
+  // Wire bytes moved on one lane link (direction 0 = next/out, 1 = prev/in).
+  uint64_t LinkBytes(int tier, int direction, int lane);
+
+ private:
+  struct Tier {
+    bool present = false;
+    std::vector<std::unique_ptr<RingLink>> next;
+    std::vector<std::unique_ptr<RingLink>> prev;
+    RingShaper next_shaper;
+    RingShaper prev_shaper;
+  };
+
+  RingLink* link(int tier, int direction, int lane);
+  bool CheckOpEntry(int tier, int lane, std::string* err);
+  void SenderLoop(RingLink* l);
+  std::shared_ptr<RingSendJob> EnqueueSend(RingLink* l, uint32_t tag,
+                                           const uint8_t* a, size_t alen,
+                                           const uint8_t* b, size_t blen,
+                                           double timeout_s);
+  RingStatus WaitSend(const std::shared_ptr<RingSendJob>& job, double timeout_s,
+                      std::string* err);
+  // Failure-path cleanup: poisons the send link (so the job fails fast)
+  // and blocks until the job has released its caller-owned buffers.
+  void AbandonSend(RingLink* nl, const std::shared_ptr<RingSendJob>& job,
+                   const std::string& why);
+  // Receives the frame for `tag` on prev-link `l`.  If dst != nullptr the
+  // payload must be exactly dst_len bytes and lands straight in dst (the
+  // zero-copy path); otherwise it is returned in *out.
+  RingStatus RecvFrame(RingLink* l, uint32_t tag, uint8_t* dst, size_t dst_len,
+                       std::string* out, double timeout_s, std::string* err);
+  RingStatus ReadPayload(RingLink* l, uint64_t nbytes, uint32_t tag,
+                         uint32_t expect_tag, uint8_t* dst, size_t dst_len,
+                         std::string* out, double timeout_s, std::string* err);
+  // One hop: enqueue the send, receive the same tag, join the send.
+  RingStatus Hop(Tier* t, int lane, uint32_t tag, const uint8_t* a, size_t alen,
+                 const uint8_t* b, size_t blen, uint8_t* rdst, size_t rlen,
+                 double timeout_s, std::string* err);
+
+  int lanes_;
+  double mbps_, rtt_ms_;
+  Tier tiers_[kNumTiers];
+  std::atomic<bool> closed_{false};
+  mutable std::mutex close_mu_;
+  // In-flight op count: Close() shuts the sockets down (waking every
+  // blocked op), then briefly waits for ops to drain before close()ing the
+  // fd numbers, so a racing reader can never touch a recycled fd.
+  std::atomic<int> active_ops_{0};
+};
+
+}  // namespace tpuft

@@ -178,6 +178,55 @@ def test_allreduce_quick_smoke() -> None:
     assert payload["pipelined_commits_ok"]
 
 
+def test_ring_engine_quick_smoke() -> None:
+    """Ring-engine tier-1 gate: one small ``bench_allreduce --engine both``
+    cell live (py + native at the same unshaped-loopback config, plus the
+    live bitwise parity pin), and the committed ALLREDUCE_BENCH.json
+    artifact must carry the engine A/B schema — engine field on every lane
+    record, native loopback >= py loopback, parity flag true."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_allreduce
+    finally:
+        sys.path.pop(0)
+    from torchft_tpu._native import ring_engine_available
+
+    if not ring_engine_available():
+        pytest.skip("libtpuft.so lacks the ring engine symbols")
+
+    payload = bench_allreduce.run_engine_quick(
+        payload_mb=4.0, lanes=2, trials=2
+    )
+    assert payload["native_available"] is True
+    by_engine = {c["engine"]: c for c in payload["cells"]}
+    assert set(by_engine) == {"py", "native"}
+    for cell in by_engine.values():
+        assert cell["gb_per_s"] > 0 and cell["wall_s"] > 0
+        assert len(cell["lane_bytes_sent"]) == cell["lanes"]
+    # Same config, same wire bytes: the engine is a pure hot-loop swap.
+    assert (by_engine["py"]["lane_bytes_sent"]
+            == by_engine["native"]["lane_bytes_sent"])
+    assert payload["parity_bitwise"] is True
+    assert payload["native_loopback_ok"], payload["native_loopback_speedup"]
+
+    # The committed artifact carries the regenerated engine A/B.
+    import json as _json
+
+    with open(os.path.join(REPO, "ALLREDUCE_BENCH.json")) as f:
+        artifact = _json.load(f)
+    lane_records = [
+        r for r in artifact["results"] if r.get("section") == "lanes"
+    ]
+    assert lane_records, "no lane records in ALLREDUCE_BENCH.json"
+    assert all(r.get("engine") in ("py", "native") for r in lane_records)
+    assert {r["engine"] for r in lane_records} == {"py", "native"}
+    summary = artifact["summary"]
+    loopback = summary["engine_loopback_gb_per_s"]
+    assert loopback["native"] >= loopback["py"]
+    assert summary["native_loopback_speedup"] >= 1.0
+    assert summary["engine_parity_bitwise"] is True
+
+
 def test_ec_quick_smoke() -> None:
     """Erasure-coded healing tier-1 gate (bench_transfer.run_ec_quick at a
     small state size): the encode-overhead cell must show the donor-side

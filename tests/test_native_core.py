@@ -29,10 +29,14 @@ def _run_gxx_fallback() -> None:
     os.makedirs(build_dir, exist_ok=True)
     binary = os.path.join(build_dir, "tpuft_test")
     gen_dir = "/tmp/tpuftpb"
+    # Same source list the bindings' auto-build compiles (minus capi.cc —
+    # the test binary has its own main): one tuple, no recipe drift.
+    from torchft_tpu._native import NATIVE_SOURCES
+
     srcs = [os.path.join(REPO, "native", "tests", "test_core.cc")] + [
         os.path.join(REPO, "native", "src", f)
-        for f in ("wire.cc", "http.cc", "flight.cc", "lighthouse.cc",
-                  "manager.cc", "store.cc")
+        for f in NATIVE_SOURCES
+        if f != "capi.cc"
     ]
     proto = os.path.join(REPO, "proto", "tpuft.proto")
     generator = os.path.join(REPO, "native", "gen_pb_local.py")

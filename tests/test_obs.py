@@ -717,3 +717,79 @@ def test_report_data_plane_rollup_across_topologies() -> None:
     # And the full attribute() payload carries the section.
     out = report.attribute(events)
     assert out["data_plane"]["allreduce_payload_bytes"] == 4000
+
+
+def test_ec_coverage_alert_pages_and_resolves() -> None:
+    """The EC redundancy sentinel end to end: two holders reporting full
+    shard coverage keep the lighthouse quiet; one holder dying drops the
+    newest generation's coverage below k + 1, and after the heartbeat-
+    timeout grace the lighthouse raises a cluster-scope "ec_coverage"
+    alert on /alerts.json (tpuft_alerts_active pages); the holder coming
+    back resolves it."""
+    from torchft_tpu._native import LighthouseServer, ManagerServer
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=300,
+    )
+    port = lighthouse.http_address().rsplit(":", 1)[1]
+
+    def alerts() -> list:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/alerts.json", timeout=10
+        ) as resp:
+            return json.loads(resp.read().decode())["alerts"]
+
+    def active_ec() -> list:
+        return [
+            a for a in alerts()
+            if a["kind"] == "ec_coverage" and a["active"]
+        ]
+
+    def start_holder(name: str, shards: int) -> "ManagerServer":
+        srv = ManagerServer(
+            replica_id=name,
+            lighthouse_addr=lighthouse.address(),
+            bind="127.0.0.1:0",
+            heartbeat_interval_ms=25,
+        )
+        # k=2 -> threshold k + 1 = 3; each holder serves 2 shards of the
+        # step-7 generation, so both together sit at coverage 4.
+        srv.set_status(7, "step", 0.0, 0.0, -1.0, shards, 7, 2)
+        return srv
+    holders = {n: start_holder(n, 2) for n in ("g0:ec", "g1:ec")}
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            m = _scrape(lighthouse)
+            if m.get("tpuft_ec_shard_coverage") == 4:
+                break
+            time.sleep(0.05)
+        assert m.get("tpuft_ec_shard_coverage") == 4
+        assert m["tpuft_alerts_active"] == 0 and not active_ec()
+
+        # One holder dies: coverage 2 < 3 once its heartbeats go stale.
+        holders.pop("g1:ec").shutdown()
+        deadline = time.monotonic() + 10.0
+        fired = []
+        while time.monotonic() < deadline and not fired:
+            fired = active_ec()
+            time.sleep(0.05)
+        assert fired, "ec_coverage alert never raised"
+        assert fired[0]["replica_id"] == "cluster"
+        assert fired[0]["coverage"] == 2 and fired[0]["threshold"] == 3
+        assert _scrape(lighthouse)["tpuft_alerts_active"] >= 1
+
+        # The holder returns with its shards: the alert resolves.
+        holders["g1:ec"] = start_holder("g1:ec", 2)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and active_ec():
+            time.sleep(0.05)
+        assert not active_ec()
+        resolved = [a for a in alerts() if a["kind"] == "ec_coverage"]
+        assert resolved and not resolved[-1]["active"]
+        assert _scrape(lighthouse)["tpuft_alerts_active"] == 0
+    finally:
+        for srv in holders.values():
+            srv.shutdown()
+        lighthouse.shutdown()

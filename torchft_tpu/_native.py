@@ -49,8 +49,59 @@ STORE_ADD = 22
 STORE_DELETE = 23
 
 
+# The plain-g++ source set (native/gen_pb_local.py's docstring recipe);
+# tests/test_native_core.py builds its test binary from the same list, so
+# the two recipes cannot drift.
+NATIVE_SOURCES = (
+    "wire.cc",
+    "http.cc",
+    "flight.cc",
+    "lighthouse.cc",
+    "manager.cc",
+    "store.cc",
+    "ring.cc",
+    "capi.cc",
+)
+
+
+def _build_native_gxx() -> None:
+    """Toolchain-less fallback: gen_pb_local.py + plain g++ -shared (the
+    recipe native/gen_pb_local.py documents).  Used when cmake/ninja are
+    absent but g++ exists — the shape of the container this repo's CI
+    runs in."""
+    import sys
+
+    native_dir = os.path.join(_REPO_ROOT, "native")
+    subprocess.run(
+        [sys.executable, os.path.join(native_dir, "gen_pb_local.py")],
+        check=True,
+        capture_output=True,
+        timeout=120,
+    )
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    srcs = [os.path.join(native_dir, "src", f) for f in NATIVE_SOURCES]
+    subprocess.run(
+        # -O3, not -O2: GCC 10 only auto-vectorizes at -O3, and the ring
+        # engine's f32 combine + wire-codec loops are the data plane's
+        # arithmetic hot path.
+        ["g++", "-std=c++17", "-O3", "-fPIC", "-shared",
+         "-I", os.path.join(native_dir, "src"), "-I", "/tmp/tpuftpb",
+         *srcs, "-o", _LIB_PATH, "-lpthread"],
+        check=True,
+        capture_output=True,
+        timeout=600,
+    )
+
+
 def _build_native() -> None:
-    """Builds libtpuft.so and the generated protobuf modules via cmake/ninja."""
+    """Builds libtpuft.so and the generated protobuf modules via cmake/ninja,
+    falling back to the gen_pb_local.py + g++ recipe on toolchain-less
+    containers."""
+    import shutil
+
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        _build_native_gxx()
+        return
     native_dir = os.path.join(_REPO_ROOT, "native")
     build_dir = os.path.join(native_dir, "build")
     subprocess.run(
@@ -143,6 +194,7 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_double,
         ctypes.c_int64,
         ctypes.c_int64,
+        ctypes.c_int64,
     ]
     lib.tf_manager_flight_json.restype = ctypes.c_void_p
     lib.tf_manager_flight_json.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -176,6 +228,103 @@ def _load_lib() -> ctypes.CDLL:
 
 
 _lib = _load_lib()
+
+
+def _bind_ring(lib: ctypes.CDLL) -> Optional[str]:
+    """Declares the tf_ring_* signatures; returns a human-readable reason
+    when the loaded libtpuft.so predates the ring engine (stale build) —
+    the capability probe TCPCollective's engine selection reads."""
+    try:
+        lib.tf_ring_new.restype = ctypes.c_void_p
+        lib.tf_ring_new.argtypes = [ctypes.c_int32, ctypes.c_double, ctypes.c_double]
+        lib.tf_ring_set_tier.restype = ctypes.c_int
+        lib.tf_ring_set_tier.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tf_ring_close.argtypes = [ctypes.c_void_p]
+        lib.tf_ring_free.argtypes = [ctypes.c_void_p]
+        lib.tf_ring_open_fds.restype = ctypes.c_int
+        lib.tf_ring_open_fds.argtypes = [ctypes.c_void_p]
+        lib.tf_ring_exchange.restype = ctypes.c_int
+        lib.tf_ring_exchange.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tf_ring_pass.restype = ctypes.c_int
+        lib.tf_ring_pass.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tf_ring_counters.restype = ctypes.c_int
+        lib.tf_ring_counters.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int32,
+        ]
+        lib.tf_ring_shaper_counters.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.tf_ring_link_bytes.restype = ctypes.c_uint64
+        lib.tf_ring_link_bytes.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+    except AttributeError:
+        return (
+            f"libtpuft.so at {_LIB_PATH} lacks the ring-engine symbols "
+            "(stale build predating native/src/ring.cc) — rebuild it: "
+            "python native/gen_pb_local.py && the g++ recipe in that "
+            "file's docstring (or cmake/ninja)"
+        )
+    return None
+
+
+_RING_UNAVAILABLE: Optional[str] = _bind_ring(_lib)
+
+
+def ring_engine_available() -> bool:
+    """True when the loaded native library exports the GIL-free ring
+    engine (tf_ring_*).  False means a stale libtpuft.so; see
+    :func:`ring_engine_unavailable_reason`."""
+    return _RING_UNAVAILABLE is None
+
+
+def ring_engine_unavailable_reason() -> str:
+    return _RING_UNAVAILABLE or ""
 
 
 def _take_string(ptr: int) -> str:
@@ -694,6 +843,7 @@ class ManagerServer:
         allreduce_gb_per_s: float = -1.0,
         ec_shards_held: int = -1,
         ec_shard_step: int = -1,
+        ec_k: int = -1,
     ) -> None:
         """Pushes live (step, state) into the heartbeat payload so the
         lighthouse's ``GET /metrics`` and ``/status.json`` show per-replica
@@ -709,7 +859,9 @@ class ManagerServer:
         ``ec_shards_held``/``ec_shard_step`` (heartbeat fields 8-9, the
         erasure-shard inventory feeding ``tpuft_ec_shard_coverage``)
         follow the same convention: 0 is an authoritative empty-store
-        report, negative keeps the prior reading."""
+        report, negative keeps the prior reading.  ``ec_k`` (field 10) is
+        the EC geometry's data-shard count — the lighthouse coverage
+        sentinel pages when per-step coverage drops below k + 1."""
         if self._ptr:
             _lib.tf_manager_set_status(
                 self._ptr,
@@ -720,6 +872,7 @@ class ManagerServer:
                 float(allreduce_gb_per_s),
                 int(ec_shards_held),
                 int(ec_shard_step),
+                int(ec_k),
             )
 
     def flight_json(self, limit: int = 0) -> str:
@@ -841,6 +994,158 @@ class ManagerClient:
 
     def close(self) -> None:
         self._client.close()
+
+
+class RingEngine:
+    """GIL-free ring data plane (native/src/ring.h).
+
+    Owns dup()'d copies of TCPCollective's established lane sockets and runs
+    the entire per-hop hot loop natively: scatter-gather socket I/O over the
+    caller's flat f32 buffers, the leader/follower tag demux, the
+    per-direction virtual-time link pacing, and the bf16/int8 wire codecs —
+    all bit-identical to the Python engine (the two interoperate on one
+    ring).  Every method releases the GIL for its full duration (ctypes),
+    which is the point: a striped allreduce keeps exactly zero interpreter
+    work on the wire path.
+
+    Tiers: 0 = flat ring, 1 = ring2d row, 2 = ring2d column.  Directions:
+    0 = next (sends), 1 = prev (receives).
+    """
+
+    TIER_FLAT = 0
+    TIER_ROW = 1
+    TIER_COL = 2
+    # Ring-pass modes / ops / wires (native/src/ring.h enums).
+    PASS_FULL = 0
+    PASS_RS = 1
+    PASS_AG = 2
+    OP_SUM = 0
+    OP_MAX = 1
+    OP_MIN = 2
+    WIRE_RAW = 0
+    WIRE_BF16 = 1
+    WIRE_INT8 = 2
+
+    def __init__(self, lanes: int, shaper_mbps: float = 0.0, shaper_rtt_ms: float = 0.0) -> None:
+        if _RING_UNAVAILABLE is not None:
+            raise RuntimeError(_RING_UNAVAILABLE)
+        self._ptr = _lib.tf_ring_new(int(lanes), float(shaper_mbps), float(shaper_rtt_ms))
+        self._lanes = int(lanes)
+
+    def set_tier(self, tier: int, next_fds: List[int], prev_fds: List[int]) -> None:
+        """Registers one tier's lane sockets (the engine dup()s them; the
+        Python sockets stay owned — and closed — by the collective)."""
+        n = len(next_fds)
+        assert len(prev_fds) == n
+        nxt = (ctypes.c_int32 * n)(*next_fds)
+        prv = (ctypes.c_int32 * n)(*prev_fds)
+        err = ctypes.c_char_p()
+        rc = _lib.tf_ring_set_tier(self._ptr, int(tier), n, nxt, prv, ctypes.byref(err))
+        if rc != 0:
+            raise RuntimeError(_take_error(err))
+
+    @staticmethod
+    def _raise(rc: int, err: "ctypes.c_char_p") -> None:
+        msg = _take_error(err)
+        if rc == 1:
+            raise TimeoutError(msg)
+        if rc == 2:
+            raise ConnectionError(msg)
+        raise RuntimeError(msg)
+
+    def exchange(self, tier: int, lane: int, tag: int, payload: bytes, timeout_s: float) -> bytes:
+        """Full-duplex framed exchange on (tier, lane): send ``payload``
+        under ``tag`` to the next neighbor while receiving the same tag
+        from the previous one.  The whole-frame path the Python-orchestrated
+        ops (allgather/broadcast/alltoall/barrier, non-f32 fallbacks) ride
+        so every read of a lane socket goes through ONE demux."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        err = ctypes.c_char_p()
+        rc = _lib.tf_ring_exchange(
+            self._ptr, int(tier), int(lane), int(tag) & 0xFFFFFFFF,
+            payload, len(payload), ctypes.byref(out), ctypes.byref(out_len),
+            float(timeout_s), ctypes.byref(err),
+        )
+        if rc != 0:
+            self._raise(rc, err)
+        data = ctypes.string_at(out, out_len.value)
+        _lib.tf_free(ctypes.cast(out, ctypes.c_void_p))
+        return data
+
+    def ring_pass(
+        self,
+        tier: int,
+        lane: int,
+        n: int,
+        rank: int,
+        tag_base: int,
+        rs_sub: int,
+        ag_sub: int,
+        mode: int,
+        op: int,
+        wire: int,
+        chunk_ptrs: List[int],
+        chunk_elems: List[int],
+        timeout_s: float,
+    ) -> None:
+        """One ring pass over ``n`` chunk views (raw addresses + element
+        counts into the caller's contiguous f32 buffer), IN PLACE.  The
+        caller guarantees the buffer outlives the call (it does: the call
+        blocks) and that chunk boundaries were cut identically on every
+        rank (np.array_split math, same as the Python engine)."""
+        ptrs = (ctypes.c_uint64 * n)(*chunk_ptrs)
+        elems = (ctypes.c_uint64 * n)(*chunk_elems)
+        err = ctypes.c_char_p()
+        rc = _lib.tf_ring_pass(
+            self._ptr, int(tier), int(lane), int(n), int(rank),
+            int(tag_base) & 0xFFFFFFFF, int(rs_sub), int(ag_sub),
+            int(mode), int(op), int(wire), ptrs, elems,
+            float(timeout_s), ctypes.byref(err),
+        )
+        if rc != 0:
+            self._raise(rc, err)
+
+    def counters(self, tier: int) -> "tuple[List[int], List[int]]":
+        """(sent, recv) wire-byte counters per lane of one tier (headers
+        included) — lane_stats' feed under the native engine."""
+        cap = self._lanes
+        sent = (ctypes.c_uint64 * cap)()
+        recv = (ctypes.c_uint64 * cap)()
+        got = _lib.tf_ring_counters(self._ptr, int(tier), sent, recv, cap)
+        return list(sent[:got]), list(recv[:got])
+
+    def shaper_counters(self, tier: int, direction: int) -> "tuple[int, int]":
+        """(bytes, frames) admitted through one tier-direction's shared
+        virtual-time pacer — LinkShaper.bytes_sent/frames_sent parity."""
+        b = ctypes.c_uint64()
+        f = ctypes.c_uint64()
+        _lib.tf_ring_shaper_counters(self._ptr, int(tier), int(direction),
+                                     ctypes.byref(b), ctypes.byref(f))
+        return int(b.value), int(f.value)
+
+    def link_bytes(self, tier: int, direction: int, lane: int) -> int:
+        return int(_lib.tf_ring_link_bytes(self._ptr, int(tier), int(direction), int(lane)))
+
+    def open_fd_count(self) -> int:
+        """Dup'd lane fds still open — 0 after close() (the native half of
+        the no-leaked-fds sweep)."""
+        return int(_lib.tf_ring_open_fds(self._ptr)) if self._ptr else 0
+
+    def close(self) -> None:
+        """Shutdown + close every dup'd lane fd and join the sender
+        threads; idempotent, safe mid-op (blocked ops fail fast)."""
+        if self._ptr:
+            _lib.tf_ring_close(self._ptr)
+
+    def __del__(self) -> None:
+        try:
+            if self._ptr:
+                _lib.tf_ring_close(self._ptr)
+                _lib.tf_ring_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
 
 
 class StoreServer:

@@ -144,6 +144,7 @@ class ManagerServer:
         allreduce_gb_per_s: float = ...,
         ec_shards_held: int = ...,
         ec_shard_step: int = ...,
+        ec_k: int = ...,
     ) -> None: ...
     def flight_json(self, limit: int = ...) -> str: ...
     def flight(self, limit: int = ...) -> Dict[str, Any]: ...
@@ -190,4 +191,51 @@ class StoreClient:
     ) -> Optional[bytes]: ...
     def add(self, key: str, delta: int, timeout_ms: int = ...) -> int: ...
     def delete(self, key: str, timeout_ms: int = ...) -> None: ...
+    def close(self) -> None: ...
+
+def ring_engine_available() -> bool: ...
+def ring_engine_unavailable_reason() -> str: ...
+
+class RingEngine:
+    TIER_FLAT: int
+    TIER_ROW: int
+    TIER_COL: int
+    PASS_FULL: int
+    PASS_RS: int
+    PASS_AG: int
+    OP_SUM: int
+    OP_MAX: int
+    OP_MIN: int
+    WIRE_RAW: int
+    WIRE_BF16: int
+    WIRE_INT8: int
+    def __init__(
+        self, lanes: int, shaper_mbps: float = ..., shaper_rtt_ms: float = ...
+    ) -> None: ...
+    def set_tier(
+        self, tier: int, next_fds: List[int], prev_fds: List[int]
+    ) -> None: ...
+    def exchange(
+        self, tier: int, lane: int, tag: int, payload: bytes, timeout_s: float
+    ) -> bytes: ...
+    def ring_pass(
+        self,
+        tier: int,
+        lane: int,
+        n: int,
+        rank: int,
+        tag_base: int,
+        rs_sub: int,
+        ag_sub: int,
+        mode: int,
+        op: int,
+        wire: int,
+        chunk_ptrs: List[int],
+        chunk_elems: List[int],
+        timeout_s: float,
+    ) -> None: ...
+    def counters(self, tier: int) -> tuple[List[int], List[int]]: ...
+    def shaper_counters(self, tier: int, direction: int) -> tuple[int, int]: ...
+    def link_bytes(self, tier: int, direction: int, lane: int) -> int: ...
+    def open_fd_count(self) -> int: ...
     def close(self) -> None: ...

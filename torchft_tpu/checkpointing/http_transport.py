@@ -161,7 +161,7 @@ class HTTPTransport(CheckpointTransport):
                 # from the ShardStore, WITHOUT the checkpoint RWLock or a
                 # serving window (the donor-free property).
                 if parts and parts[0] == "ec":
-                    transport._handle_ec_get(self, parts)
+                    transport._handle_ec_get(self, parts, query)
                     return
                 # /checkpoint/<step>/<what>[?n=<stripes>]
                 if len(parts) != 3 or parts[0] != "checkpoint":
@@ -456,10 +456,15 @@ class HTTPTransport(CheckpointTransport):
 
     # -- erasure shard endpoints (torchft_tpu/ec) ----------------------------
 
-    def _handle_ec_get(self, handler, parts: List[str]) -> None:
-        """GET /ec/shard/<step>/<idx> (one self-verifying shard frame) and
-        GET /ec/have/<step> (JSON inventory + geometry).  Served straight
-        from the ShardStore — no RWLock, no serving window."""
+    def _handle_ec_get(self, handler, parts: List[str], query: str = "") -> None:
+        """GET /ec/shard/<step>/<idx>[?part=<i>&n=<N>] (one self-verifying
+        shard frame, or header + payload byte-range part i of N — the
+        striped-receiver idiom of the checkpoint path's ``?n=`` chunks,
+        receiver-parameterized so reconstruction chooses its own
+        parallelism; see ec.encoder.write_shard_part for the range
+        contract) and GET /ec/have/<step> (JSON inventory + geometry).
+        Served straight from the ShardStore — no RWLock, no serving
+        window."""
         store = self._shard_store
         if store is None:
             handler.send_error(404, "no shard store attached")
@@ -471,9 +476,26 @@ class HTTPTransport(CheckpointTransport):
                 if shard is None:
                     handler.send_error(404, f"shard {idx} for step {step} not held")
                     return
-                from torchft_tpu.ec.encoder import write_shard
+                from torchft_tpu.ec.encoder import write_shard, write_shard_part
 
-                body = write_shard(shard)
+                part = n = None
+                if query:
+                    qs = urllib.parse.parse_qs(query)
+                    raw_part = qs.get("part", [None])[0]
+                    raw_n = qs.get("n", [None])[0]
+                    if raw_part is not None or raw_n is not None:
+                        try:
+                            part, n = int(raw_part or 0), int(raw_n or 0)
+                        except ValueError:
+                            handler.send_error(400, "bad shard range")
+                            return
+                        if n <= 0 or not 0 <= part < n:
+                            handler.send_error(400, "bad shard range")
+                            return
+                body = (
+                    write_shard(shard) if n is None
+                    else write_shard_part(shard, part, n)
+                )
                 handler.send_response(200)
                 handler.send_header("Content-Type", "application/octet-stream")
                 handler.send_header("Content-Length", str(len(body)))

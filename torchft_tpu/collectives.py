@@ -303,12 +303,28 @@ class LinkShaper:
     def __init__(self, mbps: float, rtt_ms: float) -> None:
         self.bytes_per_s = mbps * 1e6 / 8.0
         self.half_rtt_s = rtt_ms / 2000.0
-        self.bytes_sent = 0
-        self.frames_sent = 0
+        self._bytes_sent = 0
+        self._frames_sent = 0
+        # When the native ring engine owns this direction's sends, its
+        # pacer does the counting; the hook keeps the byte-accounting
+        # surface (tests, benches) engine-agnostic.
+        self._native_read: Optional[Callable[[], tuple]] = None
         self._lock = threading.Lock()
         # Virtual time (monotonic clock) until which the modeled link is
         # busy serializing already-admitted frames.
         self._busy_until = 0.0
+
+    @property
+    def bytes_sent(self) -> int:
+        if self._native_read is not None:
+            return self._native_read()[0]
+        return self._bytes_sent
+
+    @property
+    def frames_sent(self) -> int:
+        if self._native_read is not None:
+            return self._native_read()[1]
+        return self._frames_sent
 
     @classmethod
     def from_env(cls) -> Optional["LinkShaper"]:
@@ -323,8 +339,8 @@ class LinkShaper:
 
     def on_send(self, nbytes: int) -> None:
         with self._lock:
-            self.bytes_sent += nbytes
-            self.frames_sent += 1
+            self._bytes_sent += nbytes
+            self._frames_sent += 1
             now = time.monotonic()
             start = max(now, self._busy_until)
             self._busy_until = start + nbytes / self.bytes_per_s
@@ -365,9 +381,24 @@ class _Peer:
         # Wire-byte counters (headers included), always on — the per-lane
         # throughput accounting the GB/s telemetry reads; ints under the
         # send lock / recv condition, so the cost is a couple of adds per
-        # frame.
-        self.bytes_out = 0
-        self.bytes_in = 0
+        # frame.  When the native ring engine owns this link's I/O the
+        # hook reads its counter instead, so lane_stats and the tests
+        # that sweep peer byte counters stay engine-agnostic.
+        self._bytes_out = 0
+        self._bytes_in = 0
+        self._native_bytes: Optional[Callable[[], int]] = None
+
+    @property
+    def bytes_out(self) -> int:
+        if self._native_bytes is not None:
+            return self._native_bytes()
+        return self._bytes_out
+
+    @property
+    def bytes_in(self) -> int:
+        if self._native_bytes is not None:
+            return self._native_bytes()
+        return self._bytes_in
 
     def send_msg(self, tag: int, payload) -> None:
         """payload: one buffer, or a list of buffers sent as a single frame
@@ -381,7 +412,7 @@ class _Peer:
             self.sock.sendall(_HDR.pack(tag, total))
             for p in parts:
                 self.sock.sendall(p)
-            self.bytes_out += total + _HDR.size
+            self._bytes_out += total + _HDR.size
 
     def recv_msg(self, expect_tag: int) -> bytearray:
         with self.recv_cond:
@@ -428,7 +459,7 @@ class _Peer:
             if r == 0:
                 raise ConnectionError("peer connection closed")
             got += r
-        self.bytes_in += n
+        self._bytes_in += n
         return buf
 
     def close(self) -> None:
@@ -523,6 +554,55 @@ TPUFT_RING_TOPOLOGY_ENV = "TPUFT_RING_TOPOLOGY"
 TPUFT_RING2D_MIN_ENV = "TPUFT_RING2D_MIN_GROUPS"
 _RING2D_DEFAULT_MIN = 8
 _TOPOLOGIES = ("auto", "ring", "ring2d")
+
+
+# Ring engine selection (docs/architecture.md "Native data plane").  The
+# hot loop — per-hop socket I/O, tag demux, link pacing, wire codecs, the
+# f32 combine — can run either in Python threads ("py") or in the native
+# GIL-free engine (native/src/ring.cc, "native").  Both produce IDENTICAL
+# wire bytes and results (bitwise — pinned by the engine-parity tests), so
+# mixed-engine rings interoperate and "auto" (the default) simply picks
+# native whenever libtpuft.so exports it, falling back to Python otherwise
+# (one warning when native was requested explicitly but the .so is stale).
+# Payloads outside the native fast path (non-f32 accumulation: int/f64
+# payloads, pickled control traffic) run the Python orchestration over the
+# engine's socket layer, so ALL reads of a lane socket share one demux.
+TPUFT_RING_ENGINE_ENV = "TPUFT_RING_ENGINE"
+_RING_ENGINES = ("auto", "py", "native")
+
+# Native engine op/wire codes (mirrors native/src/ring.h enums).
+_NATIVE_OP = {"sum": 0, "avg": 0, "max": 1, "min": 2}
+_NATIVE_WIRE_RAW = 0
+_NATIVE_WIRE_BF16 = 1
+_NATIVE_WIRE_INT8 = 2
+_NATIVE_PASS_FULL = 0
+_NATIVE_PASS_RS = 1
+_NATIVE_PASS_AG = 2
+
+_native_fallback_warned = False
+
+
+def _warn_native_fallback(reason: str) -> None:
+    """One clear line per process when TPUFT_RING_ENGINE=native was
+    requested but the loaded libtpuft.so predates the ring engine — a
+    silent Python fallback here would report CPU-bound numbers as if they
+    were the native data plane's."""
+    global _native_fallback_warned
+    if _native_fallback_warned:
+        return
+    _native_fallback_warned = True
+    import logging
+
+    logging.getLogger("torchft_tpu.collectives").warning(
+        "TPUFT_RING_ENGINE=native requested but the native ring engine is "
+        "unavailable; running the PYTHON ring engine instead: %s",
+        reason,
+    )
+
+
+def _ring_engine_from_env() -> str:
+    engine = os.environ.get(TPUFT_RING_ENGINE_ENV, "auto")
+    return engine if engine in _RING_ENGINES else "auto"
 
 
 def _ring_lanes_from_env() -> int:
@@ -635,6 +715,7 @@ class TCPCollective(Collective):
         wire_dtype: str = "auto",
         lanes: Optional[int] = None,
         topology: Optional[str] = None,
+        engine: Optional[str] = None,
     ) -> None:
         """``wire_dtype="bf16"`` halves allreduce bytes on the wire (DCN is
         the cross-slice bottleneck): ring payloads are cast to bfloat16 per
@@ -671,6 +752,11 @@ class TCPCollective(Collective):
             raise ValueError(
                 f"unsupported topology {topology!r}; expected one of {_TOPOLOGIES}"
             )
+        engine = engine if engine is not None else _ring_engine_from_env()
+        if engine not in _RING_ENGINES:
+            raise ValueError(
+                f"unsupported engine {engine!r}; expected one of {_RING_ENGINES}"
+            )
         self._timeout = timeout
         self._chunk_bytes = chunk_bytes
         self._wire_dtype = wire_dtype
@@ -679,6 +765,10 @@ class TCPCollective(Collective):
         self._topology = topology  # requested; resolved per configure()
         self._ring2d_min = _ring2d_min_from_env()
         self._active_topology = "ring"
+        # Native GIL-free ring engine handle (None = Python engine); built
+        # per configure() over the freshly rendezvoused lane sockets.
+        self._engine_mode = engine
+        self._engine = None
         self._row_tier: Optional[_TierLinks] = None
         self._col_tier: Optional[_TierLinks] = None
         self._lock = threading.Lock()
@@ -761,6 +851,7 @@ class TCPCollective(Collective):
                 return
             self._store = StoreClient(store_addr)
             self._rendezvous()
+            self._engine = self._create_engine()
             from concurrent.futures import ThreadPoolExecutor
 
             # Single-lane ring ops share the lane-0 sockets and execute one
@@ -801,6 +892,71 @@ class TCPCollective(Collective):
             self._executor = ThreadPoolExecutor(
                 max_workers=4, thread_name_prefix="tpuft_p2p"
             )
+
+    @property
+    def ring_engine(self) -> str:
+        """The engine the CURRENT configuration runs the ring hot loop on:
+        "native" (GIL-free, native/src/ring.cc) or "py".  "auto" and
+        explicit requests resolve here — what the bench's engine A/B
+        records and the parity tests pin."""
+        return "native" if self._engine is not None else "py"
+
+    def _create_engine(self) -> Optional[object]:
+        """Builds the native ring engine over this generation's lane fds
+        (all tiers), or returns None for the Python engine.  Called under
+        _lock right after _rendezvous; any failure degrades to Python."""
+        if self._engine_mode == "py":
+            return None
+        from torchft_tpu import _native
+
+        if not _native.ring_engine_available():
+            if self._engine_mode == "native":
+                _warn_native_fallback(_native.ring_engine_unavailable_reason())
+            return None
+        mbps = rtt_ms = 0.0
+        spec = os.environ.get("TPUFT_SHAPED_LINK")
+        if spec:
+            try:
+                head, _, tail = spec.partition(":")
+                mbps, rtt_ms = float(head), float(tail or "0")
+            except ValueError:
+                mbps = rtt_ms = 0.0
+        tiers = [(_native.RingEngine.TIER_FLAT, self._next_lanes, self._prev_lanes)]
+        for tid, tier in ((_native.RingEngine.TIER_ROW, self._row_tier),
+                          (_native.RingEngine.TIER_COL, self._col_tier)):
+            if tier is not None:
+                tiers.append((tid, tier.next_lanes, tier.prev_lanes))
+        try:
+            eng = _native.RingEngine(self._lanes, mbps, rtt_ms)
+            for tid, nexts, prevs in tiers:
+                eng.set_tier(
+                    tid,
+                    [p.sock.fileno() for p in nexts],
+                    [p.sock.fileno() for p in prevs],
+                )
+        except Exception as e:  # noqa: BLE001 — engine is an optimization
+            if self._engine_mode == "native":
+                _warn_native_fallback(f"engine construction failed: {e}")
+            return None
+        # Re-point the byte-accounting surface at the native counters so
+        # lane_stats, the shaped-link byte assertions, and the Manager's
+        # GB/s telemetry are engine-agnostic.
+        for tid, nexts, prevs in tiers:
+            for lane, peer in enumerate(nexts):
+                peer._native_bytes = (
+                    lambda eng=eng, tid=tid, lane=lane: eng.link_bytes(tid, 0, lane)
+                )
+            for lane, peer in enumerate(prevs):
+                peer._native_bytes = (
+                    lambda eng=eng, tid=tid, lane=lane: eng.link_bytes(tid, 1, lane)
+                )
+            for direction, peers in ((0, nexts), (1, prevs)):
+                shaper = peers[0].shaper if peers else None
+                if shaper is not None:
+                    shaper._native_read = (
+                        lambda eng=eng, tid=tid, d=direction: eng.shaper_counters(tid, d)
+                    )
+        return eng
 
     # Channel ids in the 12-byte connection preamble (rank, channel, lane).
     # _CH_ROW/_CH_COL are the 2D topology's tier rings — distinct channels
@@ -1074,8 +1230,13 @@ class TCPCollective(Collective):
             if self._store is not None:
                 self._store.close()
                 self._store = None
+            engine, self._engine = self._engine, None
             inflight, self._inflight = list(self._inflight), set()
-        # Outside the lock: failing a future runs its done-callbacks inline.
+        # Outside the lock: the engine close briefly drains in-flight native
+        # ops (they wake instantly — every socket was just shut down), and
+        # failing a future runs its done-callbacks inline.
+        if engine is not None:
+            engine.close()
         err = RuntimeError("collective aborted")
         for fut in inflight:
             if not fut.done():
@@ -1155,6 +1316,7 @@ class TCPCollective(Collective):
         out = {
             "lanes": self._lanes,
             "topology": self._active_topology,
+            "engine": self.ring_engine,
             "sent": [p.bytes_out for p in nexts],
             "recv": [p.bytes_in for p in prevs],
         }
@@ -1179,7 +1341,15 @@ class TCPCollective(Collective):
         op: str = "sum",
         allow_wire_compression: bool = True,
         wire_codec: Optional[str] = None,
+        donate: bool = False,
     ) -> Work:
+        """``donate=True`` hands the input buffers to the op: the caller
+        promises not to read them again, so the native engine may reduce IN
+        PLACE over them (zero-copy — no defensive working-buffer memcpy)
+        and the results may alias the inputs.  Safe for temporaries and for
+        staging buffers overwritten before the next round (the DDP wire
+        stage); the Python engine ignores the hint (it never mutates
+        inputs), so results are bitwise-identical either way."""
         # Validate BEFORE the world-size-1 fast path: a typo'd op must fail
         # on a single-replica config too, not only after scaling up.
         if op not in _REDUCE_COMBINE:
@@ -1218,20 +1388,24 @@ class TCPCollective(Collective):
         if self._active_topology == "ring2d":
             if self._lanes > 1:
                 return self._striped_hier_allreduce(
-                    arrays, op, allow_wire_compression, seq, codec=wire_codec
+                    arrays, op, allow_wire_compression, seq, codec=wire_codec,
+                    donate=donate,
                 )
             return self._submit(
                 lambda: self._hier_allreduce(
-                    arrays, op, allow_wire_compression, seq, codec=wire_codec
+                    arrays, op, allow_wire_compression, seq, codec=wire_codec,
+                    donate=donate,
                 )
             )
         if self._lanes > 1:
             return self._striped_allreduce(
-                arrays, op, allow_wire_compression, seq, codec=wire_codec
+                arrays, op, allow_wire_compression, seq, codec=wire_codec,
+                donate=donate,
             )
         return self._submit(
             lambda: self._ring_allreduce(
-                arrays, op, allow_wire_compression, seq, codec=wire_codec
+                arrays, op, allow_wire_compression, seq, codec=wire_codec,
+                donate=donate,
             )
         )
 
@@ -1246,6 +1420,18 @@ class TCPCollective(Collective):
         hops per op, and a fresh thread per hop is pure scheduler churn.
         One worker per lane serializes sends exactly like the peer's
         send_lock already does, so ordering is unchanged."""
+        engine = self._engine
+        if engine is not None:
+            # Native path: the engine's per-link sender thread + demux do
+            # the full-duplex work GIL-free; all ring-lane socket reads go
+            # through its one stash, so native ring passes and Python-
+            # orchestrated ops (this path) can interleave on one lane.
+            tier_id = 0 if tier is None else (1 if tier is self._row_tier else 2)
+            if isinstance(payload, (list, tuple)):
+                payload = b"".join(bytes(p) for p in payload)
+            elif not isinstance(payload, bytes):
+                payload = bytes(payload)
+            return engine.exchange(tier_id, lane, tag, payload, self._timeout)
         if tier is not None:
             nxt = tier.next_lanes[lane]
             prv = tier.prev_lanes[lane]
@@ -1370,6 +1556,130 @@ class TCPCollective(Collective):
             return np.frombuffer(raw, dtype=acc_dtype)
 
         return encode, decode
+
+    # -- native engine dispatch --------------------------------------------
+
+    def _native_wire_mode(
+        self, flat_dtype, wire, acc_dtype, codec: Optional[str]
+    ) -> Optional[int]:
+        """The native engine's wire mode for one allreduce, or None when
+        this payload stays on the Python orchestration (no engine, or a
+        payload outside the native fast path: integer/f64 accumulation,
+        bf16 raw framing, codecs over non-f32 buffers).  The fallback is
+        per-op and silent — it still rides the engine's socket layer via
+        _exchange, so the demux stays unified."""
+        if self._engine is None:
+            return None
+        if codec is not None:
+            if codec != "int8":
+                return None
+            return (
+                _NATIVE_WIRE_INT8
+                if np.dtype(flat_dtype) == np.float32
+                and np.dtype(acc_dtype) == np.float32
+                else None
+            )
+        if wire is not None:
+            # bf16 wire: f32 accumulation covers both f32 inputs and
+            # device-prepped bf16 inputs (upcast is lossless).
+            return _NATIVE_WIRE_BF16 if np.dtype(acc_dtype) == np.float32 else None
+        return _NATIVE_WIRE_RAW if np.dtype(flat_dtype) == np.float32 else None
+
+    def _native_buffer(self, flat: np.ndarray, fresh: bool = False) -> np.ndarray:
+        """The f32 working buffer a native pass mutates IN PLACE — never a
+        caller input (the ring never mutates its inputs); bf16 payloads
+        upcast losslessly and _unflatten's astype casts back.  ``fresh``
+        marks a flat buffer _flatten just ALLOCATED (the multi-array
+        concatenate path), which the pass may therefore mutate directly —
+        skipping the defensive copy saves a full memcpy per bucket on the
+        hot path."""
+        if flat.dtype == np.float32:
+            return flat if fresh else flat.copy()
+        return flat.astype(np.float32)
+
+    def _native_pass_views(
+        self,
+        views: List[np.ndarray],
+        tier_id: int,
+        lane: int,
+        n: int,
+        rank: int,
+        tag_base: int,
+        rs_sub: int,
+        ag_sub: int,
+        pass_mode: int,
+        op: str,
+        wire_mode: int,
+    ) -> None:
+        """One GIL-free ring pass over contiguous f32 views of the working
+        buffer.  The views' addresses go straight to the engine (zero-copy
+        scatter-gather I/O over them); the GIL is released for the whole
+        pass — this call IS the native hot loop."""
+        engine = self._engine
+        if engine is None:
+            raise RuntimeError("collective aborted")
+        engine.ring_pass(
+            tier_id,
+            lane,
+            n,
+            rank,
+            tag_base,
+            rs_sub,
+            ag_sub,
+            pass_mode,
+            _NATIVE_OP[op],
+            wire_mode,
+            [int(v.ctypes.data) for v in views],
+            [int(v.size) for v in views],
+            self._timeout,
+        )
+
+    def _native_flat_pass(
+        self, buf: np.ndarray, lane: int, tag_base: int, op: str, wire_mode: int
+    ) -> None:
+        """Full flat-ring pass (reduce-scatter + allgather) over ``buf`` in
+        place — the native counterpart of one _ring_rs_ag over
+        np.array_split(buf, world)."""
+        self._native_pass_views(
+            list(np.array_split(buf, self._world_size)),
+            0,
+            lane,
+            self._world_size,
+            self._rank,
+            tag_base,
+            _SUB_RS,
+            _SUB_AG,
+            _NATIVE_PASS_FULL,
+            op,
+            wire_mode,
+        )
+
+    def _native_hier_pass(
+        self, buf: np.ndarray, lane: int, tag_base: int, op: str, wire_mode: int
+    ) -> None:
+        """Hierarchical (ring2d) pass over ``buf`` in place: row
+        reduce-scatter, column full pass over the owned row chunk, row
+        allgather — the same three phases (and the same tag subspaces) as
+        _hier_rs_ag_flat, each phase one GIL-free native call."""
+        row = cast(_TierLinks, self._row_tier)
+        col = cast(_TierLinks, self._col_tier)
+        C, crank = row.size, row.ring_rank
+        chunks = list(np.array_split(buf, C))
+        self._native_pass_views(
+            chunks, 1, lane, C, crank, tag_base, _SUB_RS, _SUB_AG,
+            _NATIVE_PASS_RS, op, wire_mode,
+        )
+        own = (crank + 1) % C
+        if col.size > 1:
+            self._native_pass_views(
+                list(np.array_split(chunks[own], col.size)),
+                2, lane, col.size, col.ring_rank, tag_base,
+                _SUB_COL_RS, _SUB_COL_AG, _NATIVE_PASS_FULL, op, wire_mode,
+            )
+        self._native_pass_views(
+            chunks, 1, lane, C, crank, tag_base, _SUB_RS, _SUB_AG,
+            _NATIVE_PASS_AG, op, wire_mode,
+        )
 
     def _ring_rs_ag(
         self,
@@ -1569,6 +1879,7 @@ class TCPCollective(Collective):
         allow_wire_compression: bool = True,
         seq: Optional[int] = None,
         codec: Optional[str] = None,
+        donate: bool = False,
     ) -> List[np.ndarray]:
         """Single-lane whole-chunk ring allreduce (the lanes=1 path, and the
         building block reduce_scatter/barrier reuse)."""
@@ -1577,10 +1888,15 @@ class TCPCollective(Collective):
         n = self._world_size
         combine = _REDUCE_COMBINE[op]
         flat = self._flatten(arrays)
-        chunks = np.array_split(flat, n)
         wire, acc_dtype = self._wire_for(
             arrays, flat.dtype, allow_wire_compression and codec is None
         )
+        wire_mode = self._native_wire_mode(flat.dtype, wire, acc_dtype, codec)
+        if wire_mode is not None:
+            buf = self._native_buffer(flat, fresh=donate or len(arrays) > 1)
+            self._native_flat_pass(buf, 0, self._tag_base(seq), op, wire_mode)
+            return self._unflatten(buf, arrays, op)
+        chunks = np.array_split(flat, n)
         chunks = self._ring_rs_ag(
             chunks, combine, wire, acc_dtype, lane=0,
             tag_base=self._tag_base(seq), codec=codec,
@@ -1594,6 +1910,7 @@ class TCPCollective(Collective):
         allow_wire_compression: bool = True,
         seq: Optional[int] = None,
         codec: Optional[str] = None,
+        donate: bool = False,
     ) -> List[np.ndarray]:
         """Single-lane hierarchical (ring2d) allreduce — the lanes=1
         counterpart of _ring_allreduce, running one 2D pass over the whole
@@ -1605,6 +1922,11 @@ class TCPCollective(Collective):
         wire, acc_dtype = self._wire_for(
             arrays, flat.dtype, allow_wire_compression and codec is None
         )
+        wire_mode = self._native_wire_mode(flat.dtype, wire, acc_dtype, codec)
+        if wire_mode is not None:
+            buf = self._native_buffer(flat, fresh=donate or len(arrays) > 1)
+            self._native_hier_pass(buf, 0, self._tag_base(seq), op, wire_mode)
+            return self._unflatten(buf, arrays, op)
         out = self._hier_rs_ag_flat(
             flat, combine, wire, acc_dtype, lane=0,
             tag_base=self._tag_base(seq), codec=codec,
@@ -1709,6 +2031,7 @@ class TCPCollective(Collective):
         allow_wire_compression: bool,
         seq: int,
         codec: Optional[str] = None,
+        donate: bool = False,
     ) -> Work:
         """Lanes > 1: stripe the ring chunks round-robin across lanes and run
         each stripe as an independent tagged ring on the per-lane worker
@@ -1723,14 +2046,46 @@ class TCPCollective(Collective):
             wire, acc_dtype = self._wire_for(
                 arrays, flat.dtype, allow_wire_compression and codec is None
             )
+            # Stripe sizing from the ORIGINAL flat chunks (not the native
+            # f32 working copy) so both engines carve identical stripe
+            # boundaries and tag blocks — the cross-engine interop contract.
             nstripes = self._stripe_count(max(c.nbytes for c in chunks))
-            # sub[i][s]: stripe s of rank-chunk i.  array_split depends only
-            # on sizes derived from the (identical) flat length, so every
-            # rank cuts identical stripe boundaries.
-            sub = [np.array_split(c, nstripes) for c in chunks]
+            wire_mode = self._native_wire_mode(flat.dtype, wire, acc_dtype, codec)
+            if wire_mode is not None:
+                buf = self._native_buffer(flat, fresh=donate or len(arrays) > 1)
+                # sub[i][s]: stripe s of rank-chunk i, a view into buf the
+                # engine reduces in place — assembly is just _unflatten.
+                sub = [
+                    np.array_split(c, nstripes)
+                    for c in np.array_split(buf, n)
+                ]
+            else:
+                sub = [np.array_split(c, nstripes) for c in chunks]
         except Exception as e:  # noqa: BLE001
             self._latch(e)
             return Work(failed_future(e))
+
+        if wire_mode is not None:
+
+            def stripe_body(s: int) -> None:
+                self._native_pass_views(
+                    [sub[i][s] for i in range(n)],
+                    0,
+                    s % self._lanes,
+                    n,
+                    self._rank,
+                    self._tag_base(seq, s),
+                    _SUB_RS,
+                    _SUB_AG,
+                    _NATIVE_PASS_FULL,
+                    op,
+                    wire_mode,
+                )
+
+            def assemble(results: List[Optional[object]]) -> List[np.ndarray]:
+                return self._unflatten(buf, arrays, op)
+
+            return self._run_striped(nstripes, stripe_body, assemble)
 
         def stripe_body(s: int) -> List[np.ndarray]:
             return self._ring_rs_ag(
@@ -1763,6 +2118,7 @@ class TCPCollective(Collective):
         allow_wire_compression: bool,
         seq: int,
         codec: Optional[str] = None,
+        donate: bool = False,
     ) -> Work:
         """Lanes > 1 under the 2D topology: split the flat payload into
         stripes directly (stripe-major — each stripe runs the COMPLETE
@@ -1779,12 +2135,31 @@ class TCPCollective(Collective):
             row_cols = cast(_TierLinks, self._row_tier).size
             # Size stripes so each stripe's ROW chunk (its per-hop exchange
             # unit) lands near chunk_bytes, mirroring the flat path's
-            # per-rank-chunk sizing.
+            # per-rank-chunk sizing.  Sized from the ORIGINAL flat payload
+            # so both engines carve identical stripes (interop contract).
             nstripes = self._stripe_count(-(-flat.nbytes // max(1, row_cols)))
-            stripes = np.array_split(flat, nstripes)
+            wire_mode = self._native_wire_mode(flat.dtype, wire, acc_dtype, codec)
+            if wire_mode is not None:
+                buf = self._native_buffer(flat, fresh=donate or len(arrays) > 1)
+                stripes = np.array_split(buf, nstripes)
+            else:
+                stripes = np.array_split(flat, nstripes)
         except Exception as e:  # noqa: BLE001
             self._latch(e)
             return Work(failed_future(e))
+
+        if wire_mode is not None:
+
+            def stripe_body(s: int) -> None:
+                self._native_hier_pass(
+                    stripes[s], s % self._lanes, self._tag_base(seq, s), op,
+                    wire_mode,
+                )
+
+            def assemble(results: List[Optional[object]]) -> List[np.ndarray]:
+                return self._unflatten(buf, arrays, op)
+
+            return self._run_striped(nstripes, stripe_body, assemble)
 
         def stripe_body(s: int) -> np.ndarray:
             return self._hier_rs_ag_flat(
@@ -1820,8 +2195,13 @@ class TCPCollective(Collective):
             for tier in (self._row_tier, self._col_tier):
                 if tier is not None:
                     peers += tier.peers()
+            engine = self._engine
         for p in peers:
             p.close()
+        # The native engine's dup'd lane fds die with the generation too
+        # (the fd-sweep contract); counters stay readable, ops fail fast.
+        if engine is not None:
+            engine.close()
 
     def allgather(self, array: np.ndarray) -> Work:
         array = np.ascontiguousarray(array)

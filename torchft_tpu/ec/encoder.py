@@ -48,6 +48,7 @@ __all__ = [
     "encode_stream",
     "read_shard",
     "write_shard",
+    "write_shard_part",
 ]
 
 
@@ -326,6 +327,25 @@ def write_shard(shard: Shard) -> bytes:
     header = pickle.dumps(shard.header())
     return b"".join(
         [len(header).to_bytes(8, "little"), header, shard.payload.tobytes()]
+    )
+
+
+def write_shard_part(shard: Shard, part: int, n: int) -> bytes:
+    """Header + one payload byte range — the ``?part=<i>&n=<N>`` response
+    body of ``/ec/shard/<step>/<idx>``.  Boundaries are ``i * L // N`` over
+    the PAYLOAD (header lengths vary with pickled int widths, so frame
+    offsets would not align across shard indices — payload offsets do,
+    which is what lets the subset-rotation fetch decode each range with a
+    different k-subset of shards).  Every part carries the full
+    self-describing header (tiny next to the payload) so any part alone
+    identifies generation and geometry; there is no per-part CRC —
+    reassemblies verify the whole-payload CRC (single-shard range fetch)
+    or the decoded stream's per-buffer CRCs (subset-rotation fetch)."""
+    header = pickle.dumps(shard.header())
+    pl = as_u8(shard.payload)
+    lo, hi = part * len(pl) // n, (part + 1) * len(pl) // n
+    return b"".join(
+        [len(header).to_bytes(8, "little"), header, pl[lo:hi].tobytes()]
     )
 
 

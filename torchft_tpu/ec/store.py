@@ -212,6 +212,153 @@ def fetch_shard(base_url: str, step: int, idx: int, timeout: float) -> Shard:
         return read_shard(resp.read())
 
 
+# Range-striped shard fetch parallelism: parts per shard (0 = auto-size at
+# ~one part per MB of shard frame, capped).  The same receiver-chooses
+# contract as the checkpoint path's chunk striping.
+TPUFT_EC_FETCH_PARTS_ENV = "TPUFT_EC_FETCH_PARTS"
+_MAX_FETCH_PARTS = 8
+
+# Subset-rotation striping (decode each payload range from its own
+# k-subset so every reachable holder's link serves, parity included).
+# Opt-in: the (k+m)/k fan-out wins only when holder LINKS bind; on a
+# CPU-bound host the per-range GF math for parity rows costs more than the
+# idle links were worth (measured ~25% slower on the 1-core bench host),
+# so operators enable it where reconstruction is genuinely link-bound.
+TPUFT_EC_SUBSET_STRIPE_ENV = "TPUFT_EC_SUBSET_STRIPE"
+
+
+def _subset_stripe_enabled() -> bool:
+    return os.environ.get(TPUFT_EC_SUBSET_STRIPE_ENV, "0") in ("1", "true", "on")
+
+
+def _fetch_parts_for(est_bytes: int) -> int:
+    raw = os.environ.get(TPUFT_EC_FETCH_PARTS_ENV, "0")
+    try:
+        parts = int(raw)
+    except ValueError:
+        parts = 0
+    if parts > 0:
+        return min(parts, _MAX_FETCH_PARTS)
+    return max(1, min(_MAX_FETCH_PARTS, est_bytes // (1 << 20)))
+
+
+def fetch_shard_part(
+    base_url: str, step: int, idx: int, part: int, n: int, timeout: float
+) -> Shard:
+    """Fetches header + payload range ``part`` of ``n`` (NOT CRC-verified:
+    the payload is a fragment; assemblies verify — see write_shard_part)."""
+    with _urlopen(
+        f"{base_url}/ec/shard/{step}/{idx}?part={part}&n={n}", timeout
+    ) as resp:
+        return read_shard(resp.read(), verify_crc=False)
+
+
+def fetch_shard_striped(
+    urls: Sequence[str],
+    step: int,
+    idx: int,
+    timeout: float,
+    est_bytes: int = 0,
+) -> Shard:
+    """Fetches ONE shard as disjoint payload byte ranges pulled in
+    parallel — ``?part=<i>&n=<N>`` splits round-robin across every holder
+    advertising this (idx, digest), or as N parallel connections to a
+    single holder (the regime where the striped donor fetch already
+    measured its win on this class of host).  Reassembly is in-order
+    payload concatenation; the whole-payload CRC then verifies the
+    assembly, so a holder serving divergent or misaligned bytes fails the
+    fetch exactly like a torn stream (IOError)."""
+    if not urls:
+        raise IOError(f"ec shard {idx} (step {step}): no holders to fetch from")
+    n = _fetch_parts_for(est_bytes)
+    if n <= 1:
+        return fetch_shard(urls[0], step, idx, timeout)
+
+    def pull_part(p: int) -> Shard:
+        return fetch_shard_part(urls[p % len(urls)], step, idx, p, n, timeout)
+
+    with ThreadPoolExecutor(max_workers=n) as pool:
+        parts = list(pool.map(pull_part, range(n)))
+    first = parts[0]
+    for p in parts[1:]:
+        if (p.digest, p.k, p.m, p.total_len) != (
+            first.digest, first.k, first.m, first.total_len,
+        ):
+            raise IOError(
+                f"ec shard {idx} (step {step}): holders disagree on "
+                "generation/geometry across range parts"
+            )
+    whole = Shard(
+        payload=np.concatenate([np.asarray(p.payload, dtype=np.uint8) for p in parts]),
+        **first.header(),
+    )
+    from torchft_tpu.checkpointing.integrity import verify
+
+    verify(
+        memoryview(whole.payload), whole.crc, whole.algo,
+        f"ec shard {idx} (step {step}, striped reassembly)",
+    )
+    return whole
+
+
+def _reconstruct_subset_striped(
+    usable: Dict[int, List[str]],
+    k: int,
+    m: int,
+    step: int,
+    deadline: float,
+    stats: dict,
+):
+    """Subset-rotation striped reconstruction: with ``h > k`` distinct
+    reachable shard indices, the payload splits into ``h`` byte ranges and
+    each range decodes from its OWN k-subset (Reed-Solomon is positionwise,
+    so per-range decodes concatenate into the whole-stream decode).  The
+    rotation excludes each index from exactly ``h - k`` ranges, so every
+    holder link serves ``k/h`` of a shard instead of one idle-parity setup
+    serving nothing — in the link-bound regime that is the (k+m)/k fan-out
+    the striped donor fetch gets from extra donors, applied to the shard
+    plane.  Integrity: no whole-shard CRC can apply to ranges; the decoded
+    stream's per-buffer CRCs (read_state_dict) verify end to end instead.
+    Raises on any failure — the caller falls back to whole-shard pulls."""
+    from torchft_tpu.checkpointing.serialization import read_state_dict
+    from torchft_tpu.ec.encoder import _SliceStream, decode_data_slices
+
+    idxs = sorted(usable)
+    h = len(idxs)
+    grid = [
+        (r, idx)
+        for r in range(h)
+        for j, idx in enumerate(idxs)
+        # Range r excludes the h - k indices rotating from position r.
+        if not any((r + t) % h == j for t in range(h - k))
+    ]
+
+    def pull_part(job):
+        r, idx = job
+        url = usable[idx][r % len(usable[idx])]
+        return fetch_shard_part(
+            url, step, idx, r, h, max(1.0, deadline - time.monotonic())
+        )
+
+    with ThreadPoolExecutor(max_workers=min(16, len(grid))) as pool:
+        parts = list(pool.map(pull_part, grid))
+    total_len = parts[0].total_len
+    digest = parts[0].digest
+    by_range: Dict[int, Dict[int, np.ndarray]] = {}
+    for (r, idx), p in zip(grid, parts):
+        if (p.digest, p.k, p.m, p.total_len) != (digest, k, m, total_len):
+            raise IOError(
+                f"ec shard {idx} range {r}: generation/geometry mismatch"
+            )
+        by_range.setdefault(r, {})[idx] = np.asarray(p.payload, dtype=np.uint8)
+    per_range = [decode_data_slices(by_range[r], k, m) for r in range(h)]
+    slices = [
+        np.concatenate([per_range[r][j] for r in range(h)]) for j in range(k)
+    ]
+    stats["subset_striped"] = {"ranges": h, "indices": idxs[: h]}
+    return read_state_dict(_SliceStream(slices, total_len))
+
+
 def fetch_inventory(base_url: str, step: int, timeout: float) -> dict:
     with _urlopen(f"{base_url}/ec/have/{step}", timeout) as resp:
         return json.loads(resp.read().decode())
@@ -289,10 +436,55 @@ def reconstruct(
         }
         usable = {idx: urls for idx, urls in usable.items() if urls}
         if geo and len(usable) >= k:
+            # Shard frame size estimate for the range-striping auto-sizer:
+            # total_len / k data bytes plus a small header.
+            est_shard_bytes = geo[2] // max(1, k)
+            # Subset-rotation striping (opt-in, link-bound deployments):
+            # more reachable indices than k means idle holder links under
+            # whole-shard pulls; per-range k-subset decode spreads the SAME
+            # k shards' worth of bytes over all of them.  Any failure falls
+            # back to the whole-shard path below.
+            if (
+                len(usable) > k
+                and _subset_stripe_enabled()
+                and _fetch_parts_for(est_shard_bytes) > 1
+            ):
+                try:
+                    meta, buffers = _reconstruct_subset_striped(
+                        usable, k, geo[1], step, deadline, stats
+                    )
+                    idxs = sorted(usable)
+                    stats["shards_used"] = idxs
+                    stats["parity_used"] = sum(1 for i in idxs if i >= k)
+                    return meta, buffers, stats
+                except Exception as e:  # noqa: BLE001 — degrade, don't fail
+                    stats["fetch_errors"] += 1
+                    stats.pop("subset_striped", None)
+                    last_err = e
+
             chosen = sorted(usable)[:k]  # lowest-first: data shards decode by concat
 
             def pull(idx: int):
                 errs: List[Exception] = []
+                # Range-striped first (disjoint byte ranges in parallel,
+                # round-robin over every same-digest holder of this idx —
+                # the striped-donor fetch's parallelism applied to the
+                # shard plane).  Any failure — including a pre-range
+                # holder serving full frames for part requests, which the
+                # reassembly CRC catches — falls back to the whole-shard
+                # per-holder loop below.
+                if _fetch_parts_for(est_shard_bytes) > 1:
+                    try:
+                        got = fetch_shard_striped(
+                            usable[idx], step, idx,
+                            max(1.0, deadline - time.monotonic()),
+                            est_bytes=est_shard_bytes,
+                        )
+                        stats["striped_fetches"] = stats.get("striped_fetches", 0) + 1
+                        return got
+                    except Exception as e:  # noqa: BLE001 — degrade, don't fail
+                        stats["fetch_errors"] += 1
+                        errs.append(e)
                 for url in usable[idx]:
                     try:
                         return fetch_shard(url, step, idx, max(1.0, deadline - time.monotonic()))

@@ -1123,12 +1123,15 @@ class Manager:
         if srv is None:
             return
         try:
-            ec_held, ec_step = -1, -1
+            ec_held, ec_step, ec_k = -1, -1, -1
             if self._ec is not None:
                 step, count = self._ec.coverage()
                 # (-1, 0) while empty -> an authoritative zero report so a
                 # pruned/fresh store never shows stale coverage.
                 ec_held, ec_step = count, max(0, step)
+                # k rides along so the lighthouse coverage sentinel can
+                # page at coverage < k + 1 without its own EC config.
+                ec_k = self._ec.config.k
             srv.set_status(
                 self._step,
                 state,
@@ -1137,6 +1140,7 @@ class Manager:
                 self._ar_gbps,
                 ec_held,
                 ec_step,
+                ec_k,
             )
         except Exception:  # noqa: BLE001
             pass
