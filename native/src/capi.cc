@@ -103,6 +103,12 @@ void tf_lighthouse_snapshot(void* p, uint8_t** buf, size_t* len) {
 
 // Flight-recorder snapshot (newest-first JSON document; limit 0 = all
 // retained events).  Same payload as GET /debug/flight.json.
+// Slow-link sentinel introspection (in-process tests; the wire surfaces
+// are /metrics and /alerts.json).
+int tf_lighthouse_link_state(void* p, const char* replica_id) {
+  return static_cast<Lighthouse*>(p)->LinkState(replica_id ? replica_id : "");
+}
+
 char* tf_lighthouse_flight_json(void* p, uint64_t limit) {
   return CopyString(static_cast<Lighthouse*>(p)->FlightJson(limit));
 }
@@ -141,11 +147,13 @@ char* tf_manager_address(void* p) { return CopyString(static_cast<ManagerServer*
 void tf_manager_set_status(void* p, int64_t step, const char* state,
                            double step_time_ms_ewma, double step_time_ms_last,
                            double allreduce_gb_per_s, int64_t ec_shards_held,
-                           int64_t ec_shard_step, int64_t ec_k) {
-  static_cast<ManagerServer*>(p)->SetStatus(step, state ? state : "",
-                                            step_time_ms_ewma, step_time_ms_last,
-                                            allreduce_gb_per_s, ec_shards_held,
-                                            ec_shard_step, ec_k);
+                           int64_t ec_shard_step, int64_t ec_k,
+                           double link_recv_gbps, double link_send_gbps,
+                           double link_hop_rtt_ms) {
+  static_cast<ManagerServer*>(p)->SetStatus(
+      step, state ? state : "", step_time_ms_ewma, step_time_ms_last,
+      allreduce_gb_per_s, ec_shards_held, ec_shard_step, ec_k, link_recv_gbps,
+      link_send_gbps, link_hop_rtt_ms);
 }
 
 // Manager-side flight recorder (no HTTP server on managers — this is the
@@ -284,6 +292,32 @@ void tf_ring_shaper_counters(void* p, int32_t tier, int32_t direction,
 
 uint64_t tf_ring_link_bytes(void* p, int32_t tier, int32_t direction, int32_t lane) {
   return static_cast<RingEngine*>(p)->LinkBytes(tier, direction, lane);
+}
+
+// -- data-plane flight recorder (hop telemetry) -----------------------------
+// These symbols double as the Python side's capability probe for the hop
+// API: a libtpuft.so missing tf_ring_hop_stats predates the recorder and
+// the bindings degrade to Python-side-only hop telemetry.
+
+void tf_ring_set_hop(void* p, int32_t sample, int32_t cap) {
+  static_cast<RingEngine*>(p)->SetHopRecorder(sample, cap);
+}
+
+int tf_ring_hop_stats(void* p, int32_t tier, double* out4) {
+  return static_cast<RingEngine*>(p)->HopStats(tier, out4);
+}
+
+int tf_ring_hop_records(void* p, double* out, int32_t cap_records) {
+  return static_cast<RingEngine*>(p)->HopRecords(out, cap_records);
+}
+
+double tf_ring_shaper_wait_s(void* p, int32_t tier, int32_t direction) {
+  return static_cast<RingEngine*>(p)->ShaperWaitS(tier, direction);
+}
+
+void tf_ring_set_shaper(void* p, int32_t tier, int32_t direction, double mbps,
+                        double rtt_ms) {
+  static_cast<RingEngine*>(p)->SetShaper(tier, direction, mbps, rtt_ms);
 }
 
 }  // extern "C"

@@ -258,6 +258,18 @@ std::string Lighthouse::SnapshotState() {
       r->set_straggler_observations(h->second.observations);
       r->set_straggler_ratio(h->second.ratio);
     }
+    auto lh = link_health_.find(id);
+    if (lh != link_health_.end()) {
+      r->set_link_recv_gbps(lh->second.recv_gbps);
+      r->set_link_send_gbps(lh->second.send_gbps);
+      r->set_link_hop_rtt_ms(lh->second.rtt_ms);
+      r->set_link_state(lh->second.state);
+      r->set_link_over(lh->second.over);
+      r->set_link_under(lh->second.under);
+      r->set_link_ratio(lh->second.ratio);
+      r->set_link_last_step(lh->second.last_step);
+      r->set_link_observations(lh->second.observations);
+    }
     if (state_.draining.count(id)) {
       r->set_draining(true);
       auto dl = drain_deadline_ms_.find(id);
@@ -276,6 +288,8 @@ std::string Lighthouse::SnapshotState() {
     out->set_auto_drained(a.auto_drained);
     out->set_coverage(a.coverage);
     out->set_threshold(a.threshold);
+    out->set_gbps(a.gbps);
+    out->set_src_replica_id(a.src_replica_id);
   }
   req.set_alert_seq(alert_seq_);
   std::string out;
@@ -324,6 +338,7 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
   allreduce_gbps_.clear();
   ec_shards_.clear();
   health_.clear();
+  link_health_.clear();
   auto now = Clock::now();
   for (const auto& r : req.replicas()) {
     const std::string& id = r.replica_id();
@@ -350,6 +365,21 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
       h.last_step = r.straggler_last_step();
       h.observations = r.straggler_observations();
     }
+    if (r.link_send_gbps() > 0.0 || r.link_state() != 0) {
+      // Full hysteresis position, like the straggler fields above: a
+      // failover must not restart the warmup gate (observations) or the
+      // per-step cursor, and the ratio gauge must not blank out.
+      LinkHealth& lh = link_health_[id];
+      lh.recv_gbps = r.link_recv_gbps();
+      lh.send_gbps = r.link_send_gbps();
+      lh.rtt_ms = r.link_hop_rtt_ms();
+      lh.state = static_cast<int>(r.link_state());
+      lh.over = r.link_over();
+      lh.under = r.link_under();
+      lh.ratio = r.link_ratio();
+      lh.last_step = r.link_last_step();
+      lh.observations = r.link_observations();
+    }
     if (r.draining()) {
       state_.draining[id] = now;
       if (r.drain_deadline_ms() > 0) drain_deadline_ms_[id] = r.drain_deadline_ms();
@@ -372,6 +402,8 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
     rec.auto_drained = a.auto_drained();
     rec.coverage = a.coverage();
     rec.threshold = a.threshold();
+    rec.gbps = a.gbps();
+    rec.src_replica_id = a.src_replica_id();
     alerts_.push_back(std::move(rec));
   }
   if (req.alert_seq() > alert_seq_) alert_seq_ = req.alert_seq();
@@ -418,6 +450,23 @@ bool Lighthouse::Start(std::string* err) {
   if (const char* w = std::getenv("TPUFT_STRAGGLER_WARMUP_STEPS")) {
     long long v = std::atoll(w);
     if (v >= 0) straggler_warmup_ = v;
+  }
+  // Slow-link sentinel knobs (same malformed-value discipline).
+  if (const char* r = std::getenv("TPUFT_LINK_RATIO")) {
+    char* end = nullptr;
+    double v = std::strtod(r, &end);
+    if (end != r && v > 1.0) link_ratio_ = v;
+  }
+  if (const char* g = std::getenv("TPUFT_LINK_GRACE_STEPS")) {
+    long long v = std::atoll(g);
+    if (v >= 1) link_grace_ = v;
+  }
+  if (const char* a = std::getenv("TPUFT_LINK_AUTO_DRAIN")) {
+    link_auto_drain_ = std::string(a) == "1";
+  }
+  if (const char* w = std::getenv("TPUFT_LINK_WARMUP_STEPS")) {
+    long long v = std::atoll(w);
+    if (v >= 0) link_warmup_ = v;
   }
   server_ = std::make_unique<RpcServer>(
       opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl,
@@ -765,6 +814,21 @@ Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
       ObserveStepTimeLocked(req.replica_id());
     }
   }
+  // Slow-link sentinel: same step-cursor discipline as the straggler
+  // sentinel above — telemetry refreshes on every heartbeat, the
+  // hysteresis machine observes once per committed step.  The scored
+  // signal is the OUTBOUND goodput (send_gbps): only the degraded edge's
+  // sender localizes a link fault (wire.md "Slow-link sentinel").
+  if (req.link_send_gbps() > 0.0) {
+    LinkHealth& lh = link_health_[req.replica_id()];
+    lh.recv_gbps = req.link_recv_gbps();
+    lh.send_gbps = req.link_send_gbps();
+    lh.rtt_ms = req.link_hop_rtt_ms();
+    if (req.step() > lh.last_step) {
+      lh.last_step = req.step();
+      ObserveLinkLocked(req.replica_id());
+    }
+  }
   return Status::kOk;
 }
 
@@ -852,7 +916,7 @@ void Lighthouse::ObserveStepTimeLocked(const std::string& id) {
       // have recovered since (a new replica joined), and "auto-drain,
       // never below the floor" must mean whenever capacity allows, not
       // only at the instant of the first alert.
-      if (MaybeAutoDrainLocked(id, /*log_skip=*/false)) {
+      if (MaybeAutoDrainLocked(id, /*log_skip=*/false, straggler_auto_drain_)) {
         for (auto& a : alerts_) {
           if (a.replica_id == id && a.resolved_ms == 0) a.auto_drained = true;
         }
@@ -892,8 +956,163 @@ void Lighthouse::RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth*
        "%.2fx cluster median over %lld steps) — alert %lld raised",
        id.c_str(), h->ewma_ms, h->ratio,
        static_cast<long long>(straggler_grace_), static_cast<long long>(a.id));
-  a.auto_drained = MaybeAutoDrainLocked(id, /*log_skip=*/true);
+  a.auto_drained = MaybeAutoDrainLocked(id, /*log_skip=*/true, straggler_auto_drain_);
   PushAlertLocked(std::move(a));
+}
+
+double Lighthouse::ClusterMedianLinkGbpsLocked() const {
+  // UPPER median of the eligible reporting replicas — the mirror image of
+  // the straggler sentinel's lower median: goodput degrades DOWNWARD, so
+  // with 2 replicas [slow, fast] the lower median would be the slow one's
+  // own reading and its ratio would read 1.0, hiding exactly the edge the
+  // sentinel exists to catch.  The dual failure mode (a majority of
+  // degraded links reads as "the fast edge is the outlier") is inherent
+  // to relative scoring, like the straggler case.
+  auto now = Clock::now();
+  auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
+  std::vector<double> gbps;
+  for (const auto& [id, lh] : link_health_) {
+    if (lh.send_gbps <= 0.0) continue;
+    if (state_.draining.count(id)) continue;
+    auto hb = state_.heartbeats.find(id);
+    if (hb == state_.heartbeats.end() || now - hb->second >= hb_timeout) continue;
+    gbps.push_back(lh.send_gbps);
+  }
+  if (gbps.size() < 2) return 0.0;  // nothing to be relative to
+  std::sort(gbps.begin(), gbps.end());
+  return gbps[gbps.size() / 2];
+}
+
+std::string Lighthouse::RingSuccessorLocked(const std::string& id) const {
+  // The cross-group ring orders participants by sorted replica id (the
+  // quorum sort TCPCollective configures against), so the receiving
+  // endpoint of `id`'s outbound edge is its successor in the last formed
+  // quorum's participant list.
+  if (!state_.prev_quorum) return "";
+  const auto& parts = state_.prev_quorum->participants();
+  int n = parts.size();
+  for (int i = 0; i < n; ++i) {
+    if (parts[i].replica_id() == id) {
+      return n > 1 ? parts[(i + 1) % n].replica_id() : "";
+    }
+  }
+  return "";
+}
+
+void Lighthouse::ObserveLinkLocked(const std::string& id) {
+  LinkHealth& h = link_health_[id];
+  const int prev_state = h.state;
+  h.observations += 1;
+  double med = ClusterMedianLinkGbpsLocked();
+  h.ratio = (med > 0.0 && h.send_gbps > 0.0) ? med / h.send_gbps : 0.0;
+  auto record = [&]() {
+    if (prev_state == h.state) return;
+    char rbuf[32];
+    snprintf(rbuf, sizeof(rbuf), "%.3f", h.ratio);
+    flight_.RecordEvent(kFlightSentinelTransition,
+                        "sentinel=link replica=" + id + " from=" +
+                            std::to_string(prev_state) + " to=" +
+                            std::to_string(h.state) + " ratio=" + rbuf);
+  };
+  if (med <= 0.0) {
+    // Unscorable (fewer than two eligible reporters): count toward
+    // recovery exactly like the straggler sentinel, so a flagged edge
+    // whose last peer died cannot page forever.
+    if (h.state != 0) {
+      h.over = 0;
+      h.under += 1;
+      if (h.state == 1) {
+        h.state = 0;
+        h.under = 0;
+      } else if (h.state == 2 && h.under >= link_grace_) {
+        h.state = 0;
+        h.under = 0;
+        ResolveLinkAlertsLocked(id);
+      }
+    }
+    record();
+    return;
+  }
+  if (h.ratio >= link_ratio_) {
+    h.under = 0;
+    h.over += 1;
+    if (h.state == 0) {
+      h.state = 1;
+      LOGW("lighthouse: replica %s outbound link suspect (%.3f GB/s, "
+           "%.2fx below cluster median)", id.c_str(), h.send_gbps, h.ratio);
+    } else if (h.state == 1 && h.over >= link_grace_ &&
+               h.observations > link_warmup_) {
+      // Warmup mirrors the straggler gate: first steps mix rendezvous,
+      // JIT warmup, and cold kernel socket buffers into the goodput
+      // estimate asymmetrically across replicas.
+      h.state = 2;
+      RaiseLinkAlertLocked(id, &h);
+    } else if (h.state == 2) {
+      // Still confirmed degraded: re-attempt a rotation skipped at the
+      // min_replicas floor (capacity may have recovered since).
+      std::string dst = RingSuccessorLocked(id);
+      if (!dst.empty() &&
+          MaybeAutoDrainLocked(dst, /*log_skip=*/false, link_auto_drain_)) {
+        for (auto& a : alerts_) {
+          if (a.kind == "slow_link" && a.src_replica_id == id &&
+              a.resolved_ms == 0) {
+            a.auto_drained = true;
+          }
+        }
+      }
+    }
+  } else {
+    h.over = 0;
+    h.under += 1;
+    if (h.state == 1) {
+      h.state = 0;
+      h.under = 0;
+    } else if (h.state == 2 && h.under >= link_grace_) {
+      h.state = 0;
+      h.under = 0;
+      LOGI("lighthouse: replica %s outbound link recovered (%.3f GB/s, "
+           "%.2fx median)", id.c_str(), h.send_gbps, h.ratio);
+      ResolveLinkAlertsLocked(id);
+    }
+  }
+  record();
+}
+
+void Lighthouse::RaiseLinkAlertLocked(const std::string& id, LinkHealth* h) {
+  for (const auto& a : alerts_) {
+    if (a.kind == "slow_link" && a.src_replica_id == id && a.resolved_ms == 0) {
+      return;  // already active
+    }
+  }
+  AlertRecord a;
+  a.id = ++alert_seq_;
+  a.kind = "slow_link";
+  // The alert names the degraded EDGE by its receiving endpoint — the
+  // node whose inbound path degraded and the auto-drain target; the
+  // reporting sender rides in src_replica_id.  With no known quorum
+  // order the alert falls back to naming the reporter itself.
+  std::string dst = RingSuccessorLocked(id);
+  a.replica_id = dst.empty() ? id : dst;
+  a.src_replica_id = id;
+  a.raised_ms = NowEpochMs();
+  a.ratio = h->ratio;
+  a.gbps = h->send_gbps;
+  LOGW("lighthouse: link %s -> %s is persistently degraded (%.3f GB/s "
+       "outbound, %.2fx below cluster median over %lld steps) — alert %lld "
+       "raised", id.c_str(), a.replica_id.c_str(), h->send_gbps, h->ratio,
+       static_cast<long long>(link_grace_), static_cast<long long>(a.id));
+  a.auto_drained =
+      MaybeAutoDrainLocked(a.replica_id, /*log_skip=*/true, link_auto_drain_);
+  PushAlertLocked(std::move(a));
+}
+
+void Lighthouse::ResolveLinkAlertsLocked(const std::string& src_id) {
+  for (auto& a : alerts_) {
+    if (a.kind == "slow_link" && a.src_replica_id == src_id &&
+        a.resolved_ms == 0) {
+      a.resolved_ms = NowEpochMs();
+    }
+  }
 }
 
 void Lighthouse::PushAlertLocked(AlertRecord a) {
@@ -993,14 +1212,15 @@ void Lighthouse::ResolveAlertsLocked(const std::string& id) {
   }
 }
 
-bool Lighthouse::MaybeAutoDrainLocked(const std::string& id, bool log_skip) {
+bool Lighthouse::MaybeAutoDrainLocked(const std::string& id, bool log_skip,
+                                      bool enabled) {
   // Rotate the slow host out through the cooperative-drain path, but only
   // while the remaining healthy set still satisfies the quorum floor —
   // the sentinel must never drain the cluster below min_replicas.  The
   // supervisor completes the handoff (Launcher polls /alerts.json and
   // pre-warms the replacement); the mark alone already removes the
   // straggler from the NEXT quorum so survivors stop pacing on it.
-  if (!straggler_auto_drain_) return false;
+  if (!enabled) return false;
   if (state_.draining.count(id)) return true;  // already rotating
   auto now = Clock::now();
   auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
@@ -1384,6 +1604,16 @@ void Lighthouse::SweepLocked(TimePoint tick_now,
       ++it;
     }
   }
+  // Slow-link health follows the graveyard: a pruned REPORTER can never
+  // post the recovery observations that would resolve its edge's alert.
+  for (auto it = link_health_.begin(); it != link_health_.end();) {
+    if (state_.heartbeats.find(it->first) == state_.heartbeats.end()) {
+      ResolveLinkAlertsLocked(it->first);
+      it = link_health_.erase(it);
+    } else {
+      ++it;
+    }
+  }
   // Coverage sentinel: the sweep is what notices holders DYING (their
   // freshness lapses without any heartbeat to trigger the check).
   CheckEcCoverageLocked();
@@ -1607,6 +1837,7 @@ std::string Lighthouse::MetricsText() {
     int64_t healthy = 0, pending = 0, draining = 0, tombstoned = 0;
     int64_t healing = 0, donor_pool = 0, max_step = 0;
     int64_t stragglers = 0, alerts_active = 0;
+    int64_t links_degraded = 0;
     std::vector<std::pair<std::string, int64_t>> steps;
     std::vector<std::pair<std::string, double>> hb_age_s;
     std::vector<std::pair<std::string, double>> commit_age_s;
@@ -1616,6 +1847,11 @@ std::string Lighthouse::MetricsText() {
     std::vector<std::pair<std::string, int64_t>> sentinel_state;
     std::vector<std::pair<std::string, int64_t>> ec_held;
     int64_t ec_step = 0, ec_coverage = 0;
+    std::vector<std::pair<std::string, double>> link_recv_gbps;
+    std::vector<std::pair<std::string, double>> link_send_gbps;
+    std::vector<std::pair<std::string, double>> link_rtt_ms;
+    std::vector<std::pair<std::string, double>> link_ratio;
+    std::vector<std::pair<std::string, int64_t>> link_state;
   } s;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -1703,6 +1939,16 @@ std::string Lighthouse::MetricsText() {
     }
     for (const auto& a : alerts_) {
       if (a.resolved_ms == 0) ++s.alerts_active;
+    }
+    // Slow-link sentinel (docs/wire.md "Slow-link sentinel").
+    s.link_recv_gbps.reserve(link_health_.size());
+    for (const auto& [id, lh] : link_health_) {
+      if (lh.state == 2) ++s.links_degraded;
+      s.link_recv_gbps.emplace_back(id, lh.recv_gbps);
+      s.link_send_gbps.emplace_back(id, lh.send_gbps);
+      s.link_rtt_ms.emplace_back(id, lh.rtt_ms);
+      s.link_state.emplace_back(id, lh.state);
+      if (lh.ratio > 0.0) s.link_ratio.emplace_back(id, lh.ratio);
     }
   }
 
@@ -1802,6 +2048,46 @@ std::string Lighthouse::MetricsText() {
   }
   gauge("tpuft_stragglers", "replicas currently in the straggler state");
   o << "tpuft_stragglers " << s.stragglers << "\n";
+
+  // Slow-link sentinel (docs/wire.md "Slow-link sentinel"): per-replica
+  // link health from heartbeat fields 11-13.  The replica label names the
+  // REPORTER; its send gauge describes the outbound edge to its ring
+  // successor, its recv gauge the inbound edge from its predecessor.
+  gauge("tpuft_link_recv_gbps",
+        "inbound ring-edge goodput EWMA per replica (payload GB/s per "
+        "second of recv-wait, from heartbeats)");
+  for (const auto& [id, v] : s.link_recv_gbps) {
+    o << "tpuft_link_recv_gbps{replica=\"" << PromEscape(id) << "\"} " << v
+      << "\n";
+  }
+  gauge("tpuft_link_send_gbps",
+        "outbound ring-edge goodput EWMA per replica (payload GB/s per "
+        "second of send-blocked time — the slow-link sentinel's signal)");
+  for (const auto& [id, v] : s.link_send_gbps) {
+    o << "tpuft_link_send_gbps{replica=\"" << PromEscape(id) << "\"} " << v
+      << "\n";
+  }
+  gauge("tpuft_link_hop_rtt_ms", "mean per-hop recv-wait per replica, ms");
+  for (const auto& [id, v] : s.link_rtt_ms) {
+    o << "tpuft_link_hop_rtt_ms{replica=\"" << PromEscape(id) << "\"} " << v
+      << "\n";
+  }
+  gauge("tpuft_link_slowness_ratio",
+        "cluster median outbound goodput over the replica's (1.0 = on "
+        "pace, >= TPUFT_LINK_RATIO = degraded candidate)");
+  for (const auto& [id, v] : s.link_ratio) {
+    o << "tpuft_link_slowness_ratio{replica=\"" << PromEscape(id) << "\"} "
+      << v << "\n";
+  }
+  gauge("tpuft_link_state",
+        "slow-link sentinel state per replica's outbound edge: 0 healthy, "
+        "1 suspect, 2 degraded");
+  for (const auto& [id, v] : s.link_state) {
+    o << "tpuft_link_state{replica=\"" << PromEscape(id) << "\"} " << v
+      << "\n";
+  }
+  gauge("tpuft_links_degraded", "replica outbound edges currently degraded");
+  o << "tpuft_links_degraded " << s.links_degraded << "\n";
   gauge("tpuft_alerts_active", "unresolved sentinel alerts (see /alerts.json)");
   o << "tpuft_alerts_active " << s.alerts_active << "\n";
 
@@ -1839,6 +2125,12 @@ int Lighthouse::StragglerState(const std::string& replica_id) {
   return it == health_.end() ? 0 : it->second.state;
 }
 
+int Lighthouse::LinkState(const std::string& replica_id) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = link_health_.find(replica_id);
+  return it == link_health_.end() ? 0 : it->second.state;
+}
+
 std::string Lighthouse::AlertsJson() {
   std::lock_guard<std::mutex> lk(mu_);
   std::ostringstream o;
@@ -1860,7 +2152,9 @@ std::string Lighthouse::AlertsJson() {
       << ",\"auto_drained\":" << (a.auto_drained ? "true" : "false")
       << ",\"coverage\":" << a.coverage
       << ",\"threshold\":" << a.threshold
-      << ",\"active\":" << (a.resolved_ms == 0 ? "true" : "false") << "}";
+      << ",\"gbps\":" << a.gbps
+      << ",\"src_replica_id\":\"" << JsonEscape(a.src_replica_id)
+      << "\",\"active\":" << (a.resolved_ms == 0 ? "true" : "false") << "}";
   }
   o << "]}";
   return o.str();

@@ -72,11 +72,34 @@ struct ReplicaHealth {
   int64_t observations = 0;
 };
 
+// Slow-link sentinel state for one replica's outbound ring edge
+// (docs/architecture.md "Data-plane observability").  Heartbeats carry the
+// Manager's per-neighbor link health EWMAs derived from the ring engines'
+// hop telemetry; the engine scores each replica's OUTBOUND goodput
+// (send_gbps — the localizing signal: only the degraded edge's SENDER
+// sees its send-blocked time explode, while recv-waits equalize around
+// the lockstep ring) against the cluster's upper median and runs the same
+// hysteresis shape as the straggler sentinel:
+//   healthy --(median/gbps >= R)--> suspect --(grace over)--> degraded
+//   degraded --(grace under)--> healthy (alert resolved)
+// R = TPUFT_LINK_RATIO, grace = TPUFT_LINK_GRACE_STEPS.
+struct LinkHealth {
+  double recv_gbps = 0.0;  // inbound-edge goodput EWMA (receiver view)
+  double send_gbps = 0.0;  // outbound-edge goodput EWMA (the scored signal)
+  double rtt_ms = 0.0;     // mean per-hop recv-wait
+  double ratio = 0.0;      // cluster median send_gbps / own (>= 1 = slow)
+  int state = 0;           // 0 healthy, 1 suspect, 2 degraded
+  int64_t over = 0;
+  int64_t under = 0;
+  int64_t last_step = -1;  // own step cursor (same rationale as ReplicaHealth)
+  int64_t observations = 0;
+};
+
 // One operator-visible alert, served on GET /alerts.json.  resolved_ms == 0
 // while active.
 struct AlertRecord {
   int64_t id = 0;
-  std::string kind;        // "straggler" | "ec_coverage"
+  std::string kind;        // "straggler" | "ec_coverage" | "slow_link"
   std::string replica_id;  // "cluster" for cluster-scope kinds
   int64_t raised_ms = 0;   // epoch ms
   int64_t resolved_ms = 0;
@@ -87,6 +110,11 @@ struct AlertRecord {
   // (kept current while active) and the k + 1 paging threshold.
   int64_t coverage = 0;
   int64_t threshold = 0;
+  // kind == "slow_link": observed outbound goodput of the degraded edge
+  // and the reporting endpoint (the edge's sender); replica_id names the
+  // edge's RECEIVING endpoint — the auto-drain target.
+  double gbps = 0.0;
+  std::string src_replica_id;
 };
 
 // Pure quorum math, unit-testable without sockets.
@@ -159,6 +187,9 @@ class Lighthouse {
   // Straggler sentinel introspection (public for in-process tests; the
   // wire-facing surfaces are /metrics, /status.json and /alerts.json).
   int StragglerState(const std::string& replica_id);
+  // Slow-link sentinel introspection: the hysteresis state of the
+  // replica's OUTBOUND edge (0 healthy, 1 suspect, 2 degraded).
+  int LinkState(const std::string& replica_id);
   // JSON alert feed: {"active": N, "alerts": [...]} — newest last.
   std::string AlertsJson();
 
@@ -231,6 +262,22 @@ class Lighthouse {
   // Raise/resolve the straggler alert for one replica.  Caller holds mu_.
   void RaiseStragglerAlertLocked(const std::string& id, ReplicaHealth* h);
   void ResolveAlertsLocked(const std::string& id);
+  // Slow-link sentinel (docs/architecture.md "Data-plane observability"):
+  // one observation for `id`'s outbound-edge goodput (its reported step
+  // advanced with link telemetry attached).  Caller must hold mu_.
+  void ObserveLinkLocked(const std::string& id);
+  // Upper median of eligible (fresh, non-draining, reporting) outbound
+  // goodputs; 0 when fewer than two replicas report.  Caller holds mu_.
+  double ClusterMedianLinkGbpsLocked() const;
+  void RaiseLinkAlertLocked(const std::string& id, LinkHealth* h);
+  // Resolves slow_link alerts REPORTED by src_id (alerts are keyed by the
+  // edge's receiving endpoint in replica_id, so resolution goes through
+  // the reporter recorded in src_replica_id).
+  void ResolveLinkAlertsLocked(const std::string& src_id);
+  // The receiving endpoint of `id`'s outbound ring edge — its successor
+  // in the last formed quorum's sorted participant order (the ring
+  // order), or empty when no quorum/successor is known.  Caller holds mu_.
+  std::string RingSuccessorLocked(const std::string& id) const;
   // EC coverage sentinel (docs/wire.md "Erasure shard endpoints"): pages
   // via /alerts.json + tpuft_alerts_active when the newest encode
   // generation's shard coverage stays below k + 1 for a heartbeat
@@ -248,13 +295,14 @@ class Lighthouse {
   // Flight-records a sentinel hysteresis transition when prev != h.state.
   void RecordSentinelLocked(const std::string& id, int prev,
                             const ReplicaHealth& h);
-  // Auto-drain attempt for a confirmed straggler: marks it draining via
-  // the cooperative path iff enabled and the remaining healthy count
+  // Auto-drain attempt for a confirmed straggler / slow-link endpoint:
+  // marks it draining via the cooperative path iff ``enabled`` (the
+  // calling sentinel's auto-drain knob) and the remaining healthy count
   // stays above min_replicas.  Returns whether the replica is (now)
-  // draining.  Retried on every later straggler observation, so a
+  // draining.  Retried on every later confirming observation, so a
   // rotation skipped at the capacity floor happens as soon as capacity
   // recovers.  Caller holds mu_.
-  bool MaybeAutoDrainLocked(const std::string& id, bool log_skip);
+  bool MaybeAutoDrainLocked(const std::string& id, bool log_skip, bool enabled);
   std::string StatusJson();
   std::string StatusHtml();
   // Prometheus text exposition for GET /metrics: quorum size/id/age,
@@ -360,6 +408,29 @@ class Lighthouse {
   int64_t straggler_grace_ = 5;
   bool straggler_auto_drain_ = false;
   int64_t straggler_warmup_ = 10;
+
+  // Slow-link sentinel (docs/architecture.md "Data-plane observability").
+  // Rolling per-replica outbound-edge health, pruned with the graveyard.
+  std::map<std::string, LinkHealth> link_health_;
+  // Knobs, read from the environment at Start:
+  //   TPUFT_LINK_RATIO         outbound-goodput slowness ratio threshold
+  //                            (cluster median / replica, default 4.0 —
+  //                            deliberately loose: healthy send-blocked
+  //                            time is near zero, so healthy goodput
+  //                            readings are high-variance)
+  //   TPUFT_LINK_GRACE_STEPS   consecutive step observations over/under
+  //                            before promoting to degraded / demoting
+  //                            (default 3)
+  //   TPUFT_LINK_AUTO_DRAIN    "1": the degraded edge's RECEIVING endpoint
+  //                            is marked draining when the alert raises
+  //                            (never below min_replicas)
+  //   TPUFT_LINK_WARMUP_STEPS  observations per incarnation before a
+  //                            suspect may be promoted (default 3; first
+  //                            steps mix rendezvous + warmup traffic)
+  double link_ratio_ = 4.0;
+  int64_t link_grace_ = 3;
+  bool link_auto_drain_ = false;
+  int64_t link_warmup_ = 3;
 
   // HA role state (SetRole).  Default: standalone permanent leader with no
   // lease (lease_expires_ms_ == 0 disables the serve-time expiry guard).

@@ -200,7 +200,9 @@ std::string ManagerServer::address() const { return server_ ? server_->address()
 void ManagerServer::SetStatus(int64_t step, const std::string& state,
                               double step_time_ms_ewma, double step_time_ms_last,
                               double allreduce_gb_per_s, int64_t ec_shards_held,
-                              int64_t ec_shard_step, int64_t ec_k) {
+                              int64_t ec_shard_step, int64_t ec_k,
+                              double link_recv_gbps, double link_send_gbps,
+                              double link_hop_rtt_ms) {
   std::lock_guard<std::mutex> lk(mu_);
   status_step_ = step;
   status_state_ = state;
@@ -227,6 +229,11 @@ void ManagerServer::SetStatus(int64_t step, const std::string& state,
   if (ec_k >= 0) {
     status_ec_k_ = ec_k;
   }
+  // Link health EWMAs (heartbeat fields 11-13): 0 is an authoritative
+  // "no observation" report, negative keeps the prior reading.
+  if (link_recv_gbps >= 0.0) status_link_recv_gbps_ = link_recv_gbps;
+  if (link_send_gbps >= 0.0) status_link_send_gbps_ = link_send_gbps;
+  if (link_hop_rtt_ms >= 0.0) status_link_rtt_ms_ = link_hop_rtt_ms;
 }
 
 void ManagerServer::HeartbeatLoop() {
@@ -271,6 +278,9 @@ void ManagerServer::HeartbeatLoop() {
       req.set_ec_shards_held(status_ec_shards_);
       req.set_ec_shard_step(status_ec_step_);
       req.set_ec_k(status_ec_k_);
+      req.set_link_recv_gbps(status_link_recv_gbps_);
+      req.set_link_send_gbps(status_link_send_gbps_);
+      req.set_link_hop_rtt_ms(status_link_rtt_ms_);
       req.set_trace_id(status_trace_id_);
       req.SerializeToString(&payload);
     }

@@ -75,10 +75,16 @@ class ManagerServer {
   // negative means "keep the prior reading".  ec_k (field 10) is the EC
   // geometry's data-shard count, the lighthouse coverage sentinel's
   // paging threshold input; same negative-keeps convention.
+  // The link health EWMAs (heartbeat fields 11-13, the slow-link
+  // sentinel's feed) follow the gauge convention too: 0 is an
+  // authoritative "no observation yet / no traffic" report, negative
+  // keeps the prior reading for phase-only pushes.
   void SetStatus(int64_t step, const std::string& state,
                  double step_time_ms_ewma = 0.0, double step_time_ms_last = 0.0,
                  double allreduce_gb_per_s = -1.0, int64_t ec_shards_held = -1,
-                 int64_t ec_shard_step = -1, int64_t ec_k = -1);
+                 int64_t ec_shard_step = -1, int64_t ec_k = -1,
+                 double link_recv_gbps = -1.0, double link_send_gbps = -1.0,
+                 double link_hop_rtt_ms = -1.0);
 
   // RPC handlers (public for in-process tests).
   Status HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
@@ -137,6 +143,10 @@ class ManagerServer {
   int64_t status_ec_shards_ = 0;
   int64_t status_ec_step_ = 0;
   int64_t status_ec_k_ = 0;
+  // Per-neighbor link health (heartbeat fields 11-13, slow-link sentinel).
+  double status_link_recv_gbps_ = 0.0;
+  double status_link_send_gbps_ = 0.0;
+  double status_link_rtt_ms_ = 0.0;
   // Causal trace id of the last quorum round this manager aggregated —
   // stamped onto every lighthouse heartbeat (proto field 7) so the
   // lighthouse's RPC spans correlate with the step in flight.

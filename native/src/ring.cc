@@ -37,6 +37,15 @@ double NowS() {
       .count();
 }
 
+// Wall-clock (epoch) seconds — hop-record timestamps must time-align with
+// the Python side's time.time()-based span/event stream so the Perfetto
+// export can put both planes on one timeline.
+double NowWallS() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
 int ModN(int a, int n) { return ((a % n) + n) % n; }
 
 // The one sanctioned writer of RingLink::{dead, dead_reason}: the reason
@@ -191,11 +200,28 @@ void RingShaper::OnSend(size_t nbytes) {
   // of seconds here, and Close() must not have to wait that out before it
   // can safely recycle fd numbers — the pacer is the one blocking state
   // the socket shutdown cannot interrupt.
+  double t0 = NowS();
   for (double remaining = wake - NowS(); remaining > 0;
        remaining = wake - NowS()) {
-    if (closed != nullptr && closed->load()) return;
+    if (closed != nullptr && closed->load()) break;
     std::this_thread::sleep_for(
         std::chrono::duration<double>(std::min(remaining, 0.05)));
+  }
+  double slept = NowS() - t0;
+  if (slept > 0) {
+    wait_us.fetch_add(static_cast<uint64_t>(slept * 1e6),
+                      std::memory_order_relaxed);
+  }
+}
+
+void RingShaper::SetRate(double mbps, double rtt_ms) {
+  std::lock_guard<std::mutex> lk(mu);
+  if (mbps > 0) {
+    enabled = true;
+    bytes_per_s = mbps * 1e6 / 8.0;
+    half_rtt_s = rtt_ms / 2000.0;
+  } else {
+    enabled = false;
   }
 }
 
@@ -700,7 +726,7 @@ struct OpGuard {
 RingStatus RingEngine::Hop(Tier* t, int lane, uint32_t tag, const uint8_t* a,
                            size_t alen, const uint8_t* b, size_t blen,
                            uint8_t* rdst, size_t rlen, double timeout_s,
-                           std::string* err) {
+                           std::string* err, RingHopRecord* rec) {
   // Zero-length frames are real traffic (a striped pass over a payload
   // smaller than the stripe count produces empty chunks — the Python
   // engine frames them as header-only too), but rdst may then be a null
@@ -710,7 +736,9 @@ RingStatus RingEngine::Hop(Tier* t, int lane, uint32_t tag, const uint8_t* a,
   if (rdst == nullptr && rlen == 0) rdst = &zero;
   RingLink* nl = t->next[static_cast<size_t>(lane)].get();
   RingLink* pl = t->prev[static_cast<size_t>(lane)].get();
+  if (rec != nullptr) rec->ts = NowWallS();
   auto job = EnqueueSend(nl, tag, a, alen, b, blen, timeout_s);
+  double t_recv = NowS();
   std::string recv_err;
   RingStatus rst = RecvFrame(pl, tag, rdst, rlen, nullptr, timeout_s, &recv_err);
   if (rst != RingStatus::kOk) {
@@ -720,6 +748,7 @@ RingStatus RingEngine::Hop(Tier* t, int lane, uint32_t tag, const uint8_t* a,
     *err = recv_err;
     return rst;
   }
+  double t_send = NowS();
   std::string send_err;
   RingStatus sst = WaitSend(job, timeout_s, &send_err);
   if (sst == RingStatus::kTimeout) AbandonSend(nl, job, send_err);
@@ -727,7 +756,79 @@ RingStatus RingEngine::Hop(Tier* t, int lane, uint32_t tag, const uint8_t* a,
     *err = send_err;
     return sst;
   }
+  if (rec != nullptr) {
+    rec->recv_s = t_send - t_recv;
+    rec->send_s = NowS() - t_send;
+    rec->nbytes = alen + blen;
+  }
   return RingStatus::kOk;
+}
+
+void RingEngine::RecordHop(const RingHopRecord& rec) {
+  int tier = rec.tier;
+  if (tier < 0 || tier >= kNumTiers) return;
+  agg_hops_[tier].fetch_add(1, std::memory_order_relaxed);
+  agg_send_us_[tier].fetch_add(static_cast<uint64_t>(rec.send_s * 1e6),
+                               std::memory_order_relaxed);
+  agg_recv_us_[tier].fetch_add(static_cast<uint64_t>(rec.recv_s * 1e6),
+                               std::memory_order_relaxed);
+  agg_comb_us_[tier].fetch_add(static_cast<uint64_t>(rec.comb_s * 1e6),
+                               std::memory_order_relaxed);
+  int sample = hop_sample_.load(std::memory_order_relaxed);
+  if (sample <= 0) return;  // aggregates only
+  uint64_t n = hop_counter_.fetch_add(1, std::memory_order_relaxed);
+  if (n % static_cast<uint64_t>(sample) != 0) return;
+  std::lock_guard<std::mutex> lk(hop_mu_);
+  if (hop_ring_.size() < hop_cap_) {
+    hop_ring_.push_back(rec);
+    hop_next_ = hop_ring_.size() % hop_cap_;
+  } else {
+    hop_ring_[hop_next_] = rec;
+    hop_next_ = (hop_next_ + 1) % hop_cap_;
+  }
+}
+
+void RingEngine::SetHopRecorder(int sample, int cap) {
+  hop_sample_.store(sample, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lk(hop_mu_);
+  if (cap > 0 && static_cast<size_t>(cap) != hop_cap_) {
+    hop_cap_ = static_cast<size_t>(cap);
+    hop_ring_.clear();
+    hop_next_ = 0;
+  }
+}
+
+int RingEngine::HopStats(int tier, double* out4) {
+  out4[0] = out4[1] = out4[2] = out4[3] = 0;
+  if (tier < 0 || tier >= kNumTiers || !tiers_[tier].present) return 0;
+  out4[0] = static_cast<double>(agg_hops_[tier].load(std::memory_order_relaxed));
+  out4[1] = agg_send_us_[tier].load(std::memory_order_relaxed) / 1e6;
+  out4[2] = agg_recv_us_[tier].load(std::memory_order_relaxed) / 1e6;
+  out4[3] = agg_comb_us_[tier].load(std::memory_order_relaxed) / 1e6;
+  return 1;
+}
+
+int RingEngine::HopRecords(double* out, int cap_records) {
+  std::lock_guard<std::mutex> lk(hop_mu_);
+  size_t n = hop_ring_.size();
+  size_t take = std::min(n, static_cast<size_t>(cap_records));
+  // Oldest first: when the ring has wrapped, the oldest retained record
+  // sits at hop_next_.
+  size_t start = (n < hop_cap_) ? 0 : hop_next_;
+  size_t skip = n - take;
+  for (size_t i = 0; i < take; ++i) {
+    const RingHopRecord& r = hop_ring_[(start + skip + i) % n];
+    double* o = out + i * 8;
+    o[0] = r.ts;
+    o[1] = r.tier;
+    o[2] = r.lane;
+    o[3] = r.tag;
+    o[4] = r.send_s;
+    o[5] = r.recv_s;
+    o[6] = r.comb_s;
+    o[7] = static_cast<double>(r.nbytes);
+  }
+  return static_cast<int>(take);
 }
 
 RingStatus RingEngine::Exchange(int tier, int lane, uint32_t tag,
@@ -874,20 +975,29 @@ RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
       int recv_idx = ModN(rank - step - 1, n);
       uint64_t selems = chunk_elems[send_idx];
       uint64_t relems = chunk_elems[recv_idx];
+      RingHopRecord rec;
+      rec.tier = tier;
+      rec.lane = lane;
+      rec.tag = tag;
       if (wire == kWireRaw) {
         st = Hop(t, lane, tag,
                  reinterpret_cast<const uint8_t*>(chunk_ptrs[send_idx]),
                  static_cast<size_t>(selems) * 4, nullptr, 0, recvbuf,
-                 static_cast<size_t>(relems) * 4, timeout_s, err);
+                 static_cast<size_t>(relems) * 4, timeout_s, err, &rec);
         if (st != RingStatus::kOk) return st;
+        double t_comb = NowS();
         decode_combine(recvbuf, relems, chunk_ptrs[recv_idx]);
+        rec.comb_s = NowS() - t_comb;
       } else {
         size_t slen = encode(chunk_ptrs[send_idx], selems, sendbuf);
         st = Hop(t, lane, tag, sendbuf, slen, nullptr, 0, recvbuf,
-                 enc_len(relems), timeout_s, err);
+                 enc_len(relems), timeout_s, err, &rec);
         if (st != RingStatus::kOk) return st;
+        double t_comb = NowS();
         decode_combine(recvbuf, relems, chunk_ptrs[recv_idx]);
+        rec.comb_s = NowS() - t_comb;
       }
+      RecordHop(rec);
     }
   }
 
@@ -904,12 +1014,18 @@ RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
     for (int step = 0; step < n - 1; ++step) {
       int send_idx = ModN(rank - step + 1, n);
       int recv_idx = ModN(rank - step, n);
+      RingHopRecord rec;
+      rec.tier = tier;
+      rec.lane = lane;
+      rec.tag = tag;
       st = Hop(t, lane, tag,
                reinterpret_cast<const uint8_t*>(chunk_ptrs[send_idx]),
                static_cast<size_t>(chunk_elems[send_idx]) * 4, nullptr, 0,
                reinterpret_cast<uint8_t*>(chunk_ptrs[recv_idx]),
-               static_cast<size_t>(chunk_elems[recv_idx]) * 4, timeout_s, err);
+               static_cast<size_t>(chunk_elems[recv_idx]) * 4, timeout_s, err,
+               &rec);
       if (st != RingStatus::kOk) return st;
+      RecordHop(rec);
     }
     return RingStatus::kOk;
   }
@@ -924,11 +1040,16 @@ RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
   for (int step = 0; step < n - 1; ++step) {
     int send_idx = ModN(rank - step + 1, n);
     int recv_idx = ModN(rank - step, n);
+    RingHopRecord rec;
+    rec.tier = tier;
+    rec.lane = lane;
+    rec.tag = tag;
     st = Hop(t, lane, tag, arena + off[send_idx],
              enc_len(chunk_elems[send_idx]), nullptr, 0,
              arena + off[recv_idx], enc_len(chunk_elems[recv_idx]),
-             timeout_s, err);
+             timeout_s, err, &rec);
     if (st != RingStatus::kOk) return st;
+    RecordHop(rec);
   }
   for (int i = 0; i < n; ++i) {
     decode_assign(arena + off[i], chunk_elems[i], chunk_ptrs[i]);
@@ -956,6 +1077,20 @@ void RingEngine::ShaperCounters(int tier, int direction, uint64_t* bytes,
                                         : &tiers_[tier].prev_shaper;
   *bytes = s->bytes_sent.load();
   *frames = s->frames_sent.load();
+}
+
+double RingEngine::ShaperWaitS(int tier, int direction) {
+  if (tier < 0 || tier >= kNumTiers || !tiers_[tier].present) return 0.0;
+  RingShaper* s = direction == kDirNext ? &tiers_[tier].next_shaper
+                                        : &tiers_[tier].prev_shaper;
+  return s->wait_us.load(std::memory_order_relaxed) / 1e6;
+}
+
+void RingEngine::SetShaper(int tier, int direction, double mbps, double rtt_ms) {
+  if (tier < 0 || tier >= kNumTiers || !tiers_[tier].present) return;
+  RingShaper* s = direction == kDirNext ? &tiers_[tier].next_shaper
+                                        : &tiers_[tier].prev_shaper;
+  s->SetRate(mbps, rtt_ms);
 }
 
 uint64_t RingEngine::LinkBytes(int tier, int direction, int lane) {

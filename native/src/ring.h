@@ -63,7 +63,9 @@ enum RingWire : int { kWireRaw = 0, kWireBf16 = 1, kWireInt8 = 2 };
 // concurrent lanes queue on the modeled link, so lanes can only win by
 // overlapping propagation and host work with serialization.
 struct RingShaper {
-  bool enabled = false;
+  // Atomic: OnSend's early-out reads it from the lane sender threads
+  // while SetRate (mid-run re-shaping) writes it from the caller.
+  std::atomic<bool> enabled{false};
   double bytes_per_s = 0;
   double half_rtt_s = 0;
   // Engine-wide close flag: the pacer sleeps in short slices against it so
@@ -73,8 +75,31 @@ struct RingShaper {
   double busy_until_s = 0;  // steady-clock seconds
   std::atomic<uint64_t> bytes_sent{0};
   std::atomic<uint64_t> frames_sent{0};
+  // Time actually slept waiting out the modeled serialization + propagation
+  // (the "shaping" bucket of the data-plane attribution split): microseconds
+  // so the counter read is one atomic load, like the byte counters.
+  std::atomic<uint64_t> wait_us{0};
 
   void OnSend(size_t nbytes);
+  // Mid-run re-shaping (the slow-link bench degrades ONE peer direction
+  // 10x without a reconfigure).  mbps <= 0 disables pacing.
+  void SetRate(double mbps, double rtt_ms);
+};
+
+// One recorded ring hop — the data-plane flight recorder's unit.  The
+// FIELD SET AND ORDER are the cross-engine schema contract: the Python
+// engine's HopRecorder (collectives.HOP_RECORD_FIELDS) emits dicts with
+// exactly these keys, and tf_ring_hop_records marshals each record as 8
+// doubles in exactly this order.  tests/test_link.py pins both.
+struct RingHopRecord {
+  double ts = 0;        // wall-clock (epoch) seconds at hop start
+  int32_t tier = 0;     // kTierFlat / kTierRow / kTierCol
+  int32_t lane = 0;
+  uint32_t tag = 0;     // frame tag (encodes op seq / stripe / rs-vs-ag)
+  double send_s = 0;    // blocked joining the lane sender (incl. pacing)
+  double recv_s = 0;    // blocked waiting for the matching inbound frame
+  double comb_s = 0;    // decode + combine of the received chunk (RS hops)
+  uint64_t nbytes = 0;  // frame payload bytes sent (header excluded)
 };
 
 struct RingSendJob;
@@ -162,8 +187,32 @@ class RingEngine {
   // frames_sent parity for shaped-link byte accounting tests).
   void ShaperCounters(int tier, int direction, uint64_t* bytes, uint64_t* frames);
 
+  // Seconds one tier-direction's pacer actually slept (the "shaping"
+  // bucket of obs.report's link_attribution split).
+  double ShaperWaitS(int tier, int direction);
+
+  // Mid-run re-shaping of one tier-direction's pacer (the slow-link bench
+  // degrades ONE peer link 10x without a reconfigure).  mbps <= 0 disables.
+  void SetShaper(int tier, int direction, double mbps, double rtt_ms);
+
   // Wire bytes moved on one lane link (direction 0 = next/out, 1 = prev/in).
   uint64_t LinkBytes(int tier, int direction, int lane);
+
+  // -- data-plane flight recorder (docs/architecture.md "Data-plane
+  // observability") ------------------------------------------------------
+  // Bounded per-hop timeline + always-on per-tier stall aggregates.  The
+  // aggregates are a handful of atomic adds per hop (microsecond cost
+  // against millisecond hops); the timeline ring records every
+  // ``sample``-th hop (0 disables the timeline, aggregates stay on) into a
+  // fixed ``cap``-slot ring — the bench's healthy control cell pins the
+  // recorder's throughput impact under its budget.
+  void SetHopRecorder(int sample, int cap);
+  // out4 = {hops, send_block_s, recv_wait_s, combine_s} for one tier.
+  // Returns 1 when the tier is registered, 0 otherwise (out zeroed).
+  int HopStats(int tier, double* out4);
+  // Copies up to ``cap_records`` retained hop records, oldest first, as 8
+  // doubles each in RingHopRecord field order.  Returns the record count.
+  int HopRecords(double* out, int cap_records);
 
  private:
   struct Tier {
@@ -196,13 +245,33 @@ class RingEngine {
                          uint32_t expect_tag, uint8_t* dst, size_t dst_len,
                          std::string* out, double timeout_s, std::string* err);
   // One hop: enqueue the send, receive the same tag, join the send.
+  // ``rec`` (optional) is filled with the hop's send/recv wait split and
+  // byte count on success — the caller stamps tier/lane/tag/combine and
+  // commits it via RecordHop.
   RingStatus Hop(Tier* t, int lane, uint32_t tag, const uint8_t* a, size_t alen,
                  const uint8_t* b, size_t blen, uint8_t* rdst, size_t rlen,
-                 double timeout_s, std::string* err);
+                 double timeout_s, std::string* err,
+                 RingHopRecord* rec = nullptr);
+  // Folds one completed hop into the per-tier aggregates and (sampled)
+  // the bounded timeline ring.
+  void RecordHop(const RingHopRecord& rec);
 
   int lanes_;
   double mbps_, rtt_ms_;
   Tier tiers_[kNumTiers];
+  // Per-tier stall aggregates (always on; lane_stats' "hops" feed).
+  std::atomic<uint64_t> agg_hops_[kNumTiers] = {};
+  std::atomic<uint64_t> agg_send_us_[kNumTiers] = {};
+  std::atomic<uint64_t> agg_recv_us_[kNumTiers] = {};
+  std::atomic<uint64_t> agg_comb_us_[kNumTiers] = {};
+  // Sampled bounded hop timeline (lock-light: one short mutex'd append per
+  // SAMPLED hop; the hot path pays an atomic increment when sampled out).
+  std::atomic<uint64_t> hop_counter_{0};
+  std::atomic<int> hop_sample_{1};
+  std::mutex hop_mu_;
+  std::vector<RingHopRecord> hop_ring_;
+  size_t hop_cap_ = 2048;
+  size_t hop_next_ = 0;
   std::atomic<bool> closed_{false};
   mutable std::mutex close_mu_;
   // In-flight op count: Close() shuts the sockets down (waking every

@@ -9,6 +9,7 @@ structural selftest catches signature drift between its scenario functions
 (the exact failure that cost round 2 its numbers).
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -277,6 +278,56 @@ def test_ec_quick_smoke() -> None:
     assert art["ec_wave"]["ok"] is True
     assert art["ec_manager_wave"]["survivor_failed_commits"] == 0
     assert artifact["summary"]["ec"]["encode_overhead_ratio"] < 1.05
+
+
+def test_link_quick_smoke() -> None:
+    """Slow-link sentinel tier-1 gate (bench_allreduce.run_link quick
+    cell): with ONE peer's outbound link re-shaped 10x slower mid-run (no
+    reconfigure — invisible to heartbeat timeouts and to the straggler
+    sentinel's wall-minus-waits signal), the lighthouse raises a slow_link
+    alert within a bounded number of victim commit rounds, names the
+    victim as the reporting sender, the healthy control run raises ZERO
+    link alerts, the attribution split's fractions sum to ~1 with the
+    ADDED wall landing on the wire/shaping/stall side, and the hop
+    recorder's overhead stays inside a generous live bound (the committed
+    artifact pins the honest number)."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_allreduce
+    finally:
+        sys.path.pop(0)
+    r = bench_allreduce.run_link(quick=True)
+    assert r["ok"], r
+    assert r["detected"] is True
+    assert r["detection_rounds"] is not None and r["detection_rounds"] <= 10
+    assert r["alert_src_is_victim"] is True
+    assert r["healthy"]["link_alerts"] == 0
+    assert r["degraded"]["link_alerts"] >= 1
+    # Every group of both cells committed every round: a degraded link is
+    # slow, not broken — no failed commits, which is exactly why only the
+    # sentinel can see it.
+    assert all(f == 0 for f in r["healthy"]["failed"])
+    assert all(f == 0 for f in r["degraded"]["failed"])
+    assert r["attribution_fraction_sum"] == pytest.approx(1.0, abs=0.01)
+    assert r["added_wire_stall_fraction"] is not None
+    assert r["added_wire_stall_fraction"] >= 0.9
+    # Hop-recorder cost guard, live (noisy-CI bound; artifact is strict).
+    assert r["overhead"]["impact"] is not None
+    assert r["overhead"]["impact"] < 1.35
+
+    # The committed artifact carries the full-size cell with strict gates.
+    with open(os.path.join(REPO, "ALLREDUCE_BENCH.json")) as f:
+        artifact = json.load(f)
+    link = artifact.get("link")
+    assert link, "ALLREDUCE_BENCH.json is missing the link cell"
+    assert link["ok"] is True
+    assert link["detected"] is True
+    assert link["detection_rounds"] <= 8
+    assert link["alert_src_is_victim"] is True
+    assert link["healthy"]["link_alerts"] == 0
+    assert link["attribution_fraction_sum"] == pytest.approx(1.0, abs=0.01)
+    assert link["added_wire_stall_fraction"] >= 0.9
+    assert link["overhead"]["impact"] < 1.02  # the <2% recorder budget
 
 
 def test_device_prep_quick_smoke() -> None:

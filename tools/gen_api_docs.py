@@ -68,6 +68,7 @@ MODULES = [
     "torchft_tpu.obs.report",
     "torchft_tpu.obs.trace",
     "torchft_tpu.obs.flight",
+    "torchft_tpu.obs.prom",
     "torchft_tpu.multihost",
     "torchft_tpu.ha.lease",
     "torchft_tpu.ha.replica",

@@ -52,6 +52,14 @@ def main(argv=None) -> int:
         metavar="FLIGHT_JSON",
         help="flight-recorder dump(s) to merge as a control-plane track",
     )
+    ap.add_argument(
+        "--hops",
+        action="append",
+        default=[],
+        metavar="HOPS_JSON",
+        help="data-plane hop-timeline dump(s) (hops_<replica>.json, from "
+        "TPUFT_HOP_DUMP_DIR or a bench) to merge as per-lane tracks",
+    )
     ap.add_argument("-o", "--out", help="output path (default: trace.json next "
                     "to the first input)")
     ap.add_argument(
@@ -69,15 +77,31 @@ def main(argv=None) -> int:
 
     if args.quick:
         # Worker stream + the lighthouse's synthetic flight view of the
-        # same run: the smoke covers the control-plane track end to end.
+        # same run + the ring engines' synthetic hop timeline: the smoke
+        # covers the control-plane AND data-plane tracks end to end.
         events = obs_trace.synthetic_stream(n_replicas=2, steps=4)
         events += obs_trace.synthetic_flight_stream(n_replicas=2, steps=4)
+        events += obs_trace.synthetic_hop_stream(n_replicas=2, steps=4)
         events.sort(key=lambda ev: ev["ts"])
         built = obs_trace.build_trace(events, align=not args.no_align)
         problems = obs_trace.validate_trace(built)
         cp_tracks = built.get("otherData", {}).get("control_plane", {})
         if not cp_tracks:
             problems.append("control-plane track missing from --quick trace")
+        dp_tracks = sum(
+            1
+            for ev in built["traceEvents"]
+            if ev.get("ph") == "M"
+            and ev.get("name") == "thread_name"
+            and " dp:" in str(ev.get("args", {}).get("name", ""))
+        )
+        if not dp_tracks:
+            problems.append("data-plane hop track missing from --quick trace")
+        hop_slices = sum(
+            1 for ev in built["traceEvents"] if ev.get("cat") == "hop"
+        )
+        if not hop_slices:
+            problems.append("no hop slices in --quick trace")
         out = args.out
         if out is None:
             fd, out = tempfile.mkstemp(prefix="tpuft_trace_", suffix=".json")
@@ -93,6 +117,8 @@ def main(argv=None) -> int:
                     "trace_events": len(built["traceEvents"]),
                     "replicas": len(built.get("otherData", {}).get("replicas", {})),
                     "control_plane_tracks": len(cp_tracks),
+                    "data_plane_tracks": dp_tracks,
+                    "hop_slices": hop_slices,
                     "problems": problems,
                 }
             )
@@ -101,6 +127,7 @@ def main(argv=None) -> int:
 
     paths = list(args.paths)
     flight_paths = list(args.flight)
+    hops_paths = list(args.hops)
     if args.workdir:
         paths += sorted(
             glob.glob(os.path.join(args.workdir, "**", "*.jsonl"), recursive=True)
@@ -110,12 +137,21 @@ def main(argv=None) -> int:
                 os.path.join(args.workdir, "**", "flight_*.json"), recursive=True
             )
         )
-    if not paths and not flight_paths:
-        ap.error("no input: pass metrics.jsonl path(s), --flight, or --workdir")
-    first = paths[0] if paths else flight_paths[0]
+        hops_paths += sorted(
+            glob.glob(
+                os.path.join(args.workdir, "**", "hops_*.json"), recursive=True
+            )
+        )
+    if not paths and not flight_paths and not hops_paths:
+        ap.error(
+            "no input: pass metrics.jsonl path(s), --flight, --hops, or "
+            "--workdir"
+        )
+    first = (paths + flight_paths + hops_paths)[0]
     out = args.out or os.path.join(os.path.dirname(first) or ".", "trace.json")
     summary = obs_trace.export(
-        paths, out, align=not args.no_align, flight_paths=flight_paths
+        paths, out, align=not args.no_align, flight_paths=flight_paths,
+        hops_paths=hops_paths,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

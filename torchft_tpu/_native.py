@@ -168,6 +168,8 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.POINTER(ctypes.c_size_t),
     ]
+    lib.tf_lighthouse_link_state.restype = ctypes.c_int
+    lib.tf_lighthouse_link_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.tf_lighthouse_flight_json.restype = ctypes.c_void_p
     lib.tf_lighthouse_flight_json.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.tf_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
@@ -195,6 +197,9 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.c_int64,
         ctypes.c_int64,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.c_double,
     ]
     lib.tf_manager_flight_json.restype = ctypes.c_void_p
     lib.tf_manager_flight_json.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -302,6 +307,40 @@ def _bind_ring(lib: ctypes.CDLL) -> Optional[str]:
             ctypes.c_int32,
             ctypes.c_int32,
             ctypes.c_int32,
+        ]
+        # Data-plane flight recorder (hop telemetry, PR 14).  Declared with
+        # the base ring symbols: a .so that has tf_ring_new but not these
+        # is a stale build, and a silent half-capability engine would
+        # break the cross-engine telemetry-parity contract.
+        lib.tf_ring_set_hop.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        lib.tf_ring_hop_stats.restype = ctypes.c_int
+        lib.tf_ring_hop_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.tf_ring_hop_records.restype = ctypes.c_int
+        lib.tf_ring_hop_records.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+        ]
+        lib.tf_ring_shaper_wait_s.restype = ctypes.c_double
+        lib.tf_ring_shaper_wait_s.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        lib.tf_ring_set_shaper.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_double,
+            ctypes.c_double,
         ]
     except AttributeError:
         return (
@@ -562,11 +601,19 @@ class LighthouseServer:
 
         return json.loads(self.flight_json(limit) or "{}")
 
+    def link_state(self, replica_id: str) -> int:
+        """Slow-link sentinel state of the replica's OUTBOUND edge (0
+        healthy, 1 suspect, 2 degraded) — in-process introspection for
+        tests; the wire surfaces are /metrics and /alerts.json."""
+        if not self._ptr:
+            return 0
+        return int(_lib.tf_lighthouse_link_state(self._ptr, replica_id.encode()))
+
     def snapshot(self) -> bytes:
         """Serialized ``LighthouseReplicateRequest`` of the full replicable
         state (membership, live step/state, straggler-sentinel health,
-        alerts, previous quorum + id) — what the HA election driver pushes
-        to each standby over wire method 6."""
+        link-health, alerts, previous quorum + id) — what the HA election
+        driver pushes to each standby over wire method 6."""
         if not self._ptr:
             return b""
         buf = ctypes.POINTER(ctypes.c_uint8)()
@@ -715,12 +762,16 @@ class LighthouseClient:
         step_time_ms_ewma: float = 0.0,
         step_time_ms_last: float = 0.0,
         trace_id: str = "",
+        link_recv_gbps: float = 0.0,
+        link_send_gbps: float = 0.0,
+        link_hop_rtt_ms: float = 0.0,
     ) -> None:
         """One heartbeat; ``step``/``state`` feed the lighthouse's live
         per-replica observability (GET /metrics step lag, /status.json) and
         the step-time fields feed its straggler sentinel (fields 4-5,
         docs/wire.md).  ``trace_id`` stamps the causal trace of the step in
-        flight (field 7)."""
+        flight (field 7).  The link fields (11-13) feed the slow-link
+        sentinel; 0 = not reported."""
         req = pb.LighthouseHeartbeatRequest(
             replica_id=replica_id,
             step=int(step),
@@ -728,6 +779,9 @@ class LighthouseClient:
             step_time_ms_ewma=float(step_time_ms_ewma),
             step_time_ms_last=float(step_time_ms_last),
             trace_id=trace_id,
+            link_recv_gbps=float(link_recv_gbps),
+            link_send_gbps=float(link_send_gbps),
+            link_hop_rtt_ms=float(link_hop_rtt_ms),
         )
         self._call_failover(LIGHTHOUSE_HEARTBEAT, req.SerializeToString(), timeout_ms)
 
@@ -844,6 +898,9 @@ class ManagerServer:
         ec_shards_held: int = -1,
         ec_shard_step: int = -1,
         ec_k: int = -1,
+        link_recv_gbps: float = -1.0,
+        link_send_gbps: float = -1.0,
+        link_hop_rtt_ms: float = -1.0,
     ) -> None:
         """Pushes live (step, state) into the heartbeat payload so the
         lighthouse's ``GET /metrics`` and ``/status.json`` show per-replica
@@ -861,7 +918,11 @@ class ManagerServer:
         follow the same convention: 0 is an authoritative empty-store
         report, negative keeps the prior reading.  ``ec_k`` (field 10) is
         the EC geometry's data-shard count — the lighthouse coverage
-        sentinel pages when per-step coverage drops below k + 1."""
+        sentinel pages when per-step coverage drops below k + 1.
+        The link-health EWMAs (heartbeat fields 11-13, the slow-link
+        sentinel's feed) share the gauge convention: 0 is an
+        authoritative "no observation" report, negative keeps the prior
+        reading."""
         if self._ptr:
             _lib.tf_manager_set_status(
                 self._ptr,
@@ -873,6 +934,9 @@ class ManagerServer:
                 int(ec_shards_held),
                 int(ec_shard_step),
                 int(ec_k),
+                float(link_recv_gbps),
+                float(link_send_gbps),
+                float(link_hop_rtt_ms),
             )
 
     def flight_json(self, limit: int = 0) -> str:
@@ -1126,6 +1190,58 @@ class RingEngine:
 
     def link_bytes(self, tier: int, direction: int, lane: int) -> int:
         return int(_lib.tf_ring_link_bytes(self._ptr, int(tier), int(direction), int(lane)))
+
+    def set_hop(self, sample: int, cap: int = 0) -> None:
+        """Configures the data-plane flight recorder: record every
+        ``sample``-th hop into the bounded timeline ring (0 disables the
+        timeline; the per-tier stall aggregates stay on).  ``cap`` > 0
+        resizes (and clears) the ring."""
+        _lib.tf_ring_set_hop(self._ptr, int(sample), int(cap))
+
+    def hop_stats(self, tier: int) -> "dict":
+        """Per-tier stall aggregates: ``{"hops", "send_block_s",
+        "recv_wait_s", "combine_s"}`` — lane_stats' native hop feed."""
+        out = (ctypes.c_double * 4)()
+        _lib.tf_ring_hop_stats(self._ptr, int(tier), out)
+        return {
+            "hops": int(out[0]),
+            "send_block_s": float(out[1]),
+            "recv_wait_s": float(out[2]),
+            "combine_s": float(out[3]),
+        }
+
+    def hop_records(self, cap: int = 4096) -> "List[dict]":
+        """The retained hop timeline, oldest first, as dicts with EXACTLY
+        the Python engine's HopRecorder keys (collectives
+        HOP_RECORD_FIELDS — the cross-engine schema contract)."""
+        buf = (ctypes.c_double * (8 * max(1, cap)))()
+        n = _lib.tf_ring_hop_records(self._ptr, buf, int(cap))
+        records = []
+        for i in range(n):
+            o = buf[i * 8 : i * 8 + 8]
+            records.append(
+                {
+                    "ts": float(o[0]),
+                    "tier": int(o[1]),
+                    "lane": int(o[2]),
+                    "tag": int(o[3]),
+                    "send_s": float(o[4]),
+                    "recv_s": float(o[5]),
+                    "comb_s": float(o[6]),
+                    "nbytes": int(o[7]),
+                }
+            )
+        return records
+
+    def shaper_wait_s(self, tier: int, direction: int) -> float:
+        """Seconds one tier-direction's pacer actually slept — the
+        "shaping" bucket of the link_attribution split."""
+        return float(_lib.tf_ring_shaper_wait_s(self._ptr, int(tier), int(direction)))
+
+    def set_shaper(self, tier: int, direction: int, mbps: float, rtt_ms: float) -> None:
+        """Mid-run re-shaping of one tier-direction's pacer (the slow-link
+        bench degrades ONE peer link without a reconfigure)."""
+        _lib.tf_ring_set_shaper(self._ptr, int(tier), int(direction), float(mbps), float(rtt_ms))
 
     def open_fd_count(self) -> int:
         """Dup'd lane fds still open — 0 after close() (the native half of

@@ -81,6 +81,7 @@ class LighthouseServer:
     def leader_epoch(self) -> int: ...
     def flight_json(self, limit: int = ...) -> str: ...
     def flight(self, limit: int = ...) -> Dict[str, Any]: ...
+    def link_state(self, replica_id: str) -> int: ...
     def snapshot(self) -> bytes: ...
     def shutdown(self) -> None: ...
 
@@ -109,6 +110,9 @@ class LighthouseClient:
         step_time_ms_ewma: float = ...,
         step_time_ms_last: float = ...,
         trace_id: str = ...,
+        link_recv_gbps: float = ...,
+        link_send_gbps: float = ...,
+        link_hop_rtt_ms: float = ...,
     ) -> None: ...
     def evict(self, replica_prefix: str, timeout_ms: int = ...) -> int: ...
     def drain(
@@ -145,6 +149,9 @@ class ManagerServer:
         ec_shards_held: int = ...,
         ec_shard_step: int = ...,
         ec_k: int = ...,
+        link_recv_gbps: float = ...,
+        link_send_gbps: float = ...,
+        link_hop_rtt_ms: float = ...,
     ) -> None: ...
     def flight_json(self, limit: int = ...) -> str: ...
     def flight(self, limit: int = ...) -> Dict[str, Any]: ...
@@ -237,5 +244,12 @@ class RingEngine:
     def counters(self, tier: int) -> tuple[List[int], List[int]]: ...
     def shaper_counters(self, tier: int, direction: int) -> tuple[int, int]: ...
     def link_bytes(self, tier: int, direction: int, lane: int) -> int: ...
+    def set_hop(self, sample: int, cap: int = ...) -> None: ...
+    def hop_stats(self, tier: int) -> Dict[str, Any]: ...
+    def hop_records(self, cap: int = ...) -> List[Dict[str, Any]]: ...
+    def shaper_wait_s(self, tier: int, direction: int) -> float: ...
+    def set_shaper(
+        self, tier: int, direction: int, mbps: float, rtt_ms: float
+    ) -> None: ...
     def open_fd_count(self) -> int: ...
     def close(self) -> None: ...

@@ -30,7 +30,7 @@ import threading
 import time
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
-from typing import Callable, List, Optional, Sequence, cast
+from typing import Callable, Dict, List, Optional, Sequence, cast
 
 import numpy as np
 
@@ -305,10 +305,15 @@ class LinkShaper:
         self.half_rtt_s = rtt_ms / 2000.0
         self._bytes_sent = 0
         self._frames_sent = 0
+        # Time actually slept waiting out the modeled serialization +
+        # propagation — the "shaping" bucket of obs.report's
+        # link_attribution split.
+        self._wait_s = 0.0
         # When the native ring engine owns this direction's sends, its
         # pacer does the counting; the hook keeps the byte-accounting
         # surface (tests, benches) engine-agnostic.
         self._native_read: Optional[Callable[[], tuple]] = None
+        self._native_wait: Optional[Callable[[], float]] = None
         self._lock = threading.Lock()
         # Virtual time (monotonic clock) until which the modeled link is
         # busy serializing already-admitted frames.
@@ -325,6 +330,26 @@ class LinkShaper:
         if self._native_read is not None:
             return self._native_read()[1]
         return self._frames_sent
+
+    @property
+    def wait_s(self) -> float:
+        """Seconds senders actually slept in this pacer (shaping time)."""
+        if self._native_wait is not None:
+            return self._native_wait()
+        return self._wait_s
+
+    def set_rate(self, mbps: float, rtt_ms: float) -> None:
+        """Mid-run re-shaping (the slow-link bench degrades ONE peer
+        direction without a reconfigure).  ``mbps <= 0`` disables the
+        pacing — matching the native engine's SetRate contract, and
+        avoiding a divide-by-zero in on_send."""
+        with self._lock:
+            if mbps > 0:
+                self.bytes_per_s = mbps * 1e6 / 8.0
+                self.half_rtt_s = rtt_ms / 2000.0
+            else:
+                self.bytes_per_s = float("inf")
+                self.half_rtt_s = 0.0
 
     @classmethod
     def from_env(cls) -> Optional["LinkShaper"]:
@@ -351,6 +376,144 @@ class LinkShaper:
         remaining = wake - time.monotonic()
         if remaining > 0:
             time.sleep(remaining)
+            with self._lock:
+                self._wait_s += remaining
+
+
+# -- data-plane flight recorder (docs/architecture.md "Data-plane
+# observability") ----------------------------------------------------------
+# Per-hop telemetry from the ring hot loop, recorded IDENTICALLY by both
+# engines: the Python loops below feed a HopRecorder, the native engine
+# records inside RingPass (native/src/ring.cc RingHopRecord) — same field
+# set, same semantics, schema-pinned against each other by
+# tests/test_link.py.  ``TPUFT_HOP_SAMPLE`` records every Nth hop into the
+# bounded timeline ring (0 keeps only the cheap per-tier aggregates);
+# ``TPUFT_HOP_RING`` bounds the retained timeline.
+TPUFT_HOP_SAMPLE_ENV = "TPUFT_HOP_SAMPLE"
+TPUFT_HOP_RING_ENV = "TPUFT_HOP_RING"
+_HOP_RING_DEFAULT = 2048
+
+# The cross-engine hop-record schema: ts = wall-clock seconds at hop
+# start; tier 0 flat / 1 row / 2 col; send_s = blocked joining the lane
+# sender (includes link pacing); recv_s = blocked on the matching inbound
+# frame; comb_s = decode + combine of the received chunk (reduce-scatter
+# hops; 0 on allgather forwards); nbytes = frame payload bytes sent.
+HOP_RECORD_FIELDS = (
+    "ts", "tier", "lane", "tag", "send_s", "recv_s", "comb_s", "nbytes",
+)
+
+
+def _hop_sample_from_env() -> int:
+    try:
+        return max(0, int(os.environ.get(TPUFT_HOP_SAMPLE_ENV, "1")))
+    except ValueError:
+        return 1
+
+
+def _hop_ring_from_env() -> int:
+    try:
+        return max(16, int(os.environ.get(TPUFT_HOP_RING_ENV, str(_HOP_RING_DEFAULT))))
+    except ValueError:
+        return _HOP_RING_DEFAULT
+
+
+class HopRecorder:
+    """Bounded, lock-light per-hop recorder — the Python engine's half of
+    the data-plane flight recorder.
+
+    Two tiers of cost: per-tier AGGREGATE stall counters (a few float adds
+    per hop, always on — ``lane_stats()``'s "hops" feed and the
+    link_attribution split's source) and a SAMPLED bounded timeline ring
+    (every ``sample``-th hop; 0 disables the timeline) that
+    ``obs/trace.py`` renders as the per-lane data-plane Perfetto track.
+    Hops are millisecond-scale network operations; the recorder's budget
+    is pinned by the bench's healthy control cell (<2% throughput impact).
+    """
+
+    def __init__(self, sample: Optional[int] = None, cap: Optional[int] = None) -> None:
+        self.sample = sample if sample is not None else _hop_sample_from_env()
+        self.cap = cap if cap is not None else _hop_ring_from_env()
+        self._lock = threading.Lock()
+        self._ring: "collections.deque[dict]" = collections.deque(maxlen=self.cap)
+        self._count = 0
+        # tier -> [hops, send_s, recv_s, comb_s]
+        self._agg: Dict[int, List[float]] = {}
+
+    def record(
+        self,
+        tier: int,
+        lane: int,
+        tag: int,
+        send_s: float,
+        recv_s: float,
+        comb_s: float,
+        nbytes: int,
+        ts: float,
+    ) -> None:
+        with self._lock:
+            agg = self._agg.get(tier)
+            if agg is None:
+                agg = self._agg[tier] = [0, 0.0, 0.0, 0.0]
+            agg[0] += 1
+            agg[1] += send_s
+            agg[2] += recv_s
+            agg[3] += comb_s
+            if self.sample <= 0:
+                return
+            n = self._count
+            self._count = n + 1
+            if n % self.sample:
+                return
+            self._ring.append(
+                {
+                    "ts": ts,
+                    "tier": tier,
+                    "lane": lane,
+                    "tag": tag,
+                    "send_s": send_s,
+                    "recv_s": recv_s,
+                    "comb_s": comb_s,
+                    "nbytes": nbytes,
+                }
+            )
+
+    def stats(self, tier: int) -> dict:
+        """Aggregate stall counters for one tier (same keys as the native
+        engine's ``hop_stats``)."""
+        with self._lock:
+            agg = self._agg.get(tier, [0, 0.0, 0.0, 0.0])
+            return {
+                "hops": int(agg[0]),
+                "send_block_s": agg[1],
+                "recv_wait_s": agg[2],
+                "combine_s": agg[3],
+            }
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def keep(self, rec: dict) -> None:
+        """Appends an already-recorded hop (e.g. the native engine's
+        timeline, banked before the engine is torn down) WITHOUT touching
+        the aggregates — it was aggregated where it was recorded."""
+        with self._lock:
+            self._ring.append(rec)
+
+    def reset_aggregates(self) -> None:
+        """Zeroes the aggregate counters, KEEPING the timeline ring: the
+        aggregates are banked into lane_totals at abort (re-reading them
+        would double-count), but the timeline is the data-plane black box
+        — wiping it at abort would empty the hop dump on exactly the
+        fault paths it exists to explain."""
+        with self._lock:
+            self._agg = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._agg = {}
+            self._count = 0
 
 
 class _Peer:
@@ -788,6 +951,19 @@ class TCPCollective(Collective):
         self._op_seq_lock = threading.Lock()
         # In-flight striped-op result futures, failed fast on abort().
         self._inflight: set = set()
+        # Data-plane flight recorder (shared by both engines' Python-
+        # orchestrated hops; native ring passes record inside ring.cc and
+        # are merged in lane_stats/hop_records).  Reset per configure(),
+        # like the lane byte counters.
+        self._hops = HopRecorder()
+        # Lifetime (cross-configure) counter bank: lane/hop counters zero
+        # on every configure(), so any cumulative exposition (the worker
+        # /metrics endpoint) would go BACKWARDS across a reconfigure.
+        # abort() banks the closing generation's totals here;
+        # lane_totals() = banked + live, monotonic by construction (the
+        # same reset-aware epoch logic obs.report.data_plane applies to
+        # step_summary snapshots, applied at the source).
+        self._lifetime: Dict[str, object] = {}
         self._peers: dict[int, _Peer] = {}
         self._accept_cond = threading.Condition()
         self._accept_thread: Optional[threading.Thread] = None
@@ -847,6 +1023,10 @@ class TCPCollective(Collective):
             # done(); fresh turnstiles avoid cross-generation waits.
             with self._fifo_lock:
                 self._fifo = {}
+            # Hop AGGREGATES are per-configure like the lane byte counters
+            # (abort() just banked the closing generation's totals and
+            # reset them; the timeline ring persists across generations —
+            # it is the bounded black box, not a counter).
             if world_size == 1:
                 return
             self._store = StoreClient(store_addr)
@@ -938,6 +1118,12 @@ class TCPCollective(Collective):
             if self._engine_mode == "native":
                 _warn_native_fallback(f"engine construction failed: {e}")
             return None
+        # The engine's hop recorder follows this collective's sampling /
+        # ring-capacity config so both engines' timelines are comparable.
+        try:
+            eng.set_hop(self._hops.sample, self._hops.cap)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         # Re-point the byte-accounting surface at the native counters so
         # lane_stats, the shaped-link byte assertions, and the Manager's
         # GB/s telemetry are engine-agnostic.
@@ -953,10 +1139,21 @@ class TCPCollective(Collective):
             for direction, peers in ((0, nexts), (1, prevs)):
                 shaper = peers[0].shaper if peers else None
                 if shaper is not None:
-                    shaper._native_read = (
-                        lambda eng=eng, tid=tid, d=direction: eng.shaper_counters(tid, d)
-                    )
+                    self._wire_native_shaper_hooks(eng, shaper, tid, direction)
         return eng
+
+    @staticmethod
+    def _wire_native_shaper_hooks(eng, shaper: LinkShaper, tid: int, direction: int) -> None:
+        """Points one LinkShaper's byte/wait reads at the native engine's
+        pacer counters — the ONE wiring used at engine creation and by
+        set_link_shaping's lazy attach, so the hook shape cannot drift
+        between the two paths."""
+        shaper._native_read = (
+            lambda eng=eng, tid=tid, d=direction: eng.shaper_counters(tid, d)
+        )
+        shaper._native_wait = (
+            lambda eng=eng, tid=tid, d=direction: eng.shaper_wait_s(tid, d)
+        )
 
     # Channel ids in the 12-byte connection preamble (rank, channel, lane).
     # _CH_ROW/_CH_COL are the 2D topology's tier rings — distinct channels
@@ -1187,6 +1384,12 @@ class TCPCollective(Collective):
         with self._lock:
             if self._error is None:
                 self._error = RuntimeError("collective aborted")
+            # Bank the closing generation's wire/hop counters BEFORE the
+            # lanes are torn down: lane_stats zeroes on every configure(),
+            # and the cumulative exposition (lane_totals / the worker
+            # /metrics endpoint) must never go backwards.  The native
+            # engine is still alive here, so its counters are readable.
+            self._bank_locked()
             with self._accept_cond:
                 peers = list(self._peers.values()) + list(self._accepted_ring.values())
                 self._peers = {}
@@ -1303,6 +1506,233 @@ class TCPCollective(Collective):
         report what actually runs."""
         return self._active_topology
 
+    def _tier_id(self, tier: Optional[_TierLinks]) -> int:
+        """The native-engine tier id (0 flat / 1 row / 2 col) for a ring
+        loop's ``tier`` argument — the tier key hop records carry."""
+        if tier is None:
+            return 0
+        return 1 if tier is self._row_tier else 2
+
+    def _record_hop(self, tier: Optional[_TierLinks], lane: int, tag: int,
+                    hop: dict, comb_s: float = 0.0) -> None:
+        """Commits one Python-orchestrated hop (the dict ``_exchange``
+        filled) into the recorder."""
+        self._hops.record(
+            self._tier_id(tier),
+            lane,
+            tag,
+            hop.get("send_s", 0.0),
+            hop.get("recv_s", 0.0),
+            comb_s,
+            hop.get("nbytes", 0),
+            hop.get("ts", 0.0),
+        )
+
+    def _hop_stats_tier(self, tier_id: int) -> dict:
+        """Merged per-tier hop aggregates: Python-orchestrated hops from
+        the local recorder plus (under the native engine) the ring passes
+        recorded inside ring.cc — ONE engine-agnostic surface."""
+        s = self._hops.stats(tier_id)
+        eng = self._engine
+        if eng is not None:
+            try:
+                ns = eng.hop_stats(tier_id)
+                s = {k: s[k] + ns[k] for k in s}
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        return s
+
+    def _tier_shape_s(self, tier: Optional[_TierLinks]) -> float:
+        """Shaping sleep charged to one tier's next direction (sends pace
+        outbound only)."""
+        peers = tier.next_lanes if tier is not None else self._next_lanes
+        shaper = peers[0].shaper if peers else None
+        return float(shaper.wait_s) if shaper is not None else 0.0
+
+    def hop_records(self) -> List[dict]:
+        """The retained data-plane hop timeline (both engines' records
+        merged, oldest first) — dicts with exactly HOP_RECORD_FIELDS.
+        Bounded by TPUFT_HOP_RING per engine; sampled per
+        TPUFT_HOP_SAMPLE.  ``obs/trace.py`` renders this as the per-lane
+        data-plane Perfetto track."""
+        recs = self._hops.records()
+        eng = self._engine
+        if eng is not None:
+            try:
+                recs = recs + eng.hop_records(self._hops.cap)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        recs.sort(key=lambda r: r.get("ts", 0.0))
+        return recs
+
+    def _live_counters(self) -> dict:
+        """Current-generation cumulative counters in lane_totals' shape."""
+        tiers: Dict[str, dict] = {}
+        hops: Dict[str, dict] = {}
+        specs = [("flat", None, self._next_lanes, self._prev_lanes)]
+        for name, tier in (("row", self._row_tier), ("col", self._col_tier)):
+            if tier is not None:
+                specs.append((name, tier, tier.next_lanes, tier.prev_lanes))
+        for name, tier, nexts, prevs in specs:
+            tiers[name] = {
+                "sent_bytes": sum(p.bytes_out for p in list(nexts)),
+                "recv_bytes": sum(p.bytes_in for p in list(prevs)),
+            }
+            tid = self._tier_id(tier)
+            hops[name] = dict(self._hop_stats_tier(tid))
+            hops[name]["shape_s"] = self._tier_shape_s(tier)
+        return {
+            "sent_bytes": sum(t["sent_bytes"] for t in tiers.values()),
+            "recv_bytes": sum(t["recv_bytes"] for t in tiers.values()),
+            "tiers": tiers,
+            "hops": hops,
+        }
+
+    def _bank_locked(self) -> None:
+        """Folds the current generation's counters into the lifetime bank
+        (caller holds _lock; called by abort() before lane teardown)."""
+        if not self._next_lanes:
+            return  # nothing configured this generation
+        try:
+            live = self._live_counters()
+        except Exception:  # noqa: BLE001 — telemetry must not fail abort
+            return
+        bank = self._lifetime
+        bank["reconfigures"] = int(bank.get("reconfigures", 0)) + 1
+        bank["sent_bytes"] = int(bank.get("sent_bytes", 0)) + live["sent_bytes"]
+        bank["recv_bytes"] = int(bank.get("recv_bytes", 0)) + live["recv_bytes"]
+        tiers = bank.setdefault("tiers", {})
+        for name, t in live["tiers"].items():
+            slot = tiers.setdefault(name, {"sent_bytes": 0, "recv_bytes": 0})
+            slot["sent_bytes"] += t["sent_bytes"]
+            slot["recv_bytes"] += t["recv_bytes"]
+        hops = bank.setdefault("hops", {})
+        for name, h in live["hops"].items():
+            slot = hops.setdefault(
+                name,
+                {"hops": 0, "send_block_s": 0.0, "recv_wait_s": 0.0,
+                 "combine_s": 0.0, "shape_s": 0.0},
+            )
+            for k in slot:
+                slot[k] += h.get(k, 0)
+        # The native engine (and its hop timeline) dies with this
+        # generation — fold its retained records into the Python ring so
+        # a post-abort dump (Manager shutdown after a fault) still holds
+        # the hops leading up to the failure.
+        eng = self._engine
+        if eng is not None:
+            try:
+                for rec in eng.hop_records(self._hops.cap):
+                    self._hops.keep(rec)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        # The recorder's AGGREGATES are now IN the bank; without this
+        # reset a lane_totals() read in the abort->configure window (or
+        # after shutdown, forever) would add them a second time — the
+        # banked hops would read ~2x and then DROP later, the exact
+        # backwards-counter regression the bank exists to prevent.  The
+        # TIMELINE stays: it is never summed into the bank, and it is the
+        # black box the fault-path hop dump reads.  (The byte counters
+        # need no equivalent: the peers carrying them are cleared by
+        # abort() itself.)
+        self._hops.reset_aggregates()
+
+    def lane_totals(self) -> dict:
+        """MONOTONIC cumulative wire/hop counters across reconfigures:
+        the lifetime bank (every closed generation, banked at abort())
+        plus the live generation.  This is what any scrape-visible
+        exposition of lane counters must read — ``lane_stats()`` resets on
+        every configure(), so exporting it directly would show Prometheus
+        counters going backwards across quorum reconfigurations.
+
+        Never blocks a scrape on the collective's big lock: configure()
+        holds it across the full network rendezvous (up to the connect
+        timeout when a peer is dead — exactly the fault windows telemetry
+        exists to explain), so a contended read degrades to the BANK-ONLY
+        snapshot (last closed generations; monotonic, slightly stale)
+        instead of hanging the /metrics endpoint."""
+        acquired = self._lock.acquire(timeout=0.5)
+        try:
+            bank = self._lifetime
+            if not acquired:
+                live = {"sent_bytes": 0, "recv_bytes": 0, "tiers": {}, "hops": {}}
+            else:
+                try:
+                    live = self._live_counters()
+                except Exception:  # noqa: BLE001
+                    live = {"sent_bytes": 0, "recv_bytes": 0, "tiers": {},
+                            "hops": {}}
+            out = {
+                "reconfigures": int(bank.get("reconfigures", 0)),
+                "sent_bytes": int(bank.get("sent_bytes", 0)) + live["sent_bytes"],
+                "recv_bytes": int(bank.get("recv_bytes", 0)) + live["recv_bytes"],
+                "tiers": {},
+                "hops": {},
+            }
+            names = set(live["tiers"]) | set(bank.get("tiers", {}))
+            for name in names:
+                b = (bank.get("tiers") or {}).get(name, {})
+                l = live["tiers"].get(name, {})
+                out["tiers"][name] = {
+                    "sent_bytes": int(b.get("sent_bytes", 0)) + int(l.get("sent_bytes", 0)),
+                    "recv_bytes": int(b.get("recv_bytes", 0)) + int(l.get("recv_bytes", 0)),
+                }
+            names = set(live["hops"]) | set(bank.get("hops", {}))
+            for name in names:
+                b = (bank.get("hops") or {}).get(name, {})
+                l = live["hops"].get(name, {})
+                out["hops"][name] = {
+                    k: (b.get(k, 0) or 0) + (l.get(k, 0) or 0)
+                    for k in ("hops", "send_block_s", "recv_wait_s",
+                              "combine_s", "shape_s")
+                }
+            return out
+        finally:
+            if acquired:
+                self._lock.release()
+
+    def set_link_shaping(self, mbps: float, rtt_ms: float,
+                         direction: str = "next", tier: str = "flat") -> None:
+        """Re-shapes ONE peer direction's modeled link mid-run, in
+        whichever engine owns the pacing — the slow-link bench's
+        fault injector (a real deployment's analogue is the physical link
+        degrading; no reconfigure happens either way)."""
+        tid = {"flat": 0, "row": 1, "col": 2}[tier]
+        t = {"flat": None, "row": self._row_tier, "col": self._col_tier}[tier]
+        if t is None:
+            peers = self._next_lanes if direction == "next" else self._prev_lanes
+        else:
+            peers = t.next_lanes if direction == "next" else t.prev_lanes
+        shared: Optional[LinkShaper] = None
+        for p in peers:
+            if p.shaper is None:
+                # mbps <= 0 means "disable pacing"; with no shaper attached
+                # there is nothing to disable — and constructing one with a
+                # zero rate would divide the next send by zero.
+                if mbps <= 0:
+                    continue
+                if shared is None:
+                    shared = LinkShaper(mbps, rtt_ms)
+                p.shaper = shared
+            else:
+                p.shaper.set_rate(mbps, rtt_ms)
+        eng = self._engine
+        if eng is not None:
+            d = 0 if direction == "next" else 1
+            try:
+                eng.set_shaper(tid, d, mbps, rtt_ms)
+                # A collective configured UNSHAPED never wired the
+                # native-counter hooks (_create_engine only hooks shapers
+                # that existed at configure) — without them the freshly
+                # attached Python shaper would read its own zeros while
+                # the native pacer does the sleeping, and the shaping
+                # bucket of link_attribution would silently read 0.
+                sh = peers[0].shaper if peers else None
+                if sh is not None and sh._native_wait is None:
+                    self._wire_native_shaper_hooks(eng, sh, tid, d)
+            except Exception:  # noqa: BLE001
+                pass
+
     def lane_stats(self) -> dict:
         """Per-lane wire-byte counters for the current configuration:
         ``{"lanes": L, "topology": ..., "sent": [bytes per next-lane],
@@ -1330,6 +1760,17 @@ class TCPCollective(Collective):
                 }
         if tiers:
             out["tiers"] = tiers
+        # Data-plane hop telemetry: per-tier stall aggregates (both
+        # engines merged) + shaping sleep — rides step_summary's
+        # allreduce_lanes into obs.report's link_attribution split and the
+        # Manager's per-neighbor link health estimate.
+        hops = {"flat": dict(self._hop_stats_tier(0))}
+        hops["flat"]["shape_s"] = self._tier_shape_s(None)
+        for name, tier in (("row", self._row_tier), ("col", self._col_tier)):
+            if tier is not None:
+                hops[name] = dict(self._hop_stats_tier(self._tier_id(tier)))
+                hops[name]["shape_s"] = self._tier_shape_s(tier)
+        out["hops"] = hops
         return out
 
     # Wire codecs this collective's allreduce accepts (see WIRE_CODECS).
@@ -1410,7 +1851,8 @@ class TCPCollective(Collective):
         )
 
     def _exchange(self, tag: int, payload, lane: int = 0,
-                  tier: Optional[_TierLinks] = None) -> bytes:
+                  tier: Optional[_TierLinks] = None,
+                  hop: Optional[dict] = None) -> bytes:
         """Sends to the next neighbor while receiving from the previous one,
         on the given lane's socket pair (of the flat ring, or of ``tier``
         when a 2D tier ring is passed).  Full-duplex is required: with
@@ -1419,7 +1861,19 @@ class TCPCollective(Collective):
         persistent sender worker — a striped allreduce makes hundreds of
         hops per op, and a fresh thread per hop is pure scheduler churn.
         One worker per lane serializes sends exactly like the peer's
-        send_lock already does, so ordering is unchanged."""
+        send_lock already does, so ordering is unchanged.
+
+        ``hop`` (optional, a mutable dict) is filled with the hop's
+        timing split — ``ts`` (wall clock at start), ``recv_s`` (blocked
+        on the inbound frame), ``send_s`` (additional wait joining the
+        send after the recv returned), ``nbytes`` (payload bytes sent) —
+        the data-plane flight recorder's feed.  Over the native socket
+        layer the engine's exchange blocks for recv AND send together, so
+        the whole wait lands in ``recv_s`` (documented coarse split for
+        Python-orchestrated control ops; the ring hot loop's native hops
+        are split natively inside ring.cc)."""
+        if hop is not None:
+            hop["ts"] = time.time()
         engine = self._engine
         if engine is not None:
             # Native path: the engine's per-link sender thread + demux do
@@ -1431,7 +1885,13 @@ class TCPCollective(Collective):
                 payload = b"".join(bytes(p) for p in payload)
             elif not isinstance(payload, bytes):
                 payload = bytes(payload)
-            return engine.exchange(tier_id, lane, tag, payload, self._timeout)
+            t0 = time.monotonic()
+            out = engine.exchange(tier_id, lane, tag, payload, self._timeout)
+            if hop is not None:
+                hop["recv_s"] = time.monotonic() - t0
+                hop["send_s"] = 0.0
+                hop["nbytes"] = len(payload)
+            return out
         if tier is not None:
             nxt = tier.next_lanes[lane]
             prv = tier.prev_lanes[lane]
@@ -1444,12 +1904,23 @@ class TCPCollective(Collective):
             raise RuntimeError("collective aborted")
         if isinstance(payload, (bytes, bytearray)):
             payload = memoryview(payload)
+        nbytes = (
+            sum(len(p) for p in payload)
+            if isinstance(payload, (list, tuple))
+            else len(payload)
+        )
         sent = pools[lane].submit(nxt.send_msg, tag, payload)
         # A recv error propagates as-is (matching the old join-then-drop
         # behavior); the in-flight send fails on its own when _fail_ring /
         # abort closes the lane sockets.
+        t0 = time.monotonic()
         received = prv.recv_msg(tag)
+        t1 = time.monotonic()
         sent.result(timeout=self._timeout)
+        if hop is not None:
+            hop["recv_s"] = t1 - t0
+            hop["send_s"] = time.monotonic() - t1
+            hop["nbytes"] = nbytes
         return received
 
     @property
@@ -1727,10 +2198,17 @@ class TCPCollective(Collective):
         for step in range(n - 1):
             send_idx = (rank - step) % n
             recv_idx = (rank - step - 1) % n
-            incoming = decode(
-                self._exchange(tag_base + rs_sub, encode(chunks[send_idx]), lane, tier)
+            hop: dict = {}
+            raw = self._exchange(
+                tag_base + rs_sub, encode(chunks[send_idx]), lane, tier, hop=hop
             )
+            t_comb = time.monotonic()
+            incoming = decode(raw)
             chunks[recv_idx] = combine(chunks[recv_idx], incoming)
+            self._record_hop(
+                tier, lane, tag_base + rs_sub, hop,
+                comb_s=time.monotonic() - t_comb,
+            )
 
         return self._ring_ag_phase(
             chunks, wire, acc_dtype, lane, tag_base + ag_sub, tier, codec=codec
@@ -1771,16 +2249,21 @@ class TCPCollective(Collective):
             for step in range(n - 1):
                 send_idx = (rank - step + 1) % n
                 recv_idx = (rank - step) % n
+                hop: dict = {}
                 raw_chunks[recv_idx] = self._exchange(
-                    tag, memoryview(cast(bytes, raw_chunks[send_idx])), lane, tier
+                    tag, memoryview(cast(bytes, raw_chunks[send_idx])), lane, tier,
+                    hop=hop,
                 )
+                self._record_hop(tier, lane, tag, hop)
             return [decode(cast(bytes, raw_chunks[i])) for i in range(n)]
         for step in range(n - 1):
             send_idx = (rank - step + 1) % n
             recv_idx = (rank - step) % n
+            hop2: dict = {}
             chunks[recv_idx] = decode(
-                self._exchange(tag, encode(chunks[send_idx]), lane, tier)
+                self._exchange(tag, encode(chunks[send_idx]), lane, tier, hop=hop2)
             ).copy()
+            self._record_hop(tier, lane, tag, hop2)
         return chunks
 
     def _hier_rs_ag_flat(
@@ -1821,10 +2304,17 @@ class TCPCollective(Collective):
         for step in range(C - 1):
             send_idx = (crank - step) % C
             recv_idx = (crank - step - 1) % C
-            incoming = decode(
-                self._exchange(tag_base + _SUB_RS, encode(chunks[send_idx]), lane, row)
+            hop: dict = {}
+            raw = self._exchange(
+                tag_base + _SUB_RS, encode(chunks[send_idx]), lane, row, hop=hop
             )
+            t_comb = time.monotonic()
+            incoming = decode(raw)
             chunks[recv_idx] = combine(chunks[recv_idx], incoming)
+            self._record_hop(
+                row, lane, tag_base + _SUB_RS, hop,
+                comb_s=time.monotonic() - t_comb,
+            )
         own = (crank + 1) % C
 
         # Phase 2: column allreduce of the owned row chunk, on the column

@@ -4,10 +4,53 @@ Reference parity: torchft/http.py:5-7.
 """
 
 import socket
-from http.server import ThreadingHTTPServer
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
 
 
 class ThreadingHTTPServerV6(ThreadingHTTPServer):
     address_family = socket.AF_INET6
     request_queue_size = 1024
     daemon_threads = True
+
+
+def serve_text_exposition(
+    render: Callable[[], str],
+    port: int,
+    bind: str = "::1",
+    path: str = "/metrics",
+    thread_name: str = "tpuft_metrics",
+) -> Optional[ThreadingHTTPServerV6]:
+    """Starts a daemon HTTP server answering ``GET <path>`` with
+    ``render()``'s text (Prometheus exposition content type) — THE shared
+    scaffolding of every Python-side metrics endpoint, so v6 handling and
+    accept-queue behavior cannot drift between them.  ``bind`` defaults to
+    loopback: the endpoints are unauthenticated, so wider binds are an
+    explicit operator choice.  Returns the server (its bound port is
+    ``server.server_address[1]``) or None on any failure — metrics must
+    never be able to fail training."""
+    try:
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API
+                if self.path != path:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr
+                pass
+
+        server = ThreadingHTTPServerV6((bind, port), Handler)
+        threading.Thread(
+            target=server.serve_forever, name=thread_name, daemon=True
+        ).start()
+        return server
+    except Exception:  # noqa: BLE001 — see docstring
+        return None

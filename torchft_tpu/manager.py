@@ -27,6 +27,7 @@ thread while JAX async dispatch keeps device compute running.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import socket
@@ -289,6 +290,18 @@ class Manager:
         # shows the scatter-back cost the allreduce_h2d span charges.
         self._d2h_bytes = 0
         self._h2d_bytes = 0
+        # Lifetime (monotonic) transfer totals for the worker /metrics
+        # endpoint — the per-step fields above reset at start_quorum.
+        self._d2h_bytes_total = 0
+        self._h2d_bytes_total = 0
+        # Per-neighbor link health (docs/architecture.md "Data-plane
+        # observability"): EWMA goodput + hop RTT derived at each commit
+        # from the ring engines' hop-telemetry deltas, pushed on heartbeat
+        # fields 11-13 for the lighthouse's slow-link sentinel.  The
+        # previous cumulative snapshot closes each step's delta window;
+        # reset-aware (lane counters zero per configure()).
+        self._link_prev: Optional[Dict[str, float]] = None
+        self._link_ewma: Dict[str, float] = {}
         # Extra fields wrappers note onto the step in flight's step_summary
         # (note_summary_fields) — the semisync engine's per-round fragment
         # counts and wire bytes ride here.  Cleared with the other per-step
@@ -340,6 +353,22 @@ class Manager:
         )
         self._heal_failures = 0
         self._ec_enqueued_step = -1
+
+        # Unified worker /metrics endpoint (obs/prom.py): step pace,
+        # transfer totals, monotonic lane/hop counters (lane_totals), the
+        # link-health EWMAs, plus any subsystem sections (the semisync
+        # plane registers its tpuft_semisync_* render here).  Pull-based:
+        # the provider snapshot runs at SCRAPE time, so training pays
+        # nothing while nobody scrapes.  serve() is a no-op unless
+        # TPUFT_WORKER_METRICS_PORT (or the deprecated
+        # TPUFT_SEMISYNC_METRICS_PORT alias) is set.
+        from torchft_tpu.obs.prom import WorkerMetrics
+
+        self._worker_metrics = WorkerMetrics(
+            replica_id=self._replica_id,
+            provider=self._worker_metrics_snapshot,
+        )
+        self._worker_metrics.serve()
 
         self._wire_transport_spans()
 
@@ -1064,6 +1093,7 @@ class Manager:
         the ~2x reduction the bench pins."""
         with self._ar_lock:
             self._d2h_bytes += int(nbytes)
+            self._d2h_bytes_total += int(nbytes)
 
     def note_h2d(self, nbytes: int) -> None:
         """Adds host->device scatter-back bytes to the step in flight's
@@ -1071,6 +1101,7 @@ class Manager:
         half of the round-trip the ``allreduce_h2d`` span charges."""
         with self._ar_lock:
             self._h2d_bytes += int(nbytes)
+            self._h2d_bytes_total += int(nbytes)
 
     def note_summary_fields(self, **fields: object) -> None:
         """Merges extra fields into the step in flight's ``step_summary``
@@ -1108,6 +1139,172 @@ class Manager:
         torchft/manager.py:95-97)."""
         return self._timeout
 
+    # -- link health (docs/architecture.md "Data-plane observability") ------
+
+    _LINK_ALPHA = 0.5
+
+    def _observe_link(self, lanes: dict) -> Dict[str, float]:
+        """One per-step link-health observation from the lane_stats
+        snapshot's hop aggregates: deltas against the previous snapshot
+        give this step's send-blocked / recv-wait seconds and wire bytes,
+        from which the per-neighbor goodput estimates follow —
+
+        * ``link_send_gbps`` = sent bytes per second of send-BLOCKED time,
+          the localizing signal (only the degraded edge's sender blocks;
+          downstream recv-waits equalize around the lockstep ring);
+        * ``link_recv_gbps`` = received bytes per second of recv-wait;
+        * ``link_hop_rtt_ms`` = mean recv-wait per hop.
+
+        EWMA'd (alpha 0.5, like the step-time stats) and returned as the
+        step_summary / heartbeat fields; {} when the step moved no ring
+        traffic or a reconfigure reset the counters mid-window."""
+        hops = lanes.get("hops") or {}
+        sent = float(sum(lanes.get("sent") or []))
+        recv = float(sum(lanes.get("recv") or []))
+        for t in (lanes.get("tiers") or {}).values():
+            sent += sum(t.get("sent") or [])
+            recv += sum(t.get("recv") or [])
+        cur = {
+            "sent": sent,
+            "recv": recv,
+            "send_block": float(
+                sum(h.get("send_block_s", 0.0) for h in hops.values())
+            ),
+            "recv_wait": float(
+                sum(h.get("recv_wait_s", 0.0) for h in hops.values())
+            ),
+            "hops": float(sum(h.get("hops", 0) for h in hops.values())),
+        }
+        prev, self._link_prev = self._link_prev, cur
+        if prev is None or cur["hops"] < prev["hops"]:
+            # First window, or the counters reset under us (reconfigure).
+            return {}
+        d = {k: cur[k] - prev[k] for k in cur}
+        if d["hops"] <= 0 or (d["sent"] <= 0 and d["recv"] <= 0):
+            return {}
+        # A healthy link's send-blocked time is near zero (sends complete
+        # into kernel buffers) — dividing by it would yield an estimate
+        # that is pure scheduler noise, and noise RATIOS between healthy
+        # peers are unbounded (the false-alert mode the bench's control
+        # cell pins at zero).  Below a 5 ms-per-window floor the estimate
+        # SATURATES: lockstep peers move identical bytes per step, so all
+        # healthy readings collapse to the same floored value (ratio 1.0
+        # by construction) while a genuinely blocked sender's seconds of
+        # send-block dominate the floor and read as the true goodput.
+        floor_s = 5e-3
+        cap = 1e4
+        send_gbps = min(d["sent"] / 1e9 / max(d["send_block"], floor_s), cap)
+        recv_gbps = min(d["recv"] / 1e9 / max(d["recv_wait"], floor_s), cap)
+        rtt_ms = d["recv_wait"] / d["hops"] * 1e3
+        ew = self._link_ewma
+        a = self._LINK_ALPHA
+        for key, obs in (
+            ("recv_gbps", recv_gbps),
+            ("send_gbps", send_gbps),
+            ("rtt_ms", rtt_ms),
+        ):
+            ew[key] = obs if key not in ew else a * obs + (1 - a) * ew[key]
+        return {
+            "link_recv_gbps": round(ew["recv_gbps"], 4),
+            "link_send_gbps": round(ew["send_gbps"], 4),
+            "link_hop_rtt_ms": round(ew["rtt_ms"], 3),
+        }
+
+    @property
+    def worker_metrics(self):
+        """The unified worker ``/metrics`` endpoint
+        (:class:`~torchft_tpu.obs.prom.WorkerMetrics`).  Public so
+        subsystems with their own exposition (the semisync engine)
+        register a section here instead of opening a second port."""
+        return self._worker_metrics
+
+    def _worker_metrics_snapshot(self):
+        """Series provider for the worker /metrics endpoint — called at
+        SCRAPE time, never on the training path."""
+        series = []
+
+        def g(name, help_, value, kind="gauge", labels=()):
+            series.append((name, kind, help_, labels, value))
+
+        g("tpuft_worker_step", "current training step", self._step)
+        snap = self._step_stats.snapshot()
+        g(
+            "tpuft_worker_step_time_ms_ewma",
+            "rolling per-step busy-time EWMA, ms",
+            snap["ewma"],
+        )
+        with self._ar_lock:
+            d2h, h2d = self._d2h_bytes_total, self._h2d_bytes_total
+        g(
+            "tpuft_worker_d2h_bytes_total",
+            "device->host fetch bytes (lifetime)", d2h, kind="counter",
+        )
+        g(
+            "tpuft_worker_h2d_bytes_total",
+            "host->device scatter-back bytes (lifetime)", h2d, kind="counter",
+        )
+        lane_totals = getattr(self._collective, "lane_totals", None)
+        if callable(lane_totals):
+            try:
+                lt = lane_totals()
+            except Exception:  # noqa: BLE001
+                lt = None
+            if lt:
+                g(
+                    "tpuft_worker_reconfigures_total",
+                    "collective reconfigurations banked", lt["reconfigures"],
+                    kind="counter",
+                )
+                # Metric-major so each series family renders contiguous
+                # (Prometheus text-format convention).
+                tiers = sorted((lt.get("tiers") or {}).items())
+                for tname, t in tiers:
+                    g(
+                        "tpuft_worker_lane_sent_bytes_total",
+                        "ring wire bytes sent per tier (monotonic across "
+                        "reconfigures — banked at the source)",
+                        t["sent_bytes"], kind="counter",
+                        labels=(("tier", tname),),
+                    )
+                for tname, t in tiers:
+                    g(
+                        "tpuft_worker_lane_recv_bytes_total",
+                        "ring wire bytes received per tier (monotonic)",
+                        t["recv_bytes"], kind="counter",
+                        labels=(("tier", tname),),
+                    )
+                hop_tiers = sorted((lt.get("hops") or {}).items())
+                for tname, h in hop_tiers:
+                    g(
+                        "tpuft_worker_hops_total",
+                        "ring hops per tier (monotonic)", h["hops"],
+                        kind="counter", labels=(("tier", tname),),
+                    )
+                for key, metric in (
+                    ("send_block_s", "tpuft_worker_hop_send_block_seconds_total"),
+                    ("recv_wait_s", "tpuft_worker_hop_recv_wait_seconds_total"),
+                    ("combine_s", "tpuft_worker_hop_combine_seconds_total"),
+                    ("shape_s", "tpuft_worker_hop_shaping_seconds_total"),
+                ):
+                    for tname, h in hop_tiers:
+                        g(
+                            metric,
+                            "per-hop stall seconds per tier (monotonic)",
+                            round(float(h.get(key, 0.0)), 6), kind="counter",
+                            labels=(("tier", tname),),
+                        )
+        ew = self._link_ewma
+        if ew:
+            g("tpuft_link_recv_gbps",
+              "inbound ring-edge goodput EWMA (worker-side view)",
+              round(ew.get("recv_gbps", 0.0), 4))
+            g("tpuft_link_send_gbps",
+              "outbound ring-edge goodput EWMA (worker-side view)",
+              round(ew.get("send_gbps", 0.0), 4))
+            g("tpuft_link_hop_rtt_ms", "mean per-hop recv-wait, ms",
+              round(ew.get("rtt_ms", 0.0), 3))
+        return series
+
     # -- status -------------------------------------------------------------
 
     def _set_status(self, state: str) -> None:
@@ -1132,6 +1329,7 @@ class Manager:
                 # k rides along so the lighthouse coverage sentinel can
                 # page at coverage < k + 1 without its own EC config.
                 ec_k = self._ec.config.k
+            lk = self._link_ewma
             srv.set_status(
                 self._step,
                 state,
@@ -1141,6 +1339,9 @@ class Manager:
                 ec_held,
                 ec_step,
                 ec_k,
+                lk.get("recv_gbps", -1.0),
+                lk.get("send_gbps", -1.0),
+                lk.get("rtt_ms", -1.0),
             )
         except Exception:  # noqa: BLE001
             pass
@@ -1213,7 +1414,12 @@ class Manager:
             lane_stats = getattr(self._collective, "lane_stats", None)
             if callable(lane_stats):
                 try:
-                    ar_fields["allreduce_lanes"] = lane_stats()
+                    lanes_snap = lane_stats()
+                    ar_fields["allreduce_lanes"] = lanes_snap
+                    # Per-neighbor link health from this step's hop-stall
+                    # deltas (rides step_summary AND heartbeat fields
+                    # 11-13 — the slow-link sentinel's feed).
+                    ar_fields.update(self._observe_link(lanes_snap))
                 except Exception:  # noqa: BLE001 — telemetry only
                     pass
 
@@ -1470,6 +1676,30 @@ class Manager:
     def collective(self) -> Collective:
         return self._collective
 
+    def _dump_hops(self) -> None:
+        """Writes the collective's retained hop timeline to
+        ``$TPUFT_HOP_DUMP_DIR/hops_<replica_id>.json`` (best-effort; the
+        dump must never fail shutdown).  Records carry wall-clock ``ts``,
+        so the trace export time-aligns them with the span stream."""
+        dump_dir = os.environ.get("TPUFT_HOP_DUMP_DIR", "")
+        if not dump_dir:
+            return
+        hop_records = getattr(self._collective, "hop_records", None)
+        if not callable(hop_records):
+            return
+        try:
+            records = hop_records()
+            path = os.path.join(
+                dump_dir,
+                f"hops_{self._replica_id.replace('/', '_').replace(':', '_')}.json",
+            )
+            with open(path, "w") as f:
+                json.dump(
+                    {"replica_id": self._replica_id, "records": records}, f
+                )
+        except Exception:  # noqa: BLE001
+            pass
+
     def shutdown(self) -> None:
         if self._drain_watcher is not None:
             try:
@@ -1477,6 +1707,13 @@ class Manager:
             except Exception:  # noqa: BLE001
                 pass
             self._drain_watcher = None
+        # Data-plane black box: like $TPUFT_FLIGHT_DIR's control-plane
+        # dumps, a departing worker leaves its retained hop timeline as
+        # hops_<replica_id>.json when TPUFT_HOP_DUMP_DIR is set —
+        # tools/trace_export.py collects these into the per-lane
+        # data-plane Perfetto track.
+        self._dump_hops()
+        self._worker_metrics.close()
         self._metrics.close()
         self._executor.shutdown(wait=True)
         if self._checkpoint_transport is not None:

@@ -70,6 +70,18 @@ EVENTS = {
              "into the stream so trace export and latency accounting see it",
     "straggler_drain": "launcher sentinel rotated a confirmed straggler out "
                        "through the cooperative-drain path",
+    # -- slow-link sentinel (native lighthouse + bench_allreduce.py) --------
+    "link_shaped": "bench driver degraded one peer direction's modeled "
+                   "link (mbps, rtt_ms, group=victim) — the data-plane "
+                   "fault the slow-link sentinel must localize",
+    "link_alert": "bench driver observed a slow_link alert on the "
+                  "lighthouse's /alerts.json (alert_id, src_replica_id, "
+                  "gbps, detection_rounds) — stamps detection into the "
+                  "stream for trace export and latency accounting",
+    # -- hop telemetry (ring engines, via hops_to_stream) -------------------
+    "hop": "one recorded ring hop (tier, lane, tag, send_s, recv_s, "
+           "comb_s, nbytes; ts = hop start) — the data-plane flight "
+           "recorder's timeline unit, merged from hops_*.json dumps",
     # -- erasure-coded peer state (torchft_tpu/ec) --------------------------
     "ec_push": "one committed step's shard generation encoded + placed "
                "(k, m, encode_ms, held, pushed parity count, push_errors) "

@@ -154,6 +154,18 @@ def fault_times(events: Sequence[dict]) -> List[Tuple[float, str]]:
     ]
 
 
+def _epoch_bank(slot: List[float], value: float) -> None:
+    """One observation of a CUMULATIVE-per-configure counter into a
+    ``[closed-epoch sum, current-epoch high-water mark]`` slot: a snapshot
+    below the previous one means the counter reset (a reconfigure), so the
+    old epoch's high-water mark is banked and a new epoch opens.  THE
+    reset-detection rule, shared by every rollup over lane/hop counters
+    (data_plane, link_attribution) so they cannot diverge."""
+    if value < slot[1]:  # counter reset: a reconfigure happened
+        slot[0] += slot[1]
+    slot[1] = value
+
+
 def data_plane(events: Sequence[dict]) -> dict:
     """Cross-topology data-plane rollup from step_summary records.
 
@@ -191,11 +203,7 @@ def data_plane(events: Sequence[dict]) -> dict:
                 tiers[name] = sum(tier.get("sent") or [])
             acc = tier_acc.setdefault(rid, {})
             for name, v in tiers.items():
-                slot = acc.setdefault(name, [0, 0])
-                v = int(v)
-                if v < slot[1]:  # counter reset: a reconfigure happened
-                    slot[0] += slot[1]
-                slot[1] = v
+                _epoch_bank(acc.setdefault(name, [0, 0]), int(v))
     tier_totals: Dict[str, int] = {}
     for tiers in tier_acc.values():
         for name, (closed, cur) in tiers.items():
@@ -205,6 +213,79 @@ def data_plane(events: Sequence[dict]) -> dict:
         "per_replica_payload_bytes": dict(sorted(payload.items())),
         "tier_wire_bytes": dict(sorted(tier_totals.items())),
         "topologies": sorted(topologies),
+    }
+
+
+def link_attribution(events: Sequence[dict]) -> dict:
+    """Data-plane wall attribution from the hop telemetry each
+    step_summary's ``allreduce_lanes["hops"]`` snapshot embeds: splits the
+    allreduce wall per replica into four classes —
+
+    * ``wire_s``   — send-blocked time net of modeled shaping (real
+      serialization/backpressure on the OUTBOUND edge: the localizing
+      signal when a link degrades),
+    * ``stall_s``  — recv-wait (blocked on the inbound edge: upstream
+      serialization + propagation + peer pace, the equalized symptom),
+    * ``combine_s`` — decode + elementwise combine (host CPU),
+    * ``shaping_s`` — time slept in the LinkShaper's virtual-time pacer
+      (bench-only modeled serialization; 0 on unshaped links).
+
+    The hop counters are CUMULATIVE per configure() and reset on every
+    quorum reconfiguration, so accumulation is epoch-banked exactly like
+    :func:`data_plane` (a snapshot below its predecessor closes the old
+    epoch).  ``fractions`` normalizes over the four classes' sum — the
+    bench's degraded cell pins that the added wall of a shaped edge lands
+    in wire+shaping/stall, not combine."""
+    keys = ("send_block_s", "recv_wait_s", "combine_s", "shape_s", "hops")
+    # rid -> key -> [closed-epoch sum, current-epoch high-water mark]
+    acc: Dict[str, Dict[str, List[float]]] = {}
+    for ev in events:
+        if ev.get("event") != "step_summary":
+            continue
+        lanes = ev.get("allreduce_lanes")
+        if not isinstance(lanes, dict):
+            continue
+        hops = lanes.get("hops")
+        if not isinstance(hops, dict):
+            continue
+        rid = str(ev.get("replica_id", ""))
+        cur = {k: 0.0 for k in keys}
+        for tier in hops.values():
+            for k in keys:
+                cur[k] += float(tier.get(k, 0) or 0)
+        slots = acc.setdefault(rid, {})
+        for k, v in cur.items():
+            _epoch_bank(slots.setdefault(k, [0.0, 0.0]), v)
+    per_replica: Dict[str, dict] = {}
+    totals = {"wire_s": 0.0, "stall_s": 0.0, "combine_s": 0.0, "shaping_s": 0.0}
+    for rid, slots in acc.items():
+        tot = {k: slots.get(k, [0.0, 0.0]) for k in keys}
+        v = {k: tot[k][0] + tot[k][1] for k in keys}
+        shaping = v["shape_s"]
+        wire = max(0.0, v["send_block_s"] - shaping)
+        row = {
+            "wire_s": round(wire, 4),
+            "stall_s": round(v["recv_wait_s"], 4),
+            "combine_s": round(v["combine_s"], 4),
+            "shaping_s": round(shaping, 4),
+            "hops": int(v["hops"]),
+        }
+        denom = wire + v["recv_wait_s"] + v["combine_s"] + shaping
+        row["fractions"] = {
+            k: (round(row[k] / denom, 4) if denom > 0 else None)
+            for k in ("wire_s", "stall_s", "combine_s", "shaping_s")
+        }
+        per_replica[rid] = row
+        for k in totals:
+            totals[k] += row[k]
+    denom = sum(totals.values())
+    return {
+        "per_replica": dict(sorted(per_replica.items())),
+        "totals": {k: round(v, 4) for k, v in totals.items()},
+        "fractions": {
+            k: (round(v / denom, 4) if denom > 0 else None)
+            for k, v in totals.items()
+        },
     }
 
 
@@ -638,6 +719,9 @@ def attribute(
         # Byte-level rollup (payload + per-tier wire), comparable across
         # ring/ring2d topologies — not a time-accounting class.
         "data_plane": data_plane(events),
+        # Hop-level wall attribution of the allreduce path (wire / stall /
+        # combine / shaping) from the ring engines' hop telemetry.
+        "link_attribution": link_attribution(events),
         "goodput": {
             "deadwindow_fraction": (
                 round(dw["fraction"], 4) if dw["fraction"] is not None else None

@@ -43,6 +43,9 @@ __all__ = [
     "validate_trace",
     "synthetic_stream",
     "synthetic_flight_stream",
+    "synthetic_hop_stream",
+    "hops_to_stream",
+    "load_hops_dump",
 ]
 
 # Track mapping for every registered span phase (obs/spans.py PHASES):
@@ -78,6 +81,8 @@ _INSTANT_EVENTS = (
     "drain_handoff",
     "drain_donor_exit",
     "alert",
+    "link_shaped",
+    "link_alert",
     "straggler_injected",
     "heal_start",
     "error",
@@ -127,19 +132,29 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
 
     spans = [ev for ev in events if ev.get("event") == "span"]
     instants = [ev for ev in events if ev.get("event") in _INSTANT_EVENTS]
+    # Data-plane hop records (the ring engines' flight recorder,
+    # hops_to_stream / hops_*.json dumps): rendered as per-(tier, lane)
+    # tracks inside the replica's process, time-aligned with its phase
+    # tracks — the view that shows whether comms actually overlap compute.
+    hops = [ev for ev in events if ev.get("event") == "hop"]
     # Control-plane stream (obs/flight.py flight_to_stream): RPC spans and
     # state instants from the native servers' flight recorders, rendered
     # on their own process next to the worker tracks.
     cp_rpcs = [ev for ev in events if ev.get("event") == "cp_rpc"]
     cp_instants = [ev for ev in events if ev.get("event") == "cp_event"]
-    if not spans and not instants and not cp_rpcs and not cp_instants:
+    if not spans and not instants and not hops and not cp_rpcs and not cp_instants:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
 
-    # Only span-emitting replicas get tracks; instants from anything else
-    # (the bench driver's fault schedule, the launcher) render on the
-    # global pid-0 lane instead of minting a phantom replica.
+    # Only span- or hop-emitting replicas get tracks; instants from
+    # anything else (the bench driver's fault schedule, the launcher)
+    # render on the global pid-0 lane instead of minting a phantom replica.
     first_seen: Dict[str, float] = {}
     for ev in spans:
+        rid = str(ev.get("replica_id", ""))
+        ts = corrected(ev)
+        if rid not in first_seen or ts < first_seen[rid]:
+            first_seen[rid] = ts
+    for ev in hops:
         rid = str(ev.get("replica_id", ""))
         ts = corrected(ev)
         if rid not in first_seen or ts < first_seen[rid]:
@@ -190,11 +205,30 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
         for i, (m, p) in enumerate(cp_lanes[s])
     }
 
+    # Data-plane lanes: one track per (replica, tier, lane) carrying hop
+    # slices, tid-spaced far above the phase/background pair so
+    # incarnation tids can never collide (odd so the validate rule "odd
+    # tids carry their own thread metadata" applies to them directly).
+    dp_lanes: Dict[str, List[Tuple[int, int]]] = {}
+    for ev in hops:
+        rid = str(ev.get("replica_id", ""))
+        if rid not in tid_of:
+            continue
+        key = (int(ev.get("tier", 0) or 0), int(ev.get("lane", 0) or 0))
+        lanes = dp_lanes.setdefault(rid, [])
+        if key not in lanes:
+            lanes.append(key)
+    dp_tid_of: Dict[Tuple[str, int, int], int] = {}
+    for rid, lanes in dp_lanes.items():
+        for i, (tier, lane) in enumerate(sorted(lanes)):
+            dp_tid_of[(rid, tier, lane)] = 100 * tid_of[rid] + 1 + 2 * i
+
     t0 = min(
         min(
             (corrected(ev) - float(ev.get("duration_ms", 0.0)) / 1e3 for ev in spans),
             default=float("inf"),
         ),
+        min((corrected(ev) for ev in hops), default=float("inf")),
         min((corrected(ev) for ev in instants), default=float("inf")),
         min(
             (
@@ -260,6 +294,19 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
                 "args": {"name": rid},
             }
         )
+    _tier_names = {0: "flat", 1: "row", 2: "col"}
+    for (rid, tier, lane), tid in sorted(dp_tid_of.items()):
+        out.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid_of[_group(rid)],
+                "tid": tid,
+                "args": {
+                    "name": f"{rid} dp:{_tier_names.get(tier, tier)} lane{lane}"
+                },
+            }
+        )
 
     # Phase slices, clamped non-overlapping per track.
     per_track: Dict[Tuple[int, int], List[dict]] = {}
@@ -291,6 +338,47 @@ def build_trace(events: Sequence[dict], align: bool = True) -> dict:
                 "_start": end - dur_s,
                 "_end": end,
                 "args": args,
+            }
+        )
+    # Data-plane hop slices: one per recorded hop, on the replica's
+    # (tier, lane) track.  ``ts`` is the hop START (unlike span records,
+    # whose ts is the end); duration is the hop's full wait+combine.
+    # Stripes sharing a lane can interleave, so hop slices ride the same
+    # non-overlap clamp as phases.
+    for ev in hops:
+        rid = str(ev.get("replica_id", ""))
+        key = (
+            rid,
+            int(ev.get("tier", 0) or 0),
+            int(ev.get("lane", 0) or 0),
+        )
+        tid = dp_tid_of.get(key)
+        if tid is None:
+            continue
+        pid = pid_of[_group(rid)]
+        start = corrected(ev)
+        dur_s = (
+            float(ev.get("send_s", 0.0))
+            + float(ev.get("recv_s", 0.0))
+            + float(ev.get("comb_s", 0.0))
+        )
+        tag = int(ev.get("tag", 0) or 0)
+        sub = tag % 8
+        phase = {1: "rs", 2: "ag", 3: "gather", 4: "rs", 5: "ag"}.get(sub, "hop")
+        per_track.setdefault((pid, tid), []).append(
+            {
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+                "name": f"hop:{phase}",
+                "cat": "hop",
+                "_start": start,
+                "_end": start + dur_s,
+                "args": {
+                    k: ev[k]
+                    for k in ("tag", "send_s", "recv_s", "comb_s", "nbytes")
+                    if ev.get(k) is not None
+                },
             }
         )
     # Control-plane RPC slices: per (source, method, peer) lane, same
@@ -612,16 +700,78 @@ def synthetic_flight_stream(
     return events
 
 
+def load_hops_dump(path: str) -> dict:
+    """Loads one ``hops_<replica>.json`` dump (Manager shutdown with
+    ``TPUFT_HOP_DUMP_DIR`` set, or a bench's direct
+    ``TCPCollective.hop_records()`` write).  Raises ValueError on a
+    malformed document."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or not isinstance(doc.get("records"), list):
+        raise ValueError(f"{path}: not a hop dump (missing records list)")
+    return doc
+
+
+def hops_to_stream(dump: dict) -> List[dict]:
+    """Converts one hop dump into ``event: "hop"`` records for
+    :func:`build_trace` — each carries the replica id plus the raw
+    RingHopRecord fields (hop-start wall-clock ``ts``)."""
+    rid = str(dump.get("replica_id", ""))
+    out: List[dict] = []
+    for rec in dump.get("records", []):
+        if not isinstance(rec, dict) or "ts" not in rec:
+            continue
+        ev = dict(rec)
+        ev["event"] = "hop"
+        ev["replica_id"] = rid
+        out.append(ev)
+    return out
+
+
+def synthetic_hop_stream(
+    n_replicas: int = 2, steps: int = 4, base_ts: float = 1_700_000_000.0
+) -> List[dict]:
+    """Data-plane companion to :func:`synthetic_stream`: per (replica,
+    step) a short burst of rs/ag hops on two lanes of the flat tier, in
+    the window the worker stream's allreduce_merge span covers.  Used by
+    ``tools/trace_export.py --quick`` and the tier-1 trace tests."""
+    events: List[dict] = []
+    for r in range(n_replicas):
+        rid = f"{r}:{'abcdef'[r % 6]}{r}"
+        for step in range(1, steps + 1):
+            end = base_ts + step * 1.0 + 0.002 * r
+            for lane in (0, 1):
+                for h, sub in enumerate((1, 1, 2, 2)):  # rs, rs, ag, ag
+                    events.append(
+                        {
+                            "event": "hop",
+                            "replica_id": rid,
+                            "ts": end - 0.4 + 0.08 * h + 0.01 * lane,
+                            "tier": 0,
+                            "lane": lane,
+                            "tag": 65 * 8 * step + lane * 8 + sub,
+                            "send_s": 0.004,
+                            "recv_s": 0.05,
+                            "comb_s": 0.002 if sub == 1 else 0.0,
+                            "nbytes": 1 << 16,
+                        }
+                    )
+    events.sort(key=lambda ev: ev["ts"])
+    return events
+
+
 def export(
     paths: Sequence[str],
     out_path: str,
     align: bool = True,
     stats: Optional[dict] = None,
     flight_paths: Sequence[str] = (),
+    hops_paths: Sequence[str] = (),
 ) -> dict:
-    """Reads JSONL streams (plus optional flight-recorder dumps), builds
-    the trace, writes ``out_path``.  Returns a summary dict (events,
-    replicas, control-plane tracks, problems)."""
+    """Reads JSONL streams (plus optional flight-recorder and hop-timeline
+    dumps), builds the trace, writes ``out_path``.  Returns a summary dict
+    (events, replicas, control-plane tracks, data-plane tracks,
+    problems)."""
     from torchft_tpu.obs.report import read_events
 
     read_stats: dict = {}
@@ -637,6 +787,12 @@ def export(
             events.extend(flight_to_stream(load_flight_dump(fp)))
         except (OSError, ValueError):
             flight_skipped.append(fp)
+    hops_skipped: List[str] = []
+    for hp in hops_paths:
+        try:
+            events.extend(hops_to_stream(load_hops_dump(hp)))
+        except (OSError, ValueError):
+            hops_skipped.append(hp)
     events.sort(key=lambda ev: float(ev.get("ts", 0.0)))
     trace = build_trace(events, align=align)
     problems = validate_trace(trace)
@@ -644,6 +800,13 @@ def export(
         json.dump(trace, f)
     replicas = trace.get("otherData", {}).get("replicas", {})
     control_plane = trace.get("otherData", {}).get("control_plane", {})
+    dp_tracks = sum(
+        1
+        for ev in trace["traceEvents"]
+        if ev.get("ph") == "M"
+        and ev.get("name") == "thread_name"
+        and " dp:" in str(ev.get("args", {}).get("name", ""))
+    )
     summary = {
         "out": out_path,
         "input_events": len(events),
@@ -651,7 +814,9 @@ def export(
         "trace_events": len(trace["traceEvents"]),
         "replicas": len(replicas),
         "control_plane_tracks": len(control_plane),
+        "data_plane_tracks": dp_tracks,
         "unreadable_flight_dumps": flight_skipped,
+        "unreadable_hop_dumps": hops_skipped,
         "problems": problems,
         "ok": not problems,
     }

@@ -190,7 +190,16 @@ class StreamingDiLoCo:
         self.metrics = SemiSyncMetrics(
             codec=self._codec_name, replica_id=str(replica_id)
         )
-        self.metrics.serve()
+        # Unified worker exposition (obs/prom.py): when the Manager runs
+        # the worker /metrics endpoint, the tpuft_semisync_* section folds
+        # into it instead of opening a second port; mocked/legacy managers
+        # fall back to the standalone exporter (the deprecated
+        # TPUFT_SEMISYNC_METRICS_PORT path).
+        worker_metrics = getattr(manager, "worker_metrics", None)
+        if worker_metrics is not None and getattr(worker_metrics, "serving", False):
+            worker_metrics.add_section(self.metrics.render_prometheus)
+        else:
+            self.metrics.serve()
         self._engine = SyncEngine(
             manager, self._codecs, stream=self._stream, metrics=self.metrics
         )

@@ -10,6 +10,14 @@ already hits the lighthouse.
 
 Counters are monotonic since construction (restart = reset, standard
 Prometheus counter semantics); gauges are last-observation.
+
+DEPRECATED as a standalone endpoint: the worker-side exposition is unified
+on :class:`torchft_tpu.obs.prom.WorkerMetrics` (one ``/metrics`` per
+worker, ``TPUFT_WORKER_METRICS_PORT``), where the semisync engine now
+registers this exposition as a section when a Manager endpoint is
+serving.  ``TPUFT_SEMISYNC_METRICS_PORT`` keeps working as an alias for
+the unified endpoint's port (one deprecation warning per process), and
+:meth:`SemiSyncMetrics.serve` remains for manager-less embedders.
 """
 
 from __future__ import annotations
@@ -160,44 +168,19 @@ class SemiSyncMetrics:
             bind = os.environ.get(
                 TPUFT_SEMISYNC_METRICS_BIND_ENV, ""
             ).strip() or "::1"
-        try:
-            from http.server import BaseHTTPRequestHandler
+        # The repo's one exposition scaffolding (torchft_tpu/http.py) —
+        # every Python-side metrics endpoint shares it, so v6 handling and
+        # accept-queue fixes apply uniformly.
+        from torchft_tpu.http import serve_text_exposition
 
-            # The repo's one dual-stack server class (torchft_tpu/http.py)
-            # — every HTTP endpoint here shares it, so v6 handling and
-            # accept-queue fixes apply uniformly.
-            from torchft_tpu.http import ThreadingHTTPServerV6
-
-            metrics = self
-
-            class Handler(BaseHTTPRequestHandler):
-                def do_GET(self):  # noqa: N802 — stdlib API
-                    if self.path != "/metrics":
-                        self.send_response(404)
-                        self.end_headers()
-                        return
-                    body = metrics.render_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/plain; version=0.0.4"
-                    )
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-
-                def log_message(self, *args):  # silence per-scrape stderr
-                    pass
-
-            server = ThreadingHTTPServerV6((bind, port), Handler)
-            threading.Thread(
-                target=server.serve_forever,
-                name="tpuft_semisync_metrics",
-                daemon=True,
-            ).start()
-            self._server = server
-            return server.server_address[1]
-        except Exception:  # noqa: BLE001 — see docstring
+        server = serve_text_exposition(
+            self.render_prometheus, port, bind,
+            thread_name="tpuft_semisync_metrics",
+        )
+        if server is None:
             return None
+        self._server = server
+        return server.server_address[1]
 
     def close(self) -> None:
         server, self._server = self._server, None
