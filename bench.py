@@ -648,6 +648,46 @@ def _run_scenario(
             second_deadline = None
             last_alert_poll = 0.0
             tail = _MetricsTail(metrics_path)
+            # Incident auto-capture: poll the embedded lighthouse's
+            # /incident.json and bundle the live evidence the moment a
+            # trigger lands (replica_stale for kills, alert:<kind> for
+            # sentinel raises) — the shutdown dumps are folded in by the
+            # finalize pass after the launcher exits.
+            from torchft_tpu.obs import incident as obs_incident
+
+            incident_watch = obs_incident.IncidentWatcher(
+                launcher.lighthouse_http_address
+            )
+            incident_bundles: dict[str, dict] = {}
+            last_incident_poll = 0.0
+
+            def poll_incidents() -> None:
+                nonlocal last_incident_poll
+                if time.monotonic() - last_incident_poll < 1.0:
+                    return
+                last_incident_poll = time.monotonic()
+                for trig in incident_watch.poll():
+                    try:
+                        bundle = obs_incident.capture_bundle(
+                            workdir,
+                            launcher.lighthouse_http_address,
+                            trig,
+                            metrics_paths=[metrics_path],
+                        )
+                    except OSError:
+                        # Transient capture failure: re-queue the trigger
+                        # so the next poll retries instead of losing the
+                        # incident the feed already recorded.
+                        incident_watch.unsee(trig.get("id"))
+                        continue
+                    incident_bundles[bundle] = trig
+                    fault_log.emit(
+                        "incident_captured",
+                        bundle=os.path.basename(bundle),
+                        reason=trig.get("reason"),
+                        incident_replica=trig.get("replica_id"),
+                        incident_id=trig.get("id"),
+                    )
             while time.monotonic() - start < total_window:
                 time.sleep(0.25)
                 if first_kill_at is not None and time.monotonic() - start >= first_kill_at:
@@ -741,8 +781,14 @@ def _run_scenario(
                             step_time_ms=alert.get("step_time_ms"),
                             auto_drained=alert.get("auto_drained"),
                         )
+                poll_incidents()
                 # Supervisor: restart any group that died for other reasons.
                 launcher.supervise_once()
+            # Final sweep while the lighthouse is still serving: a trigger
+            # that landed in the last poll gap (e.g. the straggler alert
+            # raising near window end) still gets its live snapshot.
+            last_incident_poll = 0.0
+            poll_incidents()
 
     finally:
         fault_log.close()
@@ -758,7 +804,60 @@ def _run_scenario(
         stats["straggler"] = _straggler_stats(
             metrics_path, straggle_info, victim, plan
         )
+    stats["incident"] = _incident_stats(
+        workdir, metrics_path, incident_bundles, victim, plan
+    )
     return stats
+
+
+def _incident_stats(
+    workdir: str,
+    metrics_path: str,
+    incident_bundles: dict,
+    victim: str | None,
+    plan: dict | None,
+) -> dict | None:
+    """Finalizes every captured incident bundle (fold in the shutdown
+    dumps, compute verdicts) and — for injected-fault plans — ASSERTS the
+    auto-capture contract: a bundle exists, its verdict names the
+    injected victim group, and (kill plans) >= 90% of the measured lost
+    wall time is charged to the matching cause."""
+    from torchft_tpu.obs import incident as obs_incident
+
+    if not incident_bundles:
+        if plan is not None and plan.get("type") != "drain":
+            # A fault was injected but nothing triggered: the auto-capture
+            # contract is broken (kills must trip replica_stale; straggler
+            # plans trip alert:straggler when the sentinel detects).
+            # Drains are PLANNED departures — no incident by design.
+            raise AssertionError(
+                f"injected fault ({plan.get('type')}) produced no incident "
+                "trigger on /incident.json — auto-capture contract broken"
+            )
+        return None
+    events = _read_events(metrics_path)
+    out: dict = {"bundles": []}
+    named_victim = False
+    for bundle in sorted(incident_bundles):
+        manifest = obs_incident.finalize_bundle(bundle, workdir, events=events)
+        v = manifest.get("verdict", {})
+        out["bundles"].append({"path": bundle, "verdict": v})
+        if victim is not None and v.get("replica") == victim:
+            named_victim = True
+            out["verdict"] = v
+    if plan is not None and victim is not None and plan.get("type") != "drain":
+        assert named_victim, (
+            f"no incident verdict named the injected victim {victim!r}: "
+            + json.dumps([b["verdict"] for b in out["bundles"]])
+        )
+        if plan.get("type") in ("single", "single_spare", "double",
+                                "during_heal"):
+            cf = out.get("verdict", {}).get("charged_fraction")
+            assert cf is None or cf >= 0.9, (
+                f"kill verdict charged only {cf} of the lost wall to the "
+                "dead window — cause attribution too weak"
+            )
+    return out
 
 
 def _flight_stats(workdir: str, assert_dump: bool) -> dict:
@@ -1195,6 +1294,25 @@ def _scenario_stats(
             )
             victim_partial_step = None
 
+    # Goodput cross-check (obs/ledger.py): the commit-count headline vs
+    # the ledger/report classification of the SAME stream — two
+    # independent accountings that must agree.  >5% disagreement fails
+    # the trial: one of them is lying about where the wall time went.
+    from torchft_tpu.obs.ledger import crosscheck_goodput
+
+    try:
+        crosscheck = crosscheck_goodput(events)
+    except Exception as e:  # noqa: BLE001 — a malformed stream already
+        # degrades the headline itself; record, don't abort the bench
+        crosscheck = {"ok": True, "error": repr(e)}
+    assert crosscheck.get("ok", True), (
+        f"goodput cross-check failed: dead-window fraction "
+        f"{crosscheck.get('deadwindow_fraction')} vs ledger fraction "
+        f"{crosscheck.get('ledger_fraction')} disagree by "
+        f"{crosscheck.get('disagreement')} (> 0.05) — the commit-count "
+        "headline and the ledger accounting diverged on the same stream"
+    )
+
     return {
         "committed_batches": sum(per_group.values()),
         "per_group": per_group,
@@ -1205,6 +1323,7 @@ def _scenario_stats(
         "goodput_deadwindow_fraction": (
             round(deadwindow_fraction, 4) if deadwindow_fraction is not None else None
         ),
+        "goodput_crosscheck": crosscheck,
         "victim_downtime_s": victim_downtime,
         "victim_partial_step_s": victim_partial_step,
         "victim_restart_s": victim_restart,

@@ -1096,6 +1096,14 @@ def _link_cell(
             except Exception:  # noqa: BLE001 — poller
                 return None
 
+        # Incident auto-capture: the alert raise also records a trigger on
+        # /incident.json; bundle the live evidence the moment it appears
+        # (the slow-link cell's half of the cross-plane capture contract).
+        from torchft_tpu.obs import incident as obs_incident
+
+        incident_watch = obs_incident.IncidentWatcher(f"http://127.0.0.1:{port}")
+        incident_bundles: List[str] = []
+
         def poll_alerts() -> None:
             seen_ids = set()
             while not stop_poll.is_set():
@@ -1113,6 +1121,26 @@ def _link_cell(
                                 alert_replica_id=a.get("replica_id"),
                                 gbps=a.get("gbps"),
                             )
+                for trig in incident_watch.poll():
+                    try:
+                        bundle = obs_incident.capture_bundle(
+                            workdir, f"http://127.0.0.1:{port}", trig,
+                            metrics_paths=[metrics_path],
+                        )
+                    except OSError:
+                        # Transient capture failure: re-queue so the next
+                        # poll tick retries.
+                        incident_watch.unsee(trig.get("id"))
+                        continue
+                    if bundle not in incident_bundles:
+                        incident_bundles.append(bundle)
+                    driver_log.emit(
+                        "incident_captured",
+                        bundle=os.path.basename(bundle),
+                        reason=trig.get("reason"),
+                        incident_replica=trig.get("replica_id"),
+                        incident_id=trig.get("id"),
+                    )
                 stop_poll.wait(0.2)
 
         poller = threading.Thread(target=poll_alerts, name="linkcell-poll")
@@ -1146,6 +1174,7 @@ def _link_cell(
         "alerts": alerts_seen,
         "link_gauges": link_gauges,
         "metrics_path": metrics_path,
+        "incident_bundles": incident_bundles,
     }
 
 
@@ -1263,6 +1292,30 @@ def run_link(
         fraction_sum = round(
             sum(v for v in frac.values() if v is not None), 4
         )
+        # Incident auto-capture verdict: the degraded cell's slow_link
+        # trigger must have produced a bundle whose verdict names the
+        # injected edge (victim group as the sender).
+        from torchft_tpu.obs import incident as obs_incident
+
+        incident_verdict = None
+        incident_ok = False
+        victim_group = victim_rid.split(":", 1)[0]
+        degraded_events = (
+            read_events([degraded["metrics_path"]])
+            if degraded.get("incident_bundles")
+            else []
+        )
+        for bundle in degraded.get("incident_bundles", []):
+            try:
+                manifest = obs_incident.finalize_bundle(
+                    bundle, workdir, events=degraded_events,
+                )
+            except (OSError, ValueError):
+                continue
+            v = manifest.get("verdict", {})
+            if v.get("kind") == "slow_link" and v.get("replica") == victim_group:
+                incident_verdict = v
+                incident_ok = True
         return {
             "section": "link",
             "quick": quick,
@@ -1281,11 +1334,14 @@ def run_link(
             "added_wall": added,
             "added_wire_stall_fraction": added_wire_stall_fraction,
             "attribution_fraction_sum": fraction_sum,
+            "incident_verdict": incident_verdict,
+            "incident_ok": incident_ok,
             "overhead": overhead,
             "ok": bool(
                 detected
                 and h["link_alerts"] == 0
                 and (detection_rounds is None or detection_rounds <= 10)
+                and incident_ok
             ),
         }
     finally:

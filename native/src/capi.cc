@@ -156,6 +156,15 @@ void tf_manager_set_status(void* p, int64_t step, const char* state,
       link_send_gbps, link_hop_rtt_ms);
 }
 
+// Goodput-ledger push (heartbeat fields 14-16, docs/wire.md "Goodput
+// ledger").  This symbol doubles as the Python side's capability probe: a
+// stale libtpuft.so without it degrades to status-only heartbeats.
+void tf_manager_set_ledger(void* p, double goodput_ratio, double compute_seconds,
+                           const double* lost_seconds, int32_t n_causes) {
+  static_cast<ManagerServer*>(p)->SetLedger(goodput_ratio, compute_seconds,
+                                            lost_seconds, n_causes);
+}
+
 // Manager-side flight recorder (no HTTP server on managers — this is the
 // only live read path besides the shutdown dump).
 char* tf_manager_flight_json(void* p, uint64_t limit) {

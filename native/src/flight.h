@@ -37,6 +37,7 @@ constexpr char kFlightReplicaDrain[] = "replica_drain";
 constexpr char kFlightSentinelTransition[] = "sentinel_transition";
 constexpr char kFlightRoleChange[] = "role_change";
 constexpr char kFlightQuorumResult[] = "quorum_result";
+constexpr char kFlightIncident[] = "incident";
 constexpr char kFlightShutdown[] = "shutdown";
 
 // One recorded event.  RPC spans fill method/peer/status/dur_us; state

@@ -18,6 +18,15 @@ int64_t NowEpochMs() {
       .count();
 }
 
+// The goodput ledger's pinned lost-cause taxonomy, in the WIRE ORDER of
+// the heartbeat's ledger_lost_seconds vector (proto field 16).  MUST stay
+// identical to torchft_tpu/obs/ledger.py LOST_CAUSES — tests/
+// test_ledger.py greps both sides, the same pinning discipline as the
+// flight-event kinds.  Append-only; never reorder.
+constexpr const char* kLedgerCauses[kLedgerCauseCount] = {
+    "wire",        "stall", "combine", "shaping",  "quorum_server",
+    "quorum_transport", "heal",  "drain",   "other_ft"};
+
 // ---------------------------------------------------------------------------
 // Pure quorum math.  Reference parity: quorum_compute, src/lighthouse.rs:133-261.
 // Semantics (in evaluation order):
@@ -275,6 +284,20 @@ std::string Lighthouse::SnapshotState() {
       auto dl = drain_deadline_ms_.find(id);
       if (dl != drain_deadline_ms_.end()) r->set_drain_deadline_ms(dl->second);
     }
+    auto led = ledger_.find(id);
+    if (led != ledger_.end()) {
+      r->set_goodput_ratio(led->second.goodput_ratio);
+      r->set_ledger_compute_seconds(led->second.compute_s);
+      for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+        r->add_ledger_lost_seconds(led->second.lost_s[i]);
+      }
+    }
+  }
+  // Cluster ledger bank: a promoted standby's /goodput.json must keep the
+  // totals of incarnations that departed before the failover.
+  req.set_ledger_banked_compute_seconds(ledger_banked_compute_);
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+    req.add_ledger_banked_lost_seconds(ledger_banked_lost_[i]);
   }
   for (const auto& a : alerts_) {
     auto* out = req.add_alerts();
@@ -339,6 +362,10 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
   ec_shards_.clear();
   health_.clear();
   link_health_.clear();
+  ledger_.clear();
+  // Bank-undo entries describe the OLD local view; the leader's push is
+  // authoritative for both the live entries and the bank.
+  ledger_banked_entries_.clear();
   auto now = Clock::now();
   for (const auto& r : req.replicas()) {
     const std::string& id = r.replica_id();
@@ -384,6 +411,31 @@ Status Lighthouse::HandleReplicate(const LighthouseReplicateRequest& req,
       state_.draining[id] = now;
       if (r.drain_deadline_ms() > 0) drain_deadline_ms_[id] = r.drain_deadline_ms();
     }
+    if (r.ledger_compute_seconds() > 0.0 || r.ledger_lost_seconds_size() > 0) {
+      ReplicaLedger& rl = ledger_[id];
+      rl.goodput_ratio = r.goodput_ratio();
+      rl.compute_s = r.ledger_compute_seconds();
+      for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+        rl.lost_s[i] = i < static_cast<size_t>(r.ledger_lost_seconds_size())
+                           ? r.ledger_lost_seconds(static_cast<int>(i))
+                           : 0.0;
+      }
+    }
+  }
+  // Cluster bank: the leader's view is AUTHORITATIVE, like every other
+  // replicated field — assignment, not max-merge.  A max would pin a
+  // stale high bank after the leader legitimately LOWERED its own (the
+  // resume-undo path subtracts a banked share when a stalled incarnation
+  // comes back), double-counting that incarnation on the standby
+  // forever.  A follower's own sweep may bank a replicated entry between
+  // pushes; the next push restores the consistent (bank, live-entry)
+  // pair either way.
+  ledger_banked_compute_ = req.ledger_banked_compute_seconds();
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+    ledger_banked_lost_[i] =
+        i < static_cast<size_t>(req.ledger_banked_lost_seconds_size())
+            ? req.ledger_banked_lost_seconds(static_cast<int>(i))
+            : 0.0;
   }
   if (req.prev_quorum().participants_size() > 0) {
     state_.prev_quorum = req.prev_quorum();
@@ -467,6 +519,16 @@ bool Lighthouse::Start(std::string* err) {
   if (const char* w = std::getenv("TPUFT_LINK_WARMUP_STEPS")) {
     long long v = std::atoll(w);
     if (v >= 0) link_warmup_ = v;
+  }
+  // Goodput-floor incident-trigger knobs (same malformed-value discipline).
+  if (const char* r = std::getenv("TPUFT_GOODPUT_DIP_RATIO")) {
+    char* end = nullptr;
+    double v = std::strtod(r, &end);
+    if (end != r && v > 0.0 && v < 1.0) goodput_dip_ratio_ = v;
+  }
+  if (const char* w = std::getenv("TPUFT_GOODPUT_WARMUP_OBS")) {
+    long long v = std::atoll(w);
+    if (v >= 0) goodput_warmup_ = v;
   }
   server_ = std::make_unique<RpcServer>(
       opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl,
@@ -571,6 +633,18 @@ bool Lighthouse::Start(std::string* err) {
             // and resolved alerts with the scores that triggered them.
             r.content_type = "application/json";
             r.body = AlertsJson();
+          } else if (method == "GET" && path == "/goodput.json") {
+            // Goodput ledger (read-only, ungated): cluster + per-replica
+            // cause-attributed lost-time rollup from heartbeat fields
+            // 14-16 (docs/wire.md "Goodput ledger").
+            r.content_type = "application/json";
+            r.body = GoodputJson();
+          } else if (method == "GET" && path == "/incident.json") {
+            // Incident-trigger feed (read-only, ungated): the capture
+            // driver (obs/incident.py) polls this and bundles the
+            // evidence when a new record appears.
+            r.content_type = "application/json";
+            r.body = IncidentJson();
           } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
                      path.size() > 14 && path.substr(path.size() - 5) == "/kill") {
             std::string replica_id = path.substr(9, path.size() - 9 - 5);
@@ -829,7 +903,135 @@ Status Lighthouse::HandleHeartbeat(const LighthouseHeartbeatRequest& req) {
       ObserveLinkLocked(req.replica_id());
     }
   }
+  // Goodput ledger (heartbeat fields 14-16): the replica's cumulative
+  // cause-attributed accounting.  Within one incarnation the counters are
+  // monotonic, so the latest report is authoritative; restarts carry new
+  // ids, whose predecessors are banked at prune/evict time.  Only a
+  // cumulative ADVANCE runs a floor observation — the heartbeat cadence
+  // (100 ms) resends identical counters between commits, and observing
+  // those would dilute the windowed-goodput EWMA with empty windows.
+  if (req.ledger_compute_seconds() > 0.0 || req.ledger_lost_seconds_size() > 0) {
+    // A RESUMED incarnation (stalled past the graveyard horizon, then
+    // recovered — the sweep banked it as departed) re-reports the same
+    // monotonic counters: subtract its banked share first or the cluster
+    // totals count it twice.
+    auto banked = ledger_banked_entries_.find(req.replica_id());
+    if (banked != ledger_banked_entries_.end()) {
+      ledger_banked_compute_ = std::max(
+          0.0, ledger_banked_compute_ - banked->second.first.compute_s);
+      for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+        ledger_banked_lost_[i] = std::max(
+            0.0, ledger_banked_lost_[i] - banked->second.first.lost_s[i]);
+      }
+      ledger_banked_entries_.erase(banked);
+    }
+    ReplicaLedger& rl = ledger_[req.replica_id()];
+    double prev_total = rl.compute_s;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) prev_total += rl.lost_s[i];
+    rl.goodput_ratio = req.goodput_ratio();
+    rl.compute_s = req.ledger_compute_seconds();
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+      rl.lost_s[i] = i < static_cast<size_t>(req.ledger_lost_seconds_size())
+                         ? req.ledger_lost_seconds(static_cast<int>(i))
+                         : 0.0;
+    }
+    double new_total = rl.compute_s;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) new_total += rl.lost_s[i];
+    if (new_total > prev_total) ObserveGoodputLocked();
+  }
   return Status::kOk;
+}
+
+void Lighthouse::BankLedgerLocked(const std::string& id, bool undoable) {
+  auto it = ledger_.find(id);
+  if (it == ledger_.end()) return;
+  ledger_banked_compute_ += it->second.compute_s;
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+    ledger_banked_lost_[i] += it->second.lost_s[i];
+  }
+  if (undoable) {
+    ledger_banked_entries_[id] = {it->second, NowEpochMs()};
+  }
+}
+
+void Lighthouse::ClusterLedgerLocked(double* compute_s,
+                                     double lost_s[kLedgerCauseCount]) const {
+  *compute_s = ledger_banked_compute_;
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_s[i] = ledger_banked_lost_[i];
+  for (const auto& [id, rl] : ledger_) {
+    *compute_s += rl.compute_s;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_s[i] += rl.lost_s[i];
+  }
+}
+
+void Lighthouse::ObserveGoodputLocked() {
+  double compute = 0.0, lost[kLedgerCauseCount];
+  ClusterLedgerLocked(&compute, lost);
+  double lost_total = 0.0;
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_total += lost[i];
+  // Windowed goodput: the productive fraction of the wall ADDED since the
+  // previous observation.  The cumulative ratio barely moves late in a
+  // run — a window is what a live dip actually shows up in.  Windows
+  // close only once >= kMinWindowS of ACCOUNTED wall accumulated: ledger
+  // pushes land per commit (every few ms on fast steps), and scoring
+  // each tiny delta made the floor trigger fire on single-step
+  // scheduler noise.
+  constexpr double kMinWindowS = 5.0;
+  double d_compute = compute - goodput_prev_compute_;
+  double d_lost = lost_total - goodput_prev_lost_;
+  if (d_compute + d_lost < kMinWindowS) return;  // window still open
+  goodput_prev_compute_ = compute;
+  goodput_prev_lost_ = lost_total;
+  double d_total = d_compute + d_lost;
+  if (d_total <= 0.0) return;  // no new accounted wall in this window
+  double windowed = d_compute / d_total;
+  if (goodput_obs_ >= goodput_warmup_ && goodput_ewma_ >= 0.0 &&
+      windowed < goodput_ewma_ * goodput_dip_ratio_) {
+    // Cluster-scope trigger: the windowed rollup has no per-replica delta
+    // tracking (deliberately — see CHANGES "remaining depth"), so the
+    // capture driver's verdict localizes from the bundled flight + alert
+    // + per-replica ledger evidence instead.
+    RecordIncidentLocked("goodput_floor", "cluster", windowed);
+  }
+  goodput_ewma_ = goodput_ewma_ < 0.0
+                      ? windowed
+                      : 0.2 * windowed + 0.8 * goodput_ewma_;
+  ++goodput_obs_;
+}
+
+void Lighthouse::RecordIncidentLocked(const std::string& reason,
+                                      const std::string& replica_id,
+                                      double detail) {
+  // Debounce per (reason, replica): a flapping trigger must not flood the
+  // feed — the capture driver bundles the FIRST record of an episode.
+  const int64_t kDebounceMs = 10000;
+  int64_t now_ms = NowEpochMs();
+  std::string key = reason + "|" + replica_id;
+  auto it = incident_last_ms_.find(key);
+  if (it != incident_last_ms_.end() && now_ms - it->second < kDebounceMs) return;
+  incident_last_ms_[key] = now_ms;
+  IncidentRecord rec;
+  rec.id = ++incident_seq_;
+  rec.reason = reason;
+  rec.replica_id = replica_id;
+  for (const auto& [id, step] : hb_step_) rec.step = std::max(rec.step, step);
+  rec.ts_ms = now_ms;
+  rec.detail = detail;
+  char dbuf[32];
+  snprintf(dbuf, sizeof(dbuf), "%.4f", detail);
+  flight_.RecordEvent(kFlightIncident,
+                      "reason=" + reason + " replica=" + replica_id +
+                          " step=" + std::to_string(rec.step) +
+                          " detail=" + dbuf);
+  LOGW("lighthouse: incident %lld recorded (reason=%s replica=%s step=%lld) "
+       "— capture drivers polling /incident.json will bundle the evidence",
+       static_cast<long long>(rec.id), reason.c_str(), replica_id.c_str(),
+       static_cast<long long>(rec.step));
+  incidents_.push_back(std::move(rec));
+  const size_t kMaxIncidents = 64;
+  if (incidents_.size() > kMaxIncidents) {
+    incidents_.erase(incidents_.begin());
+  }
 }
 
 double Lighthouse::ClusterMedianEwmaLocked() const {
@@ -1116,6 +1318,11 @@ void Lighthouse::ResolveLinkAlertsLocked(const std::string& src_id) {
 }
 
 void Lighthouse::PushAlertLocked(AlertRecord a) {
+  // Every alert raise is an incident trigger: the sentinels page on
+  // exactly the degradations whose evidence the auto-capture bundles
+  // (straggler, slow_link, ec_coverage alike).
+  RecordIncidentLocked("alert:" + a.kind, a.replica_id,
+                       a.ratio > 0.0 ? a.ratio : a.gbps);
   alerts_.push_back(std::move(a));
   // Bounded history: drop the oldest RESOLVED record first; active alerts
   // are never evicted (there can be at most one per live replica id, plus
@@ -1522,6 +1729,12 @@ void Lighthouse::SweepLocked(TimePoint tick_now,
       } else {
         LOGW("lighthouse: replica %s heartbeat stale (age %lld ms) — declaring dead",
              id.c_str(), static_cast<long long>(age_ms));
+        // The kill signature: an UNANNOUNCED heartbeat loss (drains were
+        // excluded above, evictions never reach here).  Trigger incident
+        // auto-capture so the dead window's evidence is bundled while the
+        // survivors' context is still hot.
+        RecordIncidentLocked("replica_stale", id,
+                             static_cast<double>(age_ms));
       }
     }
   }
@@ -1592,6 +1805,47 @@ void Lighthouse::SweepLocked(TimePoint tick_now,
   prune_with_heartbeats(last_commit_ms_);
   prune_with_heartbeats(allreduce_gbps_);
   prune_with_heartbeats(ec_shards_);
+  // Ledger entries bank before they prune: a departed incarnation's
+  // accounted seconds belong to the cluster totals forever — pruning
+  // without banking would make tpuft_lost_seconds_total go backwards
+  // under exactly the id churn a fault run produces.
+  for (auto it = ledger_.begin(); it != ledger_.end();) {
+    if (state_.heartbeats.find(it->first) == state_.heartbeats.end()) {
+      BankLedgerLocked(it->first, /*undoable=*/true);
+      it = ledger_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  // Bank-undo entries age out on the tombstone horizon: a same-id resume
+  // that late is beyond the system's zombie window everywhere else too.
+  {
+    int64_t now_ms = NowEpochMs();
+    int64_t horizon_ms = static_cast<int64_t>(opt_.heartbeat_timeout_ms) * 10;
+    for (auto it = ledger_banked_entries_.begin();
+         it != ledger_banked_entries_.end();) {
+      if (now_ms - it->second.second > horizon_ms) {
+        it = ledger_banked_entries_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  // Incident-debounce stamps age out once far past any debounce window:
+  // keys embed incarnation ids ("replica_stale|<group>:<uuid>"), so a
+  // crash-looping group would otherwise grow the map one key per restart
+  // for the life of the daemon.
+  {
+    int64_t now_ms = NowEpochMs();
+    const int64_t kDebounceHorizonMs = 10 * 10000;  // 10x the debounce
+    for (auto it = incident_last_ms_.begin(); it != incident_last_ms_.end();) {
+      if (now_ms - it->second > kDebounceHorizonMs) {
+        it = incident_last_ms_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
   // Sentinel health follows the graveyard too, and a pruned replica's
   // active alert resolves here: a process that is gone (crashed, drained
   // out, auto-drained straggler that exited) can never post the recovery
@@ -1699,6 +1953,17 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
   erase_matching(last_commit_ms_);
   erase_matching(allreduce_gbps_);
   erase_matching(ec_shards_);
+  // Evicted incarnations bank their ledger counters first (see
+  // SweepLocked) — the work they accounted happened.  Not undoable: the
+  // tombstone guarantees this id can never heartbeat again.
+  for (auto it = ledger_.begin(); it != ledger_.end();) {
+    if (matches(it->first)) {
+      BankLedgerLocked(it->first, /*undoable=*/false);
+      it = ledger_.erase(it);
+    } else {
+      ++it;
+    }
+  }
   erase_matching(health_);
   // An evicted incarnation's straggler alert resolves with it (the
   // supervisor already replaced the process; the alert described a corpse).
@@ -1714,6 +1979,11 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
     flight_.RecordEvent(kFlightReplicaEvict,
                         "prefix=" + prefix +
                             " dropped=" + std::to_string(dropped));
+    // A supervisor-reported death is the OTHER kill signature (scripted
+    // kills evict before the heartbeat ever goes stale): trigger incident
+    // auto-capture just like SweepLocked's stale transition.
+    RecordIncidentLocked("replica_evicted", prefix,
+                         static_cast<double>(dropped));
     TickLocked();  // a waiting quorum can now form without the straggler wait
   }
   return dropped;
@@ -1852,6 +2122,12 @@ std::string Lighthouse::MetricsText() {
     std::vector<std::pair<std::string, double>> link_rtt_ms;
     std::vector<std::pair<std::string, double>> link_ratio;
     std::vector<std::pair<std::string, int64_t>> link_state;
+    // Goodput ledger (docs/wire.md "Goodput ledger").
+    double ledger_compute = 0.0;
+    double ledger_lost[kLedgerCauseCount] = {0};
+    std::vector<std::pair<std::string, double>> goodput_ratio;
+    double goodput_ewma = -1.0;
+    int64_t incidents = 0;
   } s;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -1950,6 +2226,14 @@ std::string Lighthouse::MetricsText() {
       s.link_state.emplace_back(id, lh.state);
       if (lh.ratio > 0.0) s.link_ratio.emplace_back(id, lh.ratio);
     }
+    // Goodput ledger: cluster totals (bank + live) and per-replica ratios.
+    ClusterLedgerLocked(&s.ledger_compute, s.ledger_lost);
+    s.goodput_ratio.reserve(ledger_.size());
+    for (const auto& [id, rl] : ledger_) {
+      s.goodput_ratio.emplace_back(id, rl.goodput_ratio);
+    }
+    s.goodput_ewma = goodput_ewma_;
+    s.incidents = incident_seq_;
   }
 
   std::ostringstream o;
@@ -2091,6 +2375,45 @@ std::string Lighthouse::MetricsText() {
   gauge("tpuft_alerts_active", "unresolved sentinel alerts (see /alerts.json)");
   o << "tpuft_alerts_active " << s.alerts_active << "\n";
 
+  // Goodput ledger (docs/wire.md "Goodput ledger"): cause-attributed
+  // cluster accounting from heartbeat fields 14-16; /goodput.json carries
+  // the per-replica breakdown.
+  {
+    double lost_total = 0.0;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_total += s.ledger_lost[i];
+    double accounted = s.ledger_compute + lost_total;
+    gauge("tpuft_goodput_ratio",
+          "cluster productive fraction: compute seconds over accounted wall "
+          "(bank + live incarnations; -1 before the first ledger report)");
+    o << "tpuft_goodput_ratio "
+      << (accounted > 0.0 ? s.ledger_compute / accounted : -1.0) << "\n";
+    gauge("tpuft_replica_goodput_ratio",
+          "per-replica cumulative productive fraction (heartbeat field 14)");
+    for (const auto& [id, v] : s.goodput_ratio) {
+      o << "tpuft_replica_goodput_ratio{replica=\"" << PromEscape(id)
+        << "\"} " << v << "\n";
+    }
+    o << "# HELP tpuft_compute_seconds_total cluster productive seconds "
+         "(goodput ledger; monotonic — departed incarnations are banked)\n"
+         "# TYPE tpuft_compute_seconds_total counter\n";
+    o << "tpuft_compute_seconds_total " << s.ledger_compute << "\n";
+    o << "# HELP tpuft_lost_seconds_total cluster lost seconds per cause "
+         "(goodput ledger's pinned taxonomy; monotonic)\n"
+         "# TYPE tpuft_lost_seconds_total counter\n";
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+      o << "tpuft_lost_seconds_total{cause=\"" << kLedgerCauses[i] << "\"} "
+        << s.ledger_lost[i] << "\n";
+    }
+    gauge("tpuft_goodput_ewma",
+          "windowed cluster-goodput EWMA (the incident floor reference; -1 "
+          "before the first observation)");
+    o << "tpuft_goodput_ewma " << s.goodput_ewma << "\n";
+    o << "# HELP tpuft_incidents_total incident-capture triggers recorded "
+         "(see /incident.json)\n"
+         "# TYPE tpuft_incidents_total counter\n";
+    o << "tpuft_incidents_total " << s.incidents << "\n";
+  }
+
   // Control-plane latency distributions (docs/wire.md "Latency
   // histograms") — the measurements ROADMAP item 2's scale sweep needs
   // before quorum/heartbeat/scrape paths can be optimized.
@@ -2155,6 +2478,61 @@ std::string Lighthouse::AlertsJson() {
       << ",\"gbps\":" << a.gbps
       << ",\"src_replica_id\":\"" << JsonEscape(a.src_replica_id)
       << "\",\"active\":" << (a.resolved_ms == 0 ? "true" : "false") << "}";
+  }
+  o << "]}";
+  return o.str();
+}
+
+std::string Lighthouse::GoodputJson() {
+  std::lock_guard<std::mutex> lk(mu_);
+  double compute = 0.0, lost[kLedgerCauseCount];
+  ClusterLedgerLocked(&compute, lost);
+  double lost_total = 0.0;
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_total += lost[i];
+  double accounted = compute + lost_total;
+  std::ostringstream o;
+  auto causes_obj = [&](const double* v) {
+    std::ostringstream c;
+    c << "{";
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+      if (i) c << ",";
+      c << "\"" << kLedgerCauses[i] << "\":" << v[i];
+    }
+    c << "}";
+    return c.str();
+  };
+  o << "{\"goodput_ratio\":"
+    << (accounted > 0.0 ? compute / accounted : -1.0)
+    << ",\"goodput_ewma\":" << goodput_ewma_
+    << ",\"compute_seconds\":" << compute
+    << ",\"lost_seconds_total\":" << lost_total
+    << ",\"lost_seconds\":" << causes_obj(lost)
+    << ",\"banked_compute_seconds\":" << ledger_banked_compute_
+    << ",\"incidents\":" << incident_seq_ << ",\"per_replica\":{";
+  bool first = true;
+  for (const auto& [id, rl] : ledger_) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(id) << "\":{\"goodput_ratio\":" << rl.goodput_ratio
+      << ",\"compute_seconds\":" << rl.compute_s
+      << ",\"lost_seconds\":" << causes_obj(rl.lost_s) << "}";
+  }
+  o << "}}";
+  return o.str();
+}
+
+std::string Lighthouse::IncidentJson() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::ostringstream o;
+  o << "{\"count\":" << incident_seq_ << ",\"incidents\":[";
+  bool first = true;
+  for (const auto& rec : incidents_) {
+    if (!first) o << ",";
+    first = false;
+    o << "{\"id\":" << rec.id << ",\"reason\":\"" << JsonEscape(rec.reason)
+      << "\",\"replica_id\":\"" << JsonEscape(rec.replica_id)
+      << "\",\"step\":" << rec.step << ",\"ts_ms\":" << rec.ts_ms
+      << ",\"detail\":" << rec.detail << "}";
   }
   o << "]}";
   return o.str();
@@ -2273,6 +2651,34 @@ std::string Lighthouse::StatusHtml() {
        "<h1>tpu-ft lighthouse</h1>";
   o << "<p>quorum_id: " << s.quorum_id() << " &mdash; " << s.prev_quorum().participants_size()
     << " participants, " << s.pending_participants_size() << " pending</p>";
+  // Goodput-ledger card: cluster productive fraction + the dominant lost
+  // cause (the full per-cause breakdown lives on /goodput.json).
+  {
+    double compute = 0.0, lost[kLedgerCauseCount];
+    int64_t incidents = 0;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ClusterLedgerLocked(&compute, lost);
+      incidents = incident_seq_;
+    }
+    double lost_total = 0.0;
+    size_t worst = 0;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+      lost_total += lost[i];
+      if (lost[i] > lost[worst]) worst = i;
+    }
+    double accounted = compute + lost_total;
+    if (accounted > 0.0) {
+      char buf[160];
+      snprintf(buf, sizeof(buf),
+               "<p>goodput: %.4f (lost %.1fs, top cause %s %.1fs; "
+               "incidents %lld — <a href=\"/goodput.json\">/goodput.json</a>)"
+               "</p>",
+               compute / accounted, lost_total, kLedgerCauses[worst],
+               lost[worst], static_cast<long long>(incidents));
+      o << buf;
+    }
+  }
   std::set<std::string> draining(s.draining().begin(), s.draining().end());
   int64_t max_live = 0;
   for (const auto& [id, st] : s.replica_step()) max_live = std::max(max_live, st);

@@ -95,6 +95,38 @@ struct LinkHealth {
   int64_t observations = 0;
 };
 
+// Number of lost-cause classes in the goodput ledger's pinned taxonomy
+// (kLedgerCauses in lighthouse.cc == torchft_tpu/obs/ledger.py
+// LOST_CAUSES; the heartbeat's ledger_lost_seconds vector order).
+constexpr size_t kLedgerCauseCount = 9;
+
+// Goodput-ledger counters for one replica incarnation, as last reported on
+// its heartbeats (fields 14-16).  Monotonic per incarnation; a restart is
+// a NEW id, whose predecessor's high-water mark is banked into the
+// cluster accumulator when its entry is pruned or evicted.
+struct ReplicaLedger {
+  double goodput_ratio = 0.0;  // replica's cumulative productive fraction
+  double compute_s = 0.0;      // cumulative productive seconds
+  double lost_s[kLedgerCauseCount] = {0};  // per cause, pinned order
+};
+
+// One auto-capture trigger record, served on GET /incident.json.  The
+// lighthouse only RECORDS triggers (always-on, bounded); the capture
+// itself — bundling flight rings, alerts, goodput, span tails into
+// incident_<step>/ — is driven by torchft_tpu/obs/incident.py, which
+// polls this feed.  reason: "alert:<kind>" (sentinel raise),
+// "replica_stale" (heartbeat loss) / "replica_evicted"
+// (supervisor-reported death — together the kill signatures), or
+// "goodput_floor" (windowed cluster goodput dipped below its EWMA floor).
+struct IncidentRecord {
+  int64_t id = 0;
+  std::string reason;
+  std::string replica_id;  // victim / edge endpoint; "cluster" for cluster scope
+  int64_t step = 0;        // max live step at trigger time
+  int64_t ts_ms = 0;       // epoch ms
+  double detail = 0.0;     // reason-specific scalar (ratio / goodput / age ms)
+};
+
 // One operator-visible alert, served on GET /alerts.json.  resolved_ms == 0
 // while active.
 struct AlertRecord {
@@ -192,6 +224,11 @@ class Lighthouse {
   int LinkState(const std::string& replica_id);
   // JSON alert feed: {"active": N, "alerts": [...]} — newest last.
   std::string AlertsJson();
+  // Goodput ledger rollup: cluster + per-replica cause-attributed totals
+  // (the GET /goodput.json body; docs/wire.md "Goodput ledger").
+  std::string GoodputJson();
+  // Incident-trigger feed (GET /incident.json), newest last.
+  std::string IncidentJson();
 
   // Flight-recorder snapshot (newest-first, bounded; 0 = all retained) —
   // the GET /debug/flight.json body and the capi accessor.
@@ -292,6 +329,28 @@ class Lighthouse {
   bool HeartbeatFreshLocked(const std::string& id, TimePoint now) const;
   // Bounded alert history push shared by every alert kind.
   void PushAlertLocked(AlertRecord a);
+  // -- goodput ledger + incident auto-capture (docs/wire.md) --------------
+  // Folds one incarnation's last-reported ledger counters into the
+  // cluster bank (called before its entry is pruned/evicted, so cluster
+  // totals never go backwards under id churn).  ``undoable`` records the
+  // banked amount so a RESUMING incarnation (long stall, not a death —
+  // sweep prunes cannot tell the two apart) can have its bank share
+  // subtracted before its monotonic counters re-ingest; evictions are
+  // tombstoned against resume and bank without an undo entry.  Caller
+  // holds mu_.
+  void BankLedgerLocked(const std::string& id, bool undoable);
+  // Cluster totals = bank + every live incarnation.  Caller holds mu_.
+  void ClusterLedgerLocked(double* compute_s,
+                           double lost_s[kLedgerCauseCount]) const;
+  // One windowed cluster-goodput observation after a ledger-carrying
+  // heartbeat: the goodput of the wall added since the previous
+  // observation, EWMA'd; a dip below EWMA * TPUFT_GOODPUT_DIP_RATIO after
+  // the warmup records a "goodput_floor" incident.  Caller holds mu_.
+  void ObserveGoodputLocked();
+  // Bounded, debounced incident-trigger record (+ flight event).  Caller
+  // holds mu_.
+  void RecordIncidentLocked(const std::string& reason,
+                            const std::string& replica_id, double detail);
   // Flight-records a sentinel hysteresis transition when prev != h.state.
   void RecordSentinelLocked(const std::string& id, int prev,
                             const ReplicaHealth& h);
@@ -431,6 +490,38 @@ class Lighthouse {
   int64_t link_grace_ = 3;
   bool link_auto_drain_ = false;
   int64_t link_warmup_ = 3;
+
+  // Goodput ledger (docs/wire.md "Goodput ledger"): per-incarnation
+  // counters from heartbeat fields 14-16, pruned with the graveyard
+  // (banked first), plus the cluster bank of departed incarnations.
+  std::map<std::string, ReplicaLedger> ledger_;
+  double ledger_banked_compute_ = 0.0;
+  double ledger_banked_lost_[kLedgerCauseCount] = {0};
+  // Sweep-banked amounts kept for UNDO (id -> (banked counters, bank
+  // epoch ms)): a heartbeat resuming after a staleness prune re-reports
+  // the SAME incarnation's monotonic counters, which would double-count
+  // against its banked share.  Pruned on the tombstone horizon.
+  std::map<std::string, std::pair<ReplicaLedger, int64_t>>
+      ledger_banked_entries_;
+  // Windowed cluster-goodput EWMA (the incident floor trigger) + the
+  // previous observation's cluster totals closing each delta window.
+  double goodput_ewma_ = -1.0;
+  int64_t goodput_obs_ = 0;
+  double goodput_prev_compute_ = 0.0;
+  double goodput_prev_lost_ = 0.0;
+  // Incident-trigger records (bounded, newest last) + per-(reason,
+  // replica) debounce stamps.  Knobs, read at Start:
+  //   TPUFT_GOODPUT_DIP_RATIO   windowed goodput below EWMA * ratio
+  //                             records a goodput_floor incident
+  //                             (default 0.9)
+  //   TPUFT_GOODPUT_WARMUP_OBS  ledger observations before the floor
+  //                             trigger may fire (default 8; early
+  //                             windows mix JIT-skewed steps)
+  std::vector<IncidentRecord> incidents_;
+  int64_t incident_seq_ = 0;
+  std::map<std::string, int64_t> incident_last_ms_;
+  double goodput_dip_ratio_ = 0.9;
+  int64_t goodput_warmup_ = 8;
 
   // HA role state (SetRole).  Default: standalone permanent leader with no
   // lease (lease_expires_ms_ == 0 disables the serve-time expiry guard).

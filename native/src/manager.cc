@@ -236,6 +236,15 @@ void ManagerServer::SetStatus(int64_t step, const std::string& state,
   if (link_hop_rtt_ms >= 0.0) status_link_rtt_ms_ = link_hop_rtt_ms;
 }
 
+void ManagerServer::SetLedger(double goodput_ratio, double compute_seconds,
+                              const double* lost_seconds, int32_t n_causes) {
+  std::lock_guard<std::mutex> lk(mu_);
+  status_goodput_ratio_ = goodput_ratio;
+  status_ledger_compute_s_ = compute_seconds;
+  status_ledger_lost_s_.assign(
+      lost_seconds, lost_seconds + (n_causes > 0 ? n_causes : 0));
+}
+
 void ManagerServer::HeartbeatLoop() {
   std::string payload, resp, err;
   // A single heartbeat RPC must never be allowed to eat a whole
@@ -281,6 +290,9 @@ void ManagerServer::HeartbeatLoop() {
       req.set_link_recv_gbps(status_link_recv_gbps_);
       req.set_link_send_gbps(status_link_send_gbps_);
       req.set_link_hop_rtt_ms(status_link_rtt_ms_);
+      req.set_goodput_ratio(status_goodput_ratio_);
+      req.set_ledger_compute_seconds(status_ledger_compute_s_);
+      for (double v : status_ledger_lost_s_) req.add_ledger_lost_seconds(v);
       req.set_trace_id(status_trace_id_);
       req.SerializeToString(&payload);
     }

@@ -15,6 +15,7 @@
 #include <optional>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "flight.h"
 #include "tpuft.pb.h"
@@ -86,6 +87,14 @@ class ManagerServer {
                  double link_recv_gbps = -1.0, double link_send_gbps = -1.0,
                  double link_hop_rtt_ms = -1.0);
 
+  // Goodput ledger push (heartbeat fields 14-16, docs/wire.md "Goodput
+  // ledger"): the replica's cumulative productive fraction, productive
+  // seconds, and per-cause lost seconds in the pinned taxonomy order
+  // (torchft_tpu/obs/ledger.py LOST_CAUSES).  Called once per commit
+  // vote by the Python Manager; counters are monotonic per incarnation.
+  void SetLedger(double goodput_ratio, double compute_seconds,
+                 const double* lost_seconds, int32_t n_causes);
+
   // RPC handlers (public for in-process tests).
   Status HandleQuorum(const ManagerQuorumRequest& req, Deadline deadline,
                       ManagerQuorumResponse* resp, std::string* err);
@@ -147,6 +156,11 @@ class ManagerServer {
   double status_link_recv_gbps_ = 0.0;
   double status_link_send_gbps_ = 0.0;
   double status_link_rtt_ms_ = 0.0;
+  // Goodput ledger (heartbeat fields 14-16): cumulative productive
+  // fraction / seconds and per-cause lost seconds (pinned order).
+  double status_goodput_ratio_ = 0.0;
+  double status_ledger_compute_s_ = 0.0;
+  std::vector<double> status_ledger_lost_s_;
   // Causal trace id of the last quorum round this manager aggregated —
   // stamped onto every lighthouse heartbeat (proto field 7) so the
   // lighthouse's RPC spans correlate with the step in flight.

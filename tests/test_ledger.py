@@ -1,0 +1,656 @@
+"""Goodput ledger + incident auto-capture (ISSUE 15).
+
+Covers the tentpole's three legs:
+
+1. ``obs/ledger.py`` classification — cause fractions sum to ~1.0 of the
+   wall, reset-aware hop banking across reconfigures, failed-commit
+   exclusion, the quorum server/transport split, drain charging;
+2. the wire + native rollup — ``ManagerServer.set_ledger`` -> heartbeat
+   fields 14-16 -> the lighthouse's ``/goodput.json`` /
+   ``tpuft_goodput_ratio`` / ``tpuft_lost_seconds_total{cause=...}``, and
+   the incident-trigger feed (``/incident.json``: stale heartbeats,
+   evictions, alert raises);
+3. the live mini-cluster smoke (tier-1): a real 2-group training run with
+   an injected kill — per-step ledger vectors in the stream sum to the
+   wall, the kill records an incident, and the captured bundle's verdict
+   names the victim.
+
+Plus the static pins: the cause taxonomy is ONE list across
+``obs/ledger.py``, ``native/src/lighthouse.cc`` (``kLedgerCauses``) and
+``docs/wire.md``; the new gauge/endpoint names exist in both the native
+server and the docs — the same grep discipline as ``metrics.EVENTS`` and
+``FLIGHT_EVENTS``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu.obs.ledger import (
+    CAUSES,
+    LOST_CAUSES,
+    StepLedger,
+    crosscheck_goodput,
+    epoch_bank,
+    ledger_rollup,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(url: str) -> str:
+    return urllib.request.urlopen(url, timeout=10).read().decode()
+
+
+def _lanes(hops: float, send: float, recv: float, comb: float, shape: float):
+    return {
+        "hops": {
+            "flat": {
+                "hops": hops,
+                "send_block_s": send,
+                "recv_wait_s": recv,
+                "combine_s": comb,
+                "shape_s": shape,
+            }
+        }
+    }
+
+
+# ---------------------------------------------------------------------------
+# Classification unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_cause_fractions_sum_to_wall() -> None:
+    led = StepLedger()
+    led.observe_step(1, 1.0, {"quorum": 50.0}, lanes=_lanes(10, 0.1, 0.3, 0.1, 0.05))
+    causes = led.observe_step(
+        2,
+        2.0,
+        {"quorum": 100.0, "allreduce_merge": 400.0, "allreduce_d2h": 100.0,
+         "commit_vote": 50.0, "heal": 0.0},
+        lanes=_lanes(20, 0.2, 0.6, 0.2, 0.1),
+    )
+    assert causes is not None
+    assert set(causes) == set(CAUSES)
+    assert sum(causes.values()) == pytest.approx(2.0, rel=1e-6)
+    # The allreduce-blocking 0.5 s spread over the hop classes, shaping
+    # netted out of send-block: deltas are (send .1, recv .3, comb .1,
+    # shape .05) -> wire .05 stall .3 comb .1 shape .05 over denom 0.5.
+    assert causes["stall"] == pytest.approx(0.5 * 0.3 / 0.5)
+    assert causes["wire"] == pytest.approx(0.5 * 0.05 / 0.5)
+    assert causes["quorum_server"] == pytest.approx(0.1)
+    assert causes["other_ft"] == pytest.approx(0.05)
+    assert causes["compute"] == pytest.approx(2.0 - 0.5 - 0.1 - 0.05)
+
+
+def test_failed_commit_excluded_but_advances_hop_window() -> None:
+    led = StepLedger()
+    led.observe_step(1, 1.0, {}, lanes=_lanes(10, 0.0, 0.1, 0.0, 0.0))
+    # Failed commit: excluded from the totals, but its hop delta window
+    # must advance so the retry is not double-charged.
+    out = led.observe_step(
+        2, 1.0, {"allreduce_merge": 200.0},
+        lanes=_lanes(20, 0.0, 0.5, 0.0, 0.0), committed=False,
+    )
+    assert out is None
+    snap = led.snapshot()
+    assert snap["steps"] == 1 and snap["steps_failed"] == 1
+    # The retried step only sees the delta SINCE the failed attempt.
+    causes = led.observe_step(
+        3, 1.0, {"allreduce_merge": 100.0},
+        lanes=_lanes(22, 0.0, 0.6, 0.0, 0.0),
+    )
+    assert causes["stall"] == pytest.approx(0.1)  # all of ar_block
+
+
+def test_reset_aware_banking_across_reconfigure() -> None:
+    led = StepLedger()
+    led.observe_step(1, 1.0, {}, lanes=_lanes(100, 1.0, 2.0, 0.5, 0.0))
+    # Reconfigure: cumulative hop counters RESET to small values.  The
+    # epoch bank must treat post-reset readings as a fresh epoch — the
+    # delta is the new epoch's absolute value, never negative.
+    causes = led.observe_step(
+        2, 1.0, {"allreduce_merge": 300.0},
+        lanes=_lanes(5, 0.01, 0.2, 0.02, 0.0),
+    )
+    assert causes is not None
+    # recv delta 0.2 dominates the split and nothing went negative.
+    assert causes["stall"] > causes["wire"] >= 0.0
+    assert sum(causes.values()) == pytest.approx(1.0)
+    # The shared primitive itself: a drop banks the high-water mark.
+    slot = [0.0, 0.0]
+    epoch_bank(slot, 10.0)
+    epoch_bank(slot, 3.0)  # reset
+    epoch_bank(slot, 4.0)
+    assert slot == [10.0, 4.0]
+
+
+def test_quorum_split_and_drain_charge() -> None:
+    led = StepLedger()
+    causes = led.observe_step(
+        1, 1.0, {"quorum": 200.0, "commit_vote": 100.0},
+        quorum_server_ms=150.0,
+    )
+    assert causes["quorum_server"] == pytest.approx(0.15)
+    assert causes["quorum_transport"] == pytest.approx(0.05)
+    # Under a drain notice the residual FT time is the departure's cost.
+    causes = led.observe_step(
+        2, 1.0, {"commit_vote": 100.0}, draining=True
+    )
+    assert causes["drain"] == pytest.approx(0.1)
+    assert causes["other_ft"] == 0.0
+
+
+def test_overcharge_scales_to_wall() -> None:
+    led = StepLedger()
+    # Span threads measured more than the commit clock's wall: charges
+    # scale down so fractions still sum to 1.0 with compute floored at 0.
+    causes = led.observe_step(1, 0.1, {"quorum": 150.0, "commit_vote": 50.0})
+    assert sum(causes.values()) == pytest.approx(0.1)
+    assert causes["compute"] == 0.0
+    assert causes["quorum_server"] == pytest.approx(0.075)
+
+
+def test_heartbeat_vector_order_is_pinned() -> None:
+    led = StepLedger()
+    led.observe_step(1, 1.0, {"heal": 250.0})
+    ratio, compute, lost = led.heartbeat_vector()
+    assert len(lost) == len(LOST_CAUSES)
+    assert lost[LOST_CAUSES.index("heal")] == pytest.approx(0.25)
+    assert ratio == pytest.approx(0.75)
+    assert compute == pytest.approx(0.75)
+
+
+# ---------------------------------------------------------------------------
+# Stream rollup + cross-check
+# ---------------------------------------------------------------------------
+
+
+def _summary(rid, step, ts, causes, committed=True):
+    return {
+        "event": "step_summary", "replica_id": rid, "step": step, "ts": ts,
+        "committed": committed,
+        "ledger": {"causes": causes, "goodput_ratio": None},
+    }
+
+
+def test_ledger_rollup_totals_and_fraction() -> None:
+    events = [
+        _summary("g0:u1", 1, 10.0, {"compute": 0.9, "heal": 0.1}),
+        _summary("g0:u1", 2, 11.0, {"compute": 0.8, "stall": 0.2}),
+        _summary("g1:u2", 1, 10.1, {"compute": 1.0}),
+        # Failed commits never carry a ledger, but a malformed stream must
+        # not crash the rollup either.
+        _summary("g1:u2", 2, 11.1, {"compute": 9.0}, committed=False),
+    ]
+    roll = ledger_rollup(events)
+    assert roll["totals"]["compute"] == pytest.approx(2.7)
+    assert roll["totals"]["heal"] == pytest.approx(0.1)
+    assert roll["productive_fraction"] == pytest.approx(2.7 / 3.0)
+    assert set(roll["per_replica"]) == {"g0:u1", "g1:u2"}
+    # And report.attribute surfaces the same rollup as its "ledger" section.
+    from torchft_tpu.obs import report
+
+    out = report.attribute(events)
+    assert out["ledger"]["totals"]["compute"] == pytest.approx(2.7)
+
+
+def test_crosscheck_agrees_on_synthetic_kill() -> None:
+    """Commit timelines with one kill gap: the dead-window headline and
+    the ledger/report gap classification must agree within 5%."""
+    events = []
+    for g in ("0", "1"):
+        for i in range(40):
+            ts = 100.0 + i
+            if g == "1" and 115.0 < ts < 127.0:
+                continue  # the dead window
+            # The restarted victim is a NEW incarnation (fresh uuid), as
+            # in a real kill run — the gap is uncovered stream time.
+            rid = f"{g}:u2" if g == "1" and ts >= 127.0 else f"{g}:u"
+            events.append({
+                "event": "commit", "replica_id": rid, "step": i,
+                "committed": True, "ts": ts, "t_mono": ts,
+            })
+            events.append(_summary(rid, i, ts + 0.001, {"compute": 0.95,
+                                                        "other_ft": 0.05}))
+    events.append({"event": "fault", "kind": "kill", "group": "1",
+                   "ts": 116.0, "replica_id": "bench-driver"})
+    events.sort(key=lambda ev: ev["ts"])
+    out = crosscheck_goodput(events)
+    assert out["deadwindow_fraction"] is not None
+    assert out["ledger_fraction"] is not None
+    assert out["ok"], out
+    assert out["disagreement"] <= 0.05
+
+
+# ---------------------------------------------------------------------------
+# Static pins: one taxonomy, everywhere
+# ---------------------------------------------------------------------------
+
+
+def test_cause_taxonomy_pinned_in_native_and_docs() -> None:
+    src = open(os.path.join(REPO, "native", "src", "lighthouse.cc")).read()
+    m = re.search(
+        r"kLedgerCauses\[kLedgerCauseCount\]\s*=\s*\{(.*?)\}", src, re.S
+    )
+    assert m, "kLedgerCauses array missing from lighthouse.cc"
+    native_causes = re.findall(r'"([a-z_]+)"', m.group(1))
+    assert tuple(native_causes) == LOST_CAUSES, (
+        "native kLedgerCauses diverged from obs.ledger.LOST_CAUSES"
+    )
+    count = re.search(r"kLedgerCauseCount\s*=\s*(\d+)", open(
+        os.path.join(REPO, "native", "src", "lighthouse.h")).read())
+    assert count and int(count.group(1)) == len(LOST_CAUSES)
+    wire_md = open(os.path.join(REPO, "docs", "wire.md")).read()
+    for cause in CAUSES:
+        assert f"`{cause}`" in wire_md, (
+            f"cause {cause!r} undocumented in docs/wire.md"
+        )
+
+
+def test_gauges_and_endpoints_pinned() -> None:
+    src = open(os.path.join(REPO, "native", "src", "lighthouse.cc")).read()
+    wire_md = open(os.path.join(REPO, "docs", "wire.md")).read()
+    for name in (
+        "tpuft_goodput_ratio",
+        "tpuft_replica_goodput_ratio",
+        "tpuft_compute_seconds_total",
+        "tpuft_lost_seconds_total",
+        "tpuft_goodput_ewma",
+        "tpuft_incidents_total",
+        "/goodput.json",
+        "/incident.json",
+    ):
+        assert name in src, f"{name} missing from lighthouse.cc"
+        assert name in wire_md, f"{name} undocumented in docs/wire.md"
+    proto = open(os.path.join(REPO, "proto", "tpuft.proto")).read()
+    for field in ("goodput_ratio", "ledger_compute_seconds",
+                  "ledger_lost_seconds"):
+        assert field in proto, f"heartbeat field {field} missing from proto"
+
+
+# ---------------------------------------------------------------------------
+# Native pipeline: set_ledger -> heartbeat -> lighthouse rollup + incidents
+# ---------------------------------------------------------------------------
+
+
+def test_set_ledger_feeds_goodput_json_and_metrics() -> None:
+    from torchft_tpu._native import LighthouseServer, ManagerServer
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=5000,
+    )
+    manager = None
+    try:
+        port = lighthouse.http_address().rsplit(":", 1)[1]
+        manager = ManagerServer(
+            replica_id="g0:led",
+            lighthouse_addr=lighthouse.address(),
+            bind="127.0.0.1:0",
+            heartbeat_interval_ms=25,
+        )
+        manager.set_status(5, "step")
+        lost = [0.0] * len(LOST_CAUSES)
+        lost[LOST_CAUSES.index("heal")] = 2.0
+        lost[LOST_CAUSES.index("stall")] = 1.0
+        manager.set_ledger(0.7, 7.0, lost)
+        deadline = time.monotonic() + 5.0
+        doc = {}
+        while time.monotonic() < deadline:
+            doc = json.loads(_get(f"http://127.0.0.1:{port}/goodput.json"))
+            if doc.get("per_replica"):
+                break
+            time.sleep(0.05)
+        assert doc["per_replica"]["g0:led"]["goodput_ratio"] == pytest.approx(0.7)
+        assert doc["per_replica"]["g0:led"]["lost_seconds"]["heal"] == 2.0
+        assert doc["compute_seconds"] == pytest.approx(7.0)
+        assert doc["goodput_ratio"] == pytest.approx(0.7)
+        text = _get(f"http://127.0.0.1:{port}/metrics")
+        assert 'tpuft_replica_goodput_ratio{replica="g0:led"} 0.7' in text
+        assert 'tpuft_lost_seconds_total{cause="heal"} 2' in text
+        assert "tpuft_goodput_ratio 0.7" in text
+    finally:
+        if manager is not None:
+            manager.shutdown()
+        lighthouse.shutdown()
+
+
+def test_ledger_banked_across_incarnation_churn() -> None:
+    """A departed incarnation's counters fold into the cluster bank: the
+    totals never go backwards when its entry is evicted."""
+    from torchft_tpu._native import (
+        LighthouseClient,
+        LighthouseServer,
+        ManagerServer,
+    )
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=5000,
+    )
+    port = lighthouse.http_address().rsplit(":", 1)[1]
+
+    def cluster_compute() -> float:
+        return json.loads(
+            _get(f"http://127.0.0.1:{port}/goodput.json")
+        )["compute_seconds"]
+
+    m1 = m2 = None
+    try:
+        m1 = ManagerServer(
+            replica_id="g0:one", lighthouse_addr=lighthouse.address(),
+            bind="127.0.0.1:0", heartbeat_interval_ms=25,
+        )
+        m1.set_status(1, "step")
+        m1.set_ledger(1.0, 4.0, [0.0] * len(LOST_CAUSES))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and cluster_compute() < 4.0:
+            time.sleep(0.05)
+        assert cluster_compute() == pytest.approx(4.0)
+        # Supervisor evicts the incarnation: the totals must persist (and
+        # the eviction records a kill-signature incident).
+        client = LighthouseClient(lighthouse.address())
+        assert client.evict("g0") == 1
+        assert cluster_compute() == pytest.approx(4.0)
+        inc = json.loads(_get(f"http://127.0.0.1:{port}/incident.json"))
+        assert any(
+            rec["reason"] == "replica_evicted" and rec["replica_id"] == "g0"
+            for rec in inc["incidents"]
+        )
+        # The replacement's counters ADD on top of the bank.
+        m2 = ManagerServer(
+            replica_id="g0:two", lighthouse_addr=lighthouse.address(),
+            bind="127.0.0.1:0", heartbeat_interval_ms=25,
+        )
+        m2.set_status(2, "step")
+        m2.set_ledger(1.0, 3.0, [0.0] * len(LOST_CAUSES))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and cluster_compute() < 7.0:
+            time.sleep(0.05)
+        assert cluster_compute() == pytest.approx(7.0)
+    finally:
+        for m in (m1, m2):
+            if m is not None:
+                m.shutdown()
+        lighthouse.shutdown()
+
+
+def test_resumed_incarnation_does_not_double_count() -> None:
+    """An incarnation pruned for heartbeat STALENESS (long stall, not a
+    death) that later resumes re-reports the same monotonic counters; its
+    banked share must be subtracted on re-ingestion or the cluster totals
+    count it twice."""
+    from torchft_tpu._native import LighthouseServer, ManagerServer
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=200,
+    )
+    port = lighthouse.http_address().rsplit(":", 1)[1]
+
+    def cluster_compute() -> float:
+        return json.loads(
+            _get(f"http://127.0.0.1:{port}/goodput.json")
+        )["compute_seconds"]
+
+    m = None
+    try:
+        m = ManagerServer(
+            replica_id="g0:resume", lighthouse_addr=lighthouse.address(),
+            bind="127.0.0.1:0", heartbeat_interval_ms=25,
+        )
+        m.set_status(1, "step")
+        m.set_ledger(1.0, 4.0, [0.0] * len(LOST_CAUSES))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and cluster_compute() < 4.0:
+            time.sleep(0.05)
+        assert cluster_compute() == pytest.approx(4.0)
+        # "Stall": heartbeats stop long enough for the graveyard prune to
+        # bank the entry (10x the 200 ms timeout).  Wait until the live
+        # per-replica entry is GONE — proof the bank actually happened —
+        # while the cluster total persists.
+        m.shutdown()
+        m = None
+        deadline = time.monotonic() + 10.0
+        pruned = False
+        while time.monotonic() < deadline and not pruned:
+            doc = json.loads(_get(f"http://127.0.0.1:{port}/goodput.json"))
+            pruned = "g0:resume" not in doc.get("per_replica", {})
+            time.sleep(0.1)
+        assert pruned, "ledger entry never pruned to the bank"
+        assert cluster_compute() == pytest.approx(4.0)
+        # Resume: the SAME incarnation id reports slightly advanced
+        # counters.  Totals must read 4.5, not 8.5.
+        m = ManagerServer(
+            replica_id="g0:resume", lighthouse_addr=lighthouse.address(),
+            bind="127.0.0.1:0", heartbeat_interval_ms=25,
+        )
+        m.set_status(2, "step")
+        m.set_ledger(1.0, 4.5, [0.0] * len(LOST_CAUSES))
+        deadline = time.monotonic() + 5.0
+        val = 0.0
+        while time.monotonic() < deadline:
+            val = cluster_compute()
+            if val >= 4.5:
+                break
+            time.sleep(0.05)
+        assert val == pytest.approx(4.5), (
+            f"cluster compute read {val}: a resumed incarnation was "
+            "double-counted against its banked share"
+        )
+    finally:
+        if m is not None:
+            m.shutdown()
+        lighthouse.shutdown()
+
+
+def test_stale_heartbeat_records_incident() -> None:
+    """An UNANNOUNCED heartbeat loss (no evict, no drain) is the other
+    kill signature: SweepLocked's fresh->stale transition records a
+    replica_stale incident."""
+    from torchft_tpu._native import LighthouseClient, LighthouseServer
+
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=300,
+    )
+    try:
+        port = lighthouse.http_address().rsplit(":", 1)[1]
+        client = LighthouseClient(lighthouse.address())
+        client.heartbeat("g7:dead", step=3, state="step")
+        deadline = time.monotonic() + 8.0
+        found = []
+        while time.monotonic() < deadline and not found:
+            inc = json.loads(_get(f"http://127.0.0.1:{port}/incident.json"))
+            found = [
+                rec for rec in inc["incidents"]
+                if rec["reason"] == "replica_stale"
+                and rec["replica_id"] == "g7:dead"
+            ]
+            time.sleep(0.1)
+        assert found, "stale heartbeat never recorded an incident"
+        assert found[0]["step"] == 3
+    finally:
+        lighthouse.shutdown()
+
+
+def test_worker_hop_histograms_monotonic_over_sliding_ring() -> None:
+    """The worker /metrics hop histograms must stay monotonic even though
+    their source is a bounded SLIDING ring: scrape 2 sees records 0-9
+    replaced by 5-14 and the exposed _count must only grow (a decrease
+    reads as a Prometheus counter reset)."""
+    import re as _re
+    import threading
+    from types import SimpleNamespace
+
+    from torchft_tpu.manager import Manager
+
+    window = [
+        {"ts": 100.0 + i, "tier": 0, "send_s": 0.001, "recv_s": 0.002,
+         "comb_s": 0.0005, "nbytes": 4096}
+        for i in range(10)
+    ]
+    fake = SimpleNamespace(
+        _collective=SimpleNamespace(hop_records=lambda: list(window)),
+        _replica_id="g0:hh",
+        _hop_hist={},
+        _hop_hist_last_ts=0.0,
+        _hop_hist_lock=threading.Lock(),
+    )
+
+    def count_of(text: str) -> int:
+        m = _re.search(
+            r'tpuft_worker_hop_latency_seconds_count\{[^}]*tier="0"\} (\d+)',
+            text,
+        )
+        assert m, text
+        return int(m.group(1))
+
+    first = Manager._render_hop_histograms(fake)
+    assert count_of(first) == 10
+    # Ring slides: 5 old records fall out, 5 new arrive.  A whole-ring
+    # rebucketization would still read 10 — but re-counted records; after
+    # ANOTHER slide it would drop below.  The monotonic fold reads 15.
+    window[:] = [
+        {"ts": 105.0 + i, "tier": 0, "send_s": 0.001, "recv_s": 0.002,
+         "comb_s": 0.0005, "nbytes": 4096}
+        for i in range(10)
+    ]
+    second = Manager._render_hop_histograms(fake)
+    assert count_of(second) == 15
+    # Idempotent on an unchanged ring (nothing newer than the high-water).
+    third = Manager._render_hop_histograms(fake)
+    assert count_of(third) == 15
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 live mini-cluster smoke
+# ---------------------------------------------------------------------------
+
+
+def test_goodput_quick_smoke(tmp_path, monkeypatch) -> None:
+    """Live 2-group mini-cluster with an injected kill: per-step ledger
+    vectors in the stream sum to the wall, the death records an incident
+    trigger, and the captured bundle's verdict names the victim group."""
+    import numpy as np
+
+    from torchft_tpu._native import LighthouseServer
+    from torchft_tpu.obs import incident as obs_incident
+
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from harness import FailureInjector, Runner, run_replicas
+
+    metrics_path = tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("TPUFT_METRICS_PATH", str(metrics_path))
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=2000,
+        quorum_tick_ms=40, heartbeat_timeout_ms=1000,
+    )
+    http = f"http://127.0.0.1:{lighthouse.http_address().rsplit(':', 1)[1]}"
+
+    def train_loop(runner, rank: int):
+        from datetime import timedelta
+
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+        from torchft_tpu.collectives import TCPCollective
+        from torchft_tpu.manager import Manager
+
+        state = {"w": np.zeros(64, dtype=np.float32)}
+        manager = Manager(
+            collective=TCPCollective(timeout=20.0),
+            load_state_dict=lambda sd: state.update(sd),
+            state_dict=lambda: dict(state),
+            min_replica_size=1,
+            timeout=timedelta(seconds=20),
+            quorum_timeout=timedelta(seconds=20),
+            rank=0,
+            world_size=1,
+            replica_id=str(runner.replica_id),
+            lighthouse_addr=runner.lighthouse_address,
+            checkpoint_transport=HTTPTransport(timeout=20.0),
+        )
+        try:
+            while manager.current_step() < 6:
+                manager.start_quorum()
+                fut = manager.allreduce(np.ones(64, dtype=np.float32))
+                out = fut.result()
+                if manager.should_commit():
+                    state["w"] = state["w"] + np.asarray(out)
+                runner.failure_injector.check(
+                    runner.replica_id, manager.current_step()
+                )
+            return manager.current_step()
+        finally:
+            manager.shutdown()
+
+    try:
+        inj = FailureInjector().fail_at(1, 3)
+        runners = [
+            Runner(
+                replica_id=i,
+                lighthouse_address=lighthouse.address(),
+                failure_injector=inj if i == 1 else FailureInjector(),
+                train_loop=train_loop,
+            )
+            for i in range(2)
+        ]
+        results = run_replicas(runners)
+        assert all(r[0] >= 6 for r in results)
+
+        # Ledger vectors ride the stream and sum to the step wall.
+        from torchft_tpu.obs.report import read_events
+
+        events = read_events([str(metrics_path)])
+        ledgered = [
+            ev for ev in events
+            if ev.get("event") == "step_summary"
+            and isinstance(ev.get("ledger"), dict)
+        ]
+        assert ledgered, "no step_summary carried a ledger vector"
+        for ev in ledgered:
+            causes = ev["ledger"]["causes"]
+            assert set(causes) <= set(CAUSES)
+            wall_s = float(ev.get("step_wall_ms", 0.0)) / 1e3
+            if wall_s > 0:
+                assert sum(causes.values()) == pytest.approx(
+                    wall_s, rel=0.05, abs=0.01
+                )
+
+        # The injected death left the old incarnation's heartbeat stale ->
+        # an incident trigger; capture + verdict must name group 1.
+        watcher = obs_incident.IncidentWatcher(http)
+        deadline = time.monotonic() + 12.0
+        triggers = []
+        while time.monotonic() < deadline and not triggers:
+            triggers = [
+                t for t in watcher.poll()
+                if t["reason"] in ("replica_stale", "replica_evicted")
+                and str(t["replica_id"]).split(":", 1)[0] == "1"
+            ]
+            time.sleep(0.1)
+        assert triggers, "injected kill recorded no incident trigger"
+        bundle = obs_incident.capture_bundle(
+            str(tmp_path), http, triggers[0], metrics_paths=[str(metrics_path)]
+        )
+        manifest = obs_incident.finalize_bundle(
+            bundle, str(tmp_path), events=events
+        )
+        v = manifest["verdict"]
+        assert v["kind"] == "kill" and v["replica"] == "1", v
+        assert os.path.exists(os.path.join(bundle, "goodput.json"))
+        assert os.path.exists(os.path.join(bundle, "lighthouse_flight.json"))
+        # The cluster ledger saw both groups.
+        goodput = json.loads(_get(f"{http}/goodput.json"))
+        assert goodput["compute_seconds"] > 0.0
+    finally:
+        lighthouse.shutdown()

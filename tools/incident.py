@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Incident bundle CLI: capture from a live lighthouse, or re-verdict an
+existing bundle.
+
+Capture (live lighthouse + a run workdir)::
+
+    python tools/incident.py capture <workdir> --lighthouse http://host:port
+    # polls /incident.json once; for every recorded trigger, writes
+    # incident_<step>/ under <workdir> (state snapshot + span tails +
+    # any dumps already on disk) and prints the manifest with its verdict
+
+Re-verdict (post-mortem, bundle already on disk)::
+
+    python tools/incident.py verdict <workdir>/incident_42 [--json]
+
+The heavy lifting lives in torchft_tpu/obs/incident.py — the same code
+the bench cells and the tier-1 smoke drive; this file is the operator
+entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/incident.py",
+        description="Capture or analyze tpu-ft incident bundles",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    cap = sub.add_parser("capture", help="poll a live lighthouse and bundle")
+    cap.add_argument("workdir", help="run workdir (bundles land here)")
+    cap.add_argument("--lighthouse", required=True,
+                     help="lighthouse dashboard address (http://host:port)")
+    cap.add_argument("--metrics", action="append", default=[],
+                     metavar="JSONL",
+                     help="metrics stream(s) to tail into the bundle "
+                     "(default: every *.jsonl under the workdir)")
+    cap.add_argument("--json", action="store_true")
+    ver = sub.add_parser("verdict", help="re-verdict an existing bundle")
+    ver.add_argument("bundle", help="incident_<step> directory")
+    ver.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from torchft_tpu.obs import incident as obs_incident
+
+    if args.cmd == "capture":
+        watcher = obs_incident.IncidentWatcher(args.lighthouse)
+        triggers = watcher.poll()
+        if not triggers:
+            print("no incident triggers recorded", file=sys.stderr)
+            return 1
+        # Earlier bundles' spans_tail.jsonl must not be re-tailed as live
+        # streams — that would duplicate records into every later
+        # bundle's verdict arithmetic.
+        metrics = args.metrics or sorted(
+            p
+            for p in glob.glob(
+                os.path.join(args.workdir, "**", "*.jsonl"), recursive=True
+            )
+            if not any(
+                part.startswith("incident_")
+                for part in os.path.relpath(p, args.workdir).split(os.sep)
+            )
+        )
+        manifests = []
+        for trig in triggers:
+            bundle = obs_incident.capture_bundle(
+                args.workdir, args.lighthouse, trig, metrics_paths=metrics
+            )
+            manifests.append(
+                {"bundle": bundle,
+                 "manifest": obs_incident.finalize_bundle(bundle, args.workdir)}
+            )
+        if args.json:
+            json.dump(manifests, sys.stdout)
+            print()
+        else:
+            for m in manifests:
+                v = m["manifest"].get("verdict", {})
+                print(f"{m['bundle']}: kind={v.get('kind')} "
+                      f"replica={v.get('replica')} cause={v.get('cause')} "
+                      f"lost_s={v.get('lost_s')}")
+        return 0
+
+    v = obs_incident.verdict(args.bundle)
+    if args.json:
+        json.dump(v, sys.stdout)
+        print()
+    else:
+        print(json.dumps(v, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

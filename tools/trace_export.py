@@ -102,6 +102,51 @@ def main(argv=None) -> int:
         )
         if not hop_slices:
             problems.append("no hop slices in --quick trace")
+        # Incident-bundle roundtrip (obs/incident.py): a synthetic kill
+        # bundle built from the same stream must write, reload, and
+        # verdict onto the injected victim group — the tier-1 pin that
+        # the bundle schema and the verdict engine stay in sync.
+        from torchft_tpu.obs import incident as obs_incident
+
+        import shutil
+
+        incident_ok = False
+        broot = None
+        try:
+            broot = tempfile.mkdtemp(prefix="tpuft_incident_quick_")
+            bundle = os.path.join(broot, "incident_4")
+            os.makedirs(bundle, exist_ok=True)
+            with open(
+                os.path.join(bundle, "spans_tail.jsonl"), "w", encoding="utf-8"
+            ) as f:
+                for ev in events:
+                    f.write(json.dumps(ev) + "\n")
+            trig = {
+                "id": 1, "reason": "replica_stale", "replica_id": "1:b1",
+                "step": 4, "ts_ms": 1_700_000_002_400, "detail": 500.0,
+            }
+            with open(
+                os.path.join(bundle, "incident.json"), "w", encoding="utf-8"
+            ) as f:
+                json.dump(
+                    {"schema": 1, "incidents": [trig],
+                     "artifacts": {"spans_tail.jsonl": "tail"}}, f
+                )
+            manifest = obs_incident.finalize_bundle(bundle, broot)
+            v = manifest.get("verdict", {})
+            incident_ok = (
+                v.get("kind") == "kill"
+                and v.get("replica") == "1"
+                and v.get("lost_s") is not None
+                and obs_incident.load_bundle(bundle)["manifest"]["incidents"]
+            )
+        except Exception as e:  # noqa: BLE001 — report, don't crash --quick
+            problems.append(f"incident bundle roundtrip raised: {e}")
+        finally:
+            if broot is not None:
+                shutil.rmtree(broot, ignore_errors=True)
+        if not incident_ok and not problems:
+            problems.append("incident bundle verdict failed to name the victim")
         out = args.out
         if out is None:
             fd, out = tempfile.mkstemp(prefix="tpuft_trace_", suffix=".json")
@@ -119,6 +164,7 @@ def main(argv=None) -> int:
                     "control_plane_tracks": len(cp_tracks),
                     "data_plane_tracks": dp_tracks,
                     "hop_slices": hop_slices,
+                    "incident_bundle_ok": bool(incident_ok),
                     "problems": problems,
                 }
             )

@@ -203,6 +203,20 @@ def _load_lib() -> ctypes.CDLL:
     ]
     lib.tf_manager_flight_json.restype = ctypes.c_void_p
     lib.tf_manager_flight_json.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    try:
+        # Goodput-ledger push (heartbeat fields 14-16).  Declared inside a
+        # probe: a stale .so without the symbol degrades to status-only
+        # heartbeats (ManagerServer.set_ledger becomes a no-op) instead of
+        # failing the module import.
+        lib.tf_manager_set_ledger.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+        ]
+    except AttributeError:
+        pass
     lib.tf_manager_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_manager_free.argtypes = [ctypes.c_void_p]
     lib.tf_store_new.restype = ctypes.c_void_p
@@ -938,6 +952,30 @@ class ManagerServer:
                 float(link_send_gbps),
                 float(link_hop_rtt_ms),
             )
+
+    def set_ledger(
+        self,
+        goodput_ratio: float,
+        compute_seconds: float,
+        lost_seconds: "list[float]",
+    ) -> None:
+        """Pushes the goodput ledger's cumulative counters onto heartbeat
+        fields 14-16 (docs/wire.md "Goodput ledger"): the replica's
+        productive fraction, productive seconds, and per-cause lost
+        seconds in the PINNED taxonomy order
+        (:data:`torchft_tpu.obs.ledger.LOST_CAUSES`).  Called once per
+        commit vote; counters are monotonic per incarnation.  No-op
+        against a stale libtpuft.so without the symbol."""
+        if not self._ptr or not hasattr(_lib, "tf_manager_set_ledger"):
+            return
+        arr = (ctypes.c_double * len(lost_seconds))(*lost_seconds)
+        _lib.tf_manager_set_ledger(
+            self._ptr,
+            float(goodput_ratio),
+            float(compute_seconds),
+            arr,
+            len(lost_seconds),
+        )
 
     def flight_json(self, limit: int = 0) -> str:
         """Flight-recorder snapshot (newest-first JSON document; ``limit``

@@ -153,6 +153,12 @@ class ManagerServer:
         link_send_gbps: float = ...,
         link_hop_rtt_ms: float = ...,
     ) -> None: ...
+    def set_ledger(
+        self,
+        goodput_ratio: float,
+        compute_seconds: float,
+        lost_seconds: List[float],
+    ) -> None: ...
     def flight_json(self, limit: int = ...) -> str: ...
     def flight(self, limit: int = ...) -> Dict[str, Any]: ...
     def shutdown(self) -> None: ...

@@ -267,6 +267,24 @@ class Manager:
         # phase below runs inside a span, and the span's single monotonic
         # measurement also feeds the legacy *_ms fields.
         self._spans = SpanTracker(self._metrics)
+        # Goodput ledger (obs/ledger.py): every committed step's wall time
+        # classified into the pinned cause taxonomy at the commit vote —
+        # the per-step vector rides step_summary, the cumulative counters
+        # ride heartbeat fields 14-16 into the lighthouse's cluster ledger
+        # (/goodput.json, tpuft_goodput_ratio, tpuft_lost_seconds_total).
+        from torchft_tpu.obs.ledger import StepLedger
+
+        self._ledger = StepLedger()
+        # The ledger's own commit clock + failed-attempt phase buffer: a
+        # failed vote resets the STEP-TIME clock (_last_commit_mono —
+        # a retry-spanning interval would misread as slowness) but the
+        # ledger must still charge the retried interval, so it keeps its
+        # own last-commit mark and accumulates the failed attempts'
+        # phases until the step finally commits (the documented rule in
+        # obs/ledger.py: the retries' charges land in the eventual
+        # committed interval).
+        self._ledger_prev_commit_mono: Optional[float] = None
+        self._ledger_pending_phases: Dict[str, float] = {}
         # Straggler-sentinel telemetry: rolling busy-time per committed step
         # (EWMA + p50/p99), pushed onto lighthouse heartbeats via SetStatus
         # so the cluster-level health scoring sees this replica's pace.
@@ -368,6 +386,15 @@ class Manager:
             replica_id=self._replica_id,
             provider=self._worker_metrics_snapshot,
         )
+        # Per-hop wire-byte + latency histograms, folded at SCRAPE time
+        # from the ring engines' retained hop timeline — no new recording
+        # cost on the data path (docs/wire.md "Worker /metrics").  The
+        # cumulative buckets live here (scrape-thread-only state) so the
+        # exposed histograms stay monotonic over the sliding ring.
+        self._hop_hist: Dict[int, dict] = {}
+        self._hop_hist_last_ts = 0.0
+        self._hop_hist_lock = threading.Lock()
+        self._worker_metrics.add_section(self._render_hop_histograms)
         self._worker_metrics.serve()
 
         self._wire_transport_spans()
@@ -1303,7 +1330,162 @@ class Manager:
               round(ew.get("send_gbps", 0.0), 4))
             g("tpuft_link_hop_rtt_ms", "mean per-hop recv-wait, ms",
               round(ew.get("rtt_ms", 0.0), 3))
+        # Goodput ledger (worker-side view; the lighthouse aggregates the
+        # same counters cluster-wide from heartbeat fields 14-16).
+        led = self._ledger.snapshot()
+        if led["steps"]:
+            g("tpuft_worker_goodput_ratio",
+              "cumulative productive fraction of accounted step wall",
+              led["goodput_ratio"] if led["goodput_ratio"] is not None else -1.0)
+            g("tpuft_worker_compute_seconds_total",
+              "productive seconds accounted by the goodput ledger",
+              led["compute_s"], kind="counter")
+            for cause, v in sorted(led["lost_s"].items()):
+                g("tpuft_worker_lost_seconds_total",
+                  "lost seconds per ledger cause (pinned taxonomy, "
+                  "obs/ledger.py CAUSES)",
+                  v, kind="counter", labels=(("cause", cause),))
         return series
+
+    def _render_hop_histograms(self) -> str:
+        """Worker /metrics section: per-hop latency + wire-byte histograms
+        per ring tier, fed from the collective's retained hop timeline
+        (``hop_records``) — the sampled ring the data-plane flight
+        recorder already keeps, so scraping adds no recording cost.
+
+        MONOTONIC across scrapes: the timeline is a bounded SLIDING ring,
+        so rebucketizing the whole ring each scrape would re-count old
+        records and DROP counts when they age out — Prometheus reads any
+        decrease in a histogram series as a counter reset.  Instead each
+        scrape folds only records NEWER than the previous scrape's
+        high-water timestamp into cumulative per-tier buckets (records
+        that fall off the ring between scrapes are missed — an undercount
+        under sparse scraping, never a reset)."""
+        hop_records = getattr(self._collective, "hop_records", None)
+        if not callable(hop_records):
+            return ""
+        try:
+            recs = hop_records()
+        except Exception:  # noqa: BLE001 — telemetry only
+            return ""
+        from torchft_tpu.obs.prom import (
+            HOP_BYTES_BOUNDS,
+            HOP_LATENCY_BOUNDS,
+            bucketize,
+            render_histogram_counts,
+        )
+
+        with self._hop_hist_lock:
+            last_ts = self._hop_hist_last_ts
+            for r in recs:
+                ts = float(r.get("ts", 0.0))
+                if ts <= last_ts:
+                    continue
+                tier = int(r.get("tier", 0))
+                slot = self._hop_hist.setdefault(
+                    tier,
+                    {
+                        "lat": [0] * (len(HOP_LATENCY_BOUNDS) + 1),
+                        "lat_sum": 0.0,
+                        "bytes": [0] * (len(HOP_BYTES_BOUNDS) + 1),
+                        "bytes_sum": 0.0,
+                    },
+                )
+                lat = (
+                    float(r.get("send_s", 0.0))
+                    + float(r.get("recv_s", 0.0))
+                    + float(r.get("comb_s", 0.0))
+                )
+                _, dsum = bucketize(HOP_LATENCY_BOUNDS, (lat,), slot["lat"])
+                slot["lat_sum"] += dsum
+                _, dsum = bucketize(
+                    HOP_BYTES_BOUNDS, (float(r.get("nbytes", 0)),),
+                    slot["bytes"],
+                )
+                slot["bytes_sum"] += dsum
+                self._hop_hist_last_ts = max(self._hop_hist_last_ts, ts)
+            if not self._hop_hist:
+                return ""
+            lat_series = []
+            byte_series = []
+            for tier in sorted(self._hop_hist):
+                labels = (
+                    ("replica", self._replica_id),
+                    ("tier", str(tier)),
+                )
+                slot = self._hop_hist[tier]
+                lat_series.append((labels, list(slot["lat"]), slot["lat_sum"]))
+                byte_series.append(
+                    (labels, list(slot["bytes"]), slot["bytes_sum"])
+                )
+        out = render_histogram_counts(
+            "tpuft_worker_hop_latency_seconds",
+            "per-hop wall time (send-block + recv-wait + combine) from the "
+            "retained hop timeline, per ring tier (sampled per "
+            "TPUFT_HOP_SAMPLE; monotonic across scrapes)",
+            HOP_LATENCY_BOUNDS, lat_series,
+        )
+        out += render_histogram_counts(
+            "tpuft_worker_hop_wire_bytes",
+            "per-hop wire payload bytes from the retained hop timeline, "
+            "per ring tier (monotonic across scrapes)",
+            HOP_BYTES_BOUNDS, byte_series,
+        )
+        return out
+
+    # -- goodput ledger (docs/architecture.md "Goodput ledger") -------------
+
+    def _quorum_server_ms(self) -> Optional[float]:
+        """Server-side share of this step's quorum wait, from the group's
+        own native ManagerServer flight ring: the ``ManagerQuorum`` RPC
+        span for the current trace id covers the local-rank aggregation +
+        the lighthouse round (formation wait included) — everything that
+        is NOT this client's transport.  The ledger splits the quorum
+        cause with it (quorum_server vs quorum_transport).  None when no
+        server runs here (rank != 0, fake-wire tests) or the ring holds no
+        matching span — the ledger then charges the whole wait as
+        quorum_server rather than fabricating a split."""
+        srv = self._manager_server
+        if srv is None or not self._trace_id:
+            return None
+        flight = getattr(srv, "flight", None)
+        if not callable(flight):
+            return None
+        try:
+            dump = flight(limit=32)
+        except Exception:  # noqa: BLE001 — telemetry only
+            return None
+        total, seen = 0.0, False
+        for ev in dump.get("events", []):
+            if (
+                isinstance(ev, dict)
+                and ev.get("kind") == "rpc"
+                and ev.get("method") == "ManagerQuorum"
+                and ev.get("trace_id") == self._trace_id
+            ):
+                total += max(0.0, float(ev.get("dur_us", 0)) / 1e3)
+                seen = True
+        return total if seen else None
+
+    def _push_ledger(self) -> None:
+        """Pushes the ledger's cumulative counters onto heartbeat fields
+        14-16 (best-effort; rank != 0 has no server, and status must never
+        fail a step)."""
+        srv = self._manager_server
+        if srv is None or not hasattr(srv, "set_ledger"):
+            return
+        try:
+            ratio, compute_s, lost = self._ledger.heartbeat_vector()
+            srv.set_ledger(ratio, compute_s, lost)
+        except Exception:  # noqa: BLE001
+            pass
+
+    @property
+    def ledger(self):
+        """The Manager's :class:`~torchft_tpu.obs.ledger.StepLedger` —
+        public so benches and tests can read the cumulative cause totals
+        without re-parsing the stream."""
+        return self._ledger
 
     # -- status -------------------------------------------------------------
 
@@ -1399,6 +1581,7 @@ class Manager:
             ar_fields["d2h_bytes"] = d2h_bytes
             ar_fields["h2d_bytes"] = h2d_bytes
         ar_gbps: Optional[float] = None
+        lanes_snap: Optional[dict] = None
         if ar_bytes and ar_t_first is not None:
             if ar_t_last is None or ar_t_last <= ar_t_first:
                 ar_t_last = time.monotonic()
@@ -1461,7 +1644,10 @@ class Manager:
         # wall-minus-waits identifies the host that actually computed the
         # whole time.  Failed commits produce no observation (their eventual
         # commit interval spans the retries and would misread as slowness).
-        step_time_fields: Dict[str, float] = {}
+        step_time_fields: Dict[str, object] = {}
+        # Ledger classification reads the span accumulation BEFORE
+        # step_summary flushes it (obs/ledger.py).
+        phases_now = self._spans.phases_ms()
         if should_commit:
             now_mono = time.monotonic()
             if self._last_commit_mono is not None:
@@ -1476,8 +1662,55 @@ class Manager:
                     "step_time_ms_p50": snap["p50"],
                     "step_time_ms_p99": snap["p99"],
                 }
+            # Ledger interval: from the ledger's own last-commit mark, so
+            # a retried step's wall (failed votes included) is charged in
+            # this one committed observation, with the failed attempts'
+            # buffered phases merged in.
+            if self._ledger_prev_commit_mono is not None:
+                ledger_wall_s = now_mono - self._ledger_prev_commit_mono
+                ledger_phases = dict(self._ledger_pending_phases)
+                for k, v in phases_now.items():
+                    ledger_phases[k] = ledger_phases.get(k, 0.0) + float(v)
+                # The server/transport split costs a flight-ring read
+                # (small JSON parse); only pay it when the quorum wait is
+                # big enough for the split to mean anything — steady-state
+                # sub-50 ms waits charge the lump to quorum_server, and
+                # the ledger's commit-path cost stays sub-0.1 ms.
+                q_server_ms = (
+                    self._quorum_server_ms()
+                    if ledger_phases.get("quorum", 0.0) > 50.0
+                    else None
+                )
+                causes = self._ledger.observe_step(
+                    vote_step,
+                    ledger_wall_s,
+                    ledger_phases,
+                    lanes=lanes_snap,
+                    committed=True,
+                    draining=self.drain_requested(),
+                    quorum_server_ms=q_server_ms,
+                )
+                if causes is not None:
+                    step_time_fields["ledger"] = {
+                        "causes": {k: round(v, 4) for k, v in causes.items()},
+                        "goodput_ratio": self._ledger.goodput_ratio(),
+                    }
+                self._push_ledger()
+            self._ledger_pending_phases = {}
+            self._ledger_prev_commit_mono = now_mono
             self._last_commit_mono = now_mono
         else:
+            # Failed votes produce no ledger observation, but their
+            # phases buffer into the eventual committed interval's charge
+            # and the hop-delta window still advances so the retried
+            # step's stalls are not double-charged.
+            for k, v in phases_now.items():
+                self._ledger_pending_phases[k] = (
+                    self._ledger_pending_phases.get(k, 0.0) + float(v)
+                )
+            self._ledger.observe_step(
+                vote_step, 0.0, phases_now, lanes=lanes_snap, committed=False
+            )
             self._last_commit_mono = None
         self._spans.step_summary(
             vote_step, committed=should_commit, **step_time_fields, **ar_fields

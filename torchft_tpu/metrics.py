@@ -104,6 +104,12 @@ EVENTS = {
              "group=victim) — written by the benchmark driver so "
              "obs/report.py sees the same fault timeline the goodput "
              "accounting charges",
+    # -- incident auto-capture (obs/incident.py, bench drivers) -------------
+    "incident_captured": "an incident trigger on the lighthouse's "
+                         "/incident.json was bundled into incident_<step>/ "
+                         "(reason, incident_replica, bundle) — stamps the "
+                         "capture into the stream next to the fault it "
+                         "explains",
 }
 
 

@@ -34,12 +34,37 @@ Three halves plus the live exposition:
   shutdown dumps): causal trace ids, quorum-transition reconstruction,
   and conversion into the Perfetto control-plane track.
 
-The live leg — cluster metrics, latency histograms, and the straggler
-sentinel — is served by the native lighthouse (``GET /metrics``,
-``GET /alerts.json``, ``GET /debug/flight.json``; see docs/wire.md).
+- :mod:`torchft_tpu.obs.ledger` — the *accounting* side.  Every committed
+  step's wall classified into the pinned cause taxonomy (``CAUSES``),
+  per-step vectors in ``step_summary.ledger``, cumulative counters on
+  heartbeat fields 14-16, cluster rollup on the lighthouse's
+  ``GET /goodput.json`` — plus the stream rollup and the bench's
+  headline-vs-ledger cross-check.
+
+- :mod:`torchft_tpu.obs.incident` — the *capture* side.  Polls the
+  lighthouse's incident-trigger feed (``GET /incident.json``) and bundles
+  flight rings + alerts + ledger + span tails + dumps into
+  ``incident_<step>/`` with a machine-readable verdict.  CLI::
+
+      python tools/incident.py capture <workdir> --lighthouse http://...
+
+The live leg — cluster metrics, latency histograms, the sentinels, the
+goodput ledger and the incident feed — is served by the native lighthouse
+(``GET /metrics``, ``GET /alerts.json``, ``GET /goodput.json``,
+``GET /incident.json``, ``GET /debug/flight.json``; the cross-plane map
+and knob index live in docs/observability.md).
 """
 
 from torchft_tpu.obs.flight import FLIGHT_EVENTS, mint_trace_id
+from torchft_tpu.obs.ledger import CAUSES, LOST_CAUSES, StepLedger
 from torchft_tpu.obs.spans import SpanTracker, StepTimeStats
 
-__all__ = ["FLIGHT_EVENTS", "SpanTracker", "StepTimeStats", "mint_trace_id"]
+__all__ = [
+    "CAUSES",
+    "FLIGHT_EVENTS",
+    "LOST_CAUSES",
+    "SpanTracker",
+    "StepLedger",
+    "StepTimeStats",
+    "mint_trace_id",
+]

@@ -63,6 +63,9 @@ FLIGHT_EVENTS = {
     "role_change": "HA role flip (leader/follower) with the lease epoch",
     "quorum_result": "manager-side outcome of one aggregated lighthouse "
                      "quorum round (quorum id + size, or failure status)",
+    "incident": "incident-capture trigger recorded (reason, replica, step, "
+                "detail) — mirrored on GET /incident.json for the capture "
+                "driver (obs/incident.py)",
     "shutdown": "server shutting down cleanly (the dump-to-file marker)",
 }
 

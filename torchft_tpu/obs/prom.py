@@ -30,6 +30,11 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 __all__ = [
     "WorkerMetrics",
+    "bucketize",
+    "render_histogram",
+    "render_histogram_counts",
+    "HOP_LATENCY_BOUNDS",
+    "HOP_BYTES_BOUNDS",
     "TPUFT_WORKER_METRICS_PORT_ENV",
     "TPUFT_WORKER_METRICS_BIND_ENV",
 ]
@@ -49,6 +54,101 @@ _alias_warned = False
 
 def _prom_escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+# Shared bucket bounds for the worker-side hop histograms (docs/wire.md
+# "Worker /metrics"): latency covers a loopback hop (~100 µs) to a
+# shaped-WAN hop (~10 s); bytes cover a control frame to a whole-bucket
+# stripe.  Built at SCRAPE time from the ring engines' retained hop
+# timeline (TCPCollective.hop_records) — no new recording cost on the
+# data path.
+HOP_LATENCY_BOUNDS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+HOP_BYTES_BOUNDS = (
+    1024.0, 4096.0, 16384.0, 65536.0, 262144.0, 1048576.0, 4194304.0,
+    16777216.0, 67108864.0, 268435456.0,
+)
+
+
+def bucketize(
+    bounds: Sequence[float], values: Sequence[float],
+    counts: Optional[List[int]] = None,
+) -> Tuple[List[int], float]:
+    """Folds raw observations into per-bucket (non-cumulative) counts over
+    ``bounds`` (+Inf slot last); pass an existing ``counts`` list to
+    ACCUMULATE — the monotonic-histogram building block.  Returns
+    (counts, sum-of-values-added)."""
+    if counts is None:
+        counts = [0] * (len(bounds) + 1)
+    total = 0.0
+    for v in values:
+        total += float(v)
+        for i, b in enumerate(bounds):
+            if v <= b:
+                counts[i] += 1
+                break
+        else:
+            counts[len(bounds)] += 1
+    return counts, total
+
+
+def render_histogram_counts(
+    name: str,
+    help_: str,
+    bounds: Sequence[float],
+    series: Sequence[Tuple[Sequence[Tuple[str, str]], Sequence[int], float]],
+) -> str:
+    """Prometheus text-format histogram family from per-bucket counts
+    (``bucketize`` output): HELP/TYPE once, then cumulative
+    ``_bucket{...,le="..."}`` / ``_sum`` / ``_count`` per (labels, counts,
+    sum) triple.  The worker endpoint's counterpart of the native
+    ``ExposeHistogram`` (flight.h).  Callers exposing these as TYPE
+    histogram must feed MONOTONIC counts (accumulate across scrapes) —
+    Prometheus reads any decrease as a counter reset."""
+    def le_value(b: float) -> str:
+        # The label must ROUND-TRIP to the exact bound bucketize compared
+        # against: %g truncates to 6 significant digits, which renders
+        # 1048576 as "1.04858e+06" — a boundary that does not exist, so
+        # quantile interpolation and le-matching rules silently break.
+        return str(int(b)) if float(b).is_integer() else repr(float(b))
+
+    lines: List[str] = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+    for labels, counts, total in series:
+        pairs = [f'{k}="{_prom_escape(str(v))}"' for k, v in labels]
+        prefix = ",".join(pairs)
+        cum = 0
+        for i, b in enumerate(bounds):
+            cum += counts[i]
+            le = f'le="{le_value(b)}"'
+            label = "{" + (prefix + "," if prefix else "") + le + "}"
+            lines.append(f"{name}_bucket{label} {cum}")
+        cum += counts[len(bounds)]
+        label = "{" + (prefix + "," if prefix else "") + 'le="+Inf"' + "}"
+        lines.append(f"{name}_bucket{label} {cum}")
+        suffix = "{" + prefix + "}" if prefix else ""
+        lines.append(f"{name}_sum{suffix} {round(total, 6)}")
+        lines.append(f"{name}_count{suffix} {cum}")
+    return "\n".join(lines) + "\n"
+
+
+def render_histogram(
+    name: str,
+    help_: str,
+    bounds: Sequence[float],
+    series: Sequence[Tuple[Sequence[Tuple[str, str]], Sequence[float]]],
+) -> str:
+    """One-shot convenience over :func:`bucketize` +
+    :func:`render_histogram_counts` for raw observations.  Only suitable
+    for single renders of a complete value set — repeated scrapes over a
+    SLIDING window must accumulate via ``bucketize`` instead, or the
+    exposed counters go backwards."""
+    folded = []
+    for labels, values in series:
+        counts, total = bucketize(bounds, values)
+        folded.append((labels, counts, total))
+    return render_histogram_counts(name, help_, bounds, folded)
 
 
 class WorkerMetrics:

@@ -154,16 +154,12 @@ def fault_times(events: Sequence[dict]) -> List[Tuple[float, str]]:
     ]
 
 
-def _epoch_bank(slot: List[float], value: float) -> None:
-    """One observation of a CUMULATIVE-per-configure counter into a
-    ``[closed-epoch sum, current-epoch high-water mark]`` slot: a snapshot
-    below the previous one means the counter reset (a reconfigure), so the
-    old epoch's high-water mark is banked and a new epoch opens.  THE
-    reset-detection rule, shared by every rollup over lane/hop counters
-    (data_plane, link_attribution) so they cannot diverge."""
-    if value < slot[1]:  # counter reset: a reconfigure happened
-        slot[0] += slot[1]
-    slot[1] = value
+# THE reset-detection rule for cumulative-per-configure counters, shared
+# with the live goodput ledger (torchft_tpu/obs/ledger.py) so the post-hoc
+# rollups here (data_plane, link_attribution) and the ledger's per-step
+# hop deltas cannot diverge on what a reconfigure looks like.
+from torchft_tpu.obs.ledger import epoch_bank as _epoch_bank
+from torchft_tpu.obs.ledger import ledger_rollup as _ledger_rollup
 
 
 def data_plane(events: Sequence[dict]) -> dict:
@@ -722,6 +718,11 @@ def attribute(
         # Hop-level wall attribution of the allreduce path (wire / stall /
         # combine / shaping) from the ring engines' hop telemetry.
         "link_attribution": link_attribution(events),
+        # Per-step goodput-ledger rollup (obs/ledger.py): the cause
+        # vectors each committed step_summary carries, summed per replica
+        # and cluster-wide — the stream-side mirror of the lighthouse's
+        # live /goodput.json.
+        "ledger": _ledger_rollup(events),
         "goodput": {
             "deadwindow_fraction": (
                 round(dw["fraction"], 4) if dw["fraction"] is not None else None

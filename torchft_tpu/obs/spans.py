@@ -140,6 +140,14 @@ class SpanTracker:
         """Context manager measuring one phase of one step."""
         return Span(self, phase, step, fields)
 
+    def phases_ms(self) -> Dict[str, float]:
+        """Copy of the per-phase accumulation since the last
+        ``step_summary`` flush — the goodput ledger reads this at commit
+        time (BEFORE the flush) to classify the step's wall interval into
+        its cause taxonomy (torchft_tpu/obs/ledger.py)."""
+        with self._lock:
+            return dict(self._acc)
+
     def ft_accounted_ms(self) -> float:
         """Milliseconds accumulated in NON-overlapped phases since the last
         ``step_summary`` flush — the FT wait time of the step in flight.
