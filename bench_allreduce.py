@@ -805,6 +805,7 @@ def bench_peer_kill(
                 # The victim dies "mid-step": its sockets go away while the
                 # survivors' stripes are in flight.
                 def die() -> None:
+                    evidence["kill_ts"] = time.time()
                     collective.abort()
                     victim_killed.set()
 
@@ -848,6 +849,20 @@ def bench_peer_kill(
                 evidence["old_lane_sockets_closed"] = all(
                     p.sock.fileno() == -1 for p in old_next + old_prev
                 )
+                # Fault-window hop bracketing: the sampled hop timeline is
+                # the black box a post-mortem reads, so it must hold
+                # records from BOTH sides of the kill — the pre-fault hops
+                # banked when abort() tore the generation down AND hops
+                # from the rebuilt lanes — or the window of interest is
+                # exactly the part the recorder lost.
+                hop_ts = [
+                    r.get("ts", 0.0) for r in collective.hop_records()
+                ]
+                kill_ts = evidence.get("kill_ts")
+                evidence["hop_timeline_records"] = len(hop_ts)
+                evidence["hop_timeline_brackets_fault"] = bool(
+                    hop_ts and kill_ts and min(hop_ts) < kill_ts < max(hop_ts)
+                )
         except BaseException as e:  # noqa: BLE001 — re-raised below
             errors.append(e)
         finally:
@@ -876,6 +891,7 @@ def bench_peer_kill(
                 and evidence.get("recovered_committed")
                 and evidence.get("lanes_rebuilt")
                 and evidence.get("old_lane_sockets_closed")
+                and evidence.get("hop_timeline_brackets_fault")
             ),
         }
     )
@@ -1262,6 +1278,19 @@ def run_link(
                 1 for ts in victim.get("commits") or []
                 if degraded_ts <= ts <= raise_s
             )
+        # Fault-window hop bracketing: the victim's sampled hop timeline
+        # must carry records from before AND after the mid-run re-shaping
+        # — the shape change never tears a lane down, so a timeline gap
+        # around the fault would mean the sampler (not the fault) went
+        # quiet exactly when the post-mortem needs it.
+        victim_hop_ts = [
+            r.get("ts", 0.0) for r in victim.get("hop_records") or []
+        ]
+        hop_brackets_fault = bool(
+            victim_hop_ts
+            and degraded_ts
+            and min(victim_hop_ts) < degraded_ts < max(victim_hop_ts)
+        )
         # The alert must name the right EDGE: reported by the victim (the
         # sender whose send-blocked time exploded), alerting its ring
         # successor (the endpoint whose inbound path degraded).
@@ -1328,6 +1357,8 @@ def run_link(
             "degraded": d,
             "detected": detected,
             "detection_rounds": detection_rounds,
+            "hop_timeline_records": len(victim_hop_ts),
+            "hop_timeline_brackets_fault": hop_brackets_fault,
             "alert_src_is_victim": src_ok,
             "victim": victim_rid,
             "alert": (degraded["alerts"][0] if degraded["alerts"] else None),
@@ -1342,6 +1373,7 @@ def run_link(
                 and h["link_alerts"] == 0
                 and (detection_rounds is None or detection_rounds <= 10)
                 and incident_ok
+                and hop_brackets_fault
             ),
         }
     finally:

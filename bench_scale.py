@@ -30,6 +30,19 @@ written by ``bench.py --scenario scale`` / ``python bench_scale.py``):
                    byte counters), reported as paired best-of-N trials with
                    speedup = ring_wall / ring2d_wall.
 
+  federated      — the two-tier control plane (docs/wire.md "Federation")
+                   at fixed region size and growing N: child-lighthouse
+                   SUBPROCESSES own their region's heartbeats and push
+                   digests to an in-driver root, which forms the global
+                   quorum from digests alone.  Per cell: per-instance
+                   heartbeat fan-in (children bounded by region size, root
+                   ZERO), scrape cost, digest-consistency checks.  The
+                   largest cell SIGKILLs an entire region — child first,
+                   then its workers (correlated cross-region preemption) —
+                   and requires the survivors' global quorum to reform with
+                   zero failed commits and the root's incident bundle
+                   verdict to name the dead REGION.
+
 Quick mode (``run_quick()``, wired into tier-1 as
 ``tests/test_bench_contract.py::test_scale_quick_smoke``): a 4-group cell
 with a 2-victim wave under a pinned ring2d topology (the post-wave 2-group
@@ -41,6 +54,7 @@ from __future__ import annotations
 
 import argparse
 import gc
+import glob
 import json
 import os
 import signal
@@ -158,6 +172,52 @@ def _worker_main(cfg: Dict) -> None:
         summary = {"group": cfg["group"], "commits": commits, "failed": failed}
         print("SCALE_WORKER " + json.dumps(summary), flush=True)
         manager.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Child: one regional lighthouse (re-entered subprocess, federated sweep)
+# ---------------------------------------------------------------------------
+
+
+def _child_main(cfg: Dict) -> None:
+    """One regional CHILD lighthouse as its own OS process — the federated
+    sweep's region tier (docs/wire.md "Federation").  Owns its region's
+    heartbeats/sentinels/ledger and pushes digests to the in-driver root;
+    publishes its addresses through an atomically-renamed info file, then
+    idles until the cell's stop file (or SIGKILL, for the region-wave
+    victim: the root must detect the silence, not a clean goodbye)."""
+    from torchft_tpu._native import LighthouseServer
+
+    server = LighthouseServer(
+        bind="127.0.0.1:0",
+        http_bind="127.0.0.1:0",
+        # Advisory: a child never forms the quorum — the ROOT's floor gates.
+        min_replicas=1,
+        join_timeout_ms=int(cfg.get("join_timeout_ms", 10000)),
+        quorum_tick_ms=int(cfg.get("quorum_tick_ms", 50)),
+        heartbeat_timeout_ms=int(cfg.get("heartbeat_timeout_ms", 3000)),
+    )
+    server.set_federation(
+        cfg["region"], cfg["root"], int(cfg.get("push_ms", 100))
+    )
+    info = {
+        "region": cfg["region"],
+        "addr": server.address(),
+        "http": server.http_address(),
+    }
+    path = os.path.join(cfg["workdir"], f"child_{cfg['region']}.json")
+    with open(path + ".tmp", "w", encoding="utf-8") as f:
+        json.dump(info, f)
+    os.replace(path + ".tmp", path)
+    # Children wait for their OWN stop file, written only after every
+    # worker exited: a child dying at the workers' stop signal would fail
+    # the in-flight quorum calls of workers mid-step — phantom "failed
+    # commits" charged to teardown, not the control plane.
+    stop_path = os.path.join(cfg["workdir"], "stop_children")
+    end_cap = float(cfg["end_cap_ts"])
+    while time.time() < end_cap and not os.path.exists(stop_path):
+        time.sleep(0.1)
+    server.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -542,6 +602,608 @@ def run_control_cell(
 
 
 # ---------------------------------------------------------------------------
+# Federated control-plane cell (two-tier: regional children + one root)
+# ---------------------------------------------------------------------------
+
+
+def run_federated_cell(
+    workdir: str,
+    groups: int,
+    regions: int,
+    window_s: float = 8.0,
+    step_s: float = 0.1,
+    region_wave: bool = False,
+    kill: int = 0,
+    push_ms: int = 100,
+    heartbeat_timeout_ms: int = 3000,
+    quorum_tick_ms: int = 50,
+) -> Dict[str, Any]:
+    """One federated control-plane cell: ``regions`` child-lighthouse
+    SUBPROCESSES (wire-method-8 digest pushers), one in-driver root, and
+    ``groups`` worker subprocesses running the unchanged flat Manager
+    loop against their region's child — the managers never learn the
+    root exists.  Measures per-instance heartbeat fan-in (children see
+    only their region; the root sees ZERO heartbeats) and scrape cost vs
+    N.  ``region_wave`` SIGKILLs the last region whole — child first,
+    then its workers, the correlated cross-region preemption shape — and
+    requires: survivors reform the global quorum with ZERO failed
+    commits, the root's incident bundle verdict names the dead REGION,
+    and the root/child digest views stay consistent.  ``kill`` instead
+    SIGKILLs that many individual workers (the quick smoke's 1-victim
+    shape).  Group g lives in region g // (groups // regions)."""
+    from torchft_tpu._native import LighthouseServer
+    from torchft_tpu.obs import flight as obs_flight
+    from torchft_tpu.obs import incident as obs_incident
+    from torchft_tpu.obs import report as obs_report
+
+    assert groups % regions == 0, "groups must divide evenly across regions"
+    # Barrier files from a previous run in the same workdir would trip
+    # this cell (a leftover ``stop`` ends workers instantly; stale
+    # child_*.json points at dead lighthouses) — scrub them up front.
+    for leftover in (
+        glob.glob(os.path.join(workdir, "child_*.json"))
+        + glob.glob(os.path.join(workdir, "ready_*"))
+        + glob.glob(os.path.join(workdir, "done_*"))
+        + [os.path.join(workdir, n) for n in ("stop", "stop_children", "go")]
+    ):
+        try:
+            os.unlink(leftover)
+        except OSError:
+            pass
+    per_region = groups // regions
+    region_names = [f"r{i}" for i in range(regions)]
+    region_of = lambda g: region_names[g // per_region]  # noqa: E731
+    if region_wave:
+        victims = list(range(groups - per_region, groups))
+        dead_region = region_names[-1]
+    else:
+        victims = list(range(groups - kill, groups)) if kill else []
+        dead_region = None
+    survivors = [g for g in range(groups) if g not in victims]
+    surviving_regions = sorted({region_of(g) for g in survivors})
+
+    os.makedirs(workdir, exist_ok=True)
+    childdir = os.path.join(workdir, "children")
+    os.makedirs(childdir, exist_ok=True)
+    metrics_path = os.path.join(workdir, "metrics.jsonl")
+    gc.collect()
+    fd_before = _fd_count()
+    prior_flight = os.environ.get("TPUFT_FLIGHT_DIR")
+    os.environ["TPUFT_FLIGHT_DIR"] = workdir
+    result: Dict[str, Any] = {
+        "section": "scale_federated",
+        "groups": groups,
+        "regions": regions,
+        "per_region": per_region,
+        "window_s": window_s,
+        "step_s": step_s,
+        "region_wave": bool(region_wave),
+        "kill": len(victims) if not region_wave else per_region,
+        "min_replicas": max(1, len(survivors)),
+        "ok": False,
+    }
+    workers: List[subprocess.Popen] = []
+    children: Dict[str, subprocess.Popen] = {}
+    child_info: Dict[str, Dict[str, str]] = {}
+    root = None
+    try:
+        root = LighthouseServer(
+            bind="127.0.0.1:0",
+            http_bind="127.0.0.1:0",
+            # Satisfiable by the survivors (wave) / everyone (clean); the
+            # ready barrier below is what makes the FIRST quorum global.
+            min_replicas=max(1, len(survivors)),
+            join_timeout_ms=10000 + 500 * groups,
+            quorum_tick_ms=quorum_tick_ms,
+            heartbeat_timeout_ms=heartbeat_timeout_ms,
+        )
+        root_http = root.http_address()
+        end_cap = time.time() + window_s + 90.0 + 1.5 * groups + (
+            240.0 if victims else 0.0
+        )
+        child_env = dict(os.environ)
+        child_env["TPUFT_FLIGHT_DIR"] = childdir  # keep root's dump unambiguous
+        for name in region_names:
+            ccfg = {
+                "region": name,
+                "root": root.address(),
+                "workdir": workdir,
+                "push_ms": push_ms,
+                "end_cap_ts": end_cap,
+                "heartbeat_timeout_ms": heartbeat_timeout_ms,
+                "quorum_tick_ms": quorum_tick_ms,
+            }
+            log = open(os.path.join(workdir, f"child_{name}.log"), "ab")
+            try:
+                children[name] = subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--child",
+                     json.dumps(ccfg)],
+                    env=child_env, stdout=log, stderr=subprocess.STDOUT,
+                    cwd=REPO,
+                )
+            finally:
+                log.close()
+        info_deadline = time.time() + 60.0
+        while time.time() < info_deadline and len(child_info) < regions:
+            for name in region_names:
+                if name in child_info:
+                    continue
+                path = os.path.join(workdir, f"child_{name}.json")
+                if os.path.exists(path):
+                    with open(path, "r", encoding="utf-8") as f:
+                        child_info[name] = json.load(f)
+            time.sleep(0.05)
+        if len(child_info) < regions:
+            raise RuntimeError(
+                f"only {len(child_info)}/{regions} child lighthouses came up"
+            )
+
+        env = dict(os.environ)
+        env["TPUFT_METRICS_PATH"] = metrics_path
+        log_paths = []
+        for g in range(groups):
+            cfg = {
+                "group": g,
+                "groups": groups,
+                "lighthouse": child_info[region_of(g)]["addr"],
+                "end_cap_ts": end_cap,
+                "workdir": workdir,
+                "step_s": step_s,
+                "quorum_timeout_s": 30.0,
+            }
+            log_path = os.path.join(workdir, f"g{g}.log")
+            log_paths.append(log_path)
+            with open(log_path, "ab") as log:
+                workers.append(
+                    subprocess.Popen(
+                        [sys.executable, os.path.abspath(__file__), "--worker",
+                         json.dumps(cfg)],
+                        env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+                    )
+                )
+
+        def commits_per_group() -> Dict[str, List[float]]:
+            return obs_report.commit_timelines(
+                obs_report.read_events([metrics_path])
+            )
+
+        def root_rollup() -> Dict[str, Dict[str, Any]]:
+            doc = _scrape(root_http, "/regions.json") or "{}"
+            try:
+                rows = json.loads(doc).get("regions", [])
+            except ValueError:
+                rows = []
+            return {r.get("region"): r for r in rows}
+
+        # Barrier: every worker constructed AND every heartbeat visible at
+        # the ROOT — which, federated, means it already rode a digest up:
+        # the rollup's replicas_total is the root's own count, so the
+        # first global quorum provably waits for all N (same soundness
+        # argument as the flat cell, one tier removed).
+        ready_deadline = time.time() + 90.0 + 1.5 * groups
+        while time.time() < ready_deadline:
+            if all(
+                os.path.exists(os.path.join(workdir, f"ready_{g}"))
+                for g in range(groups)
+            ):
+                rollup = root_rollup()
+                if sum(
+                    int(r.get("replicas_total", 0)) for r in rollup.values()
+                ) >= groups:
+                    break
+            time.sleep(0.1)
+        with open(os.path.join(workdir, "go"), "w"):
+            pass
+
+        t0 = time.time()
+        warm_deadline = t0 + 90.0 + 1.5 * groups
+        while time.time() < warm_deadline:
+            cs = commits_per_group()
+            if all(len(cs.get(str(g), [])) >= 3 for g in range(groups)):
+                break
+            time.sleep(0.25)
+        cs = commits_per_group()
+        result["warmed_groups"] = sum(
+            1 for g in range(groups) if len(cs.get(str(g), [])) >= 3
+        )
+        result["warmup_s"] = round(time.time() - t0, 2)
+
+        # Prime every instance's scrape-cost histogram.
+        for _ in range(3):
+            _scrape(root_http, "/metrics")
+            for info in child_info.values():
+                _scrape(info["http"], "/metrics")
+
+        def digest_consistent() -> Dict[str, Any]:
+            """Root's per-region digest view vs each surviving child's own
+            rollup.  Retries briefly: totals legitimately diverge for one
+            push interval after membership changes."""
+            deadline = time.time() + 10.0
+            last: Dict[str, Any] = {"ok": False}
+            while time.time() < deadline:
+                rollup = root_rollup()
+                rows = []
+                ok = True
+                for name in surviving_regions:
+                    cdoc = json.loads(
+                        _scrape(child_info[name]["http"], "/regions.json")
+                        or "{}"
+                    )
+                    crows = cdoc.get("regions") or [{}]
+                    self_row = crows[0]
+                    rrow = rollup.get(name) or {}
+                    match = (
+                        cdoc.get("role") == "child"
+                        and int(self_row.get("replicas_total", -1))
+                        == int(rrow.get("replicas_total", -2))
+                        and not rrow.get("stale", True)
+                    )
+                    ok = ok and match
+                    rows.append({
+                        "region": name,
+                        "child_total": self_row.get("replicas_total"),
+                        "root_total": rrow.get("replicas_total"),
+                        "root_stale": rrow.get("stale"),
+                        "match": match,
+                    })
+                last = {"ok": ok, "rows": rows}
+                if ok:
+                    break
+                time.sleep(0.5)
+            return last
+
+        result["digest_consistency_pre"] = digest_consistent()
+
+        wave_ts = None
+        watcher = obs_incident.IncidentWatcher(root_http)
+        watcher.poll()  # baseline: ignore any pre-fault triggers
+        bundle_dir = None
+        if victims:
+            # THE FAULT.  Region wave: the child dies FIRST (the region's
+            # control plane goes dark with its capacity block — the root
+            # must infer the loss from digest silence, no goodbye), then
+            # the region's workers.  kill-one: just the worker.
+            wave_ts = time.time()
+            if region_wave and dead_region is not None:
+                try:
+                    children[dead_region].send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+            for g in victims:
+                try:
+                    workers[g].send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+            for g in victims:
+                workers[g].wait()
+            if region_wave and dead_region is not None:
+                children[dead_region].wait()
+            result["wave_ts"] = wave_ts
+            result["wave_kill_span_s"] = round(time.time() - wave_ts, 3)
+
+            if region_wave:
+                # The root must declare the region dead (digest silence >
+                # heartbeat timeout) and record the region_stale trigger;
+                # capture the bundle LIVE while the survivors reform.
+                stale_deadline = time.time() + 60.0
+                region_incident = None
+                while time.time() < stale_deadline and region_incident is None:
+                    for rec in watcher.poll():
+                        if rec.get("reason") == "region_stale":
+                            region_incident = rec
+                            break
+                    time.sleep(0.25)
+                result["region_stale_incident"] = region_incident
+                if region_incident is not None:
+                    bundle_dir = obs_incident.capture_bundle(
+                        workdir, root_http, region_incident, [metrics_path]
+                    )
+                rollup = root_rollup()
+                result["dead_region_stale_at_root"] = bool(
+                    (rollup.get(dead_region) or {}).get("stale")
+                )
+
+            # Reformation: every survivor commits >= 2 AFTER the fault.
+            reform_deadline = time.time() + 90.0 + 2 * 30.0
+            reformed = False
+            while time.time() < reform_deadline and not reformed:
+                cs = commits_per_group()
+                reformed = all(
+                    len([t for t in cs.get(str(g), []) if t > wave_ts]) >= 2
+                    for g in survivors
+                )
+                time.sleep(0.25)
+            result["quorum_reformed"] = reformed
+            if reformed:
+                cs = commits_per_group()
+                first_post = max(
+                    min(t for t in cs[str(g)] if t > wave_ts)
+                    for g in survivors
+                )
+                result["first_commit_after_wave_s"] = round(
+                    first_post - wave_ts, 3
+                )
+            result["digest_consistency_post"] = digest_consistent()
+
+        time.sleep(max(0.0, (t0 + result["warmup_s"] + window_s) - time.time()))
+
+        # Per-instance control-plane cost BEFORE teardown: the federated
+        # claim is that no instance's load scales with N — children see
+        # only their region's heartbeat fan-in, the root sees none at all
+        # (digests only), and every scrape payload is bounded by the
+        # instance's own region.
+        per_instance: Dict[str, Any] = {}
+        final_root = _scrape(root_http, "/metrics") or ""
+        per_instance["root"] = {
+            "heartbeat_fanin": _hist_stats(
+                final_root, "tpuft_heartbeat_fanin_seconds"
+            ),
+            "scrape": _hist_stats(final_root, "tpuft_metrics_scrape_seconds"),
+            "scrape_bytes": len(final_root),
+            "rpc_region_digest": _hist_stats(
+                final_root, "tpuft_rpc_latency_seconds", 'method="RegionDigest"'
+            ),
+            "rpc_heartbeat": _hist_stats(
+                final_root, "tpuft_rpc_latency_seconds", 'method="Heartbeat"'
+            ),
+        }
+        per_instance["children"] = {}
+        for name in surviving_regions:
+            text = _scrape(child_info[name]["http"], "/metrics") or ""
+            per_instance["children"][name] = {
+                "heartbeat_fanin": _hist_stats(
+                    text, "tpuft_heartbeat_fanin_seconds"
+                ),
+                "scrape": _hist_stats(text, "tpuft_metrics_scrape_seconds"),
+                "scrape_bytes": len(text),
+            }
+        result["per_instance"] = per_instance
+        fanins = [
+            c["heartbeat_fanin"]["count"]
+            for c in per_instance["children"].values()
+        ]
+        result["root_heartbeat_rpcs"] = per_instance["root"]["rpc_heartbeat"][
+            "count"
+        ]
+        result["max_child_fanin_count"] = max(fanins) if fanins else 0
+
+        with open(os.path.join(workdir, "stop"), "w"):
+            pass
+        for g, w in enumerate(workers):
+            if g in victims:
+                continue
+            try:
+                w.wait(timeout=110.0)
+            except subprocess.TimeoutExpired:
+                w.kill()
+                w.wait()
+        with open(os.path.join(workdir, "stop_children"), "w"):
+            pass
+        for name, proc in children.items():
+            if region_wave and name == dead_region:
+                continue
+            try:
+                proc.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+        summaries = []
+        for path in log_paths:
+            with open(path, "rb") as f:
+                for line in f:
+                    if line.startswith(b"SCALE_WORKER "):
+                        summaries.append(
+                            json.loads(line[len(b"SCALE_WORKER "):])
+                        )
+        result["worker_summaries"] = sorted(summaries, key=lambda s: s["group"])
+        result["survivor_failed_commits"] = sum(
+            s["failed"] for s in summaries if s["group"] in survivors
+        )
+        cs = commits_per_group()
+        result["per_group_commits"] = {
+            g: len(ts) for g, ts in sorted(cs.items())
+        }
+        if victims and wave_ts is not None:
+            result["post_wave_commits"] = {
+                str(g): len([t for t in cs.get(str(g), []) if t > wave_ts])
+                for g in survivors
+            }
+
+        if bundle_dir is not None:
+            manifest = obs_incident.finalize_bundle(
+                bundle_dir, workdir, events=obs_report.read_events([metrics_path])
+            )
+            v = manifest.get("verdict", {})
+            result["incident_bundle"] = bundle_dir
+            result["verdict"] = v
+            result["verdict_names_dead_region"] = bool(
+                v.get("kind") == "region_loss"
+                and v.get("region") == dead_region
+            )
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        for proc in children.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if root is not None:
+            root.shutdown()  # writes the flight dump into workdir
+        if prior_flight is None:
+            os.environ.pop("TPUFT_FLIGHT_DIR", None)
+        else:
+            os.environ["TPUFT_FLIGHT_DIR"] = prior_flight
+
+    # Flight-recorder post-mortem on the ROOT's dump (children dump into
+    # their own subdir): the global quorum transitions must reconstruct
+    # the fault — members N -> survivors with the victims in `left`.
+    dumps = [
+        os.path.join(workdir, f)
+        for f in os.listdir(workdir)
+        if f.startswith("flight_lighthouse_") and f.endswith(".json")
+    ]
+    result["flight_dump_found"] = bool(dumps)
+    if dumps and victims and wave_ts is not None:
+        dump = obs_flight.load_flight_dump(dumps[0])
+        transitions = obs_flight.quorum_transitions(
+            obs_flight.flight_events(dump)
+        )
+        result["flight_transitions"] = len(transitions)
+        group_of = lambda m: str(m).split(":", 1)[0]  # noqa: E731
+        post = [
+            t for t in transitions if t["ts_ms"] >= int(wave_ts * 1000) - 500
+        ]
+        left_union: set = set()
+        for t in post:
+            left_union.update(group_of(m) for m in t["left"])
+        victim_ids = {str(g) for g in victims}
+        survivor_ids = {str(g) for g in survivors}
+        shrunk = next(
+            (t for t in post
+             if {group_of(m) for m in t["members"]} == survivor_ids),
+            None,
+        )
+        result["wave_reconstructed"] = bool(
+            victim_ids <= left_union and shrunk is not None
+        )
+        if shrunk is not None:
+            result["wave_reform_s"] = round(
+                shrunk["ts_ms"] / 1000.0 - wave_ts, 3
+            )
+
+    fd_after = _fd_count()
+    settle = time.time() + 5.0
+    while fd_after > fd_before and time.time() < settle:
+        gc.collect()
+        time.sleep(0.2)
+        fd_after = _fd_count()
+    result["fd_before"] = fd_before
+    result["fd_after"] = fd_after
+    result["fd_leaked"] = (
+        max(0, fd_after - fd_before) if fd_before >= 0 else None
+    )
+
+    stream_commits = result.get("per_group_commits", {})
+    all_committed = all(
+        stream_commits.get(str(g), 0) > 0 for g in survivors
+    )
+    fault_ok = True
+    if victims:
+        fault_ok = bool(
+            result.get("quorum_reformed")
+            and result.get("survivor_failed_commits") == 0
+            and result.get("digest_consistency_post", {}).get("ok")
+        )
+        if region_wave:
+            fault_ok = fault_ok and bool(
+                result.get("dead_region_stale_at_root")
+                and result.get("verdict_names_dead_region")
+                and result.get("wave_reconstructed")
+            )
+    result["ok"] = bool(
+        result.get("warmed_groups") == groups
+        and all_committed
+        and result.get("digest_consistency_pre", {}).get("ok")
+        and result.get("flight_dump_found")
+        and result.get("root_heartbeat_rpcs") == 0
+        and fault_ok
+        and (result.get("fd_leaked") in (0, None))
+    )
+    return result
+
+
+def run_federated_sweep(
+    cells: Optional[List[Dict[str, Any]]] = None,
+    window_s: float = 8.0,
+) -> Dict[str, Any]:
+    """The federated half of the scale story: cells with a FIXED region
+    size and growing N, so per-instance fan-in / scrape cost stay flat
+    while the flat cells' grow with N; the largest cell takes the
+    correlated cross-region preemption wave."""
+    cells = cells or [
+        {"groups": 32, "regions": 4, "step_s": 0.25},
+        {"groups": 64, "regions": 8, "step_s": 0.5, "region_wave": True,
+         "heartbeat_timeout_ms": 5000},
+    ]
+    base = os.environ.get("TPUFT_BENCH_WORKDIR") or tempfile.mkdtemp(
+        prefix="tpuft_fed_"
+    )
+    out_cells: List[Dict[str, Any]] = []
+    for spec in cells:
+        spec = dict(spec)
+        n, r = spec.pop("groups"), spec.pop("regions")
+        cell = run_federated_cell(
+            os.path.join(base, f"fed_n{n}_r{r}"),
+            groups=n, regions=r, window_s=window_s, **spec,
+        )
+        out_cells.append(cell)
+        print(json.dumps(cell), flush=True)
+    wave_cell = next(
+        (c for c in out_cells if c.get("region_wave")), None
+    )
+    summary = {
+        "cells": [
+            {
+                "groups": c["groups"],
+                "regions": c["regions"],
+                "per_region": c["per_region"],
+                "max_child_fanin_count": c.get("max_child_fanin_count"),
+                "max_child_fanin_mean_ms": max(
+                    (v["heartbeat_fanin"]["mean_ms"] or 0.0)
+                    for v in c.get("per_instance", {})
+                    .get("children", {"x": {"heartbeat_fanin": {"mean_ms": 0}}})
+                    .values()
+                ),
+                "root_heartbeat_rpcs": c.get("root_heartbeat_rpcs"),
+                "root_scrape_bytes": c.get("per_instance", {})
+                .get("root", {}).get("scrape_bytes"),
+                "ok": c["ok"],
+            }
+            for c in out_cells
+        ],
+        "region_wave": None if wave_cell is None else {
+            "groups": wave_cell["groups"],
+            "regions": wave_cell["regions"],
+            "dead_region_groups": wave_cell["per_region"],
+            "reformed": wave_cell.get("quorum_reformed"),
+            "survivor_failed_commits": wave_cell.get(
+                "survivor_failed_commits"
+            ),
+            "verdict_names_dead_region": wave_cell.get(
+                "verdict_names_dead_region"
+            ),
+            "verdict": wave_cell.get("verdict"),
+            "wave_reform_s": wave_cell.get("wave_reform_s"),
+        },
+        "cells_ok": all(c["ok"] for c in out_cells),
+    }
+    return {"workdir": base, "cells": out_cells, "summary": summary}
+
+
+def run_federated_quick() -> Dict[str, Any]:
+    """Tier-1 federation smoke (tests/test_federation.py::
+    test_federation_quick_smoke): 2 regions x 2 groups through real
+    child subprocesses, one worker SIGKILLed mid-window; gates on digest
+    consistency across the kill, the survivors' reformed global quorum,
+    and ZERO failed survivor commits."""
+    workdir = tempfile.mkdtemp(prefix="tpuft_fed_quick_")
+    cell = run_federated_cell(
+        workdir, groups=4, regions=2, window_s=4.0, step_s=0.1, kill=1,
+        push_ms=100,
+    )
+    return {
+        "metric": "federation",
+        "quick": True,
+        "workdir": workdir,
+        "cells": [cell],
+        "ok": cell["ok"],
+    }
+
+
+# ---------------------------------------------------------------------------
 # Data-plane sweep (flat ring vs ring2d at N ranks)
 # ---------------------------------------------------------------------------
 
@@ -732,8 +1394,10 @@ def run_full(
         cells.append(cell)
         print(json.dumps(cell), flush=True)
     dataplane = run_dataplane_sweep(ns, mbps=mbps, rtt_ms=rtt_ms, trials=trials)
+    federation = run_federated_sweep()
     summary = {
         "groups_swept": ns,
+        "federation": federation["summary"],
         "quorum_formation_ms_by_n": {
             str(c["groups"]): c.get("quorum_formation", {}).get("mean_ms")
             for c in cells
@@ -774,6 +1438,7 @@ def run_full(
         "workdir": base,
         "cells": cells,
         "dataplane": dataplane,
+        "federation": federation,
         "summary": summary,
     }
 
@@ -781,7 +1446,13 @@ def run_full(
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--worker", default=None, help=argparse.SUPPRESS)
+    parser.add_argument("--child", default=None, help=argparse.SUPPRESS)
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument(
+        "--federated", action="store_true",
+        help="run only the federated sweep and merge it into an existing "
+        "SCALE_BENCH.json (the flat cells are kept as-is)",
+    )
     parser.add_argument("--ns", type=int, nargs="*", default=[4, 8, 16, 32])
     parser.add_argument("--window-s", type=float, default=10.0)
     parser.add_argument("--mbps", type=float, default=200.0)
@@ -791,6 +1462,24 @@ def main() -> None:
     args = parser.parse_args()
     if args.worker is not None:
         _worker_main(json.loads(args.worker))
+        return
+    if args.child is not None:
+        _child_main(json.loads(args.child))
+        return
+    if args.federated:
+        federation = run_federated_sweep()
+        try:
+            with open(args.out, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = {"metric": "scale", "quick": False, "cells": [],
+                       "dataplane": {}, "summary": {}}
+        payload["federation"] = federation
+        payload.setdefault("summary", {})["federation"] = federation["summary"]
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(json.dumps(federation["summary"]), flush=True)
         return
     if args.quick:
         payload = run_quick()

@@ -476,6 +476,32 @@ def bench_ec_reconstruct(
         if striped_fetch_s:
             out["striped_donor_fetch_s"] = striped_fetch_s
             out["vs_striped_ratio"] = round(reconstruct_s / striped_fetch_s, 3)
+        # Subset-rotation arm: the same reconstruction with
+        # TPUFT_EC_SUBSET_STRIPE=1, so each payload range decodes from its
+        # own k-subset and every holder LINK serves — parity included.
+        # Only meaningful in the shaped (link-bound) regime; unshaped, the
+        # per-range GF math costs more than the idle links were worth.
+        if shaped_mbps > 0:
+            prior_ss = os.environ.get("TPUFT_EC_SUBSET_STRIPE")
+            os.environ["TPUFT_EC_SUBSET_STRIPE"] = "1"
+            try:
+                t0 = time.perf_counter()
+                meta3, bufs3, stats_ss = reconstruct(urls, step, timeout=600.0)
+                subset_s = time.perf_counter() - t0
+            finally:
+                if prior_ss is None:
+                    del os.environ["TPUFT_EC_SUBSET_STRIPE"]
+                else:
+                    os.environ["TPUFT_EC_SUBSET_STRIPE"] = prior_ss
+            subset_bitwise = all(
+                x.tobytes() == y.tobytes() for x, y in zip(bufs, bufs3)
+            ) and len(bufs) == len(bufs3)
+            out["subset_striped"] = stats_ss.get("subset_striped")
+            out["reconstruct_subset_s"] = round(subset_s, 3)
+            out["reconstruct_subset_gb_per_s"] = round(_gb(nbytes) / subset_s, 3)
+            out["subset_bitwise"] = bool(subset_bitwise)
+            if striped_fetch_s:
+                out["vs_striped_ratio_subset"] = round(subset_s / striped_fetch_s, 3)
         return out
     finally:
         for h in holders:
@@ -1050,6 +1076,9 @@ def main() -> None:
             ],
             "reconstruct_bitwise": by_op["ec_reconstruct"]["bitwise"],
             "vs_striped_ratio": by_op["ec_reconstruct"].get("vs_striped_ratio"),
+            "vs_striped_ratio_subset": by_op["ec_reconstruct"].get(
+                "vs_striped_ratio_subset"
+            ),
             "wave_ok": by_op["ec_wave"]["ok"],
             "manager_wave_ok": by_op["ec_manager_wave"]["ok"],
             "survivor_failed_commits": by_op["ec_manager_wave"][

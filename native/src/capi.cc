@@ -113,6 +113,24 @@ char* tf_lighthouse_flight_json(void* p, uint64_t limit) {
   return CopyString(static_cast<Lighthouse*>(p)->FlightJson(limit));
 }
 
+// Federation (docs/wire.md "Federation"): makes this lighthouse a regional
+// CHILD reporting digests to the root's address list.  This symbol doubles
+// as the Python side's capability probe: a stale libtpuft.so without it
+// predates the federation surface and the bindings raise a clear error
+// instead of silently running flat.
+void tf_lighthouse_set_federation(void* p, const char* region,
+                                  const char* root_addrs,
+                                  int64_t push_interval_ms) {
+  static_cast<Lighthouse*>(p)->SetFederation(region ? region : "",
+                                             root_addrs ? root_addrs : "",
+                                             push_interval_ms);
+}
+
+// Per-instance federation rollup (same payload as GET /regions.json).
+char* tf_lighthouse_regions_json(void* p) {
+  return CopyString(static_cast<Lighthouse*>(p)->RegionsJson());
+}
+
 void tf_lighthouse_shutdown(void* p) { static_cast<Lighthouse*>(p)->Shutdown(); }
 
 void tf_lighthouse_free(void* p) { delete static_cast<Lighthouse*>(p); }

@@ -146,7 +146,8 @@ Lighthouse::Lighthouse(LighthouseOpt opt) : opt_(std::move(opt)) {
   // never mutate the map (lock-free reads against a frozen key set).
   for (uint16_t m : {kLighthouseQuorum, kLighthouseHeartbeat, kLighthouseStatus,
                      kLighthouseEvict, kLighthouseDrain, kLighthouseReplicate,
-                     kLighthouseLeaderInfo}) {
+                     kLighthouseLeaderInfo, kLighthouseRegionDigest,
+                     kLighthouseRegions}) {
     rpc_hist_[m];
   }
 }
@@ -474,6 +475,449 @@ void Lighthouse::FillLeaderInfo(LighthouseLeaderInfoResponse* resp) {
   resp->set_role(IsLeaderLocked() ? 1 : 0);
 }
 
+// ---------------------------------------------------------------------------
+// Federation (docs/wire.md "Federation"): two-tier lighthouse topology.
+// Regional CHILD lighthouses keep owning heartbeats, sentinel scoring and
+// the goodput-ledger rollup for their O(N/R) groups; a push loop reports a
+// bounded membership + ledger digest upward (wire method 8), and the ROOT
+// computes the global quorum over digests only — no instance ever handles
+// O(N) heartbeat or scrape traffic.  A lighthouse that never calls
+// SetFederation and never receives a digest is bit-identical to the flat
+// single-tier service.
+// ---------------------------------------------------------------------------
+
+void Lighthouse::SetFederation(const std::string& region,
+                               const std::string& root_addrs,
+                               int64_t push_interval_ms) {
+  bool start_thread = false;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    fed_region_ = region;
+    fed_root_addrs_ = root_addrs;
+    if (push_interval_ms > 0) fed_push_interval_ms_ = push_interval_ms;
+    bool child = !region.empty() && !root_addrs.empty();
+    start_thread = child && !fed_child_;
+    fed_child_ = child;
+  }
+  if (start_thread) {
+    fed_thread_ = std::thread([this] { FederationLoop(); });
+    LOGI("lighthouse: federated CHILD for region '%s' -> root %s (push every "
+         "%lld ms)", region.c_str(), root_addrs.c_str(),
+         static_cast<long long>(fed_push_interval_ms_));
+  }
+}
+
+void Lighthouse::BuildDigestLocked(RegionDigest* d) {
+  d->set_region(fed_region_);
+  d->set_child_epoch(leader_epoch_);
+  d->set_seq(++fed_digest_seq_);
+  d->set_root_gen(fed_root_gen_);
+  auto now = Clock::now();
+  auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
+  int64_t fresh = 0;
+  // One row per heartbeating id: the root's QuorumCompute needs the FULL
+  // healthy set (its strict-majority guard divides joined by healthy), not
+  // just the joiners — so ages ride along and install at the root via the
+  // same freshness-carry the HA replication path uses.
+  for (const auto& [id, last] : state_.heartbeats) {
+    auto* rm = d->add_members();
+    auto p = state_.participants.find(id);
+    if (p != state_.participants.end()) {
+      *rm->mutable_member() = p->second.member;
+      rm->set_joined(true);
+    } else {
+      rm->mutable_member()->set_replica_id(id);
+    }
+    rm->set_heartbeat_age_ms(
+        std::chrono::duration_cast<std::chrono::milliseconds>(now - last)
+            .count());
+    if (state_.draining.count(id)) rm->set_draining(true);
+    auto hs = hb_state_.find(id);
+    if (hs != hb_state_.end()) rm->set_state(hs->second);
+    auto st = hb_step_.find(id);
+    if (st != hb_step_.end()) {
+      rm->set_hb_step(st->second);
+      if (p == state_.participants.end()) {
+        rm->mutable_member()->set_step(st->second);
+      }
+    }
+    if (now - last < hb_timeout) ++fresh;
+  }
+  d->set_replicas_total(static_cast<int64_t>(state_.heartbeats.size()));
+  d->set_replicas_fresh(fresh);
+  double compute = 0.0, lost[kLedgerCauseCount];
+  ClusterLedgerLocked(&compute, lost);
+  d->set_ledger_compute_seconds(compute);
+  double lost_total = 0.0;
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+    d->add_ledger_lost_seconds(lost[i]);
+    lost_total += lost[i];
+  }
+  double accounted = compute + lost_total;
+  d->set_goodput_ratio(accounted > 0.0 ? compute / accounted : 0.0);
+  int64_t active = 0;
+  for (const auto& a : alerts_) {
+    if (a.resolved_ms == 0) ++active;
+  }
+  d->set_alerts_active(active);
+  d->set_incident_seq(incident_seq_);
+}
+
+void Lighthouse::InstallGlobalQuorumLocked(const Quorum& q, int64_t root_gen) {
+  fed_root_gen_ = root_gen;
+  bool changed = true;
+  std::set<std::string> new_ids;
+  for (const auto& m : q.participants()) new_ids.insert(m.replica_id());
+  if (state_.prev_quorum) {
+    std::set<std::string> old_ids;
+    for (const auto& m : state_.prev_quorum->participants()) {
+      old_ids.insert(m.replica_id());
+    }
+    changed = old_ids != new_ids;
+  }
+  state_.prev_quorum = q;
+  state_.quorum_id = q.quorum_id();
+  // Same broadcast discipline as a local formation: every member re-joins
+  // for the next round, blocked joiners wake with the GLOBAL quorum.
+  state_.participants.clear();
+  latest_quorum_ = q;
+  quorum_gen_ += 1;
+  quorum_cv_.notify_all();
+  if (changed) {
+    std::string ids;
+    for (const auto& id : new_ids) {
+      if (!ids.empty()) ids += ",";
+      ids += id;
+    }
+    LOGI("lighthouse: installed GLOBAL quorum %lld (%zu members) from root "
+         "gen %lld", static_cast<long long>(q.quorum_id()), new_ids.size(),
+         static_cast<long long>(root_gen));
+    flight_.RecordEvent(kFlightQuorumFormed,
+                        "quorum_id=" + std::to_string(q.quorum_id()) +
+                            " members=[" + ids + "] joined=[] left=[] " +
+                            "formation_ms=0 source=root");
+    logged_reasons_.clear();
+  }
+}
+
+void Lighthouse::FederationLoop() {
+  // One failover client for the root's HA replica set: a "not the leader"
+  // rejection jumps to the named root leader, transport failures rotate —
+  // the exact client Managers use against a child's address list.
+  FailoverRpcClient client(fed_root_addrs_);
+  TimePoint next_push = Clock::now();
+  while (true) {
+    LighthouseRegionDigestRequest req;
+    bool push = false;
+    int64_t interval_ms;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      quorum_cv_.wait_until(lk, next_push, [&] { return shutdown_; });
+      if (shutdown_) return;
+      interval_ms = fed_push_interval_ms_;
+      // Only the region's LEASE HOLDER reports upward: a follower child's
+      // replicated view would race the leader's digests at the root (and a
+      // deposed leader is fenced there by child_epoch anyway).
+      if (fed_child_ && IsLeaderLocked()) {
+        BuildDigestLocked(req.mutable_digest());
+        push = true;
+      }
+    }
+    next_push = Clock::now() + std::chrono::milliseconds(interval_ms);
+    if (!push) continue;
+    std::string body, resp_body, err;
+    req.SerializeToString(&body);
+    Status st = client.Call(kLighthouseRegionDigest, body,
+                            static_cast<uint64_t>(interval_ms) * 4, &resp_body,
+                            &err);
+    if (st != Status::kOk) {
+      std::lock_guard<std::mutex> lk(mu_);
+      ++fed_pushes_rejected_;
+      // Dedup through logged_reasons_ (cleared on membership change) so a
+      // dead root logs once per episode, not once per push.
+      std::string reason = "region digest push failed: " + StatusName(st);
+      if (logged_reasons_.insert(reason).second) {
+        LOGW("lighthouse: region '%s' digest push failed (%s: %s)",
+             fed_region_.c_str(), StatusName(st).c_str(), err.c_str());
+      }
+      continue;
+    }
+    LighthouseRegionDigestResponse resp;
+    if (!resp.ParseFromString(resp_body)) continue;
+    // Downward directives first (they take mu_ themselves): the root's
+    // evict/drain decisions act on THIS region's members.
+    for (const auto& prefix : resp.evict_prefixes()) {
+      EvictReplica(prefix);
+    }
+    for (const auto& prefix : resp.drain_prefixes()) {
+      DrainReplica(prefix, resp.drain_deadline_ms());
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!resp.applied()) {
+      ++fed_pushes_rejected_;
+      // Fenced: the root saw a HIGHER epoch from this region — a rival
+      // child leader took the lease.  The local HA driver demotes this
+      // instance on its own; stop pushing authoritative digests now.
+      LOGW("lighthouse: region '%s' digest fenced by root (our epoch %lld, "
+           "root holds %lld)", fed_region_.c_str(),
+           static_cast<long long>(leader_epoch_),
+           static_cast<long long>(resp.leader_epoch()));
+      continue;
+    }
+    ++fed_pushes_ok_;
+    // Install the root's global quorum only on generation CHANGE: a
+    // repeated response must not re-clear the round's pending joins,
+    // while a gen that moved backwards is a failed-over root whose
+    // counter restarted — its formations are still authoritative.
+    // (Presence test by content: the local pb codegen has no has_quorum.)
+    if (resp.quorum().participants_size() > 0 &&
+        resp.quorum_gen() != fed_root_gen_) {
+      InstallGlobalQuorumLocked(resp.quorum(), resp.quorum_gen());
+    }
+  }
+}
+
+Status Lighthouse::HandleRegionDigest(const LighthouseRegionDigestRequest& req,
+                                      LighthouseRegionDigestResponse* resp,
+                                      std::string* err) {
+  const RegionDigest& d = req.digest();
+  if (d.region().empty()) {
+    if (err) *err = "region digest without a region name";
+    return Status::kInvalidArgument;
+  }
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!IsLeaderLocked()) {
+    // Root standby: the child's failover client parses the redirect and
+    // jumps to the live root leader, exactly like a Manager client would.
+    if (err) *err = NotLeaderErrLocked();
+    return Status::kUnavailable;
+  }
+  auto& entry = regions_[d.region()];
+  // Per-region epoch fence: a deposed child leader (older lease epoch than
+  // the newest this region has pushed) must not overwrite its successor's
+  // digests.  Mirrors HandleReplicate's fencing, per tier.
+  if (d.child_epoch() < entry.child_epoch) {
+    resp->set_applied(false);
+    resp->set_leader_epoch(entry.child_epoch);
+    return Status::kOk;
+  }
+  bool first = entry.digests == 0;
+  bool was_stale = entry.stale;
+  entry.child_epoch = d.child_epoch();
+  entry.seq = d.seq();
+  entry.last_push = Clock::now();
+  entry.stale = false;
+  entry.digests += 1;
+  entry.replicas_total = d.replicas_total();
+  entry.replicas_fresh = d.replicas_fresh();
+  // Region ledger rollup advances monotonically per child incarnation;
+  // goodput observation below fires only when the totals actually moved.
+  double prev_accounted = entry.compute_s;
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) prev_accounted += entry.lost_s[i];
+  entry.compute_s = d.ledger_compute_seconds();
+  double new_accounted = entry.compute_s;
+  for (size_t i = 0; i < kLedgerCauseCount &&
+                     i < static_cast<size_t>(d.ledger_lost_seconds_size());
+       ++i) {
+    entry.lost_s[i] = d.ledger_lost_seconds(i);
+    new_accounted += entry.lost_s[i];
+  }
+  entry.goodput_ratio = d.goodput_ratio();
+  entry.alerts_active = d.alerts_active();
+  entry.incident_seq = d.incident_seq();
+  if (first) {
+    LOGI("lighthouse: region '%s' joined the federation (%lld replicas, "
+         "child epoch %lld)", d.region().c_str(),
+         static_cast<long long>(d.replicas_total()),
+         static_cast<long long>(d.child_epoch()));
+  } else if (was_stale) {
+    LOGI("lighthouse: region '%s' digest pushes recovered", d.region().c_str());
+  }
+  // Member ingestion: heartbeats install via the SAME freshness-carry the
+  // HA replication path uses (now - age), so the root's QuorumCompute
+  // applies its ordinary staleness rule to region members; joined members
+  // register as participants (the digest is the region's bulk join),
+  // preserving joined_at across re-pushes so join_timeout still measures
+  // from the round's true first joiner.
+  auto now = Clock::now();
+  // `joined` flags are only valid relative to the quorum generation the
+  // child has installed: a digest built before the child saw the latest
+  // formation re-reports joins that formation already consumed, and
+  // ingesting those phantom rows would form rounds with members that
+  // never re-joined (their stale steps then trigger spurious heals).
+  // Heartbeats/steps/draining stay welcome from any generation.
+  bool joins_current = d.root_gen() >= quorum_gen_;
+  std::set<std::string> seen;
+  for (const auto& rm : d.members()) {
+    const std::string& id = rm.member().replica_id();
+    if (id.empty() || evicted_.count(id)) continue;
+    seen.insert(id);
+    region_of_[id] = d.region();
+    state_.heartbeats[id] =
+        now - std::chrono::milliseconds(rm.heartbeat_age_ms());
+    auto st = hb_step_.find(id);
+    int64_t step = std::max(rm.hb_step(), rm.member().step());
+    if (st == hb_step_.end()) {
+      hb_step_[id] = step;
+    } else if (step > st->second) {
+      st->second = step;
+      last_commit_ms_[id] = NowEpochMs();
+    }
+    if (!rm.state().empty()) hb_state_[id] = rm.state();
+    if (rm.draining()) state_.draining.emplace(id, now);
+    if (rm.joined() && joins_current) {
+      auto p = state_.participants.find(id);
+      if (p == state_.participants.end()) {
+        state_.participants.emplace(
+            id, QuorumState::Joined{rm.member(), now});
+      } else {
+        p->second.member = rm.member();  // refresh the step snapshot
+      }
+    }
+  }
+  // Ids the child no longer reports left THERE (child-side evict/prune):
+  // drop them here too so the global quorum stops counting them at digest
+  // speed instead of heartbeat-staleness speed.
+  for (auto it = region_of_.begin(); it != region_of_.end();) {
+    if (it->second == d.region() && !seen.count(it->first)) {
+      const std::string& id = it->first;
+      state_.heartbeats.erase(id);
+      state_.participants.erase(id);
+      hb_step_.erase(id);
+      hb_state_.erase(id);
+      last_commit_ms_.erase(id);
+      last_fresh_.erase(id);
+      it = region_of_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  // Cluster goodput observation across regions: the root's floor trigger
+  // watches the FLEET ledger (its own members + every region's rollup).
+  if (new_accounted > prev_accounted) ObserveGoodputLocked();
+  // Try forming the global quorum right away (the digest may have
+  // completed the joined set), then answer with whatever is newest.
+  TickLocked();
+  resp->set_applied(true);
+  resp->set_leader_epoch(entry.child_epoch);
+  if (latest_quorum_) {
+    *resp->mutable_quorum() = *latest_quorum_;
+    resp->set_quorum_gen(quorum_gen_);
+  }
+  for (const auto& p : entry.pending_evicts) resp->add_evict_prefixes(p);
+  for (const auto& p : entry.pending_drains) resp->add_drain_prefixes(p);
+  resp->set_drain_deadline_ms(entry.pending_drain_deadline_ms);
+  entry.pending_evicts.clear();
+  entry.pending_drains.clear();
+  entry.pending_drain_deadline_ms = 0;
+  return Status::kOk;
+}
+
+void Lighthouse::SweepRegionsLocked(TimePoint tick_now,
+                                    std::chrono::milliseconds hb_timeout) {
+  for (auto& [region, entry] : regions_) {
+    if (entry.stale || tick_now - entry.last_push <= hb_timeout) continue;
+    entry.stale = true;
+    auto age_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      tick_now - entry.last_push)
+                      .count();
+    LOGW("lighthouse: region '%s' digest pushes stale (age %lld ms) — "
+         "declaring the region dead", region.c_str(),
+         static_cast<long long>(age_ms));
+    // The cross-region kill signature: a whole region went dark (child
+    // leader AND standbys, or the network partition ate it).  The incident
+    // record NAMES the region — obs/incident.py's verdict surfaces it.
+    RecordIncidentLocked("region_stale", region,
+                         static_cast<double>(age_ms));
+    // Drop its members from the current round immediately; their carried
+    // heartbeats froze at the last push, so the ordinary freshness rule
+    // already excludes them from QuorumCompute — this just stops a formed
+    // round from waiting out join_timeout on corpses.
+    for (auto it = state_.participants.begin();
+         it != state_.participants.end();) {
+      auto r = region_of_.find(it->first);
+      if (r != region_of_.end() && r->second == region) {
+        it = state_.participants.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+}
+
+void Lighthouse::FillRegions(LighthouseRegionsResponse* resp) {
+  std::lock_guard<std::mutex> lk(mu_);
+  resp->set_role(!regions_.empty() ? "root" : (fed_child_ ? "child" : "flat"));
+  resp->set_region(fed_region_);
+  auto now = Clock::now();
+  if (fed_child_) {
+    // A child reports ITSELF as one region row (its own live totals): the
+    // same shape the root would render for it, sourced locally.
+    auto* ri = resp->add_regions();
+    ri->set_region(fed_region_);
+    ri->set_child_epoch(leader_epoch_);
+    ri->set_seq(fed_digest_seq_);
+    auto hb_timeout = std::chrono::milliseconds(opt_.heartbeat_timeout_ms);
+    int64_t fresh = 0;
+    for (const auto& [id, last] : state_.heartbeats) {
+      if (now - last < hb_timeout) ++fresh;
+    }
+    ri->set_replicas_total(static_cast<int64_t>(state_.heartbeats.size()));
+    ri->set_replicas_fresh(fresh);
+    double compute = 0.0, lost[kLedgerCauseCount];
+    ClusterLedgerLocked(&compute, lost);
+    double lost_total = 0.0;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_total += lost[i];
+    ri->set_ledger_compute_seconds(compute);
+    double accounted = compute + lost_total;
+    ri->set_goodput_ratio(accounted > 0.0 ? compute / accounted : 0.0);
+    int64_t active = 0;
+    for (const auto& a : alerts_) {
+      if (a.resolved_ms == 0) ++active;
+    }
+    ri->set_alerts_active(active);
+  }
+  for (const auto& [name, e] : regions_) {
+    auto* ri = resp->add_regions();
+    ri->set_region(name);
+    ri->set_child_epoch(e.child_epoch);
+    ri->set_seq(e.seq);
+    ri->set_replicas_total(e.replicas_total);
+    ri->set_replicas_fresh(e.replicas_fresh);
+    ri->set_last_push_age_ms(
+        std::chrono::duration_cast<std::chrono::milliseconds>(now - e.last_push)
+            .count());
+    ri->set_stale(e.stale);
+    ri->set_ledger_compute_seconds(e.compute_s);
+    ri->set_goodput_ratio(e.goodput_ratio);
+    ri->set_alerts_active(e.alerts_active);
+  }
+}
+
+std::string Lighthouse::RegionsJson() {
+  LighthouseRegionsResponse r;
+  FillRegions(&r);
+  std::ostringstream o;
+  o << "{\"role\":\"" << JsonEscape(r.role()) << "\",\"region\":\""
+    << JsonEscape(r.region()) << "\",\"regions\":[";
+  bool first = true;
+  for (const auto& ri : r.regions()) {
+    if (!first) o << ",";
+    first = false;
+    o << "{\"region\":\"" << JsonEscape(ri.region())
+      << "\",\"child_epoch\":" << ri.child_epoch() << ",\"seq\":" << ri.seq()
+      << ",\"replicas_total\":" << ri.replicas_total()
+      << ",\"replicas_fresh\":" << ri.replicas_fresh()
+      << ",\"last_push_age_ms\":" << ri.last_push_age_ms()
+      << ",\"stale\":" << (ri.stale() ? "true" : "false")
+      << ",\"ledger_compute_seconds\":" << ri.ledger_compute_seconds()
+      << ",\"goodput_ratio\":" << ri.goodput_ratio()
+      << ",\"alerts_active\":" << ri.alerts_active() << "}";
+  }
+  o << "]}";
+  return o.str();
+}
+
 bool Lighthouse::Start(std::string* err) {
   if (const char* tok = std::getenv("TPUFT_ADMIN_TOKEN")) admin_token_ = tok;
   // HA replicas start as followers BEFORE the listeners open (the HA
@@ -558,8 +1002,11 @@ bool Lighthouse::Start(std::string* err) {
           // double-count the leader under scrapes — and
           // /debug/flight.json is each instance's OWN black box
           // (redirecting a standby's recorder would hide exactly the
-          // election evidence it exists to keep).
-          if (path != "/metrics" && path != "/debug/flight.json") {
+          // election evidence it exists to keep).  /regions.json is the
+          // same shape: a per-instance federation view (wire method 9 is
+          // answered by every instance too).
+          if (path != "/metrics" && path != "/debug/flight.json" &&
+              path != "/regions.json") {
             std::string leader_http;
             bool follower;
             {
@@ -639,6 +1086,11 @@ bool Lighthouse::Start(std::string* err) {
             // 14-16 (docs/wire.md "Goodput ledger").
             r.content_type = "application/json";
             r.body = GoodputJson();
+          } else if (method == "GET" && path == "/regions.json") {
+            // Federation rollup (read-only, ungated): this instance's
+            // role + one row per known region (docs/wire.md "Federation").
+            r.content_type = "application/json";
+            r.body = RegionsJson();
           } else if (method == "GET" && path == "/incident.json") {
             // Incident-trigger feed (read-only, ungated): the capture
             // driver (obs/incident.py) polls this and bundles the
@@ -692,6 +1144,7 @@ void Lighthouse::Shutdown() {
     quorum_cv_.notify_all();
   }
   if (tick_thread_.joinable()) tick_thread_.join();
+  if (fed_thread_.joinable()) fed_thread_.join();
   if (server_) server_->Shutdown();
   if (http_) http_->Shutdown();
   // Black-box dump: with TPUFT_FLIGHT_DIR set, a shutting-down lighthouse
@@ -822,6 +1275,30 @@ Status Lighthouse::DispatchInner(uint16_t method, const std::string& req, Deadli
       // of role (clients use it to find the leader without guessing).
       LighthouseLeaderInfoResponse r;
       FillLeaderInfo(&r);
+      r.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kLighthouseRegionDigest: {
+      // Federation: a regional child leader pushing its membership + ledger
+      // digest (docs/wire.md "Federation").
+      LighthouseRegionDigestRequest q;
+      if (!q.ParseFromString(req)) return Status::kInvalidArgument;
+      *trace_id = q.trace_id();
+      LighthouseRegionDigestResponse r;
+      std::string err;
+      Status st = HandleRegionDigest(q, &r, &err);
+      if (st != Status::kOk) {
+        *resp = err;
+        return st;
+      }
+      r.SerializeToString(resp);
+      return Status::kOk;
+    }
+    case kLighthouseRegions: {
+      // Read-only federation rollup: answered by every instance regardless
+      // of role (like LeaderInfo — each instance reports its own view).
+      LighthouseRegionsResponse r;
+      FillRegions(&r);
       r.SerializeToString(resp);
       return Status::kOk;
     }
@@ -961,6 +1438,14 @@ void Lighthouse::ClusterLedgerLocked(double* compute_s,
   for (const auto& [id, rl] : ledger_) {
     *compute_s += rl.compute_s;
     for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_s[i] += rl.lost_s[i];
+  }
+  // Federation root: fold every region's digest rollup into the fleet
+  // totals.  Region members heartbeat their own CHILD (never here), so
+  // there is no double counting with the per-replica ledger above; a dead
+  // region's last rollup stays in the totals (monotonic, like the bank).
+  for (const auto& [name, e] : regions_) {
+    *compute_s += e.compute_s;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_s[i] += e.lost_s[i];
   }
 }
 
@@ -1616,6 +2101,13 @@ void Lighthouse::TickLocked() {
     SweepLocked(tick_now, hb_timeout);
   }
 
+  // Federated child: quorum formation is the ROOT's job — the push loop
+  // reports this region's membership upward and installs the root's
+  // returned GLOBAL quorum (InstallGlobalQuorumLocked), which is what
+  // wakes this instance's blocked joiners.  The sweep above still runs:
+  // the child owns its region's sentinels, prunes and ledger banking.
+  if (fed_child_) return;
+
   // Formation latency reference point: the round's first joiner (the same
   // origin QuorumCompute's straggler wait uses).  Captured before the
   // compute because formation clears `participants`.
@@ -1805,6 +2297,7 @@ void Lighthouse::SweepLocked(TimePoint tick_now,
   prune_with_heartbeats(last_commit_ms_);
   prune_with_heartbeats(allreduce_gbps_);
   prune_with_heartbeats(ec_shards_);
+  prune_with_heartbeats(region_of_);
   // Ledger entries bank before they prune: a departed incarnation's
   // accounted seconds belong to the cluster totals forever — pruning
   // without banking would make tpuft_lost_seconds_total go backwards
@@ -1868,6 +2361,10 @@ void Lighthouse::SweepLocked(TimePoint tick_now,
       ++it;
     }
   }
+  // Federation root: regions whose digest pushes stopped (docs/wire.md
+  // "Federation") — the region-scale analogue of the stale transition
+  // above.
+  SweepRegionsLocked(tick_now, hb_timeout);
   // Coverage sentinel: the sweep is what notices holders DYING (their
   // freshness lapses without any heartbeat to trigger the check).
   CheckEcCoverageLocked();
@@ -1915,6 +2412,25 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
   auto matches = [&](const std::string& id) {
     return id == prefix || id.rfind(prefix + ":", 0) == 0;
   };
+  // Federation root: route the eviction DOWN to the owning region(s) as a
+  // one-shot directive on their next digest response — the CHILD owns the
+  // members' heartbeats, so dropping them only here would let the next
+  // digest re-register the corpse.  Queued before the local drops erase
+  // the region_of_ ownership rows; a prefix no region is known to own
+  // broadcasts (the supervisor may be ahead of the first digest).
+  if (!regions_.empty()) {
+    std::set<std::string> targets;
+    for (const auto& [id, region] : region_of_) {
+      if (matches(id)) targets.insert(region);
+    }
+    if (targets.empty()) {
+      for (const auto& [name, e] : regions_) targets.insert(name);
+    }
+    for (const auto& t : targets) {
+      auto rit = regions_.find(t);
+      if (rit != regions_.end()) rit->second.pending_evicts.push_back(prefix);
+    }
+  }
   for (auto it = state_.heartbeats.begin(); it != state_.heartbeats.end();) {
     if (matches(it->first)) {
       evicted_[it->first] = now;  // tombstone: no zombie re-registration
@@ -1953,6 +2469,7 @@ int Lighthouse::EvictReplica(const std::string& prefix) {
   erase_matching(last_commit_ms_);
   erase_matching(allreduce_gbps_);
   erase_matching(ec_shards_);
+  erase_matching(region_of_);
   // Evicted incarnations bank their ledger counters first (see
   // SweepLocked) — the work they accounted happened.  Not undoable: the
   // tombstone guarantees this id can never heartbeat again.
@@ -2015,6 +2532,23 @@ int Lighthouse::DrainLocked(const std::string& prefix, int64_t deadline_ms) {
   if (state_.prev_quorum) {
     for (const auto& m : state_.prev_quorum->participants()) {
       if (matches(m.replica_id())) ids.insert(m.replica_id());
+    }
+  }
+  // Federation root: drains propagate down the digest path like evictions
+  // (the child's QuorumCompute is what must skip the draining members).
+  if (!regions_.empty()) {
+    std::set<std::string> targets;
+    for (const auto& [id, region] : region_of_) {
+      if (matches(id)) targets.insert(region);
+    }
+    if (targets.empty()) {
+      for (const auto& [name, e] : regions_) targets.insert(name);
+    }
+    for (const auto& t : targets) {
+      auto rit = regions_.find(t);
+      if (rit == regions_.end()) continue;
+      rit->second.pending_drains.push_back(prefix);
+      if (deadline_ms > 0) rit->second.pending_drain_deadline_ms = deadline_ms;
     }
   }
   auto now = Clock::now();
@@ -2128,6 +2662,16 @@ std::string Lighthouse::MetricsText() {
     std::vector<std::pair<std::string, double>> goodput_ratio;
     double goodput_ewma = -1.0;
     int64_t incidents = 0;
+    // Federation (docs/wire.md "Federation").
+    int fed_role = 0;  // 0 flat, 1 regional child, 2 root
+    int64_t fed_digests = 0, fed_rejected = 0;
+    struct RegionRow {
+      std::string name;
+      int64_t total = 0, fresh = 0, epoch = 0, seq = 0, alerts = 0;
+      double age_s = 0.0, compute_s = 0.0, lost_s = 0.0, goodput = 0.0;
+      bool stale = false;
+    };
+    std::vector<RegionRow> regions;
   } s;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -2234,6 +2778,29 @@ std::string Lighthouse::MetricsText() {
     }
     s.goodput_ewma = goodput_ewma_;
     s.incidents = incident_seq_;
+    // Federation: a root is whoever has accepted digests; a child counts
+    // its own accepted pushes (roots keep fed_pushes_ok_ at 0, children
+    // keep regions_ empty, so the sum below is whichever applies).
+    s.fed_role = !regions_.empty() ? 2 : (fed_child_ ? 1 : 0);
+    s.fed_digests = fed_pushes_ok_;
+    s.fed_rejected = fed_pushes_rejected_;
+    s.regions.reserve(regions_.size());
+    for (const auto& [name, e] : regions_) {
+      Snap::RegionRow row;
+      row.name = name;
+      row.total = e.replicas_total;
+      row.fresh = e.replicas_fresh;
+      row.epoch = e.child_epoch;
+      row.seq = e.seq;
+      row.alerts = e.alerts_active;
+      row.age_s = std::chrono::duration<double>(now - e.last_push).count();
+      row.compute_s = e.compute_s;
+      for (size_t i = 0; i < kLedgerCauseCount; ++i) row.lost_s += e.lost_s[i];
+      row.goodput = e.goodput_ratio;
+      row.stale = e.stale;
+      s.fed_digests += e.digests;
+      s.regions.push_back(std::move(row));
+    }
   }
 
   std::ostringstream o;
@@ -2412,6 +2979,82 @@ std::string Lighthouse::MetricsText() {
          "(see /incident.json)\n"
          "# TYPE tpuft_incidents_total counter\n";
     o << "tpuft_incidents_total " << s.incidents << "\n";
+  }
+
+  // Federation (docs/wire.md "Federation"): per-instance role + push
+  // counters, plus the root's per-region rollup (one series set per region
+  // — region count is O(10), so the scrape stays bounded by REGION SIZE,
+  // never global N; flat instances expose role 0 and empty region series).
+  gauge("tpuft_federation_role",
+        "federation role of this instance: 0 flat, 1 regional child, 2 root");
+  o << "tpuft_federation_role " << s.fed_role << "\n";
+  o << "# HELP tpuft_federation_digests_total region digest pushes accepted "
+       "(child: accepted by the root; root: accepted from every region)\n"
+       "# TYPE tpuft_federation_digests_total counter\n";
+  o << "tpuft_federation_digests_total " << s.fed_digests << "\n";
+  o << "# HELP tpuft_federation_digests_rejected_total digest pushes fenced "
+       "or failed (stale child epoch, root unreachable)\n"
+       "# TYPE tpuft_federation_digests_rejected_total counter\n";
+  o << "tpuft_federation_digests_rejected_total " << s.fed_rejected << "\n";
+  gauge("tpuft_regions", "regions known to this root (ever pushed a digest)");
+  o << "tpuft_regions " << s.regions.size() << "\n";
+  gauge("tpuft_region_replicas",
+        "replicas reported by the region's last digest");
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_replicas{region=\"" << PromEscape(r.name) << "\"} "
+      << r.total << "\n";
+  }
+  gauge("tpuft_region_replicas_fresh",
+        "heartbeat-fresh replicas in the region's last digest");
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_replicas_fresh{region=\"" << PromEscape(r.name)
+      << "\"} " << r.fresh << "\n";
+  }
+  gauge("tpuft_region_digest_age_seconds",
+        "seconds since the region's last accepted digest push");
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_digest_age_seconds{region=\"" << PromEscape(r.name)
+      << "\"} " << r.age_s << "\n";
+  }
+  gauge("tpuft_region_epoch",
+        "child lease epoch of the region's last accepted digest (the "
+        "per-region fencing token)");
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_epoch{region=\"" << PromEscape(r.name) << "\"} "
+      << r.epoch << "\n";
+  }
+  gauge("tpuft_region_stale",
+        "1 when the region's digest pushes stopped for a heartbeat timeout "
+        "(the cross-region kill signature)");
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_stale{region=\"" << PromEscape(r.name) << "\"} "
+      << (r.stale ? 1 : 0) << "\n";
+  }
+  gauge("tpuft_region_goodput_ratio",
+        "region cumulative productive fraction from its ledger rollup");
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_goodput_ratio{region=\"" << PromEscape(r.name)
+      << "\"} " << r.goodput << "\n";
+  }
+  gauge("tpuft_region_alerts_active",
+        "unresolved sentinel alerts inside the region (child-owned)");
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_alerts_active{region=\"" << PromEscape(r.name)
+      << "\"} " << r.alerts << "\n";
+  }
+  o << "# HELP tpuft_region_compute_seconds_total region productive seconds "
+       "(goodput-ledger rollup from the region's digests; monotonic)\n"
+       "# TYPE tpuft_region_compute_seconds_total counter\n";
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_compute_seconds_total{region=\"" << PromEscape(r.name)
+      << "\"} " << r.compute_s << "\n";
+  }
+  o << "# HELP tpuft_region_lost_seconds_total region lost seconds summed "
+       "over the ledger's cause taxonomy (monotonic)\n"
+       "# TYPE tpuft_region_lost_seconds_total counter\n";
+  for (const auto& r : s.regions) {
+    o << "tpuft_region_lost_seconds_total{region=\"" << PromEscape(r.name)
+      << "\"} " << r.lost_s << "\n";
   }
 
   // Control-plane latency distributions (docs/wire.md "Latency

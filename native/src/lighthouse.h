@@ -149,6 +149,37 @@ struct AlertRecord {
   std::string src_replica_id;
 };
 
+// Root-side record of one regional child lighthouse (docs/wire.md
+// "Federation").  Created on the first accepted RegionDigest push and kept
+// for the lifetime of the root (region count is O(10), not O(N)); `stale`
+// flips when pushes stop arriving for a heartbeat timeout — the region's
+// members drop out of the global quorum through the ordinary
+// heartbeat-freshness rule (their installed heartbeats freeze at the last
+// push), and a "region_stale" incident names the lost region for the
+// capture driver.  Not replicated to HA standbys: a promoted root
+// repopulates this table from each region's next push (one push interval),
+// re-latching child-epoch fences as digests arrive.
+struct RegionEntry {
+  TimePoint last_push{};      // when the last digest was accepted
+  int64_t child_epoch = 0;    // fencing: highest child lease epoch accepted
+  int64_t seq = 0;            // child's digest sequence at last accept
+  int64_t replicas_total = 0;
+  int64_t replicas_fresh = 0;
+  double compute_s = 0.0;     // region ledger rollup (cumulative)
+  double lost_s[kLedgerCauseCount] = {0};
+  double goodput_ratio = 0.0;
+  int64_t alerts_active = 0;
+  int64_t incident_seq = 0;   // child's incident counter (digest freshness)
+  int64_t digests = 0;        // accepted pushes (gauge)
+  bool stale = false;         // digests stopped arriving
+  // One-shot downward directives queued for the region's next digest
+  // response: evict/drain prefixes issued at the root (ops endpoints,
+  // auto-drain) for ids this region owns.
+  std::vector<std::string> pending_evicts;
+  std::vector<std::string> pending_drains;
+  int64_t pending_drain_deadline_ms = 0;
+};
+
 // Pure quorum math, unit-testable without sockets.
 // Reference parity: quorum_compute, src/lighthouse.rs:133-261.
 struct QuorumState {
@@ -267,6 +298,38 @@ class Lighthouse {
                          LighthouseReplicateResponse* resp);
   void FillLeaderInfo(LighthouseLeaderInfoResponse* resp);
 
+  // -- Federation (docs/wire.md "Federation") -----------------------------
+  // Makes this lighthouse a regional CHILD: it keeps owning heartbeats,
+  // sentinel scoring and the goodput-ledger rollup for its own replica
+  // groups (Manager clients keep pointing at the region's address list,
+  // unchanged), but stops forming local quorums — instead a push loop
+  // reports a bounded membership + ledger digest to the ROOT lighthouse at
+  // `root_addrs` (comma-separated; the root's HA replica set) every
+  // `push_interval_ms`, installs the root's returned GLOBAL quorum for its
+  // blocked joiners, and applies the root's downward evict/drain
+  // directives.  Pushes only while this instance holds its region's lease
+  // (HA follower children stay quiet); the digest carries the child lease
+  // epoch so a deposed child leader is fenced at the root.  Call after
+  // Start.  A lighthouse that never calls this and never receives digests
+  // behaves bit-identically to the flat single-tier service.
+  void SetFederation(const std::string& region, const std::string& root_addrs,
+                     int64_t push_interval_ms);
+  // Root-side ingest of one region digest (wire method 8): fences on the
+  // child epoch, installs the region's members into the global membership
+  // maps (heartbeats via age-carry, joined members as participants), rolls
+  // the region's ledger into the fleet totals, attempts a global quorum,
+  // and answers with the latest quorum + any pending directives for the
+  // region.  Public for in-process tests.
+  Status HandleRegionDigest(const LighthouseRegionDigestRequest& req,
+                            LighthouseRegionDigestResponse* resp,
+                            std::string* err);
+  // Read-only federation rollup (wire method 9 / GET /regions.json),
+  // answered by every instance regardless of role: role ("root" once any
+  // digest was accepted, "child" when federated, else "flat") + one row
+  // per known region.
+  void FillRegions(LighthouseRegionsResponse* resp);
+  std::string RegionsJson();
+
  private:
   // Outer dispatch: times the handler, records the server-side RPC span
   // (method, peer, status, duration, trace id) into the flight recorder
@@ -372,6 +435,25 @@ class Lighthouse {
   // factored out of TickLocked so it can run on a bounded cadence instead
   // of once per quorum join.  Caller holds mu_.
   void SweepLocked(TimePoint tick_now, std::chrono::milliseconds hb_timeout);
+  // -- federation internals ----------------------------------------------
+  // Child push loop: builds + pushes the region digest on a fixed cadence
+  // on its own thread (a slow root must not stall quorum ticks).
+  void FederationLoop();
+  // Snapshots this child's digest: every heartbeating id with its age,
+  // joined/draining flags and step, plus the region ledger rollup.
+  // Caller holds mu_.
+  void BuildDigestLocked(RegionDigest* d);
+  // Installs a root-returned global quorum on a child (same broadcast
+  // discipline as TickLocked: set prev_quorum/quorum_id, clear the round's
+  // participants, bump quorum_gen_, wake blocked joiners).  Caller holds
+  // mu_.
+  void InstallGlobalQuorumLocked(const Quorum& q, int64_t root_gen);
+  // Root-side region staleness check (runs inside SweepLocked): a region
+  // whose pushes stopped for a heartbeat timeout goes stale — its
+  // participants drop from the current round and a "region_stale" incident
+  // names it.  Caller holds mu_.
+  void SweepRegionsLocked(TimePoint tick_now,
+                          std::chrono::milliseconds hb_timeout);
 
   LighthouseOpt opt_;
   std::unique_ptr<RpcServer> server_;
@@ -536,6 +618,27 @@ class Lighthouse {
   // The standby-rejection message (kNotLeaderPrefix contract, wire.h).
   std::string NotLeaderErrLocked() const;
 
+  // -- federation state (docs/wire.md "Federation") -----------------------
+  // Child side: region name ("" = not a child), root address list, push
+  // cadence, and the last installed root quorum generation (installs only
+  // on advance, so a repeated push response cannot re-clear the round's
+  // participants).
+  bool fed_child_ = false;
+  std::string fed_region_;
+  std::string fed_root_addrs_;
+  int64_t fed_push_interval_ms_ = 500;
+  int64_t fed_digest_seq_ = 0;
+  int64_t fed_root_gen_ = 0;
+  int64_t fed_pushes_ok_ = 0;        // digests the root accepted
+  int64_t fed_pushes_rejected_ = 0;  // fenced / not-applied responses
+  std::thread fed_thread_;
+  // Root side: one entry per region that has ever pushed (the federation
+  // fan-in surface the /metrics region gauges render), plus the member-id
+  // -> region owner map directives route through.  region_of_ is pruned
+  // with the heartbeat graveyard.
+  std::map<std::string, RegionEntry> regions_;
+  std::map<std::string, std::string> region_of_;
+
   std::thread tick_thread_;
   bool shutdown_ = false;
 
@@ -544,7 +647,7 @@ class Lighthouse {
   // GET /debug/flight.json and dumped to $TPUFT_FLIGHT_DIR on Shutdown.
   FlightRecorder flight_;
   // Server-side handling latency per wire method (pre-populated for
-  // methods 1-7 in the ctor so lookups never mutate the map).
+  // methods 1-9 in the ctor so lookups never mutate the map).
   std::map<uint16_t, LatencyHistogram> rpc_hist_;
   // Round first-joiner -> formation latency, observed on every formation.
   LatencyHistogram quorum_formation_hist_;

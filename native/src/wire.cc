@@ -160,6 +160,8 @@ std::string MethodName(uint16_t method) {
     case kLighthouseDrain: return "Drain";
     case kLighthouseReplicate: return "Replicate";
     case kLighthouseLeaderInfo: return "LeaderInfo";
+    case kLighthouseRegionDigest: return "RegionDigest";
+    case kLighthouseRegions: return "Regions";
     case kManagerQuorum: return "ManagerQuorum";
     case kManagerCheckpointMetadata: return "CheckpointMetadata";
     case kManagerShouldCommit: return "ShouldCommit";

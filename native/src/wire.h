@@ -76,6 +76,11 @@ enum Method : uint16_t {
   // replica regardless of role.
   kLighthouseReplicate = 6,
   kLighthouseLeaderInfo = 7,
+  // Federation (docs/wire.md "Federation"): regional child -> root digest
+  // push, and the read-only per-region rollup listing answered by every
+  // instance regardless of federation role.
+  kLighthouseRegionDigest = 8,
+  kLighthouseRegions = 9,
   kManagerQuorum = 10,
   kManagerCheckpointMetadata = 11,
   kManagerShouldCommit = 12,

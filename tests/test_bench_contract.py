@@ -311,6 +311,11 @@ def test_link_quick_smoke() -> None:
     assert r["attribution_fraction_sum"] == pytest.approx(1.0, abs=0.01)
     assert r["added_wire_stall_fraction"] is not None
     assert r["added_wire_stall_fraction"] >= 0.9
+    # The victim's sampled hop timeline must bracket the injected fault
+    # window: records before AND after the mid-run re-shaping, so the
+    # post-mortem black box covers the moment that matters.
+    assert r["hop_timeline_records"] > 0
+    assert r["hop_timeline_brackets_fault"] is True
     # Hop-recorder cost guard, live (noisy-CI bound; artifact is strict).
     assert r["overhead"]["impact"] is not None
     assert r["overhead"]["impact"] < 1.35
@@ -328,6 +333,35 @@ def test_link_quick_smoke() -> None:
     assert link["attribution_fraction_sum"] == pytest.approx(1.0, abs=0.01)
     assert link["added_wire_stall_fraction"] >= 0.9
     assert link["overhead"]["impact"] < 1.02  # the <2% recorder budget
+
+
+def test_peer_kill_hop_timeline_brackets_fault() -> None:
+    """Mid-allreduce peer-kill cell: beyond the existing latch/rebuild
+    gates, the surviving group's hop timeline must BRACKET the kill —
+    pre-fault hops banked when abort() tore the generation down, plus
+    hops from the rebuilt lanes.  A timeline that only covers one side
+    of the fault window is useless as a black box.
+
+    The cell injects the kill on a 0.3 s wall timer against a shaped
+    16 MB allreduce; on a loaded 1-core host that race occasionally
+    mis-lands (timer after drain, or recovery outrunning a gate), so the
+    trial retries like the other timing-shaped smokes — the contract is
+    that a CLEAN run brackets the fault, not that the scheduler never
+    starves the timer."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_allreduce
+    finally:
+        sys.path.pop(0)
+    r = None
+    for _ in range(3):
+        r = bench_allreduce.bench_peer_kill(lanes=2)
+        if r["ok"]:
+            break
+    assert r["ok"], r
+    assert r["hop_timeline_records"] > 0
+    assert r["hop_timeline_brackets_fault"] is True
+    assert r["kill_ts"] is not None
 
 
 def test_device_prep_quick_smoke() -> None:

@@ -372,6 +372,112 @@ def test_fragment_scope_rejects_tree_state_dict() -> None:
         algo._load_outer_state({"backup": box.params, "outer_state": tree_state})
 
 
+def test_fragment_writeback_lands_per_fragment() -> None:
+    """With a ``set_fragment_params`` hook, a committed round writes each
+    fragment to device as its outer step is computed — one hook call per
+    fragment covering every leaf exactly once — and the round-boundary
+    whole-tree ``set_params`` reset is skipped (it would re-land the same
+    bytes a second time)."""
+    import optax
+
+    from torchft_tpu.semisync import StreamingDiLoCo
+
+    manager = _mock_manager(commit=True)
+
+    class Box:
+        # 4 KiB fragments over 4x 1 KiB leaves -> one leaf per fragment.
+        params = {f"w{i}": np.ones(256, dtype=np.float32) for i in range(4)}
+        set_calls = 0
+        frag_calls: list = []
+
+        def get(self):
+            return self.params
+
+        def set(self, p):
+            Box.set_calls += 1
+            self.params = p
+
+        def set_fragment(self, indices, leaves):
+            Box.frag_calls.append(list(indices))
+            flat = list(jax.tree.flatten(self.params)[0])
+            for i, leaf in zip(indices, leaves):
+                flat[i] = leaf
+            self.params = jax.tree.unflatten(
+                jax.tree.structure(self.params), flat
+            )
+
+    import jax
+
+    box = Box()
+    algo = StreamingDiLoCo(
+        manager, box.get, box.set, optax.sgd(0.5), sync_every=1,
+        fragment_bytes=1024, stream=False, set_fragment_params=box.set_fragment,
+    )
+    assert algo.num_fragments == 4
+    box.params = {k: np.zeros(256, dtype=np.float32) for k in box.params}
+    algo.step()
+    # One write-back per fragment, together covering every leaf once; no
+    # whole-tree set_params on the committed path.
+    assert len(Box.frag_calls) == 4
+    assert sorted(i for call in Box.frag_calls for i in call) == [0, 1, 2, 3]
+    assert Box.set_calls == 0
+    # The landed params equal the backup the outer step produced.
+    for a, b in zip(
+        jax.tree.flatten(box.params)[0], jax.tree.flatten(algo.backup_params)[0]
+    ):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_fragment_writeback_aborted_round_resets_whole_tree() -> None:
+    """A failed commit vote must still roll the live params back through
+    the whole-tree ``set_params`` — the backup predates the round, so no
+    per-fragment outer step ever 'commits'."""
+    import optax
+
+    from torchft_tpu.semisync import StreamingDiLoCo
+
+    manager = _mock_manager(commit=False)
+
+    class Box:
+        params = {"w": np.ones(512, dtype=np.float32)}
+        set_calls = 0
+        frag_calls = 0
+
+        def get(self):
+            return self.params
+
+        def set(self, p):
+            Box.set_calls += 1
+            self.params = p
+
+        def set_fragment(self, indices, leaves):
+            Box.frag_calls += 1
+
+    box = Box()
+    algo = StreamingDiLoCo(
+        manager, box.get, box.set, optax.sgd(0.5), sync_every=1,
+        stream=False, set_fragment_params=box.set_fragment,
+    )
+    box.params = {"w": np.zeros(512, dtype=np.float32)}
+    algo.step()
+    assert Box.frag_calls == 0
+    assert Box.set_calls == 1
+    assert np.array_equal(box.params["w"], np.ones(512, dtype=np.float32))
+
+
+def test_fragment_writeback_rejects_tree_scope() -> None:
+    import optax
+
+    from torchft_tpu.semisync import StreamingDiLoCo
+
+    with pytest.raises(ValueError, match="set_fragment_params"):
+        StreamingDiLoCo(
+            _mock_manager(), lambda: {"w": np.ones(4, dtype=np.float32)},
+            lambda p: None, optax.sgd(0.5), sync_every=1, stream=False,
+            outer_scope="tree", set_fragment_params=lambda i, l: None,
+        )
+
+
 def test_sync_max_retries_still_propagates() -> None:
     """ExceededMaxRetriesError is the give-up contract, not a sync
     failure: the latch-and-continue path must not swallow it."""

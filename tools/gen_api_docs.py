@@ -73,6 +73,8 @@ MODULES = [
     "torchft_tpu.ha.lease",
     "torchft_tpu.ha.replica",
     "torchft_tpu.ha.backoff",
+    "torchft_tpu.federation.region",
+    "torchft_tpu.federation.root",
     "torchft_tpu.launch",
     "torchft_tpu.lighthouse_cli",
     "torchft_tpu.parameter_server",

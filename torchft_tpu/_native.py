@@ -39,6 +39,8 @@ LIGHTHOUSE_EVICT = 4
 LIGHTHOUSE_DRAIN = 5
 LIGHTHOUSE_REPLICATE = 6
 LIGHTHOUSE_LEADER_INFO = 7
+LIGHTHOUSE_REGION_DIGEST = 8
+LIGHTHOUSE_REGIONS = 9
 MANAGER_QUORUM = 10
 MANAGER_CHECKPOINT_METADATA = 11
 MANAGER_SHOULD_COMMIT = 12
@@ -172,6 +174,21 @@ def _load_lib() -> ctypes.CDLL:
     lib.tf_lighthouse_link_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.tf_lighthouse_flight_json.restype = ctypes.c_void_p
     lib.tf_lighthouse_flight_json.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    try:
+        # Federation surface (docs/wire.md "Federation").  Declared inside a
+        # probe: a stale .so without the symbols predates the two-tier
+        # topology — LighthouseServer.set_federation raises a clear error
+        # and regions_json degrades to an empty rollup.
+        lib.tf_lighthouse_set_federation.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        lib.tf_lighthouse_regions_json.restype = ctypes.c_void_p
+        lib.tf_lighthouse_regions_json.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        pass
     lib.tf_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
     lib.tf_lighthouse_free.argtypes = [ctypes.c_void_p]
     lib.tf_manager_new.restype = ctypes.c_void_p
@@ -596,6 +613,48 @@ class LighthouseServer:
 
     def leader_epoch(self) -> int:
         return int(_lib.tf_lighthouse_leader_epoch(self._ptr)) if self._ptr else 0
+
+    def set_federation(
+        self, region: str, root_addrs: str, push_interval_ms: int = 500
+    ) -> None:
+        """Join a two-tier federation as the CHILD lighthouse for
+        ``region`` (docs/wire.md "Federation").  This instance keeps
+        owning heartbeats, sentinels, and the goodput ledger for its
+        local replica groups, but stops forming quorums itself: a
+        background loop pushes a membership + ledger digest to the ROOT
+        at ``root_addrs`` (comma-separated, leader + standbys) every
+        ``push_interval_ms`` and installs the global quorum the root
+        returns.  Call after the server is up; the root needs no
+        configuration — any lighthouse that receives digests serves as
+        root.  Flat (non-federated) deployments never call this and
+        behave exactly as before."""
+        if not self._ptr:
+            return
+        if not hasattr(_lib, "tf_lighthouse_set_federation"):
+            raise RuntimeError(
+                "libtpuft.so predates the federation surface "
+                "(tf_lighthouse_set_federation missing) — rebuild native/"
+            )
+        _lib.tf_lighthouse_set_federation(
+            self._ptr, region.encode(), root_addrs.encode(), int(push_interval_ms)
+        )
+
+    def regions_json(self) -> str:
+        """Federation rollup as a JSON document string — same payload as
+        this lighthouse's ``GET /regions.json`` (docs/wire.md
+        "Federation"): ``{"role", "region", "regions": [...]}`` where
+        role is "root"/"child"/"flat".  A root lists one row per region
+        with digest freshness and ledger rollups; a child lists its own
+        region; a flat instance lists nothing."""
+        if not self._ptr or not hasattr(_lib, "tf_lighthouse_regions_json"):
+            return '{"role":"flat","region":"","regions":[]}'
+        return _take_string(_lib.tf_lighthouse_regions_json(self._ptr))
+
+    def regions(self) -> dict:
+        """Parsed :meth:`regions_json`."""
+        import json
+
+        return json.loads(self.regions_json() or "{}")
 
     def flight_json(self, limit: int = 0) -> str:
         """Flight-recorder snapshot as a JSON document string (newest-first

@@ -10,6 +10,8 @@ LIGHTHOUSE_EVICT: int
 LIGHTHOUSE_DRAIN: int
 LIGHTHOUSE_REPLICATE: int
 LIGHTHOUSE_LEADER_INFO: int
+LIGHTHOUSE_REGION_DIGEST: int
+LIGHTHOUSE_REGIONS: int
 NOT_LEADER_PREFIX: str
 MANAGER_QUORUM: int
 MANAGER_CHECKPOINT_METADATA: int
@@ -79,6 +81,11 @@ class LighthouseServer:
     ) -> None: ...
     def role(self) -> int: ...
     def leader_epoch(self) -> int: ...
+    def set_federation(
+        self, region: str, root_addrs: str, push_interval_ms: int = ...
+    ) -> None: ...
+    def regions_json(self) -> str: ...
+    def regions(self) -> Dict[str, Any]: ...
     def flight_json(self, limit: int = ...) -> str: ...
     def flight(self, limit: int = ...) -> Dict[str, Any]: ...
     def link_state(self, replica_id: str) -> int: ...

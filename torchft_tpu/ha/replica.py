@@ -157,6 +157,18 @@ class HALighthouse:
     def http_address(self) -> str:
         return self._http
 
+    def native_server(self):
+        """The wrapped native :class:`~torchft_tpu._native.LighthouseServer`.
+
+        For surfaces that live on the native object and compose with HA
+        per-instance rather than per-group — federation enrollment above
+        all (:mod:`torchft_tpu.federation` calls ``set_federation`` on
+        every replica of an HA child group; the native push loop only
+        fires while the replica holds the lease, so leadership changes
+        hand off the digest stream automatically).  Role flips stay owned
+        by the election loop: never call ``set_role`` on this directly."""
+        return self._server
+
     def role(self) -> str:
         """"leader" (live lease) or "follower"."""
         return "leader" if self._server.role() == 1 else "follower"

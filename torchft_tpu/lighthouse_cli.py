@@ -15,6 +15,16 @@ list and fail over automatically::
     python -m torchft_tpu.lighthouse_cli --bind host1:29510 \
         --http_bind host1:29511 --lease-file /shared/tpuft_lease \
         --lease-ms 2000 --peers host2:29510,host3:29510
+
+Federated mode (docs/wire.md "Federation"): pass ``--region`` and
+``--root-addrs`` to run this instance as a regional CHILD that owns its
+local groups' heartbeats/sentinels/ledger and pushes digests to the root;
+the root is just another lighthouse (no extra flag — set its
+``--min_replicas`` to the GLOBAL group count).  Combines with HA flags on
+either tier::
+
+    python -m torchft_tpu.lighthouse_cli --bind 0.0.0.0:29510 \
+        --region us-east --root-addrs root-host:29500
 """
 
 from __future__ import annotations
@@ -56,7 +66,31 @@ def main(argv=None) -> None:
         help="comma-separated RPC addresses of the OTHER replicas (the "
         "replication push targets); this replica's own address is ignored",
     )
+    fed = parser.add_argument_group(
+        "federation",
+        "run this instance as a regional child lighthouse of a two-tier "
+        "federation (the root needs no flags — any lighthouse receiving "
+        "digests serves as root)",
+    )
+    fed.add_argument(
+        "--region", default="",
+        help="region name enabling child mode; managers in this region keep "
+        "their unchanged flat config pointed at this instance",
+    )
+    fed.add_argument(
+        "--root-addrs", default="",
+        help="comma-separated RPC addresses of the root lighthouse "
+        "(leader + standbys when the root is HA)",
+    )
+    fed.add_argument(
+        "--region-push-interval-ms", type=int, default=500,
+        help="digest push cadence; keep well under the root's "
+        "heartbeat_timeout_ms (the region-staleness horizon)",
+    )
     args = parser.parse_args(argv)
+
+    if bool(args.region) != bool(args.root_addrs):
+        parser.error("--region and --root-addrs must be given together")
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s"
@@ -80,6 +114,13 @@ def main(argv=None) -> None:
             quorum_tick_ms=args.quorum_tick_ms,
             heartbeat_timeout_ms=args.heartbeat_timeout_ms,
         )
+        if args.region:
+            # Every HA replica enrolls; the native push loop only fires on
+            # the current lease holder, so failover hands off the digest
+            # stream without re-enrollment.
+            server.native_server().set_federation(
+                args.region, args.root_addrs, args.region_push_interval_ms
+            )
         logging.info(
             "HA lighthouse replica on %s (dashboard at %s, lease %s, %d peer(s))",
             server.address(), server.http_address(), args.lease_file,
@@ -99,6 +140,10 @@ def main(argv=None) -> None:
         heartbeat_timeout_ms=args.heartbeat_timeout_ms,
         http_bind=args.http_bind,
     )
+    if args.region:
+        server.set_federation(
+            args.region, args.root_addrs, args.region_push_interval_ms
+        )
     logging.info("lighthouse listening on %s (dashboard at %s)",
                  server.address(), server.http_address())
     stop.wait()

@@ -122,6 +122,11 @@ class Manager:
                 store, created by local rank 0 (env: MASTER_ADDR/MASTER_PORT).
             external_store_addr: use an existing store (tests / shared infra).
             lighthouse_addr: lighthouse RPC address (env: TPUFT_LIGHTHOUSE).
+                A comma-separated list fails over across an HA replica
+                set.  Under a federated control plane this names the
+                REGION's child lighthouse(s) — byte-for-byte the same
+                config as a flat deployment; managers never learn the
+                root exists (docs/wire.md "Federation").
             replica_id: stable replica-group id; a ":uuid" suffix is added so
                 fast restarts look like new members (torchft/manager.py:230-238).
             init_sync: sync weights from replica 0 at step 0.

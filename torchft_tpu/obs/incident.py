@@ -270,6 +270,11 @@ def verdict(bundle: str, events: Optional[Sequence[dict]] = None) -> dict:
       charged; the verdict names the shortfall).
     * ``goodput_floor`` — generic dip: names the cause class with the
       largest lost share in the cluster ledger.
+    * ``region_stale`` — a federated region's digest stream went dark (a
+      correlated preemption wave / region loss): the verdict names the
+      dead REGION (``region`` field) rather than a single group; the
+      charge is the survivors' dead window while the global quorum
+      reforms.
     """
     data = load_bundle(bundle)
     manifest = data["manifest"]
@@ -367,6 +372,27 @@ def verdict(bundle: str, events: Optional[Sequence[dict]] = None) -> dict:
                     out["charged_fraction"] = round(
                         dw["dead_time_s"] / total, 4
                     )
+        if out["lost_s"] is None:
+            out["lost_s"] = round(lost["heal"] + lost["quorum_server"]
+                                  + lost["quorum_transport"], 3)
+    elif reason == "region_stale":
+        # Federated root declared a whole region dead: its child stopped
+        # pushing digests for a full heartbeat timeout — the signature of
+        # a correlated preemption wave (every group in the region dies at
+        # once, so no single replica_stale names the blast radius).
+        out["kind"] = "region_loss"
+        out["region"] = incident.get("replica_id", "")
+        out["replica"] = out["region"]
+        out["cause"] = "dead_window"
+        out["digest_age_ms"] = incident.get("detail")
+        if events:
+            from torchft_tpu.obs import report
+
+            commits = report.commit_timelines(events)
+            faults = report.fault_times(events)
+            dw = report.deadwindow(commits, faults)
+            if dw["dead_time_s"] is not None:
+                out["lost_s"] = round(dw["dead_time_s"], 3)
         if out["lost_s"] is None:
             out["lost_s"] = round(lost["heal"] + lost["quorum_server"]
                                   + lost["quorum_transport"], 3)

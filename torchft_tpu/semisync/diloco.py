@@ -20,7 +20,11 @@ arXiv:2311.08105; Streaming DiLoCo, arXiv:2501.18512):
     applied ONLY after the round's commit vote passes, so a failed sync
     never corrupts the model, the backup, or the outer state — and the
     backup + outer states travel with heals through the same
-    ``register_state_dict_fn`` channel the blocking port used.
+    ``register_state_dict_fn`` channel the blocking port used;
+  - with a ``set_fragment_params`` hook, a committed fragment's outer
+    step lands on device the moment it is computed (device transfer
+    overlapping the next fragment's outer math) instead of the whole
+    tree re-landing at the round boundary.
 
 ``torchft_tpu.local_sgd.DiLoCo`` remains as a thin wrapper (stream=False,
 codec="auto"): the old API and blocking semantics, now running on this
@@ -108,6 +112,9 @@ class StreamingDiLoCo:
         stream: Optional[bool] = None,
         outer_scope: str = "fragment",
         state_dict_key: str = "diloco",
+        set_fragment_params: Optional[
+            Callable[[List[int], List[np.ndarray]], None]
+        ] = None,
     ) -> None:
         """``outer_scope``: "fragment" (default) keeps one optax state per
         fragment and applies the outer update fragment-locally — the
@@ -116,7 +123,18 @@ class StreamingDiLoCo:
         pseudogradient tree at the round boundary — the blocking port's
         exact semantics (and its state-dict format), which outer
         transforms with CROSS-LEAF coupling (global-norm clipping) depend
-        on; the legacy ``DiLoCo`` wrapper uses this."""
+        on; the legacy ``DiLoCo`` wrapper uses this.
+
+        ``set_fragment_params``: optional partial write-back hook,
+        ``(leaf_indices, new_leaves) -> None``, landing ONE fragment's
+        leaves on device.  When provided (fragment scope only), a
+        committed round writes each fragment back the moment its outer
+        step is computed — device transfer of fragment ``k`` overlaps the
+        outer math of fragment ``k+1``, and the round-boundary whole-tree
+        ``set_params`` reset is skipped entirely (it would re-land every
+        byte a second time).  Aborted rounds still reset through the
+        whole-tree ``set_params`` — inner steps moved ALL leaves, and the
+        backup they roll back to predates this round's fragments."""
         if manager._use_async_quorum:
             raise ValueError(
                 "StreamingDiLoCo requires synchronous quorum: construct the "
@@ -174,6 +192,12 @@ class StreamingDiLoCo:
                 f"outer_scope must be 'fragment' or 'tree', got {outer_scope!r}"
             )
         self._outer_scope = outer_scope
+        if set_fragment_params is not None and outer_scope != "fragment":
+            raise ValueError(
+                "set_fragment_params requires outer_scope='fragment' — a "
+                "whole-tree outer update has no per-fragment commit moment"
+            )
+        self._set_fragment_params = set_fragment_params
         if outer_scope == "fragment":
             self._outer_states: Any = [
                 outer_tx.init([self._leaves[i] for i in f.bucket.indices])
@@ -435,22 +459,27 @@ class StreamingDiLoCo:
         self._voted = True
         committed = bool(self._manager.should_commit())
         self._vote_passed = committed
-        if committed:
-            self._apply(results)
+        applied_inplace = self._apply(results) if committed else False
         self._engine.end_round(committed=committed)
         self._round_closed = True
         self._emit_round(stats, committed, round_step)
         # Commit or not, the live params reset to the (possibly updated)
-        # last-committed weights — the blocking port's contract.
-        self._set_params(self.backup_params)
+        # last-committed weights — the blocking port's contract.  When the
+        # per-fragment write-back already landed every leaf as its outer
+        # step committed, the whole-tree reset would only re-send the same
+        # bytes; skip it.
+        if not applied_inplace:
+            self._set_params(self.backup_params)
 
-    def _apply(self, results: Dict[int, np.ndarray]) -> None:
+    def _apply(self, results: Dict[int, np.ndarray]) -> bool:
         """Outer optimizer step on the averaged pseudogradients —
         per-fragment or whole-tree per ``outer_scope``.  Deterministic
         given identical inputs, and the ring guarantees bitwise-identical
         averages on every group — so all groups land bitwise-identical
         backups (the replica-consistency property the integration tests
-        pin)."""
+        pin).  Returns True when the per-fragment write-back hook landed
+        EVERY leaf on device already (the caller then skips the
+        whole-tree reset)."""
         import optax
 
         if self._outer_scope == "tree":
@@ -476,10 +505,21 @@ class StreamingDiLoCo:
                 np.asarray(l) for l in self._jax.tree.flatten(new_tree)[0]
             ]
             self._refresh_codec_backups()
-            return
+            return False
+        write_back = self._set_fragment_params
         for k, frag in enumerate(self._plan.fragments):
             flat = results.get(frag.index)
             if flat is None:
+                # No averaged pseudogradient for this fragment: its backup
+                # stands, but its LIVE leaves moved through sync_every
+                # inner steps — the per-fragment path must still roll them
+                # back, or skipping the whole-tree reset would leave this
+                # fragment's device leaves uncommitted.
+                if write_back is not None:
+                    write_back(
+                        list(frag.bucket.indices),
+                        [self._leaves[i] for i in frag.bucket.indices],
+                    )
                 continue
             pg_leaves = [
                 np.ascontiguousarray(arr) for _i, arr in frag.unpack(flat)
@@ -491,7 +531,17 @@ class StreamingDiLoCo:
             new_leaves = optax.apply_updates(backup_leaves, updates)
             for i, nl in zip(frag.bucket.indices, new_leaves):
                 self._leaves[i] = np.asarray(nl)
+            if write_back is not None:
+                # Land this fragment the moment its outer step committed:
+                # the device transfer overlaps fragment k+1's outer math
+                # instead of queueing behind the whole tree at the round
+                # boundary.
+                write_back(
+                    list(frag.bucket.indices),
+                    [self._leaves[i] for i in frag.bucket.indices],
+                )
         self._refresh_codec_backups()
+        return write_back is not None
 
     def _note_summary(self, stats: Dict[str, int]) -> None:
         """Round accounting into the step in flight's step_summary — must
@@ -538,6 +588,9 @@ class StreamingDiLoCo:
                 d2h_bytes=stats["d2h_bytes"],
                 codec=self._codec_name,
                 streamed=self._stream,
+                writeback=(
+                    "fragment" if self._set_fragment_params is not None else "tree"
+                ),
                 residual_l2=round(residual_l2, 6),
             )
         except Exception:  # noqa: BLE001 — mocked managers / telemetry only
