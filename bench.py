@@ -1852,6 +1852,190 @@ def straggler_benchmark(trials: int | None = None) -> dict:
     }
 
 
+def slo_benchmark() -> dict:
+    """SLO engine + culprit attribution + IncidentWatcher arc
+    (``--scenario slo``): two live control-plane cells on the native
+    lighthouse, one degraded and one healthy control.
+
+    Degraded cell: replica groups report healthy goodput ledgers over the
+    warmup, then the victim turns stall-heavy mid-run (the straggler's
+    ledger signature).  Asserted, per the acceptance criteria:
+
+    - a ``goodput_floor`` incident fires whose attribution names the
+      VICTIM replica (``culprit_replica``) with a dominant cause and
+      positive ``charged_seconds`` — not "cluster";
+    - an ``slo_burn`` alert is raised on ``/alerts.json`` carrying the
+      same attribution;
+    - the IncidentWatcher journals the recommended policy EXACTLY once
+      (the flap guard folds the floor trigger and the burn alert into a
+      single debounced recommendation).
+
+    Control cell: the same schedule with every replica healthy — zero
+    SLO alerts, zero goodput_floor incidents, empty watcher journal.
+
+    The ledgers are pumped through ``ManagerServer.set_ledger`` (real
+    heartbeats, real windowing, real attribution — only the train loop
+    is synthetic), so the cell runs in seconds instead of warming up
+    5 s windows at real step pace."""
+    from torchft_tpu._native import LighthouseServer, ManagerServer
+    from torchft_tpu.obs.ledger import LOST_CAUSES
+    from torchft_tpu.obs.watcher import IncidentWatcher
+
+    prior = {
+        k: os.environ.get(k)
+        for k in (
+            "TPUFT_SLO_TARGET", "TPUFT_SLO_FAST_S", "TPUFT_SLO_SLOW_S",
+            "TPUFT_GOODPUT_WARMUP_OBS", "TPUFT_WATCHER_POLL_S",
+            "TPUFT_WATCHER_DEBOUNCE_S",
+        )
+    }
+    os.environ["TPUFT_SLO_TARGET"] = "0.92"
+    os.environ["TPUFT_SLO_FAST_S"] = "10"
+    os.environ["TPUFT_SLO_SLOW_S"] = "20"
+    os.environ["TPUFT_GOODPUT_WARMUP_OBS"] = "2"
+    out_root = os.environ.get("TPUFT_BENCH_WORKDIR") or tempfile.mkdtemp(
+        prefix="tpuft_bench_slo_"
+    )
+    stall_i = LOST_CAUSES.index("stall")
+
+    def run_cell(name: str, degrade: bool) -> dict:
+        workdir = os.path.join(out_root, name)
+        os.makedirs(workdir, exist_ok=True)
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=200,
+            quorum_tick_ms=20, heartbeat_timeout_ms=5000,
+            http_bind="127.0.0.1:0",
+        )
+        groups = ("0", "1", "2")
+        victim = groups[-1]
+        mgrs = {
+            g: ManagerServer(
+                replica_id=f"{g}:slo", lighthouse_addr=lh.address(),
+                bind="127.0.0.1:0", world_size=1, heartbeat_interval_ms=25,
+            )
+            for g in groups
+        }
+        watcher = IncidentWatcher(
+            [lh.http_address()], workdir,
+            poll_interval_s=0.05, debounce_s=60.0,
+        )
+        comp = {g: 0.0 for g in groups}
+        stall = {g: 0.0 for g in groups}
+
+        def pump(g: str, d_comp: float, d_stall: float) -> None:
+            comp[g] += d_comp
+            stall[g] += d_stall
+            lost = [0.0] * len(LOST_CAUSES)
+            lost[stall_i] = stall[g]
+            tot = comp[g] + stall[g]
+            mgrs[g].set_ledger(comp[g] / tot if tot else -1.0, comp[g], lost)
+
+        try:
+            # Healthy phase: everyone at ~97% goodput for several windows.
+            for _ in range(8):
+                for g in groups:
+                    pump(g, 2.91, 0.09)
+                watcher.poll_once(force=True)
+                time.sleep(0.08)
+            # Degraded phase: the victim's ledger turns stall-heavy.
+            for _ in range(14):
+                for g in groups:
+                    if degrade and g == victim:
+                        pump(g, 1.0, 9.0)
+                    else:
+                        pump(g, 2.91, 0.09)
+                watcher.poll_once(force=True)
+                time.sleep(0.08)
+            time.sleep(0.3)
+            watcher.poll_once(force=True)
+            alerts = _fetch_json(lh.http_address(), "/alerts.json") or {}
+            incidents = _fetch_json(lh.http_address(), "/incident.json") or {}
+            slo = _fetch_json(lh.http_address(), "/slo.json") or {}
+        finally:
+            for m in mgrs.values():
+                m.shutdown()
+            lh.shutdown()
+        journal_path = os.path.join(workdir, "watcher_journal.jsonl")
+        journal = []
+        if os.path.exists(journal_path):
+            with open(journal_path, "r", encoding="utf-8") as f:
+                journal = [json.loads(ln) for ln in f if ln.strip()]
+        burn = [a for a in alerts.get("alerts", []) if a.get("kind") == "slo_burn"]
+        floors = [
+            r for r in incidents.get("incidents", [])
+            if r.get("reason") == "goodput_floor"
+        ]
+        return {
+            "victim": f"{victim}:slo",
+            "slo": {k: slo.get(k) for k in (
+                "burn_rate_fast", "burn_rate_slow", "error_budget_remaining",
+                "alert_active",
+            )},
+            "slo_burn_alerts": burn,
+            "goodput_floor_incidents": floors,
+            "journal": journal,
+            "workdir": workdir,
+        }
+
+    try:
+        degraded = run_cell("degraded", degrade=True)
+        control = run_cell("control", degrade=False)
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    victim = degraded["victim"]
+    floors = degraded["goodput_floor_incidents"]
+    burns = degraded["slo_burn_alerts"]
+    journal = degraded["journal"]
+    # Acceptance criteria (ISSUE 17): hard asserts, not soft reporting.
+    assert floors, "degraded cell recorded no goodput_floor incident"
+    named = [r for r in floors if r.get("culprit_replica") == victim]
+    assert named, (
+        f"goodput_floor verdicts named {[r.get('culprit_replica') for r in floors]},"
+        f" not the victim {victim}"
+    )
+    assert named[0].get("dominant_cause") == "stall", named[0]
+    assert float(named[0].get("charged_seconds") or 0.0) > 0.0, named[0]
+    assert burns, "degraded cell raised no slo_burn alert"
+    assert burns[-1].get("replica_id") == victim, burns[-1]
+    assert len(journal) == 1, (
+        f"watcher journal must hold exactly one flap-guarded entry, got "
+        f"{len(journal)}: {journal}"
+    )
+    assert journal[0]["policy"] == "drain" and journal[0]["acted"] is False
+    assert journal[0]["target"] == victim.split(":", 1)[0]
+    assert not control["slo_burn_alerts"], control["slo_burn_alerts"]
+    assert not control["goodput_floor_incidents"], (
+        control["goodput_floor_incidents"]
+    )
+    assert not control["journal"], control["journal"]
+    return {
+        "ok": True,
+        "workdir": out_root,
+        "victim": victim,
+        "dominant_cause": named[0].get("dominant_cause"),
+        "charged_seconds": named[0].get("charged_seconds"),
+        "burn_rate_fast": degraded["slo"].get("burn_rate_fast"),
+        "burn_rate_slow": degraded["slo"].get("burn_rate_slow"),
+        "error_budget_remaining": degraded["slo"].get("error_budget_remaining"),
+        "journal_entries": len(journal),
+        "journal_policy": journal[0]["policy"],
+        "control_clean": True,
+        "degraded": degraded,
+        "control": control,
+    }
+
+
+def _fetch_json(address: str, path: str):
+    from torchft_tpu.obs.incident import fetch_json
+
+    return fetch_json(address, path)
+
+
 def lighthouse_failover_benchmark() -> dict:
     """HA lighthouse failover scenario (``--scenario lighthouse-failover``):
     N lighthouse replicas behind the lease election, G Manager worker
@@ -2021,6 +2205,7 @@ def selftest() -> None:
     inspect.signature(drain_benchmark).bind()
     inspect.signature(kill_scenario_benchmark).bind()
     inspect.signature(straggler_benchmark).bind()
+    inspect.signature(slo_benchmark).bind()
     inspect.signature(lighthouse_failover_benchmark).bind()
     inspect.signature(scale_benchmark).bind()
     inspect.signature(diloco_benchmark).bind()
@@ -2041,8 +2226,8 @@ if __name__ == "__main__":
     elif "--scenario" in sys.argv:
         which = sys.argv[sys.argv.index("--scenario") + 1:]
         if not which or which[0] not in (
-            "drain", "kill", "straggler", "lighthouse-failover", "scale",
-            "diloco",
+            "drain", "kill", "straggler", "slo", "lighthouse-failover",
+            "scale", "diloco",
         ):
             print(f"unknown --scenario {which[:1] or '(missing)'}", file=sys.stderr)
             sys.exit(2)
@@ -2085,6 +2270,18 @@ if __name__ == "__main__":
                         "value": ha.get("takeover_s"),
                         "unit": "seconds_to_takeover",
                         "detail": ha,
+                    }
+                )
+            )
+        elif which[0] == "slo":
+            slo = slo_benchmark()
+            print(
+                json.dumps(
+                    {
+                        "metric": "slo_attribution",
+                        "value": slo["charged_seconds"],
+                        "unit": "charged_seconds_on_named_culprit",
+                        "detail": slo,
                     }
                 )
             )

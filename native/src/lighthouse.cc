@@ -974,6 +974,24 @@ bool Lighthouse::Start(std::string* err) {
     long long v = std::atoll(w);
     if (v >= 0) goodput_warmup_ = v;
   }
+  // SLO engine knobs (same malformed-value discipline).  The engine is
+  // OFF unless TPUFT_SLO_TARGET parses to a ratio in (0, 1).
+  if (const char* t = std::getenv("TPUFT_SLO_TARGET")) {
+    char* end = nullptr;
+    double v = std::strtod(t, &end);
+    if (end != t && v > 0.0 && v < 1.0) slo_target_ = v;
+  }
+  if (const char* f = std::getenv("TPUFT_SLO_FAST_S")) {
+    char* end = nullptr;
+    double v = std::strtod(f, &end);
+    if (end != f && v > 0.0) slo_fast_s_ = v;
+  }
+  if (const char* s = std::getenv("TPUFT_SLO_SLOW_S")) {
+    char* end = nullptr;
+    double v = std::strtod(s, &end);
+    if (end != s && v > 0.0) slo_slow_s_ = v;
+  }
+  if (slo_slow_s_ < slo_fast_s_) slo_slow_s_ = slo_fast_s_;
   server_ = std::make_unique<RpcServer>(
       opt_.bind, [this](uint16_t method, const std::string& req, Deadline dl,
                         const std::string& peer, std::string* resp) {
@@ -1097,6 +1115,13 @@ bool Lighthouse::Start(std::string* err) {
             // evidence when a new record appears.
             r.content_type = "application/json";
             r.body = IncidentJson();
+          } else if (method == "GET" && path == "/slo.json") {
+            // SLO engine snapshot (read-only, ungated): target, burn
+            // rates, error budget and the newest culprit attribution.
+            // Served at every tier — a root answers over its digest
+            // rollups (docs/observability.md "SLO engine").
+            r.content_type = "application/json";
+            r.body = SloJson();
           } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
                      path.size() > 14 && path.substr(path.size() - 5) == "/kill") {
             std::string replica_id = path.substr(9, path.size() - 9 - 5);
@@ -1118,7 +1143,17 @@ bool Lighthouse::Start(std::string* err) {
           } else if (method == "POST" && path.rfind("/replica/", 0) == 0 &&
                      path.size() > 15 && path.substr(path.size() - 6) == "/drain") {
             std::string prefix = path.substr(9, path.size() - 9 - 6);
-            int n = DrainReplica(prefix, 0);
+            // ?deadline_ms=N announces the grace period: the drain mark
+            // outlives staleness pruning until the deadline passes, and
+            // the "is draining" quorum rejection carries the remainder so
+            // rejoining managers pace their auto-drain to it.
+            int64_t deadline_ms = 0;
+            if (auto dpos = query.find("deadline_ms=");
+                dpos != std::string::npos) {
+              long long v = atoll(query.c_str() + dpos + 12);
+              if (v > 0) deadline_ms = v;
+            }
+            int n = DrainReplica(prefix, deadline_ms);
             r.body = "draining " + std::to_string(n) + " id(s) for " + prefix;
             r.content_type = "text/plain";
           } else {
@@ -1470,13 +1505,18 @@ void Lighthouse::ObserveGoodputLocked() {
   double d_total = d_compute + d_lost;
   if (d_total <= 0.0) return;  // no new accounted wall in this window
   double windowed = d_compute / d_total;
+  last_windowed_goodput_ = windowed;
+  // Score the closed window BEFORE the dip check so a firing trigger
+  // carries the attribution of the very window that dipped, and the SLO
+  // engine's burn rates move on the same cadence as the floor trigger.
+  AttributeWindowLocked();
+  EvaluateSloLocked(d_compute, d_lost);
   if (goodput_obs_ >= goodput_warmup_ && goodput_ewma_ >= 0.0 &&
       windowed < goodput_ewma_ * goodput_dip_ratio_) {
-    // Cluster-scope trigger: the windowed rollup has no per-replica delta
-    // tracking (deliberately — see CHANGES "remaining depth"), so the
-    // capture driver's verdict localizes from the bundled flight + alert
-    // + per-replica ledger evidence instead.
-    RecordIncidentLocked("goodput_floor", "cluster", windowed);
+    // replica_id stays "cluster" (schema + debounce-key stability); the
+    // culprit attribution of the dipped window rides the record's
+    // culprit_* fields for the capture driver's verdict to name.
+    RecordIncidentLocked("goodput_floor", "cluster", windowed, &last_attr_);
   }
   goodput_ewma_ = goodput_ewma_ < 0.0
                       ? windowed
@@ -1484,9 +1524,193 @@ void Lighthouse::ObserveGoodputLocked() {
   ++goodput_obs_;
 }
 
+void Lighthouse::AttributeWindowLocked() {
+  // Per-entity window delta vs the entity's OWN trailing baseline: a
+  // replica that always spends 10% on wire is not news; one whose stall
+  // seconds jumped 5x over its baseline in this window is.  Entities are
+  // live replica incarnations (flat / child tier) and regions (root tier
+  // over digest rollups) — the same scoring either way, so the verdict
+  // names whichever granularity this instance can see.
+  constexpr double kBaseAlpha = 0.2;  // baseline EWMA weight per window
+  // Noise floor: a window must charge at least this many excess seconds
+  // before anyone is blamed (float dust and scheduler jitter otherwise
+  // elect a "culprit" in perfectly healthy windows).
+  double best_excess = 1e-3;
+  std::string best_id, best_cause;
+  bool best_is_region = false;
+  std::ostringstream deltas;
+  deltas << "{";
+  bool first = true;
+  auto score = [&](const std::string& id, WindowDelta& w, double compute_s,
+                   const double lost_s[kLedgerCauseCount], bool is_region) {
+    double d_compute = compute_s - w.prev_compute;
+    double d_lost[kLedgerCauseCount];
+    double d_lost_total = 0.0;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+      d_lost[i] = lost_s[i] - w.prev_lost[i];
+      if (d_lost[i] < 0.0) d_lost[i] = 0.0;  // re-ingest undo can wobble
+      d_lost_total += d_lost[i];
+    }
+    if (w.primed) {
+      double excess = 0.0;
+      double worst_excess = 0.0;
+      size_t worst = kLedgerCauseCount;
+      for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+        double e = d_lost[i] - w.base_lost[i];
+        if (e > 0.0) excess += e;
+        if (e > worst_excess) {
+          worst_excess = e;
+          worst = i;
+        }
+      }
+      if (excess > best_excess && worst != kLedgerCauseCount) {
+        best_excess = excess;
+        best_id = id;
+        best_cause = kLedgerCauses[worst];
+        best_is_region = is_region;
+      }
+      // Idle entities (no accounted wall this window) stay out of the
+      // delta map — an O(N) roster of zeros helps nobody.
+      if (!is_region && d_compute + d_lost_total > 0.0) {
+        if (!first) deltas << ",";
+        first = false;
+        deltas << "\"" << JsonEscape(id) << "\":{\"compute_s\":" << d_compute
+               << ",\"lost_s\":" << d_lost_total
+               << ",\"excess_s\":" << (excess > 0.0 ? excess : 0.0) << "}";
+      }
+    }
+    // Baseline learns AFTER scoring: the culprit window must not teach
+    // the baseline its own anomaly before being judged against it.
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) {
+      w.base_lost[i] = w.primed
+                           ? kBaseAlpha * d_lost[i] + (1.0 - kBaseAlpha) * w.base_lost[i]
+                           : d_lost[i];
+    }
+    w.prev_compute = compute_s;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) w.prev_lost[i] = lost_s[i];
+    w.primed = true;
+  };
+  for (const auto& [id, rl] : ledger_) {
+    score(id, win_replicas_[id], rl.compute_s, rl.lost_s, false);
+  }
+  for (const auto& [name, e] : regions_) {
+    score(name, win_regions_[name], e.compute_s, e.lost_s, true);
+  }
+  // Prune delta state for departed incarnations (banked + pruned from
+  // ledger_); regions_ entries live forever, so win_regions_ follows.
+  for (auto it = win_replicas_.begin(); it != win_replicas_.end();) {
+    if (!ledger_.count(it->first)) {
+      it = win_replicas_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  deltas << "}";
+  if (best_id.empty()) {
+    // Quiet window: keep the previous attribution (the alert-refresh path
+    // reads it) but record that this window blamed nobody new.
+    return;
+  }
+  last_attr_.replica = best_id;
+  last_attr_.cause = best_cause;
+  last_attr_.charged_s = best_excess;
+  last_attr_.delta_json = deltas.str();
+  if (best_is_region) {
+    last_attr_.region = best_id;
+  } else {
+    auto ro = region_of_.find(best_id);
+    last_attr_.region =
+        ro != region_of_.end() ? ro->second : (fed_child_ ? fed_region_ : "");
+  }
+}
+
+void Lighthouse::EvaluateSloLocked(double d_compute, double d_lost) {
+  if (slo_target_ <= 0.0) return;  // engine off (TPUFT_SLO_TARGET unset)
+  slo_windows_.push_back({d_compute, d_lost});
+  // Prune to the slow horizon of ACCOUNTED seconds (windows are sized in
+  // accounted wall, so the deque's depth is bounded by slow_s / 5 s).
+  double total = 0.0;
+  for (const auto& w : slo_windows_) total += w.compute_s + w.lost_s;
+  while (slo_windows_.size() > 1) {
+    double head = slo_windows_.front().compute_s + slo_windows_.front().lost_s;
+    if (total - head < slo_slow_s_) break;
+    total -= head;
+    slo_windows_.pop_front();
+  }
+  // Burn rate over a horizon: lost fraction of the most recent windows
+  // covering `horizon_s` accounted seconds, divided by the error budget
+  // (1 - target).  burn == 1.0 consumes the budget exactly at the
+  // sustainable rate; > 1.0 is on track to violate the SLO.
+  double budget = 1.0 - slo_target_;
+  auto burn = [&](double horizon_s) {
+    double acc = 0.0, lost = 0.0;
+    for (auto it = slo_windows_.rbegin(); it != slo_windows_.rend(); ++it) {
+      acc += it->compute_s + it->lost_s;
+      lost += it->lost_s;
+      if (acc >= horizon_s) break;
+    }
+    if (acc <= 0.0) return 0.0;
+    return (lost / acc) / budget;
+  };
+  slo_burn_fast_ = burn(slo_fast_s_);
+  slo_burn_slow_ = burn(slo_slow_s_);
+  // Multi-window discipline: raise only when the fast AND slow windows
+  // both burn hot (a transient blip fails the slow window; a long slow
+  // bleed fails the fast one once it is bad enough to page on), resolve
+  // when the fast window cools.
+  AlertRecord* active = nullptr;
+  for (auto& a : alerts_) {
+    if (a.kind == "slo_burn" && a.resolved_ms == 0) {
+      active = &a;
+      break;
+    }
+  }
+  bool hot = slo_burn_fast_ > 1.0 && slo_burn_slow_ > 1.0;
+  if (hot && active == nullptr) {
+    AlertRecord a;
+    a.kind = "slo_burn";
+    a.replica_id = last_attr_.replica.empty() ? "cluster" : last_attr_.replica;
+    a.raised_ms = NowEpochMs();
+    a.ratio = slo_burn_fast_;
+    a.burn_fast = slo_burn_fast_;
+    a.burn_slow = slo_burn_slow_;
+    a.dominant_cause = last_attr_.cause;
+    a.charged_seconds = last_attr_.charged_s;
+    LOGW("lighthouse: slo_burn alert raised (burn fast=%.2f slow=%.2f "
+         "target=%.3f culprit=%s cause=%s)",
+         slo_burn_fast_, slo_burn_slow_, slo_target_,
+         a.replica_id.c_str(),
+         a.dominant_cause.empty() ? "-" : a.dominant_cause.c_str());
+    PushAlertLocked(std::move(a));
+  } else if (active != nullptr) {
+    if (slo_burn_fast_ < 1.0) {
+      active->resolved_ms = NowEpochMs();
+      LOGI("lighthouse: slo_burn alert resolved (burn fast=%.2f slow=%.2f)",
+           slo_burn_fast_, slo_burn_slow_);
+    } else {
+      // Keep the burn rates current so /alerts.json pages with live
+      // numbers, but the attribution stays the raise-time verdict: the
+      // trailing baseline LEARNS a sustained degradation within a few
+      // windows, after which the true victim's "excess" decays and a
+      // refreshed culprit would rotate onto whichever healthy replica
+      // wobbled last.  A bigger charge may still re-point the blame.
+      active->ratio = slo_burn_fast_;
+      active->burn_fast = slo_burn_fast_;
+      active->burn_slow = slo_burn_slow_;
+      if (!last_attr_.replica.empty() &&
+          last_attr_.charged_s > active->charged_seconds) {
+        active->replica_id = last_attr_.replica;
+        active->dominant_cause = last_attr_.cause;
+        active->charged_seconds = last_attr_.charged_s;
+      }
+    }
+  }
+}
+
 void Lighthouse::RecordIncidentLocked(const std::string& reason,
                                       const std::string& replica_id,
-                                      double detail) {
+                                      double detail,
+                                      const IncidentAttribution* attr) {
   // Debounce per (reason, replica): a flapping trigger must not flood the
   // feed — the capture driver bundles the FIRST record of an episode.
   const int64_t kDebounceMs = 10000;
@@ -1502,12 +1726,21 @@ void Lighthouse::RecordIncidentLocked(const std::string& reason,
   for (const auto& [id, step] : hb_step_) rec.step = std::max(rec.step, step);
   rec.ts_ms = now_ms;
   rec.detail = detail;
+  if (attr != nullptr && !attr->replica.empty()) {
+    rec.culprit_replica = attr->replica;
+    rec.culprit_region = attr->region;
+    rec.dominant_cause = attr->cause;
+    rec.charged_seconds = attr->charged_s;
+    rec.delta_by_replica_json = attr->delta_json;
+  }
   char dbuf[32];
   snprintf(dbuf, sizeof(dbuf), "%.4f", detail);
-  flight_.RecordEvent(kFlightIncident,
-                      "reason=" + reason + " replica=" + replica_id +
-                          " step=" + std::to_string(rec.step) +
-                          " detail=" + dbuf);
+  std::string msg = "reason=" + reason + " replica=" + replica_id +
+                    " step=" + std::to_string(rec.step) + " detail=" + dbuf;
+  if (!rec.culprit_replica.empty()) {
+    msg += " culprit=" + rec.culprit_replica + " cause=" + rec.dominant_cause;
+  }
+  flight_.RecordEvent(kFlightIncident, msg);
   LOGW("lighthouse: incident %lld recorded (reason=%s replica=%s step=%lld) "
        "— capture drivers polling /incident.json will bundle the evidence",
        static_cast<long long>(rec.id), reason.c_str(), replica_id.c_str(),
@@ -1807,7 +2040,8 @@ void Lighthouse::PushAlertLocked(AlertRecord a) {
   // exactly the degradations whose evidence the auto-capture bundles
   // (straggler, slow_link, ec_coverage alike).
   RecordIncidentLocked("alert:" + a.kind, a.replica_id,
-                       a.ratio > 0.0 ? a.ratio : a.gbps);
+                       a.ratio > 0.0 ? a.ratio : a.gbps,
+                       a.kind == "slo_burn" ? &last_attr_ : nullptr);
   alerts_.push_back(std::move(a));
   // Bounded history: drop the oldest RESOLVED record first; active alerts
   // are never evicted (there can be at most one per live replica id, plus
@@ -1964,8 +2198,14 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
     // a GREP CONTRACT with the Python Manager (_async_quorum converts this
     // abort into a cooperative drain exit; pinned by
     // tests/test_straggler.py) — keep both message sites in sync if
-    // rewording.
+    // rewording.  A "(deadline_ms=N)" suffix carries the announced grace
+    // remainder so the manager paces its auto-drain to the real deadline
+    // instead of a hardcoded default.
     *err = "replica " + id + " is draining; rejoin as a new incarnation";
+    if (auto dl = drain_deadline_ms_.find(id); dl != drain_deadline_ms_.end()) {
+      int64_t remain = dl->second - NowEpochMs();
+      if (remain > 0) *err += " (deadline_ms=" + std::to_string(remain) + ")";
+    }
     return Status::kAborted;
   }
   // First contact from this incarnation (no heartbeat on file): the join
@@ -2018,6 +2258,11 @@ Status Lighthouse::HandleQuorum(const LighthouseQuorumRequest& req, Deadline dea
       // waiting for will exclude it forever — unblock the caller so the
       // departing process can proceed to its drain exit.
       *err = "replica " + id + " is draining; rejoin as a new incarnation";
+      if (auto dl = drain_deadline_ms_.find(id);
+          dl != drain_deadline_ms_.end()) {
+        int64_t remain = dl->second - NowEpochMs();
+        if (remain > 0) *err += " (deadline_ms=" + std::to_string(remain) + ")";
+      }
       return Status::kAborted;
     }
     if (latest_quorum_ && quorum_gen_ > start_gen) {
@@ -2662,6 +2907,11 @@ std::string Lighthouse::MetricsText() {
     std::vector<std::pair<std::string, double>> goodput_ratio;
     double goodput_ewma = -1.0;
     int64_t incidents = 0;
+    // SLO engine (docs/observability.md "SLO engine").
+    double slo_target = 0.0;
+    double slo_burn_fast = 0.0, slo_burn_slow = 0.0;
+    double slo_budget_remaining = 1.0;
+    double fleet_goodput = -1.0;
     // Federation (docs/wire.md "Federation").
     int fed_role = 0;  // 0 flat, 1 regional child, 2 root
     int64_t fed_digests = 0, fed_rejected = 0;
@@ -2778,6 +3028,28 @@ std::string Lighthouse::MetricsText() {
     }
     s.goodput_ewma = goodput_ewma_;
     s.incidents = incident_seq_;
+    // SLO engine: target + live burn rates + cumulative budget remainder.
+    s.slo_target = slo_target_;
+    s.slo_burn_fast = slo_burn_fast_;
+    s.slo_burn_slow = slo_burn_slow_;
+    if (slo_target_ > 0.0) {
+      double lt = 0.0;
+      for (size_t i = 0; i < kLedgerCauseCount; ++i) lt += s.ledger_lost[i];
+      double acc = s.ledger_compute + lt;
+      if (acc > 0.0) {
+        s.slo_budget_remaining = 1.0 - (lt / acc) / (1.0 - slo_target_);
+      }
+    }
+    // Fleet goodput: digest-fed region rollups only (the root's O(R)
+    // fleet view; -1 on flat/child instances with no regions).
+    {
+      double fc = 0.0, fl = 0.0;
+      for (const auto& [name, e] : regions_) {
+        fc += e.compute_s;
+        for (size_t i = 0; i < kLedgerCauseCount; ++i) fl += e.lost_s[i];
+      }
+      if (fc + fl > 0.0) s.fleet_goodput = fc / (fc + fl);
+    }
     // Federation: a root is whoever has accepted digests; a child counts
     // its own accepted pushes (roots keep fed_pushes_ok_ at 0, children
     // keep regions_ empty, so the sum below is whichever applies).
@@ -2981,6 +3253,25 @@ std::string Lighthouse::MetricsText() {
     o << "tpuft_incidents_total " << s.incidents << "\n";
   }
 
+  // SLO engine (docs/observability.md "SLO engine"): goodput SLO target +
+  // multi-window burn rates.  Families are always declared; target reads 0
+  // and burns read 0 while TPUFT_SLO_TARGET is unset, so dashboards need
+  // no conditional queries.
+  gauge("tpuft_slo_target",
+        "configured goodput SLO target (TPUFT_SLO_TARGET; 0 = engine off)");
+  o << "tpuft_slo_target " << s.slo_target << "\n";
+  gauge("tpuft_slo_burn_rate_fast",
+        "error-budget burn rate over the fast window (1.0 = burning exactly "
+        "at the sustainable rate)");
+  o << "tpuft_slo_burn_rate_fast " << s.slo_burn_fast << "\n";
+  gauge("tpuft_slo_burn_rate_slow",
+        "error-budget burn rate over the slow window");
+  o << "tpuft_slo_burn_rate_slow " << s.slo_burn_slow << "\n";
+  gauge("tpuft_slo_error_budget_remaining",
+        "cumulative error budget remaining (1 = untouched, 0 = consumed, "
+        "negative = SLO violated; 1 while the engine is off)");
+  o << "tpuft_slo_error_budget_remaining " << s.slo_budget_remaining << "\n";
+
   // Federation (docs/wire.md "Federation"): per-instance role + push
   // counters, plus the root's per-region rollup (one series set per region
   // — region count is O(10), so the scrape stays bounded by REGION SIZE,
@@ -2998,6 +3289,10 @@ std::string Lighthouse::MetricsText() {
   o << "tpuft_federation_digests_rejected_total " << s.fed_rejected << "\n";
   gauge("tpuft_regions", "regions known to this root (ever pushed a digest)");
   o << "tpuft_regions " << s.regions.size() << "\n";
+  gauge("tpuft_fleet_goodput_ratio",
+        "fleet productive fraction over every region's digest-fed ledger "
+        "rollup (root tier; -1 when no region has pushed)");
+  o << "tpuft_fleet_goodput_ratio " << s.fleet_goodput << "\n";
   gauge("tpuft_region_replicas",
         "replicas reported by the region's last digest");
   for (const auto& r : s.regions) {
@@ -3120,7 +3415,11 @@ std::string Lighthouse::AlertsJson() {
       << ",\"threshold\":" << a.threshold
       << ",\"gbps\":" << a.gbps
       << ",\"src_replica_id\":\"" << JsonEscape(a.src_replica_id)
-      << "\",\"active\":" << (a.resolved_ms == 0 ? "true" : "false") << "}";
+      << "\",\"burn_fast\":" << a.burn_fast
+      << ",\"burn_slow\":" << a.burn_slow
+      << ",\"dominant_cause\":\"" << JsonEscape(a.dominant_cause)
+      << "\",\"charged_seconds\":" << a.charged_seconds
+      << ",\"active\":" << (a.resolved_ms == 0 ? "true" : "false") << "}";
   }
   o << "]}";
   return o.str();
@@ -3160,6 +3459,85 @@ std::string Lighthouse::GoodputJson() {
       << ",\"compute_seconds\":" << rl.compute_s
       << ",\"lost_seconds\":" << causes_obj(rl.lost_s) << "}";
   }
+  o << "}";
+  // Federation fleet rollup: the digest-fed region totals alone (distinct
+  // from the cluster totals above, which also include this instance's own
+  // members + bank).  Empty on a flat / child lighthouse.
+  double fleet_compute = 0.0, fleet_lost = 0.0;
+  for (const auto& [name, e] : regions_) {
+    fleet_compute += e.compute_s;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) fleet_lost += e.lost_s[i];
+  }
+  double fleet_acc = fleet_compute + fleet_lost;
+  o << ",\"fleet\":{\"regions\":" << regions_.size()
+    << ",\"goodput_ratio\":" << (fleet_acc > 0.0 ? fleet_compute / fleet_acc : -1.0)
+    << ",\"compute_seconds\":" << fleet_compute
+    << ",\"lost_seconds_total\":" << fleet_lost << ",\"per_region\":{";
+  first = true;
+  for (const auto& [name, e] : regions_) {
+    double rl = 0.0;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) rl += e.lost_s[i];
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(name) << "\":{\"goodput_ratio\":" << e.goodput_ratio
+      << ",\"compute_seconds\":" << e.compute_s
+      << ",\"lost_seconds_total\":" << rl
+      << ",\"lost_seconds\":" << causes_obj(e.lost_s) << "}";
+  }
+  o << "}}}";
+  return o.str();
+}
+
+std::string Lighthouse::SloJson() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::ostringstream o;
+  if (slo_target_ <= 0.0) {
+    o << "{\"enabled\":false}";
+    return o.str();
+  }
+  double compute = 0.0, lost[kLedgerCauseCount];
+  ClusterLedgerLocked(&compute, lost);
+  double lost_total = 0.0;
+  for (size_t i = 0; i < kLedgerCauseCount; ++i) lost_total += lost[i];
+  double accounted = compute + lost_total;
+  double budget = 1.0 - slo_target_;
+  // Error budget remaining over the run to date: 1 at zero loss, 0 when
+  // the cumulative lost fraction has consumed exactly (1 - target), and
+  // negative once the SLO is violated outright.
+  double budget_remaining =
+      accounted > 0.0 ? 1.0 - (lost_total / accounted) / budget : 1.0;
+  bool alert_active = false;
+  for (const auto& a : alerts_) {
+    if (a.kind == "slo_burn" && a.resolved_ms == 0) alert_active = true;
+  }
+  o << "{\"enabled\":true,\"target\":" << slo_target_
+    << ",\"fast_window_s\":" << slo_fast_s_
+    << ",\"slow_window_s\":" << slo_slow_s_
+    << ",\"burn_rate_fast\":" << slo_burn_fast_
+    << ",\"burn_rate_slow\":" << slo_burn_slow_
+    << ",\"error_budget_remaining\":" << budget_remaining
+    << ",\"goodput_ewma\":" << goodput_ewma_
+    << ",\"windowed_goodput\":" << last_windowed_goodput_
+    << ",\"alert_active\":" << (alert_active ? "true" : "false")
+    << ",\"culprit\":{\"replica\":\"" << JsonEscape(last_attr_.replica)
+    << "\",\"region\":\"" << JsonEscape(last_attr_.region)
+    << "\",\"dominant_cause\":\"" << JsonEscape(last_attr_.cause)
+    << "\",\"charged_seconds\":" << last_attr_.charged_s
+    << ",\"delta_by_replica\":"
+    << (last_attr_.delta_json.empty() ? "{}" : last_attr_.delta_json)
+    << "},\"regions\":{";
+  // Root tier: per-region cumulative burn over digest rollups — O(R), no
+  // per-replica fan-in (the region's own child serves the windowed view).
+  bool first = true;
+  for (const auto& [name, e] : regions_) {
+    double rl = 0.0;
+    for (size_t i = 0; i < kLedgerCauseCount; ++i) rl += e.lost_s[i];
+    double acc = e.compute_s + rl;
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << JsonEscape(name) << "\":{\"goodput_ratio\":" << e.goodput_ratio
+      << ",\"burn_rate\":" << (acc > 0.0 ? (rl / acc) / budget : 0.0) << "}";
+  }
   o << "}}";
   return o.str();
 }
@@ -3175,7 +3553,14 @@ std::string Lighthouse::IncidentJson() {
     o << "{\"id\":" << rec.id << ",\"reason\":\"" << JsonEscape(rec.reason)
       << "\",\"replica_id\":\"" << JsonEscape(rec.replica_id)
       << "\",\"step\":" << rec.step << ",\"ts_ms\":" << rec.ts_ms
-      << ",\"detail\":" << rec.detail << "}";
+      << ",\"detail\":" << rec.detail
+      << ",\"culprit_replica\":\"" << JsonEscape(rec.culprit_replica)
+      << "\",\"culprit_region\":\"" << JsonEscape(rec.culprit_region)
+      << "\",\"dominant_cause\":\"" << JsonEscape(rec.dominant_cause)
+      << "\",\"charged_seconds\":" << rec.charged_seconds
+      << ",\"delta_by_replica\":"
+      << (rec.delta_by_replica_json.empty() ? "{}" : rec.delta_by_replica_json)
+      << "}";
   }
   o << "]}";
   return o.str();

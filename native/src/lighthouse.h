@@ -8,6 +8,7 @@
 #pragma once
 
 #include <condition_variable>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -118,6 +119,22 @@ struct ReplicaLedger {
 // "replica_stale" (heartbeat loss) / "replica_evicted"
 // (supervisor-reported death — together the kill signatures), or
 // "goodput_floor" (windowed cluster goodput dipped below its EWMA floor).
+// Culprit attribution for one closed goodput window (docs/observability.md
+// "Culprit attribution"): when the window's goodput is scored, every
+// entity's (replica incarnation or federated region) per-cause ledger
+// delta is compared against its OWN trailing per-window baseline; the
+// entity with the largest positive excess is the culprit, and the cause
+// with the largest excess within it is dominant.  Attached to
+// goodput_floor incidents and slo_burn alerts so the verdict names a
+// replica instead of "cluster".
+struct IncidentAttribution {
+  std::string replica;     // culprit entity id ("" = no attribution yet)
+  std::string region;      // owning region ("" when flat / unknown)
+  std::string cause;       // dominant LOST cause (kLedgerCauses name)
+  double charged_s = 0.0;  // total excess-over-baseline seconds charged
+  std::string delta_json;  // {"<id>":{"compute_s":..,"lost_s":..,"excess_s":..}}
+};
+
 struct IncidentRecord {
   int64_t id = 0;
   std::string reason;
@@ -125,13 +142,21 @@ struct IncidentRecord {
   int64_t step = 0;        // max live step at trigger time
   int64_t ts_ms = 0;       // epoch ms
   double detail = 0.0;     // reason-specific scalar (ratio / goodput / age ms)
+  // Culprit attribution (goodput_floor / slo_burn triggers; empty
+  // otherwise).  replica_id stays "cluster" for schema + debounce-key
+  // stability — the blame rides here.
+  std::string culprit_replica;
+  std::string culprit_region;
+  std::string dominant_cause;
+  double charged_seconds = 0.0;
+  std::string delta_by_replica_json;  // per-replica window deltas (JSON object)
 };
 
 // One operator-visible alert, served on GET /alerts.json.  resolved_ms == 0
 // while active.
 struct AlertRecord {
   int64_t id = 0;
-  std::string kind;        // "straggler" | "ec_coverage" | "slow_link"
+  std::string kind;        // "straggler" | "ec_coverage" | "slow_link" | "slo_burn"
   std::string replica_id;  // "cluster" for cluster-scope kinds
   int64_t raised_ms = 0;   // epoch ms
   int64_t resolved_ms = 0;
@@ -147,6 +172,13 @@ struct AlertRecord {
   // edge's RECEIVING endpoint — the auto-drain target.
   double gbps = 0.0;
   std::string src_replica_id;
+  // kind == "slo_burn": multi-window burn rates at raise time (refreshed
+  // while active) + the culprit attribution of the newest closed goodput
+  // window, so the alert names who is burning the budget.
+  double burn_fast = 0.0;
+  double burn_slow = 0.0;
+  std::string dominant_cause;
+  double charged_seconds = 0.0;
 };
 
 // Root-side record of one regional child lighthouse (docs/wire.md
@@ -260,6 +292,12 @@ class Lighthouse {
   std::string GoodputJson();
   // Incident-trigger feed (GET /incident.json), newest last.
   std::string IncidentJson();
+  // SLO engine snapshot (GET /slo.json): target, multi-window burn rates,
+  // error budget remaining, the newest culprit attribution, and per-region
+  // rollups when federated (the root evaluates over digest rollups, so the
+  // fleet view costs O(R)).  Valid at every tier; {"enabled": false} when
+  // TPUFT_SLO_TARGET is unset.
+  std::string SloJson();
 
   // Flight-recorder snapshot (newest-first, bounded; 0 = all retained) —
   // the GET /debug/flight.json body and the capi accessor.
@@ -410,10 +448,24 @@ class Lighthouse {
   // observation, EWMA'd; a dip below EWMA * TPUFT_GOODPUT_DIP_RATIO after
   // the warmup records a "goodput_floor" incident.  Caller holds mu_.
   void ObserveGoodputLocked();
-  // Bounded, debounced incident-trigger record (+ flight event).  Caller
+  // Culprit attribution for the window just closed: per entity (live
+  // replica incarnations + federated regions), delta its cumulative
+  // ledger against the previous window's snapshot, score the delta's
+  // lost seconds against the entity's own trailing per-window baseline
+  // (EWMA), and blame the largest positive excess.  Updates last_attr_.
+  // Caller holds mu_.
+  void AttributeWindowLocked();
+  // SLO burn-rate evaluation over the window just closed (d_compute /
+  // d_lost = the window's accounted seconds).  No-op unless
+  // TPUFT_SLO_TARGET is set; raises/refreshes/resolves the "slo_burn"
+  // alert.  Caller holds mu_.
+  void EvaluateSloLocked(double d_compute, double d_lost);
+  // Bounded, debounced incident-trigger record (+ flight event).  `attr`
+  // attaches culprit attribution (goodput_floor / slo_burn).  Caller
   // holds mu_.
   void RecordIncidentLocked(const std::string& reason,
-                            const std::string& replica_id, double detail);
+                            const std::string& replica_id, double detail,
+                            const IncidentAttribution* attr = nullptr);
   // Flight-records a sentinel hysteresis transition when prev != h.state.
   void RecordSentinelLocked(const std::string& id, int prev,
                             const ReplicaHealth& h);
@@ -604,6 +656,45 @@ class Lighthouse {
   std::map<std::string, int64_t> incident_last_ms_;
   double goodput_dip_ratio_ = 0.9;
   int64_t goodput_warmup_ = 8;
+
+  // -- culprit attribution (docs/observability.md) ------------------------
+  // Per-entity window-delta state: cumulative counters at the previous
+  // window close + a trailing EWMA baseline of per-window lost seconds
+  // per cause.  Keyed by replica incarnation id (win_replicas_, pruned
+  // when the id leaves ledger_) or region name (win_regions_).
+  struct WindowDelta {
+    double prev_compute = 0.0;
+    double prev_lost[kLedgerCauseCount] = {0};
+    double base_lost[kLedgerCauseCount] = {0};  // per-window baseline EWMA
+    bool primed = false;  // first window seeds the baseline, never blames
+  };
+  std::map<std::string, WindowDelta> win_replicas_;
+  std::map<std::string, WindowDelta> win_regions_;
+  // Attribution of the newest closed window (replica == "" until any
+  // window produced a positive excess).
+  IncidentAttribution last_attr_;
+
+  // -- SLO engine (docs/observability.md "SLO engine") --------------------
+  // Knobs, read at Start:
+  //   TPUFT_SLO_TARGET  goodput SLO target in (0, 1); unset/invalid
+  //                     disables the engine entirely (default off)
+  //   TPUFT_SLO_FAST_S  fast burn-rate window, accounted seconds (60)
+  //   TPUFT_SLO_SLOW_S  slow burn-rate window, accounted seconds (600)
+  // Burn rate = (window lost fraction) / (1 - target); the "slo_burn"
+  // alert raises when BOTH windows burn > 1.0 and resolves when the fast
+  // window drops below 1.0 (multi-window discipline: the slow window
+  // confirms, the fast window gates paging latency both ways).
+  double slo_target_ = 0.0;
+  double slo_fast_s_ = 60.0;
+  double slo_slow_s_ = 600.0;
+  struct SloWindow {
+    double compute_s = 0.0;
+    double lost_s = 0.0;
+  };
+  std::deque<SloWindow> slo_windows_;  // newest last; pruned to slow_s
+  double slo_burn_fast_ = 0.0;
+  double slo_burn_slow_ = 0.0;
+  double last_windowed_goodput_ = -1.0;
 
   // HA role state (SetRole).  Default: standalone permanent leader with no
   // lease (lease_expires_ms_ == 0 disables the serve-time expiry guard).

@@ -1,0 +1,367 @@
+"""SLO engine + culprit attribution + IncidentWatcher tests (PR 17).
+
+Three layers:
+
+- ``test_slo_quick_smoke``: a live mini-cluster on the native lighthouse —
+  ledgers pumped through ``ManagerServer.set_ledger`` (real heartbeats,
+  real windowing, real burn-rate math), a victim turns stall-heavy, and
+  the full arc is asserted: named ``goodput_floor`` attribution, an
+  ``slo_burn`` alert, ``/slo.json``, SLO gauges on ``/metrics``, and one
+  flap-guarded watcher journal entry.  The healthy control checks ride
+  the same cell's warmup phase (no alerts before the injection).
+- Watcher unit tests against a synthetic feed (the ``fetch``/``clock``
+  injectables exist for exactly this): flap guard, debounce expiry,
+  dry-run vs --act, address failover.
+- ``test_metrics_lint_clean``: tools/metrics_lint.py must exit 0 — every
+  exported metric family has a doc row.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from torchft_tpu.obs.ledger import LOST_CAUSES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Live smoke: lighthouse SLO engine + attribution + watcher, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_slo_quick_smoke(tmp_path, monkeypatch) -> None:
+    from torchft_tpu._native import LighthouseServer, ManagerServer
+    from torchft_tpu.obs.watcher import IncidentWatcher
+
+    # Knobs parse in Start(): set them BEFORE the server is constructed.
+    monkeypatch.setenv("TPUFT_SLO_TARGET", "0.92")
+    monkeypatch.setenv("TPUFT_SLO_FAST_S", "10")
+    monkeypatch.setenv("TPUFT_SLO_SLOW_S", "20")
+    monkeypatch.setenv("TPUFT_GOODPUT_WARMUP_OBS", "2")
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=5000,
+    )
+    http = lighthouse.http_address()
+    managers = {}
+    stall_i = LOST_CAUSES.index("stall")
+    comp = {"g0": 0.0, "g1": 0.0}
+    stall = {"g0": 0.0, "g1": 0.0}
+
+    def pump(g: str, d_comp: float, d_stall: float) -> None:
+        comp[g] += d_comp
+        stall[g] += d_stall
+        lost = [0.0] * len(LOST_CAUSES)
+        lost[stall_i] = stall[g]
+        managers[g].set_ledger(
+            comp[g] / (comp[g] + stall[g]), comp[g], lost
+        )
+
+    watcher = IncidentWatcher(
+        [http], str(tmp_path), poll_interval_s=0.05, debounce_s=60.0
+    )
+    try:
+        for g in comp:
+            managers[g] = ManagerServer(
+                replica_id=f"{g}:u", lighthouse_addr=lighthouse.address(),
+                bind="127.0.0.1:0", heartbeat_interval_ms=25,
+            )
+        # Healthy phase: several full windows at ~97% goodput.
+        for _ in range(8):
+            for g in comp:
+                pump(g, 2.91, 0.09)
+            time.sleep(0.08)
+        watcher.poll_once(force=True)
+        # Control assertions: the healthy phase must blame nobody.
+        slo = json.loads(_get(http + "/slo.json"))
+        assert slo["enabled"] is True
+        assert slo["target"] == pytest.approx(0.92)
+        assert slo["alert_active"] is False
+        assert slo["burn_rate_fast"] < 1.0
+        assert not [
+            a
+            for a in json.loads(_get(http + "/alerts.json"))["alerts"]
+            if a["kind"] == "slo_burn"
+        ]
+        assert not os.path.exists(watcher.journal_path)
+        # Degraded phase: g1 turns stall-heavy (the straggler's ledger
+        # signature) while g0 stays healthy.
+        for _ in range(14):
+            pump("g0", 2.91, 0.09)
+            pump("g1", 1.0, 9.0)
+            watcher.poll_once(force=True)
+            time.sleep(0.08)
+        time.sleep(0.3)
+        watcher.poll_once(force=True)
+
+        # The verdicts name the victim — not "cluster".
+        incidents = json.loads(_get(http + "/incident.json"))["incidents"]
+        floors = [r for r in incidents if r["reason"] == "goodput_floor"]
+        assert floors, incidents
+        assert floors[0]["culprit_replica"] == "g1:u"
+        assert floors[0]["dominant_cause"] == "stall"
+        assert floors[0]["charged_seconds"] > 0.0
+        assert "g1:u" in floors[0]["delta_by_replica"]
+
+        burns = [
+            a
+            for a in json.loads(_get(http + "/alerts.json"))["alerts"]
+            if a["kind"] == "slo_burn"
+        ]
+        assert burns, "no slo_burn alert raised"
+        assert burns[-1]["replica_id"] == "g1:u"
+        assert burns[-1]["burn_fast"] > 1.0
+        assert burns[-1]["dominant_cause"] == "stall"
+
+        slo = json.loads(_get(http + "/slo.json"))
+        assert slo["alert_active"] is True
+        assert slo["burn_rate_fast"] > 1.0
+        assert slo["culprit"]["replica"] == "g1:u"
+        assert slo["error_budget_remaining"] < 1.0
+
+        text = _get(http + "/metrics")
+        assert "tpuft_slo_target 0.92" in text
+        assert "tpuft_slo_burn_rate_fast" in text
+        assert "tpuft_slo_burn_rate_slow" in text
+        assert "tpuft_slo_error_budget_remaining" in text
+        assert "tpuft_fleet_goodput_ratio" in text
+
+        # Exactly ONE journal entry: the floor incident and the burn
+        # alert both map to (drain, g1) and the flap guard folds them.
+        with open(watcher.journal_path, encoding="utf-8") as f:
+            journal = [json.loads(ln) for ln in f if ln.strip()]
+        assert len(journal) == 1, journal
+        assert journal[0]["policy"] == "drain"
+        assert journal[0]["target"] == "g1"
+        assert journal[0]["acted"] is False
+        assert journal[0]["verdict"]["culprit_replica"] == "g1:u"
+    finally:
+        for m in managers.values():
+            m.shutdown()
+        lighthouse.shutdown()
+
+
+def test_slo_disabled_by_default(tmp_path, monkeypatch) -> None:
+    """Without TPUFT_SLO_TARGET the engine is off: /slo.json says so and
+    no burn gauges carry a target."""
+    from torchft_tpu._native import LighthouseServer
+
+    monkeypatch.delenv("TPUFT_SLO_TARGET", raising=False)
+    lighthouse = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=100,
+        quorum_tick_ms=50, heartbeat_timeout_ms=1000,
+    )
+    try:
+        doc = json.loads(_get(lighthouse.http_address() + "/slo.json"))
+        assert doc == {"enabled": False}
+        text = _get(lighthouse.http_address() + "/metrics")
+        assert "tpuft_slo_target 0" in text
+    finally:
+        lighthouse.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Watcher unit tests: synthetic feed through the fetch/clock injectables
+# ---------------------------------------------------------------------------
+
+
+def _feed(incidents):
+    """A fetch(address, path) closure serving a mutable incident list plus
+    empty companion endpoints (capture_bundle probes several paths)."""
+    def fetch(address, path):
+        if path == "/incident.json":
+            return {"incidents": list(incidents)}
+        if path == "/alerts.json":
+            return {"alerts": []}
+        return {}
+    return fetch
+
+
+def _incident(rid, reason="alert:straggler", replica="g2:u", **extra):
+    rec = {
+        "id": rid, "reason": reason, "replica_id": replica, "step": rid,
+        "ts_ms": 1000 + rid, "detail": 2.5,
+    }
+    rec.update(extra)
+    return rec
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _mk_watcher(tmp_path, incidents, clock, **kw):
+    from torchft_tpu.obs.watcher import IncidentWatcher
+
+    kw.setdefault("fetch", _feed(incidents))
+    return IncidentWatcher(
+        ["http://127.0.0.1:1"], str(tmp_path), poll_interval_s=1.0,
+        debounce_s=30.0, clock=clock, **kw
+    )
+
+
+def test_watcher_flap_guard_and_debounce_expiry(tmp_path) -> None:
+    clock = _Clock()
+    incidents = [_incident(1)]
+    w = _mk_watcher(tmp_path, incidents, clock)
+    first = w.poll_once(force=True)
+    assert len(first) == 1
+    assert first[0]["policy"] == "drain" and first[0]["target"] == "g2"
+    # A confirming trigger for the same (policy, target) inside the
+    # debounce window journals nothing (the bundle still captures it).
+    incidents.append(_incident(2))
+    clock.t += 5.0
+    assert w.poll_once(force=True) == []
+    # Past the window the same pair journals again.
+    incidents.append(_incident(3))
+    clock.t += 31.0
+    again = w.poll_once(force=True)
+    assert len(again) == 1 and again[0]["incident_id"] == 3
+    with open(w.journal_path, encoding="utf-8") as f:
+        assert len(f.readlines()) == 2
+
+
+def test_watcher_poll_throttle_and_seen_dedup(tmp_path) -> None:
+    clock = _Clock()
+    incidents = [_incident(1)]
+    w = _mk_watcher(tmp_path, incidents, clock)
+    assert len(w.poll_once(force=True)) == 1
+    # Unforced polls inside poll_interval_s short-circuit entirely.
+    assert w.poll_once() == []
+    # A re-served incident id is never re-handled.
+    clock.t += 50.0
+    assert w.poll_once() == []
+
+
+def test_watcher_dry_run_vs_act(tmp_path) -> None:
+    clock = _Clock()
+    drained = []
+    w = _mk_watcher(
+        tmp_path, [_incident(1)], clock, act=True, drain_cb=drained.append
+    )
+    entry = w.poll_once(force=True)[0]
+    assert entry["acted"] is True and drained == ["g2"]
+    # Dry-run (the default): same verdict, acted stays false.
+    clock2 = _Clock()
+    drained2 = []
+    w2 = _mk_watcher(
+        tmp_path / "dry", [_incident(1)], clock2, drain_cb=drained2.append
+    )
+    entry2 = w2.poll_once(force=True)[0]
+    assert entry2["acted"] is False and drained2 == []
+
+
+def test_watcher_act_never_drains_cluster(tmp_path) -> None:
+    """A cluster-wide verdict has no single replica to rotate out: --act
+    must not fire the drain."""
+    clock = _Clock()
+    drained = []
+    w = _mk_watcher(
+        tmp_path,
+        [_incident(1, reason="alert:ec_coverage", replica="cluster")],
+        clock, act=True, drain_cb=drained.append,
+    )
+    entries = w.poll_once(force=True)
+    assert len(entries) == 1
+    assert entries[0]["policy"] == "re-stripe"
+    assert entries[0]["acted"] is False and drained == []
+
+
+def test_watcher_address_failover(tmp_path) -> None:
+    from torchft_tpu.obs.watcher import IncidentWatcher
+
+    calls = []
+
+    def fetch(address, path):
+        calls.append(address)
+        if address.endswith(":1"):
+            return None  # dead leader
+        if path == "/incident.json":
+            return {"incidents": []}
+        return {}
+
+    w = IncidentWatcher(
+        ["http://127.0.0.1:1", "http://127.0.0.1:2"], str(tmp_path),
+        poll_interval_s=0.0, debounce_s=30.0, fetch=fetch,
+    )
+    w.poll_once(force=True)
+    assert w.serving_address() == "http://127.0.0.1:2"
+    # The next poll starts from the known-good address, not the dead one.
+    calls.clear()
+    w.poll_once(force=True)
+    assert calls[0] == "http://127.0.0.1:2"
+
+
+def test_watcher_requires_an_address(tmp_path) -> None:
+    from torchft_tpu.obs.watcher import IncidentWatcher
+
+    with pytest.raises(ValueError):
+        IncidentWatcher([], str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Incident bundle retention
+# ---------------------------------------------------------------------------
+
+
+def test_incident_retention_prunes_oldest(tmp_path, monkeypatch) -> None:
+    from torchft_tpu.obs.incident import _prune_bundles
+
+    monkeypatch.setenv("TPUFT_INCIDENT_RETAIN", "3")
+    for step in (1, 2, 3, 4, 5):
+        (tmp_path / f"incident_{step}").mkdir()
+        (tmp_path / f"incident_{step}" / "state.json").write_text("{}")
+    # Non-bundle dirs are never candidates.
+    (tmp_path / "incident_notastep").mkdir()
+    (tmp_path / "checkpoints").mkdir()
+    pruned = _prune_bundles(str(tmp_path), keep=str(tmp_path / "incident_5"))
+    assert sorted(os.path.basename(p) for p in pruned) == [
+        "incident_1", "incident_2"
+    ]
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == [
+        "checkpoints", "incident_3", "incident_4", "incident_5",
+        "incident_notastep",
+    ]
+    # keep= wins even when it would be the oldest.
+    monkeypatch.setenv("TPUFT_INCIDENT_RETAIN", "1")
+    pruned = _prune_bundles(str(tmp_path), keep=str(tmp_path / "incident_3"))
+    assert sorted(os.path.basename(p) for p in pruned) == [
+        "incident_4", "incident_5"
+    ]
+    assert (tmp_path / "incident_3").exists()
+    # retain <= 0 disables pruning.
+    monkeypatch.setenv("TPUFT_INCIDENT_RETAIN", "0")
+    (tmp_path / "incident_9").mkdir()
+    assert _prune_bundles(str(tmp_path), keep=None) == []
+
+
+# ---------------------------------------------------------------------------
+# Metrics lint: every exported family is documented
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_lint_clean() -> None:
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_lint
+    finally:
+        sys.path.pop(0)
+    assert metrics_lint.main([]) == 0

@@ -85,9 +85,20 @@ def main(argv=None) -> int:
         else:
             for m in manifests:
                 v = m["manifest"].get("verdict", {})
-                print(f"{m['bundle']}: kind={v.get('kind')} "
-                      f"replica={v.get('replica')} cause={v.get('cause')} "
-                      f"lost_s={v.get('lost_s')}")
+                line = (f"{m['bundle']}: kind={v.get('kind')} "
+                        f"replica={v.get('replica')} cause={v.get('cause')} "
+                        f"lost_s={v.get('lost_s')}")
+                # Culprit attribution (goodput_floor / slo_burn verdicts):
+                # name who ate the window and how much was charged.
+                if v.get("culprit_replica"):
+                    line += (f" culprit={v['culprit_replica']}"
+                             f" charged_s={v.get('charged_seconds')}")
+                    if v.get("culprit_region"):
+                        line += f" region={v['culprit_region']}"
+                if v.get("burn_fast") is not None:
+                    line += (f" burn_fast={v.get('burn_fast')}"
+                             f" burn_slow={v.get('burn_slow')}")
+                print(line)
         return 0
 
     v = obs_incident.verdict(args.bundle)

@@ -179,6 +179,8 @@ class Launcher:
         cwd: Optional[str] = None,
         spares: int = 0,
         straggler_auto_drain: Optional[bool] = None,
+        incident_watcher: Optional[bool] = None,
+        watcher_act: Optional[bool] = None,
     ) -> None:
         self._cmd = list(cmd)
         self._num_groups = num_groups
@@ -205,6 +207,17 @@ class Launcher:
         self._straggler_auto_drain = straggler_auto_drain
         self._sentinel_last_poll = 0.0
         self._handled_alerts: set = set()
+        # IncidentWatcher (docs/observability.md "IncidentWatcher"): polls
+        # the incident feed, captures bundles, journals flap-guarded
+        # remediation recommendations.  Dry-run unless watcher_act
+        # (TPUFT_WATCHER_ACT=1), which gates the cooperative-drain action.
+        if incident_watcher is None:
+            incident_watcher = os.environ.get("TPUFT_INCIDENT_WATCHER", "") == "1"
+        if watcher_act is None:
+            watcher_act = os.environ.get("TPUFT_WATCHER_ACT", "") == "1"
+        self._incident_watcher_enabled = incident_watcher
+        self._watcher_act = watcher_act
+        self._watcher = None  # built lazily on the first supervise pass
 
         lighthouse_http = ""
         if lighthouse == "embed":
@@ -677,6 +690,9 @@ class Launcher:
         # Straggler sentinel: rotate confirmed-slow hosts out (throttled,
         # no-op unless straggler_auto_drain and an embedded lighthouse).
         self._sentinel_once()
+        # IncidentWatcher: capture + journal (throttled internally; no-op
+        # unless --incident-watcher and an embedded lighthouse).
+        self._watcher_once()
         return restarted
 
     def pid(self, group: int) -> Optional[int]:
@@ -763,6 +779,46 @@ class Launcher:
                 # owns the slot.
                 if g.proc is None or g.proc.poll() is not None:
                     self.spawn(group)
+
+    def _watcher_once(self) -> None:
+        """One IncidentWatcher pass (built lazily, throttled internally):
+        the watcher polls the incident feed, captures evidence bundles
+        into the drain/log dir, and journals flap-guarded remediation
+        recommendations to ``watcher_journal.jsonl`` there.  Acting is
+        gated separately (watcher_act) and limited to the cooperative
+        drain, routed through this supervisor's own :meth:`drain` so the
+        departing group gets a replacement."""
+        if not self._incident_watcher_enabled or not self.lighthouse_http_address:
+            return
+        if self._watcher is None:
+            from torchft_tpu.obs.watcher import IncidentWatcher
+
+            def _drain_group(target: str) -> None:
+                group = int(target)
+                if group not in self._groups:
+                    raise ValueError(f"unknown group {target}")
+                try:
+                    self.drain(group, deadline_s=30.0)
+                except RuntimeError:
+                    # Donor already gone (the lighthouse-side drain mark
+                    # aborted its joins); just refill the slot.
+                    g = self._groups[group]
+                    if g.proc is None or g.proc.poll() is not None:
+                        self.spawn(group)
+
+            metrics_path = self._base_env.get("TPUFT_METRICS_PATH")
+            self._watcher = IncidentWatcher(
+                [self.lighthouse_http_address],
+                self._drain_dir or ".",
+                act=self._watcher_act,
+                metrics_paths=[metrics_path] if metrics_path else [],
+                drain_cb=_drain_group,
+            )
+        try:
+            self._watcher.poll_once()
+        except Exception:  # noqa: BLE001
+            # The watcher observes the run; it must never take it down.
+            logger.exception("incident watcher poll failed")
 
     def running(self) -> bool:
         """True while any group process is alive."""
@@ -897,6 +953,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--cache-dir", default=None, help="shared persistent XLA compile cache"
     )
+    parser.add_argument(
+        "--incident-watcher", action="store_true",
+        help="run the IncidentWatcher against the embedded lighthouse: "
+        "auto-capture incident bundles + journal flap-guarded remediation "
+        "recommendations (watcher_journal.jsonl in the log dir); dry-run "
+        "unless --watcher-act (also TPUFT_INCIDENT_WATCHER=1)",
+    )
+    parser.add_argument(
+        "--watcher-act", action="store_true",
+        help="let the IncidentWatcher execute its one actionable policy "
+        "(cooperative drain); all other recommendations stay dry-run "
+        "(also TPUFT_WATCHER_ACT=1)",
+    )
     spec = parser.add_argument_group(
         "scheduler spec generation",
         "--dump-spec renders the same env contract as a GKE JobSet manifest "
@@ -959,6 +1028,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         log_dir=args.log_dir,
         cache_dir=args.cache_dir,
         spares=args.spares,
+        incident_watcher=args.incident_watcher or None,
+        watcher_act=args.watcher_act or None,
     )
     with launcher:
         print(

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import socket
 import threading
 import time
@@ -541,12 +542,18 @@ class Manager:
                 # pinned by tests/test_straggler.py.
                 from torchft_tpu.drain import DrainNotice
 
+                # The rejection may carry the announced grace remainder as
+                # a "(deadline_ms=N)" suffix (root-issued drains plumb the
+                # operator's deadline down through the digest response);
+                # pace the cooperative exit to it instead of a fixed 30 s.
+                m = re.search(r"deadline_ms=(\d+)", str(e))
+                grace_s = int(m.group(1)) / 1000.0 if m else 30.0
                 self._logger.warn(
                     "lighthouse declared this replica draining; beginning "
-                    "cooperative exit"
+                    f"cooperative exit (grace {grace_s:.1f}s)"
                 )
                 self.begin_drain(
-                    DrainNotice(source="lighthouse", deadline=time.time() + 30.0)
+                    DrainNotice(source="lighthouse", deadline=time.time() + grace_s)
                 )
             else:
                 self._logger.exception(f"quorum failed: {e}")

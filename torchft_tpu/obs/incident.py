@@ -170,7 +170,45 @@ def capture_bundle(
     manifest["incidents"].append(incident)
     with open(manifest_path, "w", encoding="utf-8") as f:
         json.dump(manifest, f, indent=2)
+    _prune_bundles(workdir, keep=bundle)
     return bundle
+
+
+def _prune_bundles(workdir: str, keep: Optional[str] = None) -> List[str]:
+    """Bounds incident-bundle disk growth: keeps the TPUFT_INCIDENT_RETAIN
+    newest ``incident_<step>/`` dirs (default 16; 0 or negative disables
+    pruning) and removes the rest, oldest step first.  ``keep`` is never
+    pruned — the bundle being written must survive its own capture even
+    at retain=1 with many older dirs present.  Returns the pruned paths."""
+    try:
+        retain = int(os.environ.get("TPUFT_INCIDENT_RETAIN", "16"))
+    except ValueError:
+        retain = 16
+    if retain <= 0:
+        return []
+    bundles = []
+    for p in glob.glob(os.path.join(workdir, "incident_*")):
+        if not os.path.isdir(p):
+            continue
+        tail = os.path.basename(p)[len("incident_"):]
+        try:
+            step = int(tail)
+        except ValueError:
+            continue  # not a capture dir of ours — never delete it
+        bundles.append((step, p))
+    bundles.sort()
+    keep_abs = os.path.abspath(keep) if keep else None
+    pruned = []
+    excess = len(bundles) - retain
+    for step, p in bundles:
+        if excess <= 0:
+            break
+        if keep_abs and os.path.abspath(p) == keep_abs:
+            continue
+        shutil.rmtree(p, ignore_errors=True)
+        pruned.append(p)
+        excess -= 1
+    return pruned
 
 
 def finalize_bundle(
@@ -268,8 +306,13 @@ def verdict(bundle: str, events: Optional[Sequence[dict]] = None) -> dict:
       ``wire``.
     * ``alert:ec_coverage`` — cluster-scope redundancy loss (no wall time
       charged; the verdict names the shortfall).
-    * ``goodput_floor`` — generic dip: names the cause class with the
-      largest lost share in the cluster ledger.
+    * ``goodput_floor`` — windowed dip: names the lighthouse-attributed
+      culprit (``culprit_replica`` / ``culprit_region`` /
+      ``dominant_cause`` / ``charged_seconds`` / ``delta_by_replica``
+      from the trigger record) when the window scored one, else falls
+      back to the cumulative ledger's largest lost-share cause.
+    * ``alert:slo_burn`` — the SLO engine's multi-window burn alert:
+      carries both burn rates plus the same culprit attribution.
     * ``region_stale`` — a federated region's digest stream went dark (a
       correlated preemption wave / region loss): the verdict names the
       dead REGION (``region`` field) rather than a single group; the
@@ -460,9 +503,48 @@ def verdict(bundle: str, events: Optional[Sequence[dict]] = None) -> dict:
         out["lost_s"] = 0.0  # redundancy loss costs no wall until a heal
     elif reason == "goodput_floor":
         out["kind"] = "goodput_dip"
-        out["replica"] = incident.get("replica_id", "cluster")
-        worst = max(lost, key=lambda c: lost[c]) if any(lost.values()) else None
-        out["cause"] = worst
         out["windowed_goodput"] = incident.get("detail")
-        out["lost_s"] = round(lost[worst], 3) if worst else None
+        # The lighthouse's per-window attribution names the culprit when
+        # the trigger carried one (each entity's per-cause delta scored
+        # against its own trailing baseline — see docs/observability.md
+        # "Culprit attribution"); a culprit-less record (old library, or
+        # a genuinely diffuse dip) falls back to the cumulative-ledger
+        # argmax the pre-attribution verdict used.
+        culprit = str(incident.get("culprit_replica") or "")
+        if culprit:
+            out["replica"] = _GROUP(culprit)
+            out["culprit_replica"] = culprit
+            out["culprit_region"] = incident.get("culprit_region") or None
+            out["cause"] = incident.get("dominant_cause") or None
+            out["charged_seconds"] = incident.get("charged_seconds")
+            out["delta_by_replica"] = incident.get("delta_by_replica") or {}
+            cs = incident.get("charged_seconds")
+            out["lost_s"] = round(float(cs), 3) if cs is not None else None
+        else:
+            out["replica"] = incident.get("replica_id", "cluster")
+            worst = (
+                max(lost, key=lambda c: lost[c]) if any(lost.values()) else None
+            )
+            out["cause"] = worst
+            out["lost_s"] = round(lost[worst], 3) if worst else None
+    elif reason == "alert:slo_burn":
+        a = match_alert("slo_burn") or {}
+        out["kind"] = "slo_burn"
+        culprit = str(
+            incident.get("culprit_replica") or a.get("replica_id") or ""
+        )
+        out["replica"] = _GROUP(culprit) if culprit else "cluster"
+        out["culprit_replica"] = culprit or None
+        out["culprit_region"] = incident.get("culprit_region") or None
+        out["cause"] = (
+            incident.get("dominant_cause") or a.get("dominant_cause") or None
+        )
+        out["burn_fast"] = a.get("burn_fast") or incident.get("detail")
+        out["burn_slow"] = a.get("burn_slow")
+        out["charged_seconds"] = (
+            incident.get("charged_seconds") or a.get("charged_seconds")
+        )
+        out["delta_by_replica"] = incident.get("delta_by_replica") or {}
+        cs = out["charged_seconds"]
+        out["lost_s"] = round(float(cs), 3) if cs else None
     return out
