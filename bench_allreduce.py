@@ -117,7 +117,8 @@ def _lane_rank_body(
     tol = max(0.5, 0.02 * expected_last)
     assert abs(float(np.asarray(outs[-1][0])[0]) - expected_last) < tol
     return {"wall_s": wall, "lane_stats": collective.lane_stats(),
-            "topology": collective.topology}
+            "topology": collective.topology,
+            "transport": collective.ring_transport}
 
 
 def _lane_worker(cfg: Dict[str, Any]) -> Dict[str, Any]:
@@ -128,6 +129,7 @@ def _lane_worker(cfg: Dict[str, Any]) -> Dict[str, Any]:
     c = TCPCollective(
         timeout=cfg["timeout"], wire_dtype=cfg["wire_dtype"], lanes=cfg["lanes"],
         topology=cfg.get("topology"), engine=cfg.get("engine"),
+        transport=cfg.get("transport"),
     )
     try:
         c.configure(cfg["store"], cfg["rank"], world)
@@ -197,6 +199,7 @@ def bench_lanes(
     world: int = 2,
     topology: Optional[str] = None,
     engine: Optional[str] = None,
+    transport: Optional[str] = None,
 ) -> Dict[str, Any]:
     """``world``-rank bucketed allreduce stream at the given lane count and
     topology under the shaped link.  ``procs=True`` (the artifact path)
@@ -224,14 +227,14 @@ def bench_lanes(
                     prefix = (
                         f"{store.address()}/lanes{lanes}_{wire_dtype}"
                         f"_{topology or 'default'}_{engine or 'auto'}"
-                        f"_w{world}_t{trial}"
+                        f"_{transport or 'default'}_w{world}_t{trial}"
                     )
                     cfgs = [
                         {"store": prefix, "rank": r, "lanes": lanes,
                          "nbytes": nbytes, "n_buckets": n_buckets,
                          "wire_dtype": wire_dtype, "timeout": timeout,
                          "world": world, "topology": topology,
-                         "engine": engine}
+                         "engine": engine, "transport": transport}
                         for r in range(world)
                     ]
                     attempt = _spawn_workers("lanes", cfgs, timeout + 60)
@@ -246,12 +249,12 @@ def bench_lanes(
                     prefix = (
                         f"{store.address()}/lanes{lanes}_{wire_dtype}"
                         f"_{topology or 'default'}_{engine or 'auto'}"
-                        f"_w{world}_t{trial}"
+                        f"_{transport or 'default'}_w{world}_t{trial}"
                     )
                     cols = [
                         TCPCollective(timeout=timeout, wire_dtype=wire_dtype,
                                       lanes=lanes, topology=topology,
-                                      engine=engine)
+                                      engine=engine, transport=transport)
                         for _ in range(world)
                     ]
                     results: Dict[int, dict] = {}
@@ -307,6 +310,10 @@ def bench_lanes(
         # "native") — requested "native" on a stale .so degrades to "py"
         # and the record says so, per the no-silent-fallback contract.
         "engine": per_rank[0]["lane_stats"].get("engine", "py"),
+        # The ring-lane transport that actually ran ("shm" only when the
+        # same-host handshake armed at least one segment) — requested shm
+        # that degraded to tcp must land under the truth.
+        "transport": per_rank[0].get("transport", "tcp"),
         "payload_mb": round(actual / (1 << 20), 2),
         "buckets": n_buckets,
         "wire_dtype": wire_dtype,
@@ -443,6 +450,212 @@ def run_engine_quick(
             by_engine["native"]["gb_per_s"] / by_engine["py"]["gb_per_s"], 2
         )
     return out
+
+
+def check_transport_parity(
+    n_elems: int = 1 << 14, lanes: int = 2, timeout: float = 60.0
+) -> bool:
+    """Bitwise transport parity on live rings: the SAME deterministic
+    payload allreduced by a tcp pair and an shm pair (f32 raw, the int8
+    codec, and the int4 codec) must produce IDENTICAL bits — the shm lane
+    replaces the byte PIPE under the frame protocol, never the arithmetic,
+    so any divergence is a framing bug."""
+    from torchft_tpu._native import StoreServer
+    from torchft_tpu.collectives import TCPCollective
+
+    rng = np.random.default_rng(4321)
+    data = [
+        (rng.standard_normal(n_elems) * (r + 1)).astype(np.float32)
+        for r in range(2)
+    ]
+    outs: Dict[str, List[np.ndarray]] = {}
+    store = StoreServer(bind="127.0.0.1:0")
+    try:
+        for transport in ("tcp", "shm"):
+            cols = [
+                TCPCollective(timeout=timeout, lanes=lanes,
+                              transport=transport)
+                for _ in range(2)
+            ]
+            results: Dict[int, List[np.ndarray]] = {}
+            errors: List[BaseException] = []
+
+            def run(rank: int, cols=cols, results=results, errors=errors,
+                    transport=transport) -> None:
+                try:
+                    c = cols[rank]
+                    c.configure(
+                        f"{store.address()}/tparity_{transport}", rank, 2
+                    )
+                    got: List[np.ndarray] = []
+                    got.append(c.allreduce(
+                        [data[rank]], op="sum", allow_wire_compression=False
+                    ).wait(timeout=timeout)[0])
+                    got.append(c.allreduce(
+                        [data[rank]], op="sum", wire_codec="int8"
+                    ).wait(timeout=timeout)[0])
+                    got.append(c.allreduce(
+                        [data[rank]], op="sum", wire_codec="int4"
+                    ).wait(timeout=timeout)[0])
+                    results[rank] = got
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            resolved = cols[0].ring_transport
+            for c in cols:
+                c.shutdown()
+            if errors:
+                raise errors[0]
+            if resolved != transport:
+                return False  # requested transport did not arm — not a proof
+            outs[transport] = results[0]
+    finally:
+        store.shutdown()
+    return all(
+        a.dtype == b.dtype
+        and a.shape == b.shape
+        and bool((a.view(np.uint32) == b.view(np.uint32)).all())
+        for a, b in zip(outs["tcp"], outs["shm"])
+    )
+
+
+def check_multi_stripe(
+    n_elems: int = 1 << 16, lanes: int = 2, chunk_bytes: int = 32 << 10,
+    ops: int = 4, timeout: float = 60.0,
+) -> Optional[Dict[str, Any]]:
+    """Pins the one-call native multi-stripe entry: a striped allreduce
+    (many stripes per op at this chunk size) must cross the C API ONCE per
+    op (``tf_ring_pass_multi``), not once per stripe — the per-stripe
+    ctypes round-trips were pure Python overhead the batch entry removed.
+    Counts ``RingEngine.pass_calls`` on rank 0 across ``ops`` back-to-back
+    allreduces.  None when the native engine is unavailable."""
+    from torchft_tpu._native import StoreServer, ring_engine_available
+    from torchft_tpu.collectives import TCPCollective
+
+    if not ring_engine_available():
+        return None
+    nstripes = max(1, (n_elems * 4 + chunk_bytes - 1) // chunk_bytes)
+    store = StoreServer(bind="127.0.0.1:0")
+    counts: Dict[int, int] = {}
+    errors: List[BaseException] = []
+    try:
+        cols = [
+            TCPCollective(timeout=timeout, lanes=lanes,
+                          chunk_bytes=chunk_bytes, engine="native")
+            for _ in range(2)
+        ]
+
+        def run(rank: int) -> None:
+            try:
+                c = cols[rank]
+                c.configure(f"{store.address()}/multistripe", rank, 2)
+                if c.ring_engine != "native":
+                    return
+                x = np.arange(n_elems, dtype=np.float32) * (rank + 1)
+                for _ in range(ops):
+                    c.allreduce([x], op="sum").wait(timeout=timeout)
+                counts[rank] = c._engine.pass_calls
+            except BaseException as e:  # noqa: BLE001 — re-raised
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for c in cols:
+            c.shutdown()
+        if errors:
+            raise errors[0]
+    finally:
+        store.shutdown()
+    if 0 not in counts:
+        return None  # native engine did not resolve
+    return {
+        "section": "multi_stripe",
+        "ops": ops,
+        "stripes_per_op": nstripes,
+        "pass_calls": counts[0],
+        "one_call_per_op": counts[0] == ops,
+    }
+
+
+def run_transport_quick(
+    payload_mb: float = 4.0, lanes: int = 2, trials: int = 3
+) -> Dict[str, Any]:
+    """The same-host transport A/B (``--transport both`` at a small
+    unshaped-loopback cell, threads): one tcp cell, one shm cell, the live
+    bitwise parity pin, and the one-call multi-stripe pin.  Wired into
+    tests/test_bench_contract.py::test_transport_quick_smoke.  shm moves
+    stripe frames through a lock-free SPSC ring in /dev/shm instead of the
+    kernel socket path — same frames, no syscalls per hop.
+
+    The record carries ``cpu_count`` for the same honesty reason the
+    engine-thread curve does: on a single-core host both transports
+    bottleneck on scheduler alternation (loopback TCP and the shm ring
+    each move bytes with two copies), so the A/B ratio there is noise
+    around 1.0 rather than a transport signal — consumers should only
+    read ``shm_ok`` as a regression gate when ``cpu_count > 1``."""
+    cells = [
+        bench_lanes(payload_mb=payload_mb, lanes=lanes, mbps=0.0, rtt_ms=0.0,
+                    n_buckets=4, timeout=120.0, procs=False, trials=trials,
+                    transport=t)
+        for t in ("tcp", "shm")
+    ]
+    by_transport = {c["transport"]: c for c in cells}
+    out: Dict[str, Any] = {
+        "section": "transport",
+        "cpu_count": os.cpu_count(),
+        "cells": cells,
+        "parity_bitwise": check_transport_parity(lanes=lanes),
+        "multi_stripe": check_multi_stripe(lanes=lanes),
+    }
+    if "tcp" in by_transport and "shm" in by_transport:
+        out["shm_ok"] = (
+            by_transport["shm"]["gb_per_s"] >= by_transport["tcp"]["gb_per_s"]
+        )
+        out["shm_speedup"] = round(
+            by_transport["shm"]["gb_per_s"] / by_transport["tcp"]["gb_per_s"], 2
+        )
+    return out
+
+
+def bench_engine_threads(
+    payload_mb: float = 4.0, lane_counts=(1, 2, 4), trials: int = 2,
+) -> Dict[str, Any]:
+    """GIL-liberation curve: the same THREADED 2-rank bucket stream at
+    rising lane counts, Python engine vs native engine.  Both ranks and
+    all lane workers share one process here, so the Python engine's lanes
+    serialize on the GIL while the native engine's C++ lane threads run
+    free — the native curve should hold or rise with lanes where the py
+    curve flattens.  On a 1-core container BOTH flatten (nothing to run
+    parallel on); the record carries ``cpu_count`` so readers can tell
+    "GIL-bound" from "core-bound" honestly."""
+    from torchft_tpu._native import ring_engine_available
+
+    cells: List[Dict[str, Any]] = []
+    engines = ["py"] + (["native"] if ring_engine_available() else [])
+    for eng in engines:
+        for lanes in lane_counts:
+            r = bench_lanes(payload_mb=payload_mb, lanes=lanes, mbps=0.0,
+                            rtt_ms=0.0, n_buckets=4, timeout=120.0,
+                            procs=False, trials=trials, engine=eng)
+            r["section"] = "engine_threads"
+            cells.append(r)
+    curve: Dict[str, Dict[str, float]] = {}
+    for c in cells:
+        curve.setdefault(c["engine"], {})[str(c["lanes"])] = c["gb_per_s"]
+    return {
+        "section": "engine_threads",
+        "cpu_count": os.cpu_count(),
+        "cells": cells,
+        "gb_per_s": curve,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1459,6 +1672,13 @@ def main() -> None:
         "'py'/'native' pin one side",
     )
     parser.add_argument(
+        "--transport", choices=["tcp", "shm", "both"], default="both",
+        help="ring-lane transport A/B: 'both' adds a tcp-vs-shm section "
+        "(same-host SPSC shm ring vs the kernel socket path, bitwise "
+        "parity pin, one-call multi-stripe pin, GIL-liberation thread "
+        "sweep); 'tcp'/'shm' pin the transport for every cell",
+    )
+    parser.add_argument(
         "--topology", choices=["ring", "ring2d", "both"], default="both",
         help="cross-group topology A/B: 'both' adds a flat-vs-ring2d sweep "
         "at --topo-world ranks on the same shaped link (the per-topology "
@@ -1552,10 +1772,12 @@ def main() -> None:
     # lane_gbps[engine][lanes]; the flat summary keys quote the engine the
     # deployment default (auto) runs — native when available.
     lane_gbps: Dict[str, Dict[int, float]] = {e: {} for e in engines}
+    pinned_transport = None if args.transport == "both" else args.transport
     for l in args.lanes:
         for eng in engines:
             r = bench_lanes(args.mb, l, args.mbps, args.rtt_ms, args.buckets,
-                            trials=args.trials, engine=eng)
+                            trials=args.trials, engine=eng,
+                            transport=pinned_transport)
             # Key by the engine that actually RAN: a stale .so degrades a
             # requested native cell to py (one warning) and the record must
             # land under the truth, not crash the sweep.
@@ -1579,6 +1801,23 @@ def main() -> None:
         parity = check_engine_parity()
         results.append({"section": "engine_parity", "parity_bitwise": parity})
         print(json.dumps(results[-1]), flush=True)
+
+    # Transport A/B: tcp vs same-host shm lanes on the unshaped loopback
+    # (a shaped link would bury the syscall cost the shm path removes),
+    # plus the bitwise parity pin, the one-call multi-stripe pin, and the
+    # GIL-liberation thread sweep.
+    transport_section: Optional[Dict[str, Any]] = None
+    if args.transport == "both":
+        transport_section = run_transport_quick(
+            payload_mb=min(args.mb, 16.0), trials=args.trials
+        )
+        results.append(transport_section)
+        print(json.dumps(transport_section), flush=True)
+        r = bench_engine_threads(
+            payload_mb=min(args.mb, 8.0), trials=max(1, args.trials - 1)
+        )
+        results.append(r)
+        print(json.dumps(r), flush=True)
 
     # Topology A/B: the same bucket stream at --topo-world ranks, flat ring
     # vs 2D ring-of-rings, on the same shaped link.  Paired same-host
@@ -1687,6 +1926,13 @@ def main() -> None:
             )
     if args.engine == "both":
         summary["engine_parity_bitwise"] = parity
+    if transport_section is not None:
+        summary["transport_parity_bitwise"] = transport_section["parity_bitwise"]
+        if "shm_speedup" in transport_section:
+            summary["shm_speedup"] = transport_section["shm_speedup"]
+        ms = transport_section.get("multi_stripe")
+        if ms is not None:
+            summary["multi_stripe_one_call_per_op"] = ms["one_call_per_op"]
     if pipe:
         summary["pipelined_steps_per_s"] = pipe["steps_per_s"]
         if mono and mono["steps_per_s"]:
@@ -1728,8 +1974,20 @@ def main() -> None:
             )
     print(json.dumps({"summary": summary}), flush=True)
     if args.out:
+        # The full sweep replaces results+summary but must not drop the
+        # additive cells other invocations merge in (--link writes
+        # doc["link"]); the artifact is one document with two writers.
+        doc: Dict[str, Any] = {}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = {}
+        doc["results"] = results
+        doc["summary"] = summary
         with open(args.out, "w") as f:
-            json.dump({"results": results, "summary": summary}, f, indent=1)
+            json.dump(doc, f, indent=1)
 
 
 if __name__ == "__main__":

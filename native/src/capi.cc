@@ -307,6 +307,31 @@ int tf_ring_pass(void* p, int32_t tier, int32_t lane, int32_t n, int32_t rank,
   return static_cast<int>(st);
 }
 
+int tf_ring_pass_multi(void* p, int32_t tier, int32_t nstripes, int32_t n,
+                       int32_t rank, const int32_t* lanes,
+                       const uint32_t* tag_bases, uint32_t rs_sub,
+                       uint32_t ag_sub, int32_t mode, int32_t op, int32_t wire,
+                       const uint64_t* chunk_ptrs, const uint64_t* chunk_elems,
+                       double timeout_s, char** err) {
+  std::string e;
+  RingStatus st = static_cast<RingEngine*>(p)->RingPassMulti(
+      tier, nstripes, n, rank, lanes, tag_bases, rs_sub, ag_sub, mode, op,
+      wire, chunk_ptrs, chunk_elems, timeout_s, &e);
+  if (st != RingStatus::kOk) SetErr(err, e);
+  return static_cast<int>(st);
+}
+
+int tf_ring_set_shm(void* p, int32_t tier, int32_t direction, int32_t lane,
+                    const char* path, uint64_t token, char** err) {
+  std::string e;
+  if (!static_cast<RingEngine*>(p)->SetShm(tier, direction, lane, path, token,
+                                           &e)) {
+    SetErr(err, e);
+    return 3;
+  }
+  return 0;
+}
+
 int tf_ring_counters(void* p, int32_t tier, uint64_t* sent, uint64_t* recv,
                      int32_t cap) {
   return static_cast<RingEngine*>(p)->Counters(tier, sent, recv, cap);

@@ -6,11 +6,19 @@
 #include "ring.h"
 
 #include <errno.h>
+#include <fcntl.h>
 #include <poll.h>
 #include <string.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/uio.h>
 #include <unistd.h>
+
+#ifdef __linux__
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#endif
 
 #include <algorithm>
 #include <chrono>
@@ -48,6 +56,72 @@ double NowWallS() {
 
 int ModN(int a, int n) { return ((a % n) + n) % n; }
 
+// Same-host SPSC segment layout (mirrored by collectives._ShmRing; the
+// cross-engine contract like the `<IQ` frame header): u64 magic, u64
+// generation token, u64 head (producer byte cursor), u64 tail (consumer
+// byte cursor), u32 poisoned, u32 consumer-parked flag, u32
+// producer-parked flag, then data at kShmHdr.  Cursors are monotonic
+// byte counts; the ring is a plain byte stream, so the 12-byte frame
+// header + tag demux above it are unchanged between transports.
+constexpr uint64_t kShmMagic = 0x746675745f736d68ULL;  // "hms_tuft" LE
+constexpr size_t kShmHdr = 64;
+constexpr size_t kShmTokenOff = 8;
+constexpr size_t kShmHeadOff = 16;
+constexpr size_t kShmTailOff = 24;
+constexpr size_t kShmPoisonOff = 32;
+constexpr size_t kShmConsWaitOff = 40;
+constexpr size_t kShmProdWaitOff = 44;
+
+inline std::atomic<uint64_t>* ShmU64(uint8_t* base, size_t off) {
+  return reinterpret_cast<std::atomic<uint64_t>*>(base + off);
+}
+
+inline std::atomic<uint32_t>* ShmU32(uint8_t* base, size_t off) {
+  return reinterpret_cast<std::atomic<uint32_t>*>(base + off);
+}
+
+#ifdef __linux__
+// Shared (cross-process) futex on the LOW 32 bits of a cursor: any
+// advance changes the low word (increments are < the segment capacity,
+// far below 2^32), so waiting for "head moved" is FUTEX_WAIT on
+// head's low half.  Little-endian only — which is every TPU/x86/arm64
+// host this runs on.
+inline uint32_t* ShmFutexWord(uint8_t* base, size_t off) {
+  return reinterpret_cast<uint32_t*>(base + off);
+}
+
+inline void ShmFutexWaitLow(uint8_t* base, size_t off, uint32_t seen,
+                            long timeout_ns) {
+  struct timespec ts = {0, timeout_ns};
+  ::syscall(SYS_futex, ShmFutexWord(base, off), FUTEX_WAIT, seen, &ts,
+            nullptr, 0);
+}
+
+inline void ShmFutexWake(uint8_t* base, size_t off) {
+  ::syscall(SYS_futex, ShmFutexWord(base, off), FUTEX_WAKE, 1, nullptr,
+            nullptr, 0);
+}
+#endif
+
+// Producer/consumer side of the cursor-advance wakeup: after publishing a
+// cursor move, wake the peer IF (and only if) it declared itself parked —
+// the flag check keeps the fast path syscall-free.  seq_cst fence pairs
+// with the waiter's flag-store/cursor-recheck ordering so a wake cannot
+// be missed between "peer checked cursor" and "peer parked".
+inline void ShmWakePeer(uint8_t* base, size_t cursor_off, size_t flag_off) {
+#ifdef __linux__
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  // exchange (not load) makes the wake one-shot: a burst of cursor
+  // advances while the peer is still coming out of futex_wait fires a
+  // single syscall, not one per advance.
+  if (ShmU32(base, flag_off)->exchange(0, std::memory_order_seq_cst) != 0) {
+    ShmFutexWake(base, cursor_off);
+  }
+#else
+  (void)base; (void)cursor_off; (void)flag_off;
+#endif
+}
+
 // The one sanctioned writer of RingLink::{dead, dead_reason}: the reason
 // lands under dead_mu before dead's release-store, so readers that observe
 // dead == true read the (now immutable) reason without a lock.
@@ -56,6 +130,15 @@ void PoisonLink(RingLink* l, const std::string& why) {
   if (l->dead.load(std::memory_order_relaxed)) return;
   l->dead_reason = why;
   l->dead.store(true, std::memory_order_release);
+  // Cross-process fail-fast: a poisoned shm lane flips the segment flag so
+  // the PEER's wait loop bails now instead of waiting out the socket FIN.
+  if (l->shm != nullptr) {
+    ShmU32(l->shm, kShmPoisonOff)->store(1, std::memory_order_release);
+    // A parked peer is waiting on a cursor futex; kick both so the abort
+    // is seen now rather than after the 2 ms park timeout.
+    ShmWakePeer(l->shm, kShmHeadOff, kShmConsWaitOff);
+    ShmWakePeer(l->shm, kShmTailOff, kShmProdWaitOff);
+  }
 }
 
 void PutHdr(uint8_t* hdr, uint32_t tag, uint64_t nbytes) {
@@ -104,8 +187,9 @@ inline float CombineOne(int op, float a, float b) {
 // collectives.quantize_int8, bit for bit: scale = amax/127 computed in
 // double then narrowed to f32 (both the frame header pack and numpy's weak
 // scalar promotion narrow the same way); round-to-nearest-even; NaN -> 0,
-// inf saturates via the nan_to_num + clip pair.
-inline float Int8Scale(const float* x, size_t n) {
+// inf saturates via the nan_to_num + clip pair.  Int4Scale/Int4Encode are
+// the amax/7 nibble-packed twins (collectives.quantize_int4).
+inline float AbsMax(const float* x, size_t n, int* has_nan_out) {
   float amax = 0.0f;
   int has_nan = 0;
   size_t i = 0;
@@ -134,8 +218,22 @@ inline float Int8Scale(const float* x, size_t n) {
     has_nan |= (a != a);
     amax = (a > amax) ? a : amax;
   }
+  *has_nan_out = has_nan;
+  return amax;
+}
+
+inline float Int8Scale(const float* x, size_t n) {
+  int has_nan = 0;
+  float amax = AbsMax(x, n, &has_nan);
   if (has_nan || !(amax > 0.0f) || !std::isfinite(amax)) return 1.0f;
   return static_cast<float>(static_cast<double>(amax) / 127.0);
+}
+
+inline float Int4Scale(const float* x, size_t n) {
+  int has_nan = 0;
+  float amax = AbsMax(x, n, &has_nan);
+  if (has_nan || !(amax > 0.0f) || !std::isfinite(amax)) return 1.0f;
+  return static_cast<float>(static_cast<double>(amax) / 7.0);
 }
 
 inline void Int8Encode(const float* x, size_t n, uint8_t* dst) {
@@ -176,6 +274,35 @@ inline void Int8Encode(const float* x, size_t n, uint8_t* dst) {
     v = v < -127.0f ? -127.0f : v;
     q[i] = static_cast<int8_t>(std::rint(v));
   }
+}
+
+// Nibble-packed 4-bit frame: 4-byte f32 scale, then ceil(n/2) bytes with
+// element 2i in the low nibble and 2i+1 in the high nibble, two's
+// complement in [-7, 7].  Same clamp-then-round equivalence as Int8Encode.
+inline void Int4Encode(const float* x, size_t n, uint8_t* dst) {
+  float s = Int4Scale(x, n);
+  memcpy(dst, &s, 4);
+  uint8_t* q = dst + 4;
+  auto quant = [&](size_t i) -> int {
+    float v = x[i] / s;
+    v = (v != v) ? 0.0f : v;
+    v = v > 7.0f ? 7.0f : v;
+    v = v < -7.0f ? -7.0f : v;
+    return static_cast<int>(std::rint(v));
+  };
+  size_t pairs = n / 2;
+  for (size_t i = 0; i < pairs; ++i) {
+    q[i] = static_cast<uint8_t>((quant(2 * i) & 0xF) |
+                                ((quant(2 * i + 1) & 0xF) << 4));
+  }
+  if (n & 1) q[pairs] = static_cast<uint8_t>(quant(n - 1) & 0xF);
+}
+
+// Sign-extends one packed nibble (index parity picks the half).
+inline float Int4Deq(const uint8_t* q, uint64_t i, float s) {
+  uint8_t b = q[i >> 1];
+  int nib = (i & 1) ? (b >> 4) : (b & 0xF);
+  return static_cast<float>((nib ^ 8) - 8) * s;
 }
 
 }  // namespace
@@ -253,10 +380,177 @@ struct RingSendJob {
 
 namespace {
 
+// One wait slice of a blocked shm producer/consumer: cheap flag checks on
+// every call, then (past the spin budget) a deadline check plus a socket
+// liveness probe — the TCP connection carries no frames on an shm lane, so
+// readability is either EOF (peer process gone: SIGKILL's only signal) or
+// a protocol violation.  A local shutdown() (Close/_fail_ring) flips
+// l->dead first, so aborts wake blocked shm ops exactly like tcp ones.
+// For the CONSUMER, peer-death signals (poison, EOF) only fail once the
+// ring is drained: the producer's final frames land in the ring before its
+// close poisons the segment, exactly like bytes sitting in a closed TCP
+// socket's buffer — the peer re-checks availability before dying.
+RingStatus ShmWaitSlice(RingLink* l, int* spins, double deadline,
+                        std::string* err, bool consumer) {
+  auto drainable = [l, consumer]() {
+    return consumer &&
+           ShmU64(l->shm, kShmHeadOff)->load(std::memory_order_acquire) !=
+               ShmU64(l->shm, kShmTailOff)->load(std::memory_order_relaxed);
+  };
+  if (l->dead.load(std::memory_order_acquire)) {
+    *err = l->dead_reason.empty() ? "peer connection closed" : l->dead_reason;
+    return RingStatus::kClosed;
+  }
+  if (ShmU32(l->shm, kShmPoisonOff)->load(std::memory_order_acquire) != 0) {
+    if (drainable()) return RingStatus::kOk;
+    *err = "shm segment poisoned by peer";
+    return RingStatus::kClosed;
+  }
+  if (++*spins < 512) {
+    std::this_thread::yield();
+    return RingStatus::kOk;
+  }
+  *spins = 0;
+  if (NowS() >= deadline) {
+    *err = "shm ring timed out";
+    return RingStatus::kTimeout;
+  }
+  struct pollfd p = {l->fd, POLLIN, 0};
+  int pr = ::poll(&p, 1, 0);
+  if (pr > 0 && (p.revents & (POLLIN | POLLERR | POLLHUP)) != 0) {
+    char c;
+    ssize_t r = ::recv(l->fd, &c, 1, MSG_DONTWAIT | MSG_PEEK);
+    if (r == 0) {
+      if (drainable()) return RingStatus::kOk;
+      *err = "peer connection closed";
+      return RingStatus::kClosed;
+    }
+    if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+      if (drainable()) return RingStatus::kOk;
+      *err = std::string("peer connection closed: ") + strerror(errno);
+      return RingStatus::kClosed;
+    }
+    if (r > 0) {
+      *err = "unexpected socket data on shm lane";
+      return RingStatus::kError;
+    }
+  }
+#ifdef __linux__
+  // Park on the peer-advanced cursor instead of burning the scheduler:
+  // the consumer sleeps until head moves, the producer until tail moves.
+  // Dekker-style handshake with ShmWakePeer — flag store and condition
+  // re-check are seq_cst-fenced so either the waker sees our parked flag
+  // or we see its cursor advance; the kernel's FUTEX_WAIT value check
+  // closes the capture-to-sleep gap.  The 2 ms timeout bounds latency
+  // against peers that never futex_wake (the Python engine's _ShmRing,
+  // or a dead peer whose EOF the next liveness poll catches).
+  const size_t watch_off = consumer ? kShmHeadOff : kShmTailOff;
+  const size_t flag_off = consumer ? kShmConsWaitOff : kShmProdWaitOff;
+  std::atomic<uint32_t>* flag = ShmU32(l->shm, flag_off);
+  flag->store(1, std::memory_order_seq_cst);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  const uint32_t seen = static_cast<uint32_t>(
+      ShmU64(l->shm, watch_off)->load(std::memory_order_relaxed));
+  const uint64_t h = ShmU64(l->shm, kShmHeadOff)->load(std::memory_order_acquire);
+  const uint64_t t = ShmU64(l->shm, kShmTailOff)->load(std::memory_order_acquire);
+  const bool ready = consumer ? (h != t)
+                              : (static_cast<size_t>(h - t) < l->shm_cap);
+  const bool poisoned =
+      ShmU32(l->shm, kShmPoisonOff)->load(std::memory_order_acquire) != 0 ||
+      l->dead.load(std::memory_order_acquire);
+  if (!ready && !poisoned) {
+    ShmFutexWaitLow(l->shm, watch_off, seen, 2 * 1000 * 1000);
+  }
+  flag->store(0, std::memory_order_release);
+#else
+  std::this_thread::sleep_for(std::chrono::microseconds(20));
+#endif
+  return RingStatus::kOk;
+}
+
+// Producer side: copies the iovec set into the SPSC byte ring (wrap-aware,
+// partial writes allowed — frames larger than the segment flow in pieces),
+// refreshing the progress deadline on every advance like the socket path.
+RingStatus ShmWriteAll(RingLink* l, struct iovec* iov, int iovcnt,
+                       double timeout_s, std::string* err) {
+  std::atomic<uint64_t>* head = ShmU64(l->shm, kShmHeadOff);
+  std::atomic<uint64_t>* tail = ShmU64(l->shm, kShmTailOff);
+  uint8_t* data = l->shm + kShmHdr;
+  const size_t cap = l->shm_cap;
+  double deadline = NowS() + timeout_s;
+  int spins = 0;
+  for (int idx = 0; idx < iovcnt; ++idx) {
+    const uint8_t* src = static_cast<const uint8_t*>(iov[idx].iov_base);
+    size_t left = iov[idx].iov_len;
+    while (left > 0) {
+      uint64_t h = head->load(std::memory_order_relaxed);
+      uint64_t t = tail->load(std::memory_order_acquire);
+      size_t free_b = cap - static_cast<size_t>(h - t);
+      if (free_b == 0) {
+        RingStatus st = ShmWaitSlice(l, &spins, deadline, err, false);
+        if (st != RingStatus::kOk) return st;
+        continue;
+      }
+      size_t nwr = std::min(left, free_b);
+      size_t pos = static_cast<size_t>(h % cap);
+      size_t first = std::min(nwr, cap - pos);
+      memcpy(data + pos, src, first);
+      memcpy(data, src + first, nwr - first);
+      head->store(h + nwr, std::memory_order_release);
+      ShmWakePeer(l->shm, kShmHeadOff, kShmConsWaitOff);
+      src += nwr;
+      left -= nwr;
+      l->bytes += static_cast<uint64_t>(nwr);
+      deadline = NowS() + timeout_s;
+      spins = 0;
+    }
+  }
+  return RingStatus::kOk;
+}
+
+// Consumer side of the SPSC byte ring.
+RingStatus ShmReadExact(RingLink* l, uint8_t* dst, size_t n, double timeout_s,
+                        std::string* err, size_t* got_out = nullptr) {
+  std::atomic<uint64_t>* head = ShmU64(l->shm, kShmHeadOff);
+  std::atomic<uint64_t>* tail = ShmU64(l->shm, kShmTailOff);
+  uint8_t* data = l->shm + kShmHdr;
+  const size_t cap = l->shm_cap;
+  double deadline = NowS() + timeout_s;
+  int spins = 0;
+  size_t got = 0;
+  while (got < n) {
+    uint64_t t = tail->load(std::memory_order_relaxed);
+    uint64_t h = head->load(std::memory_order_acquire);
+    size_t avail = static_cast<size_t>(h - t);
+    if (avail == 0) {
+      RingStatus st = ShmWaitSlice(l, &spins, deadline, err, true);
+      if (st != RingStatus::kOk) {
+        if (got_out) *got_out = got;
+        return st;
+      }
+      continue;
+    }
+    size_t nrd = std::min(n - got, avail);
+    size_t pos = static_cast<size_t>(t % cap);
+    size_t first = std::min(nrd, cap - pos);
+    memcpy(dst + got, data + pos, first);
+    memcpy(dst + got + first, data, nrd - first);
+    tail->store(t + nrd, std::memory_order_release);
+    ShmWakePeer(l->shm, kShmTailOff, kShmProdWaitOff);
+    got += nrd;
+    l->bytes += static_cast<uint64_t>(nrd);
+    deadline = NowS() + timeout_s;
+    spins = 0;
+  }
+  if (got_out) *got_out = got;
+  return RingStatus::kOk;
+}
+
 // Writes the full iovec set with MSG_DONTWAIT + poll, refreshing the
 // progress deadline on every advance (the Python socket-timeout model).
 RingStatus WriteAll(RingLink* l, struct iovec* iov, int iovcnt, double timeout_s,
                     std::string* err) {
+  if (l->shm != nullptr) return ShmWriteAll(l, iov, iovcnt, timeout_s, err);
   double deadline = NowS() + timeout_s;
   int idx = 0;
   while (idx < iovcnt) {
@@ -314,6 +608,7 @@ RingStatus WriteAll(RingLink* l, struct iovec* iov, int iovcnt, double timeout_s
 
 RingStatus ReadExact(RingLink* l, uint8_t* dst, size_t n, double timeout_s,
                      std::string* err, size_t* got_out = nullptr) {
+  if (l->shm != nullptr) return ShmReadExact(l, dst, n, timeout_s, err, got_out);
   double deadline = NowS() + timeout_s;
   size_t got = 0;
   while (got < n) {
@@ -623,6 +918,57 @@ bool RingEngine::SetTier(int tier, int nlanes, const int32_t* next_fds,
   return true;
 }
 
+bool RingEngine::SetShm(int tier, int direction, int lane, const char* path,
+                        uint64_t token, std::string* err) {
+  if (closed_.load()) {
+    *err = "ring engine closed";
+    return false;
+  }
+  RingLink* l = link(tier, direction, lane);
+  if (l == nullptr) {
+    *err = "no such tier/lane";
+    return false;
+  }
+  if (l->shm != nullptr) {
+    *err = "shm already attached";
+    return false;
+  }
+  // Plain open of the /dev/shm path (the Python side created it there —
+  // same file shm_open names, without the librt dependency).
+  int fd = ::open(path, O_RDWR | O_CLOEXEC);
+  if (fd < 0) {
+    *err = std::string("shm open: ") + strerror(errno);
+    return false;
+  }
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) <= kShmHdr) {
+    ::close(fd);
+    *err = "shm segment truncated";
+    return false;
+  }
+  size_t len = static_cast<size_t>(st.st_size);
+  void* m = ::mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (m == MAP_FAILED) {
+    *err = std::string("shm mmap: ") + strerror(errno);
+    return false;
+  }
+  uint8_t* base = static_cast<uint8_t*>(m);
+  // Generation guard: only the segment minted for THIS rendezvous (magic +
+  // negotiated token) is ever attached — a dead peer's stale segment has a
+  // different token and is refused here.
+  if (ShmU64(base, 0)->load(std::memory_order_acquire) != kShmMagic ||
+      ShmU64(base, kShmTokenOff)->load(std::memory_order_acquire) != token) {
+    ::munmap(m, len);
+    *err = "stale shm segment (generation mismatch)";
+    return false;
+  }
+  l->shm = base;
+  l->shm_len = len;
+  l->shm_cap = len - kShmHdr;
+  return true;
+}
+
 void RingEngine::Close() {
   std::lock_guard<std::mutex> lk(close_mu_);
   if (closed_.exchange(true)) {
@@ -653,6 +999,18 @@ void RingEngine::Close() {
       l->rcv.notify_all();
     }
   }
+  // Multi-stripe pool: poisoned links make in-flight batch stripes fail
+  // fast, so the join is bounded like the sender joins below.
+  {
+    std::lock_guard<std::mutex> mlk(mw_mu_);
+    mw_stop_ = true;
+    mw_queue_.clear();  // callers complete their batches inline
+  }
+  mw_cv_.notify_all();
+  for (auto& th : mw_threads_) {
+    if (th.joinable()) th.join();
+  }
+  mw_threads_.clear();
   // Phase 2: wait (bounded) for in-flight ops to drain, join senders,
   // close the dup'd fds.
   double deadline = NowS() + 2.0;
@@ -672,6 +1030,25 @@ void RingEngine::Close() {
       if (l->fd >= 0) {
         ::close(l->fd);
         l->fd = -1;
+      }
+    }
+  }
+  // Unmap shm segments only once the op drain succeeded — a straggler op
+  // past the deadline keeps its (leaked) mapping rather than faulting.
+  if (active_ops_.load() == 0) {
+    for (auto& t : tiers_) {
+      if (!t.present) continue;
+      for (auto& l : t.next) {
+        if (l->shm != nullptr) {
+          ::munmap(l->shm, l->shm_len);
+          l->shm = nullptr;
+        }
+      }
+      for (auto& l : t.prev) {
+        if (l->shm != nullptr) {
+          ::munmap(l->shm, l->shm_len);
+          l->shm = nullptr;
+        }
       }
     }
   }
@@ -883,6 +1260,8 @@ RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
         return static_cast<size_t>(elems) * 2;
       case kWireInt8:
         return 4 + static_cast<size_t>(elems);
+      case kWireInt4:
+        return 4 + (static_cast<size_t>(elems) + 1) / 2;
       default:
         return static_cast<size_t>(elems) * 4;
     }
@@ -896,6 +1275,10 @@ RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
       uint16_t* o = reinterpret_cast<uint16_t*>(dst);
       for (uint64_t i = 0; i < elems; ++i) o[i] = F32ToBf16(src[i]);
       return static_cast<size_t>(elems) * 2;
+    }
+    if (wire == kWireInt4) {
+      Int4Encode(src, static_cast<size_t>(elems), dst);
+      return 4 + (static_cast<size_t>(elems) + 1) / 2;
     }
     Int8Encode(src, static_cast<size_t>(elems), dst);
     return 4 + static_cast<size_t>(elems);
@@ -928,6 +1311,17 @@ RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
           dst[i] = CombineOne(op, dst[i], static_cast<float>(q[i]) * s);
         }
       }
+    } else if (wire == kWireInt4) {
+      float s;
+      memcpy(&s, raw, 4);
+      const uint8_t* q = raw + 4;
+      if (op == kOpSum) {
+        for (uint64_t i = 0; i < elems; ++i) dst[i] += Int4Deq(q, i, s);
+      } else {
+        for (uint64_t i = 0; i < elems; ++i) {
+          dst[i] = CombineOne(op, dst[i], Int4Deq(q, i, s));
+        }
+      }
     } else {
       const float* in = reinterpret_cast<const float*>(raw);
       if (op == kOpSum) {
@@ -943,6 +1337,11 @@ RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
     if (wire == kWireBf16) {
       const uint16_t* in = reinterpret_cast<const uint16_t*>(raw);
       for (uint64_t i = 0; i < elems; ++i) dst[i] = Bf16ToF32(in[i]);
+    } else if (wire == kWireInt4) {
+      float s;
+      memcpy(&s, raw, 4);
+      const uint8_t* q = raw + 4;
+      for (uint64_t i = 0; i < elems; ++i) dst[i] = Int4Deq(q, i, s);
     } else {
       float s;
       memcpy(&s, raw, 4);
@@ -1055,6 +1454,145 @@ RingStatus RingEngine::RingPass(int tier, int lane, int n, int rank,
     decode_assign(arena + off[i], chunk_elems[i], chunk_ptrs[i]);
   }
   return RingStatus::kOk;
+}
+
+// ---------------------------------------------------------------------------
+// Batched multi-stripe pass (one capi crossing per op)
+// ---------------------------------------------------------------------------
+
+// One op's whole stripe set.  Workers claim stripes off `next`; the caller
+// thread claims too, so the op progresses even with every pool worker busy
+// on other ops' batches.  Args are copied in so a straggler pool task that
+// pops the batch after completion touches only live memory.
+struct RingEngine::MultiBatch {
+  int tier = 0, nstripes = 0, n = 0, rank = 0, mode = 0, op = 0, wire = 0;
+  uint32_t rs_sub = 0, ag_sub = 0;
+  std::vector<int32_t> lanes;
+  std::vector<uint32_t> tag_bases;
+  std::vector<uint64_t> ptrs, elems;
+  double timeout_s = 0;
+  std::atomic<int> next{0};
+  std::mutex mu;
+  std::condition_variable cv;
+  int done = 0;
+  RingStatus st = RingStatus::kOk;
+  std::string err;
+};
+
+void RingEngine::RunBatchClaims(const std::shared_ptr<MultiBatch>& b) {
+  for (;;) {
+    int s = b->next.fetch_add(1, std::memory_order_relaxed);
+    if (s >= b->nstripes) return;
+    std::string werr;
+    RingStatus st = RingPass(
+        b->tier, b->lanes[static_cast<size_t>(s)], b->n, b->rank,
+        b->tag_bases[static_cast<size_t>(s)], b->rs_sub, b->ag_sub, b->mode,
+        b->op, b->wire,
+        reinterpret_cast<float* const*>(b->ptrs.data() +
+                                        static_cast<size_t>(s) * b->n),
+        b->elems.data() + static_cast<size_t>(s) * b->n, b->timeout_s, &werr);
+    bool first_fail = false;
+    {
+      std::lock_guard<std::mutex> lk(b->mu);
+      if (st != RingStatus::kOk && b->st == RingStatus::kOk) {
+        b->st = st;
+        b->err = werr;
+        first_fail = true;
+      }
+      ++b->done;
+    }
+    if (first_fail && b->tier >= 0 && b->tier < kNumTiers) {
+      // Mirror _run_striped's _fail_ring: poison + shut down every lane of
+      // the tier so sibling stripes (and the peer) fail fast instead of
+      // each waiting out its own timeout.
+      Tier* t = &tiers_[b->tier];
+      for (auto& l : t->next) {
+        PoisonLink(l.get(), "stripe sibling failed: " + werr);
+        if (l->fd >= 0) ::shutdown(l->fd, SHUT_RDWR);
+        l->qcv.notify_all();
+      }
+      for (auto& l : t->prev) {
+        PoisonLink(l.get(), "stripe sibling failed: " + werr);
+        if (l->fd >= 0) ::shutdown(l->fd, SHUT_RDWR);
+        l->rcv.notify_all();
+      }
+    }
+    b->cv.notify_all();
+  }
+}
+
+void RingEngine::MultiWorkerLoop() {
+  for (;;) {
+    std::shared_ptr<MultiBatch> b;
+    {
+      std::unique_lock<std::mutex> lk(mw_mu_);
+      mw_cv_.wait(lk, [&] { return mw_stop_ || !mw_queue_.empty(); });
+      if (mw_stop_) return;  // callers finish their batches inline
+      b = mw_queue_.front();
+      mw_queue_.pop_front();
+    }
+    RunBatchClaims(b);
+  }
+}
+
+void RingEngine::EnsureMultiPool() {
+  std::lock_guard<std::mutex> lk(mw_mu_);
+  if (!mw_threads_.empty() || mw_stop_) return;
+  int nw = std::max(1, std::min(lanes_ * 2, 16));
+  for (int i = 0; i < nw; ++i) {
+    mw_threads_.emplace_back([this] { MultiWorkerLoop(); });
+  }
+}
+
+RingStatus RingEngine::RingPassMulti(int tier, int nstripes, int n, int rank,
+                                     const int32_t* lanes,
+                                     const uint32_t* tag_bases, uint32_t rs_sub,
+                                     uint32_t ag_sub, int mode, int op,
+                                     int wire, const uint64_t* chunk_ptrs,
+                                     const uint64_t* chunk_elems,
+                                     double timeout_s, std::string* err) {
+  if (nstripes < 1 || n < 1) {
+    *err = "bad stripe set";
+    return RingStatus::kError;
+  }
+  if (closed_.load()) {
+    *err = "ring engine closed";
+    return RingStatus::kClosed;
+  }
+  OpGuard guard(&active_ops_);
+  auto b = std::make_shared<MultiBatch>();
+  b->tier = tier;
+  b->nstripes = nstripes;
+  b->n = n;
+  b->rank = rank;
+  b->mode = mode;
+  b->op = op;
+  b->wire = wire;
+  b->rs_sub = rs_sub;
+  b->ag_sub = ag_sub;
+  b->timeout_s = timeout_s;
+  b->lanes.assign(lanes, lanes + nstripes);
+  b->tag_bases.assign(tag_bases, tag_bases + nstripes);
+  size_t total = static_cast<size_t>(nstripes) * static_cast<size_t>(n);
+  b->ptrs.assign(chunk_ptrs, chunk_ptrs + total);
+  b->elems.assign(chunk_elems, chunk_elems + total);
+  if (nstripes > 1) {
+    EnsureMultiPool();
+    {
+      std::lock_guard<std::mutex> lk(mw_mu_);
+      if (!mw_stop_) {
+        int helpers =
+            std::min(nstripes - 1, static_cast<int>(mw_threads_.size()));
+        for (int i = 0; i < helpers; ++i) mw_queue_.push_back(b);
+      }
+    }
+    mw_cv_.notify_all();
+  }
+  RunBatchClaims(b);
+  std::unique_lock<std::mutex> lk(b->mu);
+  b->cv.wait(lk, [&] { return b->done >= b->nstripes; });
+  if (b->st != RingStatus::kOk) *err = b->err;
+  return b->st;
 }
 
 int RingEngine::Counters(int tier, uint64_t* sent, uint64_t* recv, int cap) {

@@ -56,8 +56,10 @@ enum RingOp : int { kOpSum = 0, kOpMax = 1, kOpMin = 2 };
 // kWireBf16 casts f32 -> bfloat16 (round-to-nearest-even, ml_dtypes
 // bit-compatible) per hop with f32 accumulation; kWireInt8 frames a 4-byte
 // f32 scale followed by symmetric int8 values (scale = amax/127), matching
-// collectives.quantize_int8 bit for bit.
-enum RingWire : int { kWireRaw = 0, kWireBf16 = 1, kWireInt8 = 2 };
+// collectives.quantize_int8 bit for bit; kWireInt4 frames the 4-byte f32
+// scale (amax/7) followed by two's-complement nibble pairs (even element in
+// the low nibble), matching collectives.quantize_int4 bit for bit.
+enum RingWire : int { kWireRaw = 0, kWireBf16 = 1, kWireInt8 = 2, kWireInt4 = 3 };
 
 // Shared virtual-time pacer for one tier-direction (LinkShaper's model):
 // concurrent lanes queue on the modeled link, so lanes can only win by
@@ -134,6 +136,15 @@ struct RingLink {
   std::condition_variable rcv;
   bool reading = false;
   std::map<uint32_t, std::deque<std::string>> stash;
+
+  // Same-host shared-memory transport (TPUFT_RING_TRANSPORT): when a
+  // segment is attached, frame bytes move through its lock-free SPSC byte
+  // ring instead of the socket.  The socket stays open as the liveness /
+  // abort channel — the shm wait loops poll it, so a dead peer or a local
+  // shutdown() wakes a blocked op exactly like the tcp path.
+  uint8_t* shm = nullptr;  // mapped segment base (64-byte header + data)
+  size_t shm_cap = 0;      // data capacity (mapping length - header)
+  size_t shm_len = 0;      // full mapping length (for munmap)
 };
 
 class RingEngine {
@@ -178,6 +189,29 @@ class RingEngine {
                       int wire, float* const* chunk_ptrs,
                       const uint64_t* chunk_elems, double timeout_s,
                       std::string* err);
+
+  // Attaches a negotiated same-host shared-memory segment to one lane link
+  // (direction 0 = next/producer, 1 = prev/consumer).  `path` is the
+  // filesystem path of the segment (under /dev/shm); `token` must match
+  // the segment's generation header or the attach is refused — a dead
+  // peer's stale segment is never re-attached.  The link's socket remains
+  // open as the liveness channel.
+  bool SetShm(int tier, int direction, int lane, const char* path,
+              uint64_t token, std::string* err);
+
+  // Batched ring passes: the whole stripe set of one op in a single call
+  // (one capi crossing instead of one per stripe), fanned out to the
+  // engine's persistent internal workers.  Per stripe s: lane lanes[s],
+  // tag base tag_bases[s], chunk views chunk_ptrs/chunk_elems[s*n..].
+  // The first failing stripe's status is returned, and the tier's links
+  // are poisoned on first failure so sibling stripes fail fast — the same
+  // fate _run_striped's _fail_ring imposes.
+  RingStatus RingPassMulti(int tier, int nstripes, int n, int rank,
+                           const int32_t* lanes, const uint32_t* tag_bases,
+                           uint32_t rs_sub, uint32_t ag_sub, int mode, int op,
+                           int wire, const uint64_t* chunk_ptrs,
+                           const uint64_t* chunk_elems, double timeout_s,
+                           std::string* err);
 
   // Per-lane wire-byte counters of one tier (lane_stats' feed).  Returns
   // the lane count written (0 for an unregistered tier).
@@ -255,6 +289,19 @@ class RingEngine {
   // Folds one completed hop into the per-tier aggregates and (sampled)
   // the bounded timeline ring.
   void RecordHop(const RingHopRecord& rec);
+
+  // Persistent multi-stripe worker pool (RingPassMulti's fan-out).  Long
+  // lived so the per-thread codec scratch (thread_local in RingPass)
+  // amortizes across ops, like the Python engine's lane executor threads.
+  struct MultiBatch;
+  void EnsureMultiPool();
+  void MultiWorkerLoop();
+  void RunBatchClaims(const std::shared_ptr<MultiBatch>& batch);
+  std::mutex mw_mu_;
+  std::condition_variable mw_cv_;
+  std::deque<std::shared_ptr<MultiBatch>> mw_queue_;
+  std::vector<std::thread> mw_threads_;
+  bool mw_stop_ = false;
 
   int lanes_;
   double mbps_, rtt_ms_;

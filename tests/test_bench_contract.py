@@ -228,6 +228,87 @@ def test_ring_engine_quick_smoke() -> None:
     assert summary["engine_parity_bitwise"] is True
 
 
+def test_transport_quick_smoke() -> None:
+    """Same-host transport tier-1 gate: one live shm-vs-tcp A/B cell
+    (bench_allreduce.run_transport_quick), the bitwise transport-parity
+    pin, the one-call multi-stripe pin (one Python<->native crossing per
+    allreduce, call count asserted), and the committed
+    ALLREDUCE_BENCH.json transport schema.  The shm >= tcp throughput
+    gate applies only on multi-core hosts: on a single core both
+    transports bottleneck on scheduler alternation and the ratio is
+    noise around 1.0 (the cell records cpu_count for exactly this)."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_allreduce
+    finally:
+        sys.path.pop(0)
+
+    payload = bench_allreduce.run_transport_quick(
+        payload_mb=4.0, lanes=2, trials=2
+    )
+    by_transport = {c["transport"]: c for c in payload["cells"]}
+    assert set(by_transport) == {"tcp", "shm"}
+    for cell in by_transport.values():
+        assert cell["gb_per_s"] > 0 and cell["wall_s"] > 0
+    # Same frames either way: the transport is a pure data-plane swap.
+    assert (by_transport["tcp"]["lane_bytes_sent"]
+            == by_transport["shm"]["lane_bytes_sent"])
+    assert payload["parity_bitwise"] is True
+    assert payload["shm_speedup"] > 0
+    if (payload.get("cpu_count") or 1) > 1:
+        assert payload["shm_ok"], payload["shm_speedup"]
+    ms = payload["multi_stripe"]
+    if ms is not None:  # native engine present
+        assert ms["stripes_per_op"] > 1
+        assert ms["pass_calls"] == ms["ops"], ms
+        assert ms["one_call_per_op"] is True
+
+    # The committed artifact carries the transport A/B + multi-stripe cell.
+    with open(os.path.join(REPO, "ALLREDUCE_BENCH.json")) as f:
+        artifact = json.load(f)
+    transport_records = [
+        r for r in artifact["results"] if r.get("section") == "transport"
+    ]
+    assert transport_records, "no transport cell in ALLREDUCE_BENCH.json"
+    rec = transport_records[0]
+    assert {c["transport"] for c in rec["cells"]} == {"tcp", "shm"}
+    assert rec["parity_bitwise"] is True
+    assert rec["multi_stripe"]["one_call_per_op"] is True
+    summary = artifact["summary"]
+    assert summary["transport_parity_bitwise"] is True
+    assert summary["shm_speedup"] > 0
+    assert summary["multi_stripe_one_call_per_op"] is True
+
+
+def test_parity_matrix_axes_static_audit() -> None:
+    """Static audit of the engine parity matrix's axis coverage: the
+    bitwise pin in tests/test_ring_engine.py must exercise every codec
+    the wire supports (f32 raw / bf16 / int8 / int4) and both lane
+    transports (tcp / shm) — an axis silently dropped from the live
+    matrix would let a codec or transport drift off the parity contract
+    without any test going red."""
+    with open(os.path.join(REPO, "tests", "test_ring_engine.py")) as f:
+        src = f.read()
+    run_ring = src.split("def _run_ring")[1].split("\ndef ")[0]
+    # Codec axis: every wire codec appears in the shared ring driver.
+    assert 'allow_wire_compression=False' in run_ring  # f32 raw framing
+    assert 'wire_dtype="bf16"' in run_ring
+    assert 'wire_codec="int8"' in run_ring
+    assert 'wire_codec="int4"' in run_ring
+    # Transport axis: the driver is transport-aware and a live test pins
+    # both transports bitwise for both engines.
+    assert "transport" in run_ring
+    assert "def test_transport_axis_parity_bitwise" in src
+    transport_test = src.split(
+        "def test_transport_axis_parity_bitwise"
+    )[1].split("\ndef ")[0]
+    assert '("tcp", "shm")' in transport_test
+    assert '("py", "native")' in transport_test
+    # Engine + topology axes: the original matrix still parametrizes both.
+    assert "def test_engine_parity_bitwise" in src
+    assert '"ring2d"' in src
+
+
 def test_ec_quick_smoke() -> None:
     """Erasure-coded healing tier-1 gate (bench_transfer.run_ec_quick at a
     small state size): the encode-overhead cell must show the donor-side
@@ -449,6 +530,14 @@ def test_diloco_quick_smoke() -> None:
     assert set(quant["drift_vs_f32"]) == {"bf16", "int8", "int8_noef"}
     assert quant["ef_bounds_drift"], quant
     assert quant["wire_ratio_int8"] <= 0.27, quant
+    # The 4-bit cell rides in its own keys (the drift_vs_f32 key set above
+    # is a pinned contract): packed wire <= 0.14x f32, EF bounds the
+    # no-EF drift, and the EF drift sits at the 127/7 step-ratio floor
+    # relative to int8 (no accumulation blowup).
+    assert set(quant["int4_drift_vs_f32"]) == {"int4", "int4_noef"}
+    assert quant["int4_ef_bounds_drift"], quant
+    assert quant["int4_drift_at_step_ratio_floor"], quant
+    assert quant["wire_ratio_int4"] <= 0.14, quant
     assert payload["ok"], payload
 
 

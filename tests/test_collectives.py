@@ -395,6 +395,48 @@ def test_large_buffer_allreduce(store) -> None:
     assert all(run_ranks(store, 2, body))
 
 
+def test_int4_pack_roundtrip_and_quantizer_guards() -> None:
+    """The nibble packing contract (elem 2i low nibble, 2i+1 high, two's
+    complement, odd tail zero-padded) and the shared quantizer guard
+    rules: inf saturates, NaN encodes 0, non-finite amax falls back to
+    scale 1."""
+    from torchft_tpu.collectives import pack_int4, quantize_int4, unpack_int4
+
+    rng = np.random.default_rng(3)
+    for n in (0, 1, 7, 8, 1001):
+        q = rng.integers(-7, 8, size=n).astype(np.int8)
+        packed = pack_int4(q)
+        assert packed.nbytes == (n + 1) // 2
+        np.testing.assert_array_equal(unpack_int4(packed, n), q)
+
+    x = np.array([0.0, 7.0, -7.0, 3.6, np.inf, -np.inf, np.nan],
+                 dtype=np.float32)
+    scale, q = quantize_int4(x)
+    assert scale == 1.0  # non-finite amax -> scale fallback
+    np.testing.assert_array_equal(q, [0, 7, -7, 4, 7, -7, 0])
+    scale, q = quantize_int4(np.array([-0.7, 0.7], dtype=np.float32))
+    assert scale == pytest.approx(0.1) and list(q) == [-7, 7]
+
+
+def test_wire_nbytes_counts_packed_int4() -> None:
+    """wire_nbytes is the single source of truth for wire-byte telemetry:
+    with wire_codec="int4" it must count the PACKED nibble bytes plus the
+    scale header (~0.125x f32) — never the int8 frame width — and only
+    for floating payloads (integers bypass the lossy wire)."""
+    c = TCPCollective(timeout=1.0, wire_dtype="f32")
+    try:
+        odd = np.zeros(1001, dtype=np.float32)
+        assert c.wire_nbytes(odd, True, "int8") == 1001 + 4
+        assert c.wire_nbytes(odd, True, "int4") == 501 + 4
+        even = np.zeros(4096, dtype=np.float32)
+        assert c.wire_nbytes(even, True, "int4") == 2048 + 4
+        assert c.wire_nbytes(even, True, "int4") / even.nbytes <= 0.14
+        ints = np.arange(64, dtype=np.int32)
+        assert c.wire_nbytes(ints, True, "int4") == ints.nbytes
+    finally:
+        c.shutdown()
+
+
 def test_shaped_link_halves_wire_bytes_with_bf16(store, monkeypatch) -> None:
     """Deterministic DCN-shaped validation: with the link shaper active
     (huge bandwidth so no real sleeping), the bf16 wire must move about

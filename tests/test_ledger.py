@@ -531,6 +531,58 @@ def test_worker_hop_histograms_monotonic_over_sliding_ring() -> None:
     assert count_of(third) == 15
 
 
+def test_hop_histograms_lane_split_and_tier_rollup() -> None:
+    """The lane axis: tpuft_hop_bytes emits one series per (tier, lane)
+    slot — the split that tells a striped ring's per-lane byte skew apart
+    from a uniform slowdown — while the per-tier families sum their lanes
+    so existing dashboards keep reading whole-tier totals.  Records from
+    engines predating the lane field fold into lane 0."""
+    import re as _re
+    import threading
+    from types import SimpleNamespace
+
+    from torchft_tpu.manager import Manager
+
+    window = [
+        {"ts": 100.0, "tier": 0, "lane": 0, "send_s": 0.001,
+         "recv_s": 0.001, "comb_s": 0.0, "nbytes": 1024},
+        {"ts": 101.0, "tier": 0, "lane": 1, "send_s": 0.001,
+         "recv_s": 0.001, "comb_s": 0.0, "nbytes": 2048},
+        # Pre-lane engine record: no "lane" key -> lane 0.
+        {"ts": 102.0, "tier": 0, "send_s": 0.001, "recv_s": 0.001,
+         "comb_s": 0.0, "nbytes": 512},
+    ]
+    fake = SimpleNamespace(
+        _collective=SimpleNamespace(hop_records=lambda: list(window)),
+        _replica_id="g0:lanes",
+        _hop_hist={},
+        _hop_hist_last_ts=0.0,
+        _hop_hist_lock=threading.Lock(),
+    )
+    text = Manager._render_hop_histograms(fake)
+
+    def lane_count(lane: str) -> int:
+        m = _re.search(
+            r'tpuft_hop_bytes_count\{[^}]*lane="%s"[^}]*tier="0"\} (\d+)'
+            % lane,
+            text,
+        ) or _re.search(
+            r'tpuft_hop_bytes_count\{[^}]*tier="0"[^}]*lane="%s"\} (\d+)'
+            % lane,
+            text,
+        )
+        assert m, (lane, text)
+        return int(m.group(1))
+
+    assert lane_count("0") == 2  # the lane-0 record + the pre-lane record
+    assert lane_count("1") == 1
+    # The per-tier rollup reads ALL lanes' records.
+    m = _re.search(
+        r'tpuft_worker_hop_wire_bytes_count\{[^}]*tier="0"\} (\d+)', text
+    )
+    assert m and int(m.group(1)) == 3, text
+
+
 # ---------------------------------------------------------------------------
 # Tier-1 live mini-cluster smoke
 # ---------------------------------------------------------------------------

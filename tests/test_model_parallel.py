@@ -244,7 +244,7 @@ def test_ft_step_auto_overlap_falls_back_when_memory_tight(monkeypatch) -> None:
     manager = create_autospec(Manager, instance=True)
     manager.num_participants.return_value = 2
     manager.timeout = timedelta(seconds=60)
-    manager.allreduce.side_effect = lambda arr, should_average=True: completed_future(
+    manager.allreduce.side_effect = lambda arr, should_average=True, **kw: completed_future(
         np.asarray(arr)
     )
     manager.should_commit.return_value = True
@@ -276,7 +276,7 @@ def test_ft_step_commit_gate() -> None:
     manager = create_autospec(Manager, instance=True)
     manager.num_participants.return_value = 2
     manager.timeout = timedelta(seconds=60)
-    manager.allreduce.side_effect = lambda arr, should_average=True: completed_future(
+    manager.allreduce.side_effect = lambda arr, should_average=True, **kw: completed_future(
         np.asarray(arr)
     )
 

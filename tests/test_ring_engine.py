@@ -69,10 +69,12 @@ def _run_ring(
     topology: Optional[str],
     engines: List[str],
     prefix: str,
+    transport: Optional[str] = None,
 ):
     """Runs every codec x payload combination on one ring (rank r uses
     ``engines[r]``); returns {rank: [outputs...]} plus the engine each
-    rank's configuration resolved to."""
+    rank's configuration resolved to.  ``transport`` pins the lane
+    transport (tcp / shm) and is asserted to have armed."""
     cols = [
         TCPCollective(
             timeout=30.0,
@@ -81,6 +83,7 @@ def _run_ring(
             topology=topology,
             engine=engines[r],
             chunk_bytes=4 << 10,  # several stripes even at small payloads
+            **({"transport": transport} if transport else {}),
         )
         for r in range(world)
     ]
@@ -91,15 +94,22 @@ def _run_ring(
         c = cols[rank]
         c.configure(f"{store.address()}/{prefix}", rank, world)
         resolved[rank] = c.ring_engine
+        if transport is not None:
+            assert c.ring_transport == transport, (
+                f"rank {rank}: transport={c.ring_transport} want {transport}"
+            )
         got: List[np.ndarray] = []
         for arrays in _payloads(rank, world):
             # f32 raw framing, the bf16 wire (avg covers the divide), and
-            # the int8 codec — one output list per hop codec.
+            # the int8 + int4 codecs — one output list per hop codec.
             got += c.allreduce(
                 arrays, op="sum", allow_wire_compression=False
             ).wait(timeout=30)
             got += c.allreduce(arrays, op="avg").wait(timeout=30)
             got += c.allreduce(arrays, op="sum", wire_codec="int8").wait(
+                timeout=30
+            )
+            got += c.allreduce(arrays, op="sum", wire_codec="int4").wait(
                 timeout=30
             )
         results[rank] = got
@@ -161,6 +171,43 @@ def test_mixed_engine_ring_interop(store) -> None:
     assert resolved == {0: "native", 1: "py"}
     for rank in range(2):
         _assert_bitwise(ref[rank], mixed[rank], f"mixed rank={rank}")
+
+
+def test_transport_axis_parity_bitwise(store) -> None:
+    """The transport axis of the parity matrix: shm lanes produce the
+    SAME BITS as tcp lanes for both engines (and hence across engines),
+    over every codec x payload combination — the pin that makes
+    TPUFT_RING_TRANSPORT a pure perf knob, exactly like engine
+    selection."""
+    outs = {}
+    for engine in ("py", "native"):
+        for transport in ("tcp", "shm"):
+            results, resolved = _run_ring(
+                store, 2, 2, None, [engine] * 2, fresh_prefix(),
+                transport=transport,
+            )
+            assert all(v == engine for v in resolved.values()), resolved
+            outs[(engine, transport)] = results
+    base = outs[("py", "tcp")]
+    for key, results in outs.items():
+        for rank in range(2):
+            _assert_bitwise(
+                base[rank], results[rank],
+                f"engine={key[0]} transport={key[1]} rank={rank}",
+            )
+
+
+def test_mixed_engine_shm_ring_interop(store) -> None:
+    """A native rank and a py rank on ONE shm ring: the native engine's
+    mmap'd producer/consumer and the Python _ShmRing speak the same
+    segment layout — bitwise equal to the all-py tcp reference."""
+    ref, _ = _run_ring(store, 2, 2, None, ["py", "py"], fresh_prefix())
+    mixed, resolved = _run_ring(
+        store, 2, 2, None, ["native", "py"], fresh_prefix(), transport="shm"
+    )
+    assert resolved == {0: "native", 1: "py"}
+    for rank in range(2):
+        _assert_bitwise(ref[rank], mixed[rank], f"mixed shm rank={rank}")
 
 
 def test_native_abort_sweeps_engine_fds_and_reconfigures(store) -> None:

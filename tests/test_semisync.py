@@ -177,6 +177,50 @@ def test_int8_codec_abort_resets_residual() -> None:
     assert codec.residual_l2() == 0.0
 
 
+def test_int4_codec_grid_and_ef() -> None:
+    """The 4-bit EF codec: the dequantized payload sits on the 15-level
+    int4 grid, its wire charge (via the collective's wire_nbytes, the
+    accounting single source of truth) counts packed nibbles at ~0.125x
+    f32, and the carried residual bounds drift exactly like int8's (EF
+    is what licenses the lossier wire)."""
+    from torchft_tpu.collectives import TCPCollective
+    from torchft_tpu.ddp import plan_buckets
+    from torchft_tpu.semisync.fragments import Fragment
+
+    n = 1001
+    frag = Fragment(0, plan_buckets([((n,), np.float32)], 1 << 30)[0])
+    codec = make_codec("int4", frag)
+    backup = np.zeros(n, dtype=np.float32)
+    codec.set_backup(backup)
+    rng = np.random.default_rng(11)
+    pg = (0.01 * rng.standard_normal(n)).astype(np.float32)
+    deq, d2h = codec.encode([backup - pg])
+    assert d2h == 0  # pure-host tree: nothing crossed the device boundary
+    # 15-level grid: every dequantized value is k * scale, k in [-7, 7].
+    assert len(np.unique(deq)) <= 15
+    # The ring charges this payload at the packed-nibble rate.
+    probe = TCPCollective(timeout=1.0, wire_dtype="f32")
+    try:
+        wire = probe.wire_nbytes(
+            deq, codec.allow_wire_compression, codec.wire_codec
+        )
+    finally:
+        probe.shutdown()
+    assert wire == (n + 1) // 2 + 4
+    assert wire / deq.nbytes <= 0.14
+    codec.on_commit()
+    assert codec.residual_l2() > 0.0
+    # EF: the residual re-enters the next round's transmission, so two
+    # rounds deliver (almost) the full signal where one round alone
+    # truncates it to the grid.
+    deq2, _ = codec.encode([backup - pg])  # same pg again
+    codec.on_commit()
+    two_round = deq.astype(np.float64) + deq2.astype(np.float64)
+    err_two = np.linalg.norm(two_round - 2.0 * pg)
+    err_naive = 2.0 * np.linalg.norm(deq - pg)
+    assert err_two < err_naive, (err_two, err_naive)
+
+
 # ---------------------------------------------------------------------------
 # fragment planning
 # ---------------------------------------------------------------------------
@@ -217,7 +261,7 @@ def test_codec_zero_payload_matches_encode_dtype() -> None:
     from torchft_tpu.semisync.fragments import Fragment
 
     frag = Fragment(0, plan_buckets([((32,), np.float32)], 1 << 20)[0])
-    for name in ("f32", "auto", "bf16", "int8"):
+    for name in ("f32", "auto", "bf16", "int8", "int4"):
         codec = make_codec(name, frag)
         codec.set_backup(np.zeros(32, dtype=np.float32))
         payload, _ = codec.encode([np.linspace(-1, 1, 32, dtype=np.float32)])
@@ -260,7 +304,7 @@ def _mock_manager(commit: bool = True):
     manager._use_async_quorum = False
     manager.timeout = timedelta(seconds=60)
     manager.allreduce.side_effect = (
-        lambda arr, should_average=True, allow_wire_compression=True: (
+        lambda arr, should_average=True, allow_wire_compression=True, donate=False: (
             completed_future(np.asarray(arr))
         )
     )

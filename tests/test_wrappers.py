@@ -24,10 +24,19 @@ def _mock_manager(num_participants: int = 2, commit: bool = True) -> MagicMock:
     manager._use_async_quorum = False
     manager.timeout = timedelta(seconds=60)
 
-    def fake_allreduce(arr, should_average: bool = True, allow_wire_compression: bool = True):
+    def fake_allreduce(
+        arr,
+        should_average: bool = True,
+        allow_wire_compression: bool = True,
+        donate: bool = False,
+    ):
         # Pretend every participant contributed identical values: the average
         # equals the input, so averaging is an identity we can verify around.
-        return completed_future(np.asarray(arr))
+        # Copy on donate: the real manager never returns the donated buffer
+        # itself on success (normalize allocates), and callers use identity
+        # with the input to detect the failure fallback.
+        out = np.asarray(arr)
+        return completed_future(out.copy() if donate and out is arr else out)
 
     manager.allreduce.side_effect = fake_allreduce
     return manager
@@ -86,6 +95,48 @@ def test_gradient_averager_roundtrip() -> None:
         np.testing.assert_allclose(np.asarray(out[k]), grads[k])
     # Small bucket size must have split the leaves into multiple allreduces.
     assert manager.allreduce.call_count >= 2
+
+
+def test_donated_buffer_failure_leaves_grads_intact() -> None:
+    """The caller-side pin of the donate contract: the wire stage donates
+    its staging buffer, so a latched collective failure — which resolves
+    the future to that SAME buffer, possibly half-reduced by the op —
+    must never be scattered back as gradients.  The original leaves come
+    home untouched and the commit vote fails; only a successful op's
+    freshly allocated result is unpacked."""
+    from torchft_tpu.ddp import GradientAverager
+
+    manager = _mock_manager()
+    seen = {}
+
+    def failing_allreduce(
+        arr,
+        should_average: bool = True,
+        allow_wire_compression: bool = True,
+        donate: bool = False,
+    ):
+        seen["donate"] = donate
+        buf = np.asarray(arr)
+        # The op owned the donated buffer and got partway through the
+        # reduction before a peer died: the bytes are garbage now.
+        buf[:] = 12345.0
+        # Latched-failure fallback: the future resolves to the input
+        # buffer ITSELF (wrap_future's default), which is how the
+        # scatter-back detects failure.
+        return completed_future(buf)
+
+    manager.allreduce.side_effect = failing_allreduce
+    avg = GradientAverager(manager, bucket_bytes=1 << 20)
+    grads = {
+        "a": np.arange(6, dtype=np.float32),
+        "b": np.full((5,), 3.0, dtype=np.float32),
+    }
+    before = {k: v.copy() for k, v in grads.items()}
+    out = avg.allreduce(grads)
+    assert seen["donate"] is True, "wire stage no longer donates"
+    for k in grads:
+        np.testing.assert_array_equal(np.asarray(out[k]), before[k])
+        np.testing.assert_array_equal(grads[k], before[k])
 
 
 def test_gradient_averager_buckets_respect_dtype() -> None:
@@ -377,7 +428,9 @@ def test_local_sgd_commit_gates_copyback() -> None:
 
     manager = _mock_manager(commit=False)
 
-    def fake_allreduce(arr, should_average=True, allow_wire_compression=True):
+    def fake_allreduce(
+        arr, should_average=True, allow_wire_compression=True, donate=False
+    ):
         return completed_future(np.zeros_like(np.asarray(arr)))
 
     manager.allreduce.side_effect = fake_allreduce

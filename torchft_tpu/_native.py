@@ -317,6 +317,35 @@ def _bind_ring(lib: ctypes.CDLL) -> Optional[str]:
             ctypes.c_double,
             ctypes.POINTER(ctypes.c_char_p),
         ]
+        lib.tf_ring_pass_multi.restype = ctypes.c_int
+        lib.tf_ring_pass_multi.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tf_ring_set_shm.restype = ctypes.c_int
+        lib.tf_ring_set_shm.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
         lib.tf_ring_counters.restype = ctypes.c_int
         lib.tf_ring_counters.argtypes = [
             ctypes.c_void_p,
@@ -1186,12 +1215,17 @@ class RingEngine:
     WIRE_RAW = 0
     WIRE_BF16 = 1
     WIRE_INT8 = 2
+    WIRE_INT4 = 3
 
     def __init__(self, lanes: int, shaper_mbps: float = 0.0, shaper_rtt_ms: float = 0.0) -> None:
         if _RING_UNAVAILABLE is not None:
             raise RuntimeError(_RING_UNAVAILABLE)
         self._ptr = _lib.tf_ring_new(int(lanes), float(shaper_mbps), float(shaper_rtt_ms))
         self._lanes = int(lanes)
+        # Python→native boundary crossings on the data path (ring_pass +
+        # ring_pass_multi calls).  The multi_stripe bench cell asserts this
+        # drops to one per op when the batched entry point is in use.
+        self.pass_calls = 0
 
     def set_tier(self, tier: int, next_fds: List[int], prev_fds: List[int]) -> None:
         """Registers one tier's lane sockets (the engine dup()s them; the
@@ -1258,6 +1292,7 @@ class RingEngine:
         ptrs = (ctypes.c_uint64 * n)(*chunk_ptrs)
         elems = (ctypes.c_uint64 * n)(*chunk_elems)
         err = ctypes.c_char_p()
+        self.pass_calls += 1
         rc = _lib.tf_ring_pass(
             self._ptr, int(tier), int(lane), int(n), int(rank),
             int(tag_base) & 0xFFFFFFFF, int(rs_sub), int(ag_sub),
@@ -1266,6 +1301,63 @@ class RingEngine:
         )
         if rc != 0:
             self._raise(rc, err)
+
+    def ring_pass_multi(
+        self,
+        tier: int,
+        nstripes: int,
+        n: int,
+        rank: int,
+        lanes: List[int],
+        tag_bases: List[int],
+        rs_sub: int,
+        ag_sub: int,
+        mode: int,
+        op: int,
+        wire: int,
+        chunk_ptrs: List[int],
+        chunk_elems: List[int],
+        timeout_s: float,
+    ) -> None:
+        """One batched ring pass over a whole stripe set: ``nstripes``
+        independent ring passes, stripe ``s`` on lane ``lanes[s]`` under
+        ``tag_bases[s]``, each over ``n`` chunk views laid out row-major in
+        ``chunk_ptrs``/``chunk_elems`` (stripe s owns slots [s*n, s*n+n)).
+        The per-stripe fan-out runs on the engine's internal worker pool so
+        Python crosses the capi boundary ONCE per allreduce; a failure on
+        any stripe poisons the tier (all stripes + the peer fail fast) and
+        the first error is raised."""
+        total = int(nstripes) * int(n)
+        assert len(chunk_ptrs) == total and len(chunk_elems) == total
+        assert len(lanes) == nstripes and len(tag_bases) == nstripes
+        lanes_a = (ctypes.c_int32 * nstripes)(*lanes)
+        tags_a = (ctypes.c_uint32 * nstripes)(*(int(t) & 0xFFFFFFFF for t in tag_bases))
+        ptrs = (ctypes.c_uint64 * total)(*chunk_ptrs)
+        elems = (ctypes.c_uint64 * total)(*chunk_elems)
+        err = ctypes.c_char_p()
+        self.pass_calls += 1
+        rc = _lib.tf_ring_pass_multi(
+            self._ptr, int(tier), int(nstripes), int(n), int(rank),
+            lanes_a, tags_a, int(rs_sub), int(ag_sub),
+            int(mode), int(op), int(wire), ptrs, elems,
+            float(timeout_s), ctypes.byref(err),
+        )
+        if rc != 0:
+            self._raise(rc, err)
+
+    def set_shm(self, tier: int, direction: int, lane: int, path: str, token: int) -> None:
+        """Attaches one lane link to a shared-memory SPSC ring segment
+        (created + negotiated by the Python rendezvous).  The link's frames
+        move through the segment from then on; the TCP socket stays open as
+        the liveness/abort channel.  Raises if the segment's magic or
+        generation token doesn't match (stale segment from a dead peer)."""
+        err = ctypes.c_char_p()
+        rc = _lib.tf_ring_set_shm(
+            self._ptr, int(tier), int(direction), int(lane),
+            path.encode(), int(token) & 0xFFFFFFFFFFFFFFFF, ctypes.byref(err),
+        )
+        if rc != 0:
+            raise RuntimeError(_take_error(err))
 
     def counters(self, tier: int) -> "tuple[List[int], List[int]]":
         """(sent, recv) wire-byte counters per lane of one tier (headers

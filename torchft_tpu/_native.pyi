@@ -229,6 +229,8 @@ class RingEngine:
     WIRE_RAW: int
     WIRE_BF16: int
     WIRE_INT8: int
+    WIRE_INT4: int
+    pass_calls: int
     def __init__(
         self, lanes: int, shaper_mbps: float = ..., shaper_rtt_ms: float = ...
     ) -> None: ...
@@ -253,6 +255,26 @@ class RingEngine:
         chunk_ptrs: List[int],
         chunk_elems: List[int],
         timeout_s: float,
+    ) -> None: ...
+    def ring_pass_multi(
+        self,
+        tier: int,
+        nstripes: int,
+        n: int,
+        rank: int,
+        lanes: List[int],
+        tag_bases: List[int],
+        rs_sub: int,
+        ag_sub: int,
+        mode: int,
+        op: int,
+        wire: int,
+        chunk_ptrs: List[int],
+        chunk_elems: List[int],
+        timeout_s: float,
+    ) -> None: ...
+    def set_shm(
+        self, tier: int, direction: int, lane: int, path: str, token: int
     ) -> None: ...
     def counters(self, tier: int) -> tuple[List[int], List[int]]: ...
     def shaper_counters(self, tier: int, direction: int) -> tuple[int, int]: ...

@@ -23,14 +23,16 @@ from __future__ import annotations
 
 import collections
 import math
+import mmap
 import os
+import select
 import socket
 import struct
 import threading
 import time
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
-from typing import Callable, Dict, List, Optional, Sequence, cast
+from typing import Any, Callable, Dict, List, Optional, Sequence, cast
 
 import numpy as np
 
@@ -46,6 +48,9 @@ __all__ = [
     "ManagedCollective",
     "WIRE_CODECS",
     "quantize_int8",
+    "quantize_int4",
+    "pack_int4",
+    "unpack_int4",
 ]
 
 # Elementwise combine per reduce op ("avg" divides by world size after the
@@ -68,10 +73,13 @@ def _bad_reduce_op(op: str) -> ValueError:
 # Optional per-call wire codecs (TCPCollective.allreduce(wire_codec=...)).
 # "int8": symmetric linear quantization, per-chunk scale = amax/127,
 # accumulation in float32 — ~0.25x the f32 wire (plus 4 scale bytes per
-# frame).  Lossy per hop exactly like the bf16 wire; meant for payloads
-# with a source-side error-feedback loop (the semisync pseudogradient
-# plane, torchft_tpu/semisync), never for raw weights.
-WIRE_CODECS = ("int8",)
+# frame).  "int4": the same shape packed two values per byte, per-chunk
+# scale = amax/7 — 0.125x the f32 wire, the Streaming-DiLoCo design point
+# (arXiv:2501.18512 quantizes outer gradients to 4 bits).  Both are lossy
+# per hop exactly like the bf16 wire; meant for payloads with a
+# source-side error-feedback loop (the semisync pseudogradient plane,
+# torchft_tpu/semisync), never for raw weights.
+WIRE_CODECS = ("int8", "int4")
 
 
 def quantize_int8(x: np.ndarray):
@@ -96,6 +104,46 @@ def quantize_int8(x: np.ndarray):
         np.rint(np.nan_to_num(x / scale, nan=0.0)), -127, 127
     ).astype(np.int8)
     return scale, q
+
+
+def quantize_int4(x: np.ndarray):
+    """``(scale, q)`` — the symmetric int4 quantizer (host side): scale =
+    amax/7, round-to-nearest, clipped to [-7, 7].  ``q`` is int8-typed but
+    every value fits a signed nibble; :func:`pack_int4` is the wire
+    packing.  The same non-finite guard rules as :func:`quantize_int8`
+    (one shared contract pinned by the codec tests); the jitted device
+    twin lives in torchft_tpu/semisync/codec.py."""
+    x = np.asarray(x)
+    if x.dtype != np.float32:
+        x = x.astype(np.float32)
+    amax = float(np.max(np.abs(x))) if x.size else 0.0
+    scale = amax / 7.0 if (amax > 0.0 and math.isfinite(amax)) else 1.0
+    q = np.clip(
+        np.rint(np.nan_to_num(x / scale, nan=0.0)), -7, 7
+    ).astype(np.int8)
+    return scale, q
+
+
+def pack_int4(q: np.ndarray) -> np.ndarray:
+    """Packs signed-nibble values (int8 in [-7, 7]) two per byte: element
+    2i in the LOW nibble, 2i+1 in the HIGH nibble, two's complement — the
+    exact frame layout native/src/ring.cc's Int4Encode emits, so both
+    engines' int4 wire bytes are bitwise-identical.  An odd tail leaves
+    the final high nibble zero."""
+    u = (q.astype(np.int16) & 0xF).astype(np.uint8)
+    if u.size % 2:
+        u = np.concatenate([u, np.zeros(1, dtype=np.uint8)])
+    return (u[0::2] | (u[1::2] << 4)).astype(np.uint8)
+
+
+def unpack_int4(raw, n: int) -> np.ndarray:
+    """Inverse of :func:`pack_int4`: ``n`` signed int8 values from the
+    packed nibble stream (sign-extended via ``(nib ^ 8) - 8``)."""
+    b = np.frombuffer(raw, dtype=np.uint8)
+    nib = np.empty(b.size * 2, dtype=np.int16)
+    nib[0::2] = b & 0xF
+    nib[1::2] = b >> 4
+    return ((nib[:n] ^ 8) - 8).astype(np.int8)
 
 
 def _is_bf16(dtype) -> bool:
@@ -516,6 +564,163 @@ class HopRecorder:
             self._count = 0
 
 
+class _ShmRing:
+    """One attached end of a same-host SPSC byte ring — the Python
+    engine's half of the shm lane transport (the native half is
+    ShmWriteAll/ShmReadExact in native/src/ring.cc over the SAME segment
+    layout, so a Python producer feeds a native consumer and vice versa).
+
+    Exactly one producer and one consumer per segment (ring lane links
+    are unidirectional: the dialer only sends, the acceptor only
+    receives), so the only synchronization is the pair of monotonic
+    byte cursors — head (producer) and tail (consumer) — in the segment
+    header.  Python's side relies on the GIL's sequencing plus x86/ARM
+    acquire-release-on-aligned-load semantics for the cursor reads, the
+    same assumption mmap-based SPSC rings make everywhere.
+
+    Stalls poll the link's kept-open TCP socket for liveness: a dead
+    peer's socket reads EOF long before the op timeout, so shm lanes
+    fail exactly as fast as tcp lanes do (the crash-cleanup test pins
+    this)."""
+
+    _SPINS = 512
+
+    def __init__(self, path: str, token: int, sock: socket.socket) -> None:
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            if size <= _SHM_HDR:
+                raise ConnectionError(f"shm segment too small: {size} bytes")
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        magic, tok = struct.unpack_from("<QQ", self._mm, 0)
+        if magic != _SHM_MAGIC or tok != token:
+            self._mm.close()
+            raise ConnectionError(
+                "stale shm segment (generation mismatch) — refusing to attach"
+            )
+        self._cap = size - _SHM_HDR
+        self._sock = sock
+        self.path = path
+        self._closed = False
+
+    def _u64(self, off: int) -> int:
+        return struct.unpack_from("<Q", self._mm, off)[0]
+
+    def poison(self) -> None:
+        """Marks the segment dead for the peer (cross-process fail-fast,
+        the shm analogue of a socket shutdown)."""
+        if not self._closed:
+            struct.pack_into("<I", self._mm, _SHM_POISON_OFF, 1)
+
+    def _wait_tick(self, spins: List[int], deadline: float,
+                   consumer: bool = False) -> None:
+        """One no-progress step: spin briefly, then check the deadline,
+        the peer's poison flag, and the TCP socket's liveness.  For the
+        CONSUMER, peer-death signals (poison, socket EOF) only fail once
+        the ring is drained: the producer's final frames land in the ring
+        before its close() sets the flag, exactly like bytes sitting in a
+        closed TCP socket's buffer."""
+        def dead(msg: str) -> None:
+            if consumer and self._u64(_SHM_HEAD_OFF) - self._u64(_SHM_TAIL_OFF):
+                return  # final frames still in the ring — drain first
+            raise ConnectionError(msg)
+
+        if struct.unpack_from("<I", self._mm, _SHM_POISON_OFF)[0]:
+            dead("peer connection closed (shm ring poisoned)")
+            return
+        if spins[0] < self._SPINS:
+            spins[0] += 1
+            return
+        spins[0] = 0
+        if time.monotonic() > deadline:
+            raise TimeoutError("shm ring timed out")
+        try:
+            readable, _, _ = select.select([self._sock], [], [], 0)
+            eof = bool(readable) and self._sock.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            readable, eof = False, True
+        if eof:
+            dead("peer connection closed")
+            return
+        if readable:
+            raise ConnectionError("unexpected socket data on shm lane")
+        time.sleep(20e-6)
+
+    def write(self, data, timeout: float) -> None:
+        """Producer: appends ``data``'s bytes, blocking (with liveness
+        polling) while the ring is full.  Frames larger than the capacity
+        flow through in pieces."""
+        mv = memoryview(data)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        deadline = time.monotonic() + timeout
+        spins = [0]
+        pos, n, cap = 0, len(mv), self._cap
+        while pos < n:
+            if self._closed:
+                raise ConnectionError("shm ring closed")
+            h = self._u64(_SHM_HEAD_OFF)
+            t = self._u64(_SHM_TAIL_OFF)
+            free = cap - (h - t)
+            if free == 0:
+                self._wait_tick(spins, deadline)
+                continue
+            take = min(n - pos, free)
+            off = h % cap
+            first = min(take, cap - off)
+            self._mm[_SHM_HDR + off : _SHM_HDR + off + first] = mv[pos : pos + first]
+            if take > first:
+                self._mm[_SHM_HDR : _SHM_HDR + take - first] = (
+                    mv[pos + first : pos + take]
+                )
+            struct.pack_into("<Q", self._mm, _SHM_HEAD_OFF, h + take)
+            pos += take
+            deadline = time.monotonic() + timeout
+            spins[0] = 0
+
+    def read_into(self, view: memoryview, timeout: float) -> None:
+        """Consumer: fills ``view`` from the ring, blocking (with liveness
+        polling) while it is empty."""
+        deadline = time.monotonic() + timeout
+        spins = [0]
+        pos, n, cap = 0, len(view), self._cap
+        while pos < n:
+            if self._closed:
+                raise ConnectionError("shm ring closed")
+            t = self._u64(_SHM_TAIL_OFF)
+            h = self._u64(_SHM_HEAD_OFF)
+            avail = h - t
+            if avail == 0:
+                self._wait_tick(spins, deadline, consumer=True)
+                continue
+            take = min(n - pos, avail)
+            off = t % cap
+            first = min(take, cap - off)
+            view[pos : pos + first] = self._mm[_SHM_HDR + off : _SHM_HDR + off + first]
+            if take > first:
+                view[pos + first : pos + take] = (
+                    self._mm[_SHM_HDR : _SHM_HDR + take - first]
+                )
+            struct.pack_into("<Q", self._mm, _SHM_TAIL_OFF, t + take)
+            pos += take
+            deadline = time.monotonic() + timeout
+            spins[0] = 0
+
+    def close(self) -> None:
+        if not self._closed:
+            try:
+                self.poison()
+            except ValueError:
+                pass
+            self._closed = True
+            try:
+                self._mm.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
 class _Peer:
     """A framed duplex TCP link to one peer rank.
 
@@ -550,6 +755,16 @@ class _Peer:
         self._bytes_out = 0
         self._bytes_in = 0
         self._native_bytes: Optional[Callable[[], int]] = None
+        # Same-host shm lane transport (ring channels only).  _shm_pending
+        # holds the negotiated (path, token, role) from rendezvous until
+        # the engine decision arms it: the native engine maps the segment
+        # itself (set_shm); the Python engine arms _shm_tx (dialer,
+        # producer) or _shm_rx (acceptor, consumer) below, after which
+        # send_msg/_recv_exact move payload bytes through the ring while
+        # the socket stays open as the liveness/abort channel.
+        self._shm_pending: Optional[tuple] = None
+        self._shm_tx: Optional[_ShmRing] = None
+        self._shm_rx: Optional[_ShmRing] = None
 
     @property
     def bytes_out(self) -> int:
@@ -572,9 +787,15 @@ class _Peer:
         with self.send_lock:
             if self.shaper is not None:
                 self.shaper.on_send(total + _HDR.size)
-            self.sock.sendall(_HDR.pack(tag, total))
-            for p in parts:
-                self.sock.sendall(p)
+            if self._shm_tx is not None:
+                budget = self.sock.gettimeout() or 60.0
+                self._shm_tx.write(_HDR.pack(tag, total), budget)
+                for p in parts:
+                    self._shm_tx.write(p, budget)
+            else:
+                self.sock.sendall(_HDR.pack(tag, total))
+                for p in parts:
+                    self.sock.sendall(p)
             self._bytes_out += total + _HDR.size
 
     def recv_msg(self, expect_tag: int) -> bytearray:
@@ -616,6 +837,10 @@ class _Peer:
         # saves a full payload memcpy.
         buf = bytearray(n)
         view = memoryview(buf)
+        if self._shm_rx is not None:
+            self._shm_rx.read_into(view, self.sock.gettimeout() or 60.0)
+            self._bytes_in += n
+            return buf
         got = 0
         while got < n:
             r = self.sock.recv_into(view[got:], n - got)
@@ -626,6 +851,9 @@ class _Peer:
         return buf
 
     def close(self) -> None:
+        for ring in (self._shm_tx, self._shm_rx):
+            if ring is not None:
+                ring.close()
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -738,9 +966,78 @@ _NATIVE_OP = {"sum": 0, "avg": 0, "max": 1, "min": 2}
 _NATIVE_WIRE_RAW = 0
 _NATIVE_WIRE_BF16 = 1
 _NATIVE_WIRE_INT8 = 2
+_NATIVE_WIRE_INT4 = 3
 _NATIVE_PASS_FULL = 0
 _NATIVE_PASS_RS = 1
 _NATIVE_PASS_AG = 2
+
+# Ring lane transport (docs/architecture.md "Same-host data plane").
+# "tcp" (default): every lane frame crosses the kernel socket.  "shm":
+# lanes whose two ranks prove same-host at rendezvous (matching
+# /proc/sys/kernel/random/boot_id, exchanged right after the connection
+# preamble) move their frames through a lock-free SPSC byte ring in a
+# /dev/shm segment instead — the TCP socket stays open as the
+# liveness/abort channel, and tag demux / abort / reconfigure semantics
+# are unchanged (the segment layout is pinned between _ShmRing here and
+# native/src/ring.cc, so mixed-engine rings still interoperate).  "auto"
+# negotiates shm where it can and silently keeps tcp elsewhere; "shm"
+# makes a failed same-host negotiation a hard configure() error.  The
+# knob must match on every rank of one collective (like lanes/topology):
+# a tcp rank cannot parse the shm handshake bytes.
+TPUFT_RING_TRANSPORT_ENV = "TPUFT_RING_TRANSPORT"
+_TRANSPORTS = ("tcp", "shm", "auto")
+
+# Per-link SPSC ring capacity (data bytes past the 64-byte header).
+# Frames larger than the capacity flow through in pieces, so this bounds
+# memory, not payload size.
+TPUFT_SHM_RING_BYTES_ENV = "TPUFT_SHM_RING_BYTES"
+_SHM_RING_BYTES_DEFAULT = 1 << 20
+
+# Segment header layout — MUST mirror native/src/ring.cc (kShmMagic,
+# kShmHdr, kShm*Off): magic u64 @0, generation token u64 @8, head
+# (producer cursor) u64 @16, tail (consumer cursor) u64 @24, poisoned
+# u32 @32, consumer-parked u32 @40, producer-parked u32 @44, data @64.
+# Cursors are monotonic byte counts.  The parked flags belong to the
+# native engine's futex wait path; this Python engine polls and never
+# sets them (a native waiter paired with a Python peer is bounded by
+# its 2 ms park timeout), but the offsets are reserved here so the two
+# layouts cannot drift.
+_SHM_MAGIC = 0x746675745F736D68
+_SHM_HDR = 64
+_SHM_TOKEN_OFF = 8
+_SHM_HEAD_OFF = 16
+_SHM_TAIL_OFF = 24
+_SHM_POISON_OFF = 32
+
+# Rendezvous extension blocks (sent on ring channels only, and only when
+# the transport knob is not "tcp"): dialer -> 64-byte padded boot-id;
+# acceptor -> (flag, token, segment name); dialer -> 1 ack byte.
+_SHM_REQ = struct.Struct("<64s")
+_SHM_REP = struct.Struct("<BQ64s")
+
+
+def _transport_from_env() -> str:
+    t = os.environ.get(TPUFT_RING_TRANSPORT_ENV, "tcp")
+    return t if t in _TRANSPORTS else "tcp"
+
+
+def _shm_ring_bytes_from_env() -> int:
+    try:
+        return max(4096, int(os.environ.get(
+            TPUFT_SHM_RING_BYTES_ENV, str(_SHM_RING_BYTES_DEFAULT))))
+    except ValueError:
+        return _SHM_RING_BYTES_DEFAULT
+
+
+def _boot_id() -> bytes:
+    """This host's boot UUID — the same-host proof two ranks compare at
+    rendezvous (equal boot-ids => same kernel instance => /dev/shm is
+    genuinely shared).  Empty when unreadable, which disables shm."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id", "rb") as f:
+            return f.read().strip()[:64]
+    except OSError:
+        return b""
 
 _native_fallback_warned = False
 
@@ -879,6 +1176,7 @@ class TCPCollective(Collective):
         lanes: Optional[int] = None,
         topology: Optional[str] = None,
         engine: Optional[str] = None,
+        transport: Optional[str] = None,
     ) -> None:
         """``wire_dtype="bf16"`` halves allreduce bytes on the wire (DCN is
         the cross-slice bottleneck): ring payloads are cast to bfloat16 per
@@ -920,6 +1218,11 @@ class TCPCollective(Collective):
             raise ValueError(
                 f"unsupported engine {engine!r}; expected one of {_RING_ENGINES}"
             )
+        transport = transport if transport is not None else _transport_from_env()
+        if transport not in _TRANSPORTS:
+            raise ValueError(
+                f"unsupported transport {transport!r}; expected one of {_TRANSPORTS}"
+            )
         self._timeout = timeout
         self._chunk_bytes = chunk_bytes
         self._wire_dtype = wire_dtype
@@ -932,6 +1235,13 @@ class TCPCollective(Collective):
         # per configure() over the freshly rendezvoused lane sockets.
         self._engine_mode = engine
         self._engine = None
+        # Lane transport: requested mode, per-configure count of armed shm
+        # links, and every segment path this rank negotiated (BOTH sides
+        # track, so whichever rank survives a peer crash unlinks).
+        self._transport = transport
+        self._shm_links = 0
+        self._shm_lock = threading.Lock()
+        self._shm_paths: set = set()
         self._row_tier: Optional[_TierLinks] = None
         self._col_tier: Optional[_TierLinks] = None
         self._lock = threading.Lock()
@@ -1032,6 +1342,7 @@ class TCPCollective(Collective):
             self._store = StoreClient(store_addr)
             self._rendezvous()
             self._engine = self._create_engine()
+            self._arm_shm_links()
             from concurrent.futures import ThreadPoolExecutor
 
             # Single-lane ring ops share the lane-0 sockets and execute one
@@ -1080,6 +1391,15 @@ class TCPCollective(Collective):
         explicit requests resolve here — what the bench's engine A/B
         records and the parity tests pin."""
         return "native" if self._engine is not None else "py"
+
+    @property
+    def ring_transport(self) -> str:
+        """The transport the CURRENT configuration's ring lanes move
+        payload bytes on: "shm" when at least one same-host segment was
+        negotiated and armed (all-loopback rings arm every lane), "tcp"
+        otherwise — what the bench's transport A/B records and
+        test_transport_quick_smoke pins."""
+        return "shm" if self._shm_links > 0 else "tcp"
 
     def _create_engine(self) -> Optional[object]:
         """Builds the native ring engine over this generation's lane fds
@@ -1236,6 +1556,8 @@ class TCPCollective(Collective):
                     their_rank, channel, lane = self._PREAMBLE.unpack(
                         peer._recv_exact(self._PREAMBLE.size)
                     )
+                    if channel != self._CH_P2P and self._transport != "tcp":
+                        self._shm_accept_handshake(peer, their_rank, channel, lane)
                     with self._accept_cond:
                         if self._generation != gen:
                             conn.close()
@@ -1318,7 +1640,142 @@ class TCPCollective(Collective):
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         peer = _Peer(sock, shaper=shaper)
         peer.sock.sendall(self._PREAMBLE.pack(self._rank, channel, lane))
+        if channel != self._CH_P2P and self._transport != "tcp":
+            self._shm_dial_handshake(peer, peer_rank)
         return peer
+
+    # -- same-host shm lane negotiation -------------------------------------
+
+    def _create_shm_segment(self, their_rank: int, channel: int, lane: int) -> tuple:
+        """Creates one fresh /dev/shm segment for a same-host lane link:
+        O_EXCL create (any stale leftover under the same name is unlinked
+        first), sized header + ring capacity, initialized with the magic
+        and a FRESH random generation token.  The token is what makes a
+        dead peer's stale segment unattachable: the dialer verifies it
+        against the value negotiated on THIS connection, so a leftover
+        file from a crashed process can never be re-attached."""
+        name = (
+            f"tpuft-{os.getpid()}-g{self._generation}-r{their_rank}"
+            f"to{self._rank}-c{channel}-l{lane}-{os.urandom(4).hex()}"
+        )
+        path = "/dev/shm/" + name
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        cap = _shm_ring_bytes_from_env()
+        fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, _SHM_HDR + cap)
+            token = int.from_bytes(os.urandom(8), "little") | 1
+            os.pwrite(fd, struct.pack("<QQQQI", _SHM_MAGIC, token, 0, 0, 0), 0)
+        except OSError:
+            os.close(fd)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        return path, token
+
+    def _shm_accept_handshake(
+        self, peer: _Peer, their_rank: int, channel: int, lane: int
+    ) -> None:
+        """Acceptor side of the shm negotiation (runs in the accept loop,
+        right after the preamble): read the dialer's boot-id; when it
+        matches ours, create a fresh segment and offer (token, name); a
+        positive ack arms this link's consumer role at engine-arm time."""
+        (req,) = _SHM_REQ.unpack(bytes(peer._recv_exact(_SHM_REQ.size)))
+        their_boot = req.rstrip(b"\x00")
+        mine = _boot_id()
+        flag, token, name, path = 0, 0, b"", None
+        if mine and their_boot == mine:
+            try:
+                path, token = self._create_shm_segment(their_rank, channel, lane)
+                name = os.path.basename(path).encode()
+                flag = 1
+            except OSError:
+                flag, token, name, path = 0, 0, b"", None
+        peer.sock.sendall(_SHM_REP.pack(flag, token, name))
+        if not flag:
+            return
+        if bytes(peer._recv_exact(1)) != b"\x01":
+            # Dialer could not attach (or refused): stay on tcp, reclaim
+            # the segment now.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return
+        peer._shm_pending = (path, token, "rx")
+        with self._shm_lock:
+            self._shm_paths.add(path)
+
+    def _shm_dial_handshake(self, peer: _Peer, peer_rank: int) -> None:
+        """Dialer side: send our boot-id; on a same-host offer, verify the
+        segment's magic + generation token BEFORE acking (a stale segment
+        from a dead peer is refused here) and record the producer role."""
+        peer.sock.sendall(_SHM_REQ.pack(_boot_id()))
+        flag, token, name = _SHM_REP.unpack(bytes(peer._recv_exact(_SHM_REP.size)))
+        if not flag:
+            if self._transport == "shm":
+                raise ConnectionError(
+                    f"TPUFT_RING_TRANSPORT=shm but rank {peer_rank} offered no "
+                    "same-host segment (different host, unreadable boot-id, or "
+                    "segment creation failed); use transport='auto' for mixed "
+                    "placements"
+                )
+            return
+        path = "/dev/shm/" + name.rstrip(b"\x00").decode()
+        try:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                magic, tok = struct.unpack("<QQ", os.pread(fd, 16, 0))
+            finally:
+                os.close(fd)
+            if magic != _SHM_MAGIC or tok != token:
+                raise ConnectionError(
+                    "stale shm segment (generation mismatch) — refusing to attach"
+                )
+        except Exception:
+            peer.sock.sendall(b"\x00")
+            if self._transport == "shm":
+                raise
+            return
+        peer.sock.sendall(b"\x01")
+        peer._shm_pending = (path, token, "tx")
+        with self._shm_lock:
+            self._shm_paths.add(path)
+
+    def _arm_shm_links(self) -> None:
+        """Applies every rendezvous-negotiated segment to whichever engine
+        this configuration runs: the native engine maps segments itself
+        (set_shm — its WriteAll/ReadExact then route through the ring),
+        the Python engine arms the peers' _ShmRing producer/consumer
+        halves.  Called under _lock right after _create_engine."""
+        specs = [(0, 0, self._next_lanes), (0, 1, self._prev_lanes)]
+        for tid, tier in ((1, self._row_tier), (2, self._col_tier)):
+            if tier is not None:
+                specs += [(tid, 0, tier.next_lanes), (tid, 1, tier.prev_lanes)]
+        self._shm_links = 0
+        for tid, direction, peers in specs:
+            for lane, peer in enumerate(peers):
+                if peer._shm_pending is None:
+                    continue
+                path, token, role = peer._shm_pending
+                try:
+                    if self._engine is not None:
+                        self._engine.set_shm(tid, direction, lane, path, token)
+                    elif role == "tx":
+                        peer._shm_tx = _ShmRing(path, token, peer.sock)
+                    else:
+                        peer._shm_rx = _ShmRing(path, token, peer.sock)
+                except Exception:
+                    if self._transport == "shm":
+                        raise
+                    continue
+                self._shm_links += 1
 
     def _dial(self, peer_rank: int) -> _Peer:
         """Point-to-point link for send/recv to an arbitrary rank.  Exactly
@@ -1410,6 +1867,19 @@ class TCPCollective(Collective):
                 self._listener = None
             self._next_lanes = []
             self._prev_lanes = []
+            # Unlink every negotiated shm segment (both ends track every
+            # path, so the survivor of a peer crash reclaims it; a second
+            # unlink is a harmless ENOENT).  The native engine's mappings
+            # survive until its close() below — unlink only removes the
+            # name.
+            with self._shm_lock:
+                shm_paths, self._shm_paths = list(self._shm_paths), set()
+            self._shm_links = 0
+            for sp in shm_paths:
+                try:
+                    os.unlink(sp)
+                except OSError:
+                    pass
             if self._executor is not None:
                 self._executor.shutdown(wait=False, cancel_futures=True)
                 self._executor = None
@@ -1943,12 +2413,17 @@ class TCPCollective(Collective):
         allreduce_gb_per_s gauge), so a change to ``_wire_for``'s gating
         cannot silently diverge from what the accounting counts.  With
         ``wire_codec="int8"`` floating payloads count 1 byte per element
-        plus the per-frame scale header (~0.25x the f32 wire)."""
+        plus the per-frame scale header (~0.25x the f32 wire); with
+        ``"int4"`` they count the PACKED nibble bytes — ceil(n/2) plus
+        the scale header (~0.125x) — never the int8 frame width."""
         array = np.asarray(array)
-        if wire_codec == "int8" and (
+        is_float = (
             np.issubdtype(array.dtype, np.floating) or _is_bf16(array.dtype)
-        ):
+        )
+        if wire_codec == "int8" and is_float:
             return int(array.size) + _INT8_SCALE.size
+        if wire_codec == "int4" and is_float:
+            return (int(array.size) + 1) // 2 + _INT8_SCALE.size
         wire, _ = self._wire_for([array], array.dtype, allow_wire_compression)
         if wire is not None:
             return int(array.size) * wire.itemsize
@@ -2007,9 +2482,32 @@ class TCPCollective(Collective):
                 scale, q = quantize_int8(chunk)
                 return [_INT8_SCALE.pack(scale), memoryview(as_u8(q))]
 
-            def decode(raw) -> np.ndarray:
+            def decode(raw, n: Optional[int] = None) -> np.ndarray:
                 (scale,) = _INT8_SCALE.unpack_from(raw, 0)
                 q = np.frombuffer(raw, dtype=np.int8, offset=_INT8_SCALE.size)
+                return (q.astype(np.float32) * np.float32(scale)).astype(
+                    acc_dtype, copy=False
+                )
+
+            return encode, decode
+
+        if codec == "int4":
+            # Same frame shape as int8 (4-byte f32 scale + payload) with
+            # the payload packed two signed nibbles per byte — 0.125x the
+            # f32 wire, bitwise-identical to native/src/ring.cc's
+            # Int4Encode frames.  A packed frame of k bytes holds 2k-1 or
+            # 2k elements, so decode takes the expected element count from
+            # the caller (the ring always knows its chunk geometry).
+            def encode(chunk: np.ndarray):
+                scale, q = quantize_int4(chunk)
+                return [_INT8_SCALE.pack(scale), memoryview(pack_int4(q))]
+
+            def decode(raw, n: Optional[int] = None) -> np.ndarray:
+                nbytes = len(raw) - _INT8_SCALE.size
+                if n is None:
+                    n = nbytes * 2
+                (scale,) = _INT8_SCALE.unpack_from(raw, 0)
+                q = unpack_int4(memoryview(raw)[_INT8_SCALE.size:], n)
                 return (q.astype(np.float32) * np.float32(scale)).astype(
                     acc_dtype, copy=False
                 )
@@ -2021,7 +2519,7 @@ class TCPCollective(Collective):
                 chunk = chunk.astype(wire)
             return memoryview(as_u8(chunk))
 
-        def decode(raw: bytes) -> np.ndarray:
+        def decode(raw, n: Optional[int] = None) -> np.ndarray:
             if wire is not None:
                 return np.frombuffer(raw, dtype=wire).astype(acc_dtype)
             return np.frombuffer(raw, dtype=acc_dtype)
@@ -2042,10 +2540,10 @@ class TCPCollective(Collective):
         if self._engine is None:
             return None
         if codec is not None:
-            if codec != "int8":
+            if codec not in ("int8", "int4"):
                 return None
             return (
-                _NATIVE_WIRE_INT8
+                (_NATIVE_WIRE_INT8 if codec == "int8" else _NATIVE_WIRE_INT4)
                 if np.dtype(flat_dtype) == np.float32
                 and np.dtype(acc_dtype) == np.float32
                 else None
@@ -2203,7 +2701,7 @@ class TCPCollective(Collective):
                 tag_base + rs_sub, encode(chunks[send_idx]), lane, tier, hop=hop
             )
             t_comb = time.monotonic()
-            incoming = decode(raw)
+            incoming = decode(raw, chunks[recv_idx].size)
             chunks[recv_idx] = combine(chunks[recv_idx], incoming)
             self._record_hop(
                 tier, lane, tag_base + rs_sub, hop,
@@ -2255,7 +2753,10 @@ class TCPCollective(Collective):
                     hop=hop,
                 )
                 self._record_hop(tier, lane, tag, hop)
-            return [decode(cast(bytes, raw_chunks[i])) for i in range(n)]
+            return [
+                decode(cast(bytes, raw_chunks[i]), chunks[i].size)
+                for i in range(n)
+            ]
         for step in range(n - 1):
             send_idx = (rank - step + 1) % n
             recv_idx = (rank - step) % n
@@ -2309,7 +2810,7 @@ class TCPCollective(Collective):
                 tag_base + _SUB_RS, encode(chunks[send_idx]), lane, row, hop=hop
             )
             t_comb = time.monotonic()
-            incoming = decode(raw)
+            incoming = decode(raw, chunks[recv_idx].size)
             chunks[recv_idx] = combine(chunks[recv_idx], incoming)
             self._record_hop(
                 row, lane, tag_base + _SUB_RS, hop,
@@ -2556,26 +3057,49 @@ class TCPCollective(Collective):
             return Work(failed_future(e))
 
         if wire_mode is not None:
+            engine = self._engine
 
-            def stripe_body(s: int) -> None:
-                self._native_pass_views(
-                    [sub[i][s] for i in range(n)],
+            def stripe_body(_s: int) -> None:
+                # ONE capi crossing for the whole stripe set: per-stripe
+                # fan-out runs on the engine's internal worker pool
+                # (ring.cc RingPassMulti), with identical stripe/lane/tag
+                # geometry to the per-stripe path — so this rank
+                # interoperates with peers still making one ring_pass per
+                # stripe, and with the Python engine.
+                if engine is None:
+                    raise RuntimeError("collective aborted")
+                engine.ring_pass_multi(
                     0,
-                    s % self._lanes,
+                    nstripes,
                     n,
                     self._rank,
-                    self._tag_base(seq, s),
+                    [s % self._lanes for s in range(nstripes)],
+                    [self._tag_base(seq, s) for s in range(nstripes)],
                     _SUB_RS,
                     _SUB_AG,
                     _NATIVE_PASS_FULL,
-                    op,
+                    _NATIVE_OP[op],
                     wire_mode,
+                    [
+                        int(sub[i][s].ctypes.data)
+                        for s in range(nstripes)
+                        for i in range(n)
+                    ],
+                    [
+                        int(sub[i][s].size)
+                        for s in range(nstripes)
+                        for i in range(n)
+                    ],
+                    self._timeout,
                 )
 
             def assemble(results: List[Optional[object]]) -> List[np.ndarray]:
                 return self._unflatten(buf, arrays, op)
 
-            return self._run_striped(nstripes, stripe_body, assemble)
+            # One "stripe" from _run_striped's perspective — the whole
+            # batched pass; back-to-back ops still overlap on the lane
+            # executor's other workers.
+            return self._run_striped(1, stripe_body, assemble)
 
         def stripe_body(s: int) -> List[np.ndarray]:
             return self._ring_rs_ag(
@@ -2979,13 +3503,19 @@ class ErrorSwallowingCollective(Collective):
         op: str = "sum",
         allow_wire_compression: bool = True,
         wire_codec: Optional[str] = None,
+        donate: bool = False,
     ) -> Work:
+        # Optional kwargs forwarded only when set (mock-compat: an inner
+        # collective with the bare 3-arg signature must keep working).
+        extra: Dict[str, Any] = {}
+        if wire_codec is not None:
+            extra["wire_codec"] = wire_codec
+        if donate:
+            extra["donate"] = True
         return self._guard(
             lambda: self._inner.allreduce(
-                arrays, op, allow_wire_compression, wire_codec=wire_codec
-            )
-            if wire_codec is not None
-            else self._inner.allreduce(arrays, op, allow_wire_compression),
+                arrays, op, allow_wire_compression, **extra
+            ),
             list(arrays),
         )
 

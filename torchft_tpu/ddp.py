@@ -678,7 +678,13 @@ class GradientAverager:
                         stats["d2h_bytes"] += view.nbytes
                         self._note("d2h", view.nbytes)
                         slice_futs.append(
-                            (shard, start, stop, view, self._manager.allreduce(view))
+                            (
+                                shard,
+                                start,
+                                stop,
+                                view,
+                                self._manager.allreduce(view, donate=True),
+                            )
                         )
                     stats["slices"] += len(parts)
                     stats["wire_bytes"] += wire_nbytes(bucket)
@@ -696,7 +702,13 @@ class GradientAverager:
                     self._note("d2h", dev.buffer.nbytes)
                     stats["wire_bytes"] += wire_nbytes(bucket)
                     pending.append(
-                        ("device", bucket, dev, buf, self._manager.allreduce(dev.buffer))
+                        (
+                            "device",
+                            bucket,
+                            dev,
+                            buf,
+                            self._manager.allreduce(dev.buffer, donate=True),
+                        )
                     )
                 continue
             if self._pipelined:
@@ -727,13 +739,15 @@ class GradientAverager:
             # encoding — full-width is the contract, not just full-width
             # fetch.
             stats["wire_bytes"] += wire_nbytes(bucket)
+            # The bucket plan's staging buffer is rewritten from the leaves
+            # every step, so the op may own it for the round: donate lets
+            # the native engine reduce in place with no working-buffer copy.
             fut = (
-                # Keyword only on the bypass path: the common case keeps the
-                # bare call signature swapped-in managers (tests, wrappers)
-                # already mock.
-                self._manager.allreduce(buf, allow_wire_compression=False)
+                self._manager.allreduce(
+                    buf, allow_wire_compression=False, donate=True
+                )
                 if bucket.wire_bypass
-                else self._manager.allreduce(buf)
+                else self._manager.allreduce(buf, donate=True)
             )
             pending.append(("host", bucket, dev, buf, fut))
 
@@ -771,10 +785,11 @@ class GradientAverager:
                 if kind == "host":
                     flat = np.asarray(res)
                     if flat is buf:
-                        # Failure fallback resolved to the input: detach from
-                        # the persistent buffer (reused next step) before
-                        # handing views to the caller.
-                        flat = flat.copy()
+                        # Latched failure resolved to the donated staging
+                        # buffer — with donate the op may have half-reduced
+                        # it, so it must not be republished as gradients.
+                        # Leaves stay untouched; the commit vote fails.
+                        continue
                     for idx, arr in bucket.unpack(flat):
                         out[idx] = arr
                 elif kind == "device":

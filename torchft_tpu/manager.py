@@ -38,7 +38,7 @@ import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from datetime import timedelta
 from enum import Enum
-from typing import Callable, Dict, List, Optional, TypeVar, cast
+from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
 import numpy as np
 
@@ -397,7 +397,7 @@ class Manager:
         # cost on the data path (docs/wire.md "Worker /metrics").  The
         # cumulative buckets live here (scrape-thread-only state) so the
         # exposed histograms stay monotonic over the sliding ring.
-        self._hop_hist: Dict[int, dict] = {}
+        self._hop_hist: Dict[tuple, dict] = {}  # (tier, lane) -> buckets
         self._hop_hist_last_ts = 0.0
         self._hop_hist_lock = threading.Lock()
         self._worker_metrics.add_section(self._render_hop_histograms)
@@ -995,6 +995,7 @@ class Manager:
         should_average: bool = True,
         allow_wire_compression: bool = True,
         wire_codec: Optional[str] = None,
+        donate: bool = False,
     ) -> Future:
         """Fault-tolerant gradient allreduce across replica groups.
 
@@ -1012,6 +1013,16 @@ class Manager:
         ~0.25x the f32 wire) — the semisync pseudogradient plane's knob.
         The kwarg is only forwarded when set, so swapped-in collectives
         (tests, wrappers) keep the bare allreduce signature they mock.
+
+        donate=True hands the host buffer's ownership to the collective:
+        it may reduce in place and return the same storage, skipping the
+        defensive copy.  Only safe when the caller does not reuse the
+        input after the call (wire/fragment staging buffers).  On failure
+        the future still resolves to the UNMODIFIED input semantics the
+        caller observes today — the collective's contract is that a failed
+        op never publishes a half-reduced buffer as the result.  Forwarded
+        to the collective only when True, same mock-compat rule as
+        wire_codec.
         """
         if self.errored() is not None:
             return completed_future(tensor)
@@ -1067,17 +1078,12 @@ class Manager:
             self._ar_bytes += ar_nbytes
 
         try:
+            kwargs: Dict[str, Any] = {"allow_wire_compression": allow_wire_compression}
             if wire_codec is not None:
-                work = self._collective.allreduce(
-                    [host],
-                    op="sum",
-                    allow_wire_compression=allow_wire_compression,
-                    wire_codec=wire_codec,
-                )
-            else:
-                work = self._collective.allreduce(
-                    [host], op="sum", allow_wire_compression=allow_wire_compression
-                )
+                kwargs["wire_codec"] = wire_codec
+            if donate:
+                kwargs["donate"] = True
+            work = self._collective.allreduce([host], op="sum", **kwargs)
 
             def normalize(results: List[np.ndarray]):
                 out = results[0]
@@ -1393,9 +1399,14 @@ class Manager:
                 ts = float(r.get("ts", 0.0))
                 if ts <= last_ts:
                     continue
+                # Slots key on (tier, lane): the lane split is what tells a
+                # striped ring's per-lane byte skew apart from a uniform
+                # slowdown.  Records from engines predating the lane field
+                # fold into lane 0.
                 tier = int(r.get("tier", 0))
+                lane = int(r.get("lane", 0))
                 slot = self._hop_hist.setdefault(
-                    tier,
+                    (tier, lane),
                     {
                         "lat": [0] * (len(HOP_LATENCY_BOUNDS) + 1),
                         "lat_sum": 0.0,
@@ -1418,17 +1429,42 @@ class Manager:
                 self._hop_hist_last_ts = max(self._hop_hist_last_ts, ts)
             if not self._hop_hist:
                 return ""
+            # Per-tier families sum their lanes (sums of monotonic buckets
+            # stay monotonic); the lane-split family emits one series per
+            # slot.
             lat_series = []
             byte_series = []
-            for tier in sorted(self._hop_hist):
+            lane_byte_series = []
+            for tier in sorted({t for t, _ in self._hop_hist}):
                 labels = (
                     ("replica", self._replica_id),
                     ("tier", str(tier)),
                 )
-                slot = self._hop_hist[tier]
-                lat_series.append((labels, list(slot["lat"]), slot["lat_sum"]))
-                byte_series.append(
-                    (labels, list(slot["bytes"]), slot["bytes_sum"])
+                lat = [0] * (len(HOP_LATENCY_BOUNDS) + 1)
+                lat_sum = 0.0
+                byts = [0] * (len(HOP_BYTES_BOUNDS) + 1)
+                bytes_sum = 0.0
+                for (t, _lane), slot in self._hop_hist.items():
+                    if t != tier:
+                        continue
+                    lat = [a + b for a, b in zip(lat, slot["lat"])]
+                    lat_sum += slot["lat_sum"]
+                    byts = [a + b for a, b in zip(byts, slot["bytes"])]
+                    bytes_sum += slot["bytes_sum"]
+                lat_series.append((labels, lat, lat_sum))
+                byte_series.append((labels, byts, bytes_sum))
+            for tier, lane in sorted(self._hop_hist):
+                slot = self._hop_hist[(tier, lane)]
+                lane_byte_series.append(
+                    (
+                        (
+                            ("replica", self._replica_id),
+                            ("tier", str(tier)),
+                            ("lane", str(lane)),
+                        ),
+                        list(slot["bytes"]),
+                        slot["bytes_sum"],
+                    )
                 )
         out = render_histogram_counts(
             "tpuft_worker_hop_latency_seconds",
@@ -1442,6 +1478,14 @@ class Manager:
             "per-hop wire payload bytes from the retained hop timeline, "
             "per ring tier (monotonic across scrapes)",
             HOP_BYTES_BOUNDS, byte_series,
+        )
+        out += render_histogram_counts(
+            "tpuft_hop_bytes",
+            "per-hop wire payload bytes split per ring tier AND lane, from "
+            "the retained hop timeline (monotonic across scrapes) — the "
+            "lane split exposes striped-ring byte skew the per-tier "
+            "histogram averages away",
+            HOP_BYTES_BOUNDS, lane_byte_series,
         )
         return out
 

@@ -31,7 +31,7 @@ codec="auto"): the old API and blocking semantics, now running on this
 engine.
 
 Knobs (all overridable per-instance):
-  TPUFT_SEMISYNC_CODEC           int8 | bf16 | f32 | auto   (default int8)
+  TPUFT_SEMISYNC_CODEC           int8 | int4 | bf16 | f32 | auto  (default int8)
   TPUFT_SEMISYNC_FRAGMENT_BYTES  fragment size              (default 4 MiB)
   TPUFT_SEMISYNC_STREAM          1 = background streaming   (default 1)
   TPUFT_SEMISYNC_METRICS_PORT    serve tpuft_semisync_* /metrics (unset=off)

@@ -137,16 +137,21 @@ class SyncEngine:
                 # happened in the codec; the ring just won't re-encode —
                 # degrade to the collective's own wire policy.
                 wire_codec = None
+            # The encoded payload is a fresh per-round buffer the engine
+            # never reads again — donate it so the ring may reduce in place
+            # (zero working-buffer copy on the native engine).
             if wire_codec is not None:
                 fut = manager.allreduce(
                     payload,
                     allow_wire_compression=codec.allow_wire_compression,
                     wire_codec=wire_codec,
+                    donate=True,
                 )
             else:
                 fut = manager.allreduce(
                     payload,
                     allow_wire_compression=codec.allow_wire_compression,
+                    donate=True,
                 )
             # Block the WORKER (not the train thread) until the averaged
             # fragment lands; failures resolve to the input with the error
