@@ -2131,6 +2131,36 @@ def diloco_benchmark() -> dict:
     return payload
 
 
+def elastic_benchmark() -> dict:
+    """Elastic quorum scenario (``--scenario elastic``): a seeded
+    spot-market arrival/departure trace over live Manager groups with the
+    elastic batch engine holding the global batch constant — cooperative
+    drains + hot-admit joins crossing the ring2d/ring boundary both ways,
+    EC re-shard at every transition, scored by the goodput ledger's commit
+    stream against a fixed-size no-churn oracle.  The heavy lifting lives
+    in bench_elastic.py (quick mode is tier-1's test_elastic_quick_smoke);
+    writes ELASTIC_BENCH.json."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import bench_elastic
+    finally:
+        sys.path.pop(0)
+    payload = bench_elastic.run_full(
+        workdir=os.environ.get("TPUFT_BENCH_WORKDIR"),
+        seed=int(os.environ.get("TPUFT_BENCH_ELASTIC_SEED", "20")),
+        global_batch=int(os.environ.get("TPUFT_BENCH_ELASTIC_GLOBAL_BATCH", "32")),
+        per_sample_s=float(
+            os.environ.get("TPUFT_BENCH_ELASTIC_PER_SAMPLE_S", "0.02")
+        ),
+    )
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "ELASTIC_BENCH.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return payload
+
+
 def main() -> None:
     # The chip result is computed, assembled, and (on any kill-scenario
     # failure) still printed first: a failure on the subprocess-heavy kill
@@ -2209,6 +2239,7 @@ def selftest() -> None:
     inspect.signature(lighthouse_failover_benchmark).bind()
     inspect.signature(scale_benchmark).bind()
     inspect.signature(diloco_benchmark).bind()
+    inspect.signature(elastic_benchmark).bind()
     plans = _trial_plans(10)
     assert len(plans) == 10
     assert {p["type"] for p in plans} == {
@@ -2227,11 +2258,37 @@ if __name__ == "__main__":
         which = sys.argv[sys.argv.index("--scenario") + 1:]
         if not which or which[0] not in (
             "drain", "kill", "straggler", "slo", "lighthouse-failover",
-            "scale", "diloco",
+            "scale", "diloco", "elastic",
         ):
             print(f"unknown --scenario {which[:1] or '(missing)'}", file=sys.stderr)
             sys.exit(2)
-        if which[0] == "diloco":
+        if which[0] == "elastic":
+            elastic = elastic_benchmark()
+            print(
+                json.dumps(
+                    {
+                        "metric": "elastic_goodput",
+                        "value": elastic["goodput_ratio_vs_oracle"],
+                        "unit": "goodput_fraction_of_fixed_size_oracle",
+                        "detail": {
+                            "ok": elastic["ok"],
+                            "max_transition_dead_s": elastic[
+                                "max_transition_dead_s"
+                            ],
+                            "survivor_failed_commits": elastic[
+                                "survivor_failed_commits"
+                            ],
+                            "constant_global_batch": elastic[
+                                "constant_global_batch"
+                            ],
+                            "crossover_exercised": elastic[
+                                "crossover_exercised"
+                            ],
+                        },
+                    }
+                )
+            )
+        elif which[0] == "diloco":
             diloco = diloco_benchmark()
             print(
                 json.dumps(

@@ -274,6 +274,15 @@ int tf_ring_set_tier(void* p, int32_t tier, int32_t nlanes, const int32_t* next_
 
 void tf_ring_close(void* p) { static_cast<RingEngine*>(p)->Close(); }
 
+int tf_ring_detach(void* p, char** err) {
+  std::string e;
+  if (!static_cast<RingEngine*>(p)->Detach(&e)) {
+    SetErr(err, e);
+    return 3;
+  }
+  return 0;
+}
+
 void tf_ring_free(void* p) { delete static_cast<RingEngine*>(p); }
 
 int tf_ring_open_fds(void* p) { return static_cast<RingEngine*>(p)->OpenFds(); }

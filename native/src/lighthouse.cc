@@ -25,7 +25,7 @@ int64_t NowEpochMs() {
 // flight-event kinds.  Append-only; never reorder.
 constexpr const char* kLedgerCauses[kLedgerCauseCount] = {
     "wire",        "stall", "combine", "shaping",  "quorum_server",
-    "quorum_transport", "heal",  "drain",   "other_ft"};
+    "quorum_transport", "heal",  "drain",   "other_ft", "resize"};
 
 // ---------------------------------------------------------------------------
 // Pure quorum math.  Reference parity: quorum_compute, src/lighthouse.rs:133-261.

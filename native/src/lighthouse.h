@@ -99,7 +99,7 @@ struct LinkHealth {
 // Number of lost-cause classes in the goodput ledger's pinned taxonomy
 // (kLedgerCauses in lighthouse.cc == torchft_tpu/obs/ledger.py
 // LOST_CAUSES; the heartbeat's ledger_lost_seconds vector order).
-constexpr size_t kLedgerCauseCount = 9;
+constexpr size_t kLedgerCauseCount = 10;
 
 // Goodput-ledger counters for one replica incarnation, as last reported on
 // its heartbeats (fields 14-16).  Monotonic per incarnation; a restart is

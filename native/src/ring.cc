@@ -1054,6 +1054,106 @@ void RingEngine::Close() {
   }
 }
 
+bool RingEngine::Detach(std::string* err) {
+  std::lock_guard<std::mutex> lk(close_mu_);
+  if (closed_.load()) {
+    *err = "ring engine already closed";
+    return false;
+  }
+  // Fence new op entries first (CheckOpEntry reads closed_), then require
+  // quiescence.  A racing op that slipped past the fence shows up in
+  // active_ops_ within its first instruction; the bounded wait below
+  // rides that out.  If ops genuinely are in flight the caller's
+  // incremental reconfigure was wrong to try — degrade to the Close()
+  // semantics (sockets shut down, full-path rebuild) and report failure.
+  closed_.store(true);
+  double deadline = NowS() + 0.5;
+  while (active_ops_.load() > 0 && NowS() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  // Stop the sender threads and the multi-stripe pool.  Quiescent links
+  // have empty queues; any stragglers are failed with kClosed exactly as
+  // Close() does.
+  for (auto& t : tiers_) {
+    if (!t.present) continue;
+    for (auto& l : t.next) {
+      {
+        std::lock_guard<std::mutex> qlk(l->qmu);
+        l->stop = true;
+        for (auto& job : l->queue) {
+          job->Finish(RingStatus::kClosed, "ring engine detached");
+        }
+        l->queue.clear();
+      }
+      l->qcv.notify_all();
+    }
+  }
+  {
+    std::lock_guard<std::mutex> mlk(mw_mu_);
+    mw_stop_ = true;
+    mw_queue_.clear();
+  }
+  mw_cv_.notify_all();
+  for (auto& th : mw_threads_) {
+    if (th.joinable()) th.join();
+  }
+  mw_threads_.clear();
+  bool quiescent = active_ops_.load() == 0;
+  if (!quiescent) {
+    // A straggler op slipped in: degrade to the Close() contract — wake
+    // it with shutdown (the shared sockets are sacrificed; the Python
+    // side sees dead lanes and takes the full-rendezvous path), drain
+    // with fd numbers still valid, THEN close.
+    for (auto& t : tiers_) {
+      if (!t.present) continue;
+      for (auto& l : t.next) {
+        PoisonLink(l.get(), "ring engine detached");
+        if (l->fd >= 0) ::shutdown(l->fd, SHUT_RDWR);
+      }
+      for (auto& l : t.prev) {
+        PoisonLink(l.get(), "ring engine detached");
+        if (l->fd >= 0) ::shutdown(l->fd, SHUT_RDWR);
+        l->rcv.notify_all();
+      }
+    }
+    double drain = NowS() + 2.0;
+    while (active_ops_.load() > 0 && NowS() < drain) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+  }
+  bool drained = active_ops_.load() == 0;
+  for (auto& t : tiers_) {
+    if (!t.present) continue;
+    for (auto& l : t.next) {
+      if (l->sender.joinable()) l->sender.join();
+      if (l->fd >= 0) {
+        ::close(l->fd);
+        l->fd = -1;
+      }
+      if (drained && l->shm != nullptr) {
+        ::munmap(l->shm, l->shm_len);
+        l->shm = nullptr;
+      }
+    }
+    for (auto& l : t.prev) {
+      if (l->fd >= 0) {
+        ::close(l->fd);
+        l->fd = -1;
+      }
+      if (drained && l->shm != nullptr) {
+        ::munmap(l->shm, l->shm_len);
+        l->shm = nullptr;
+      }
+      l->rcv.notify_all();
+    }
+  }
+  if (!quiescent) {
+    *err = "ops in flight during detach";
+    return false;
+  }
+  return true;
+}
+
 int RingEngine::OpenFds() const {
   int n = 0;
   for (const auto& t : tiers_) {

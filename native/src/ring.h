@@ -165,6 +165,20 @@ class RingEngine {
   // kClosed).  This is what abort()/_fail_ring latch onto.
   void Close();
 
+  // Quiescent teardown for INCREMENTAL reconfiguration: releases every
+  // dup'd fd with plain close() — never shutdown(), so the underlying
+  // sockets the Python side still owns stay connected and the next
+  // engine generation can re-adopt them — joins the sender/multi-pool
+  // threads and unmaps shm segments (the segment files persist; the new
+  // generation re-attaches by path + token).  Refuses (returns false,
+  // engine untouched) when any op is in flight: a mid-op detach would
+  // leave the reused socket mid-frame.  The engine is closed afterwards.
+  bool Detach(std::string* err);
+
+  // Close()/Detach() already ran (a detached engine stays safely inert
+  // until freed).
+  bool Closed() const { return closed_.load(); }
+
   // Dup'd fds still open (the fd-leak sweep's native counterpart).
   int OpenFds() const;
 

@@ -541,6 +541,67 @@ def test_diloco_quick_smoke() -> None:
     assert payload["ok"], payload
 
 
+def test_elastic_quick_smoke() -> None:
+    """bench_elastic --quick in-process: a 3-group spot-market trace
+    (leave/join/leave over cooperative drain notices) scored against a
+    fixed-size oracle.  The tier-1 gate on the elastic tentpole: goodput
+    within the oracle gate, ZERO failed survivor commits across every
+    transition, constant global batch in every committed step record,
+    incremental lane reconfiguration engaged, proactive EC re-shard on
+    membership change, and no leaked fds — plus the ELASTIC_BENCH.json
+    schema the full artifact is built from."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench_elastic
+    finally:
+        sys.path.pop(0)
+    payload = bench_elastic.run_quick()
+    # Schema contract: the keys the full ELASTIC_BENCH.json artifact is
+    # built from (bench.py --scenario elastic writes the same dict).
+    for key in ("metric", "quick", "seed", "global_batch", "elastic",
+                "oracle", "goodput_ratio_vs_oracle", "goodput_gate",
+                "dead_time_baseline_s", "max_transition_dead_s",
+                "survivor_failed_commits", "constant_global_batch",
+                "fd_leaked_total", "crossover_exercised", "ok"):
+        assert key in payload, f"ELASTIC_BENCH schema missing {key}"
+    assert payload["quick"] is True
+    cell = payload["elastic"]
+    for key in ("committed_steps", "membership_changes", "reconfigure_modes",
+                "ec_reshard_pushes", "elastic_records", "transitions",
+                "transitions_stabilized", "survivor_failed_commits",
+                "max_transition_dead_s", "fd_leaked", "ok"):
+        assert key in cell, f"elastic cell schema missing {key}"
+    assert payload["goodput_ratio_vs_oracle"] >= payload["goodput_gate"], payload
+    # The headline criteria: departures are notice-driven, so NO survivor
+    # ever fails a commit, and the batch engine holds the global batch
+    # constant through every membership size it saw.
+    assert payload["survivor_failed_commits"] == 0, payload
+    assert payload["constant_global_batch"] is True, payload
+    assert payload["max_transition_dead_s"] < payload["dead_time_baseline_s"]
+    assert payload["fd_leaked_total"] == 0
+    assert cell["membership_changes"] > 0
+    assert cell["reconfigure_modes"].get("incremental", 0) > 0, cell
+    assert cell["ec_reshard_pushes"] > 0, cell
+    assert cell["elastic_records"]["committed_with_plan"] > 0
+    assert len(cell["elastic_records"]["participants_seen"]) >= 2
+    assert payload["ok"], payload
+
+    # The committed full-trace artifact carries the strict gates plus the
+    # ring2d<->ring crossover pin quick mode cannot exercise.
+    with open(os.path.join(REPO, "ELASTIC_BENCH.json")) as f:
+        artifact = json.load(f)
+    assert artifact["metric"] == "elastic_goodput_vs_oracle"
+    assert artifact["quick"] is False
+    assert artifact["goodput_ratio_vs_oracle"] >= artifact["goodput_gate"]
+    assert artifact["survivor_failed_commits"] == 0
+    assert artifact["constant_global_batch"] is True
+    assert artifact["max_transition_dead_s"] < artifact["dead_time_baseline_s"]
+    assert artifact["crossover_exercised"] is True
+    assert artifact["elastic"]["reconfigure_modes"].get("incremental", 0) > 0
+    assert artifact["elastic"]["ec_reshard_pushes"] > 0
+    assert artifact["ok"] is True
+
+
 def test_bench_selftest() -> None:
     """bench.py --selftest verifies its own scenario-call signatures without
     touching the chip or spawning training subprocesses."""

@@ -536,8 +536,22 @@ def test_report_splits_quorum_wait_with_flight_data(tmp_path) -> None:
     assert t["quorum_wait_s"] > 0
     assert t["quorum_server_s"] > 0, "no server-side time matched by trace id"
     assert t["quorum_server_s"] <= t["quorum_wait_s"] + 1e-9
-    assert abs(t["quorum_server_s"] + t["quorum_transport_s"]
-               - t["quorum_wait_s"]) < 1e-6
+    # The server/transport split is exact per MATCHED interval.  An
+    # interval may legitimately match nothing: a quorum RPC answered from
+    # the already-formed quorum within one lighthouse tick triggers no new
+    # formation, so there is no server span to join and the split stays at
+    # its informational zero while the (sub-tick) wait is still counted.
+    matched = 0
+    for row in result["steps"]:
+        if row["quorum_server_s"] > 0:
+            matched += 1
+            # Row values are rounded to 4 decimals by attribute().
+            assert abs(row["quorum_server_s"] + row["quorum_transport_s"]
+                       - row["quorum_wait_s"]) < 5e-4, row
+        else:
+            assert row["quorum_transport_s"] == 0.0, row
+            assert row["quorum_wait_s"] < 0.05, row  # sub-tick fast answer
+    assert matched > 0
     # Without flight data the split stays zero (informational default).
     plain = obs_report.attribute(events)
     assert plain["totals"]["quorum_server_s"] == 0.0

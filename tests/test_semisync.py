@@ -522,6 +522,90 @@ def test_fragment_writeback_rejects_tree_scope() -> None:
         )
 
 
+def test_fragment_commit_mode_per_fragment_votes() -> None:
+    """``fragment_commit=True``: every fragment runs its OWN quorum and
+    commit vote, a failed vote rolls back only that fragment (write-back
+    of the pre-round backup leaf), and committed fragments promote their
+    backups independently — one fragment's abort never discards its
+    siblings' outer steps."""
+    import jax
+    import optax
+
+    from torchft_tpu.semisync import StreamingDiLoCo
+
+    manager = _mock_manager()
+    # Fragment 1's vote fails; 0, 2, 3 commit.
+    manager.should_commit.side_effect = [True, False, True, True]
+
+    class Box:
+        params = {f"w{i}": np.ones(256, dtype=np.float32) for i in range(4)}
+        set_calls = 0
+        frag_calls: list = []
+
+        def get(self):
+            return self.params
+
+        def set(self, p):
+            Box.set_calls += 1
+            self.params = p
+
+        def set_fragment(self, indices, leaves):
+            Box.frag_calls.append(list(indices))
+            flat = list(jax.tree.flatten(self.params)[0])
+            for i, leaf in zip(indices, leaves):
+                flat[i] = leaf
+            self.params = jax.tree.unflatten(
+                jax.tree.structure(self.params), flat
+            )
+
+    box = Box()
+    algo = StreamingDiLoCo(
+        manager, box.get, box.set, optax.sgd(0.5), sync_every=1,
+        fragment_bytes=1024, stream=False,
+        set_fragment_params=box.set_fragment, fragment_commit=True,
+    )
+    assert algo.num_fragments == 4
+    backup_before = [
+        np.array(l, copy=True) for l in jax.tree.flatten(algo.backup_params)[0]
+    ]
+    box.params = {k: np.zeros(256, dtype=np.float32) for k in box.params}
+    algo.step()
+
+    # One quorum + one vote PER FRAGMENT, one write-back per fragment
+    # covering every leaf once, never the whole-tree set_params.
+    assert manager.start_quorum.call_count == 4
+    assert manager.should_commit.call_count == 4
+    assert Box.set_calls == 0
+    assert sorted(i for c in Box.frag_calls for i in c) == [0, 1, 2, 3]
+
+    backup_after = jax.tree.flatten(algo.backup_params)[0]
+    live = jax.tree.flatten(box.params)[0]
+    # Fragment 1 aborted: backup untouched, live leaf rolled back to it.
+    assert np.array_equal(backup_after[1], backup_before[1])
+    assert np.array_equal(live[1], backup_before[1])
+    # Fragments 0, 2, 3 committed: pseudogradient = backup - live = 1.0,
+    # outer SGD at lr 0.5 moves each backup to 0.5 and lands it live.
+    for i in (0, 2, 3):
+        assert np.array_equal(live[i], backup_after[i]), i
+        assert np.allclose(backup_after[i], 0.5), i
+
+
+def test_fragment_commit_requires_fragment_writeback() -> None:
+    """fragment_commit without a per-fragment write-back hook cannot honor
+    a mixed verdict (some fragments committed, some not) — rejected at
+    construction, not at the first mixed round."""
+    import optax
+
+    from torchft_tpu.semisync import StreamingDiLoCo
+
+    with pytest.raises(ValueError, match="set_fragment_params"):
+        StreamingDiLoCo(
+            _mock_manager(), lambda: {"w": np.ones(4, dtype=np.float32)},
+            lambda p: None, optax.sgd(0.5), sync_every=1, stream=False,
+            fragment_commit=True,
+        )
+
+
 def test_sync_max_retries_still_propagates() -> None:
     """ExceededMaxRetriesError is the give-up contract, not a sync
     failure: the latch-and-continue path must not swallow it."""

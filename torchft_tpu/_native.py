@@ -284,6 +284,11 @@ def _bind_ring(lib: ctypes.CDLL) -> Optional[str]:
         ]
         lib.tf_ring_close.argtypes = [ctypes.c_void_p]
         lib.tf_ring_free.argtypes = [ctypes.c_void_p]
+        lib.tf_ring_detach.restype = ctypes.c_int
+        lib.tf_ring_detach.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
         lib.tf_ring_open_fds.restype = ctypes.c_int
         lib.tf_ring_open_fds.argtypes = [ctypes.c_void_p]
         lib.tf_ring_exchange.restype = ctypes.c_int
@@ -1442,6 +1447,19 @@ class RingEngine:
         threads; idempotent, safe mid-op (blocked ops fail fast)."""
         if self._ptr:
             _lib.tf_ring_close(self._ptr)
+
+    def detach(self) -> None:
+        """Quiescent teardown for incremental reconfiguration: releases
+        the dup'd lane fds WITHOUT socket shutdown, so the collective's
+        surviving sockets stay connected for the next engine generation
+        (shm segment files persist too; only the mappings drop).  Raises
+        if ops were in flight — the caller must then treat the lanes as
+        dead and take the full-rendezvous path."""
+        if self._ptr:
+            err = ctypes.c_char_p()
+            rc = _lib.tf_ring_detach(self._ptr, ctypes.byref(err))
+            if rc != 0:
+                raise RuntimeError(_take_error(err))
 
     def __del__(self) -> None:
         try:

@@ -987,6 +987,19 @@ _NATIVE_PASS_AG = 2
 TPUFT_RING_TRANSPORT_ENV = "TPUFT_RING_TRANSPORT"
 _TRANSPORTS = ("tcp", "shm", "auto")
 
+# Incremental reconfiguration (docs/architecture.md "Elastic scale").  A
+# membership delta that preserves this rank's flat-ring position reuses
+# the surviving lane sockets and shm segments instead of the full
+# teardown-and-rendezvous — the dominant per-transition dead-time cost
+# under churn.  Default on; "0" forces the full path on every quorum
+# transition (the parity baseline the elastic soak compares against).
+TPUFT_INCREMENTAL_RECONF_ENV = "TPUFT_INCREMENTAL_RECONF"
+
+
+def _incremental_from_env() -> bool:
+    v = os.environ.get(TPUFT_INCREMENTAL_RECONF_ENV, "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
 # Per-link SPSC ring capacity (data bytes past the 64-byte header).
 # Frames larger than the capacity flow through in pieces, so this bounds
 # memory, not payload size.
@@ -1290,6 +1303,29 @@ class TCPCollective(Collective):
         self._fifo_lock = threading.Lock()
         self._fifo: dict[tuple, "_FifoQueue"] = {}
         self._p2p_submit_lock = threading.Lock()
+        # Incremental (elastic) reconfiguration state.  Each flat-ring
+        # neighbor's identity is its published listener address plus an
+        # incarnation token minted with the listener — equal identity
+        # across a quorum transition proves the SAME process still holds
+        # the other end of our lane sockets, so the edge can be reused.
+        # The prev-direction shapers live on the instance (not the accept
+        # loop's closure) so an accept loop started by one generation can
+        # arm peers for a later incremental generation.
+        self._incremental = _incremental_from_env()
+        self._self_addr: Optional[str] = None
+        self._listener_token = ""
+        self._neighbor_ids: Dict[str, tuple] = {}
+        self._ring_prev_shaper: Optional[LinkShaper] = None
+        self._tier_prev_shapers: Dict[int, Optional[LinkShaper]] = {}
+        # What the LAST configure() did — the Manager's membership_change
+        # event and the elastic bench read this to attribute transition
+        # cost to the full vs incremental path.
+        self.last_configure: Dict[str, object] = {
+            "mode": "none",
+            "reused_lanes": 0,
+            "opened_lanes": 0,
+            "configure_s": 0.0,
+        }
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -1319,6 +1355,9 @@ class TCPCollective(Collective):
         return "ring2d" if world_size >= self._ring2d_min else "ring"
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        t0 = time.monotonic()
+        if self._configure_incremental(store_addr, rank, world_size, t0):
+            return
         self.abort()
         with self._lock:
             self._error = None
@@ -1338,6 +1377,12 @@ class TCPCollective(Collective):
             # reset them; the timeline ring persists across generations —
             # it is the bounded black box, not a counter).
             if world_size == 1:
+                self.last_configure = {
+                    "mode": "full",
+                    "reused_lanes": 0,
+                    "opened_lanes": 0,
+                    "configure_s": time.monotonic() - t0,
+                }
                 return
             self._store = StoreClient(store_addr)
             self._rendezvous()
@@ -1383,6 +1428,240 @@ class TCPCollective(Collective):
             self._executor = ThreadPoolExecutor(
                 max_workers=4, thread_name_prefix="tpuft_p2p"
             )
+            opened = len(self._next_lanes) + len(self._prev_lanes)
+            for tier in (self._row_tier, self._col_tier):
+                if tier is not None:
+                    opened += len(tier.next_lanes) + len(tier.prev_lanes)
+            self.last_configure = {
+                "mode": "full",
+                "reused_lanes": 0,
+                "opened_lanes": opened,
+                "configure_s": time.monotonic() - t0,
+            }
+
+    def _configure_incremental(
+        self, store_addr: str, rank: int, world_size: int, t0: float
+    ) -> bool:
+        """Quorum-transition fast path: when this rank's flat-ring position
+        survives the membership delta, reuse the surviving lane sockets and
+        shm segments and open only the edges that changed, instead of the
+        full teardown-and-rendezvous (the dominant per-transition dead-time
+        cost under churn).  Returns False — the caller then runs the full
+        path — whenever a precondition fails or any step slips; the
+        subsequent abort() reclaims everything a partial attempt registered
+        on self.
+
+        Protocol: every configuring rank publishes ``rank_{r}`` (listener
+        address — stable here, the listener is kept) and ``cfg_{r}``
+        ("inc:<token>" on this path, "full:<token>" on the full path) into
+        the NEW quorum's store namespace.  An edge is reused iff the
+        neighbor's published (addr, token) identity equals the identity
+        recorded at the previous configure AND its mode is "inc" (a "full"
+        neighbor's old sockets were closed by its abort()).  Both ends of
+        a surviving edge evaluate the same two records, so the decision is
+        symmetric.  Once this rank has PUBLISHED it commits to the
+        incremental path even when no edge survives (both neighbors
+        replaced — it then rebuilds every edge over the kept listener):
+        the published address is live the moment the key lands, so a
+        fresh neighbor may already hold a connection to it.  The rare
+        asymmetric slip (a rank aborts to the full path AFTER publishing
+        "inc", e.g. a peer crash mid-configure) leaves the reusing side
+        holding a dead socket, which surfaces as an op error and recovers
+        on the next quorum — the same contract as the crash itself.
+        Late-arriving spares take the full path (nothing of theirs
+        survives) and hot-admit by dialing the survivors' kept listeners.
+        """
+        if not self._incremental:
+            return False
+        with self._lock:
+            try:
+                return self._configure_incremental_locked(
+                    store_addr, rank, world_size, t0
+                )
+            except Exception:  # noqa: BLE001 — any slip falls back to full
+                return False
+
+    def _configure_incremental_locked(
+        self, store_addr: str, rank: int, world_size: int, t0: float
+    ) -> bool:
+        # Preconditions: a live single-tier ring on BOTH sides of the
+        # transition (ring2d crossovers always rebuild — tier membership
+        # changes shape, not just neighbors), a kept listener, no latched
+        # error, and nothing in flight (the Manager reconfigures at a step
+        # boundary; in-flight work means something already failed).
+        if (
+            self._listener is None
+            or self._self_addr is None
+            or not self._neighbor_ids
+            or self._world_size <= 1
+            or world_size <= 1
+            or self._error is not None
+            or self._op_error is not None
+            or self._inflight
+            or self._active_topology != "ring"
+            or self._resolve_topology(world_size) != "ring"
+            or not self._next_lanes
+            or not self._prev_lanes
+            or self._ring_executor is None
+        ):
+            return False
+        old_next_id = self._neighbor_ids.get("next")
+        old_prev_id = self._neighbor_ids.get("prev")
+        if old_next_id is None or old_prev_id is None:
+            return False
+        store = StoreClient(store_addr)
+        old_store, self._store = self._store, store
+        if old_store is not None:
+            try:
+                old_store.close()
+            except Exception:  # noqa: BLE001
+                pass
+        # Purge point-to-point links and stale accepted conns BEFORE
+        # publishing our address: ranks renumber (p2p can never survive),
+        # and a fast new neighbor may dial the moment it reads the key —
+        # its lanes must land in _accepted_ring AFTER this sweep, not be
+        # closed by it.  Generation bump invalidates in-flight dials,
+        # exactly as abort() does.
+        with self._accept_cond:
+            stale = list(self._peers.values()) + list(self._accepted_ring.values())
+            self._peers = {}
+            self._accepted_ring = {}
+            self._generation += 1
+            self._dialing = set()
+            self._accept_cond.notify_all()
+        for p in stale:
+            p.close()
+        # Fresh prev-direction shaper installed before the publish for the
+        # same reason; if the prev edge ends up reused, the accepted-lane
+        # path never reads it and the reused peers keep their own shaper.
+        self._ring_prev_shaper = LinkShaper.from_env()
+        store.set(f"rank_{rank}", self._self_addr.encode())
+        store.set(f"cfg_{rank}", f"inc:{self._listener_token}".encode())
+        next_rank = (rank + 1) % world_size
+        prev_rank = (rank - 1) % world_size
+        # Full rendezvous budget, not the surviving-neighbor short wait: a
+        # REPLACED neighbor is a fresh process that may publish late
+        # (restart + runtime init), and the full path would wait just as
+        # long for its dial.
+        ident_ms = self.RENDEZVOUS_TIMEOUT_MS
+        next_id = self._peer_identity(next_rank, timeout_ms=ident_ms)
+        prev_id = self._peer_identity(prev_rank, timeout_ms=ident_ms)
+        if next_id is None or prev_id is None:
+            return False
+        reuse_next = next_id[2] == "inc" and next_id[:2] == old_next_id
+        reuse_prev = prev_id[2] == "inc" and prev_id[:2] == old_prev_id
+        # When NOTHING survives (e.g. world 2 and the only neighbor was
+        # replaced by a fresh incarnation publishing "full") we still stay
+        # on this path and rebuild both edges over the KEPT listener.
+        # Falling back to full here would be unsound, not just slow: our
+        # address + "inc" marker are already published, and a fresh
+        # neighbor may have dialed that listener the moment the key
+        # appeared — the fallback's abort() would close it under them,
+        # they'd finish their rendezvous holding dead sockets, and our
+        # full-path replacement listener would wait out the whole
+        # rendezvous timeout for a dial that never comes (a survivor +
+        # restarted-peer pair stalled 60 s per transition this way).
+        # Bank the closing generation's counters while the native engine
+        # (if any) is still readable, then DETACH it: plain close() of its
+        # dup'd fds — unlike Close()'s shutdown(), the reused sockets'
+        # underlying connections stay alive.  A detach refusal (ops in
+        # flight) raises and falls back to the full path.
+        self._bank_locked()
+        engine, self._engine = self._engine, None
+        if engine is not None:
+            engine.detach()
+        # Close the edges that did not survive; zero the surviving ones'
+        # per-generation counters (their totals were just banked) and drop
+        # their native hooks until _create_engine rewires them.
+        keep_paths: set = set()
+        for reused, lanes_list in (
+            (reuse_next, self._next_lanes),
+            (reuse_prev, self._prev_lanes),
+        ):
+            sh = lanes_list[0].shaper if lanes_list else None
+            if reused and sh is not None:
+                sh._native_read = None
+                sh._native_wait = None
+                with sh._lock:
+                    sh._bytes_sent = 0
+                    sh._frames_sent = 0
+                    sh._wait_s = 0.0
+                    sh._busy_until = 0.0
+            for p in lanes_list:
+                if reused:
+                    p._bytes_out = 0
+                    p._bytes_in = 0
+                    p._native_bytes = None
+                    if p._shm_pending is not None:
+                        keep_paths.add(p._shm_pending[0])
+                else:
+                    p.close()
+        # Reclaim only the segments whose edges died; surviving segments
+        # keep their names (the re-built engine re-attaches them by the
+        # unchanged header token).
+        with self._shm_lock:
+            drop = [sp for sp in self._shm_paths if sp not in keep_paths]
+            self._shm_paths = set(keep_paths)
+        for sp in drop:
+            try:
+                os.unlink(sp)
+            except OSError:
+                pass
+        self._error = None
+        self._op_error = None
+        self._rank = rank
+        self._world_size = world_size
+        self._active_topology = "ring"
+        with self._op_seq_lock:
+            self._op_seq = 0
+        with self._fifo_lock:
+            self._fifo = {}
+        # Open only the changed edges.  Executors and the accept loop are
+        # generation-agnostic and stay up — that, plus the kept sockets,
+        # is the entire dead-time win.
+        lanes = self._lanes
+        opened = 0
+        if not reuse_next:
+            next_shaper = LinkShaper.from_env()
+            self._next_lanes = []
+            for lane in range(lanes):
+                self._next_lanes.append(
+                    self._dial_rank(
+                        next_rank, self._CH_RING, lane=lane, shaper=next_shaper
+                    )
+                )
+            opened += lanes
+        if not reuse_prev:
+            self._prev_lanes = []
+            expected = [(prev_rank, self._CH_RING, lane) for lane in range(lanes)]
+            deadline = self.RENDEZVOUS_TIMEOUT_MS / 1000
+            with self._accept_cond:
+                ok = self._accept_cond.wait_for(
+                    lambda: all(key in self._accepted_ring for key in expected),
+                    timeout=deadline,
+                )
+                if not ok:
+                    missing = [k for k in expected if k not in self._accepted_ring]
+                    raise TimeoutError(
+                        f"incremental reconfigure: ring peers never connected: "
+                        f"{missing}"
+                    )
+                self._prev_lanes = [
+                    self._accepted_ring.pop((prev_rank, self._CH_RING, lane))
+                    for lane in range(lanes)
+                ]
+            opened += lanes
+        self._engine = self._create_engine()
+        self._arm_shm_links()
+        self._neighbor_ids = {"next": next_id[:2], "prev": prev_id[:2]}
+        self.last_configure = {
+            "mode": "incremental",
+            "reused_lanes": (lanes if reuse_next else 0)
+            + (lanes if reuse_prev else 0),
+            "opened_lanes": opened,
+            "configure_s": time.monotonic() - t0,
+        }
+        return True
 
     @property
     def ring_engine(self) -> str:
@@ -1489,16 +1768,27 @@ class TCPCollective(Collective):
         listener = socket.create_server(("", 0), family=socket.AF_INET6, dualstack_ipv6=True)
         listener.listen(16 + 6 * self._lanes)
         self._listener = listener
+        # Incarnation token: minted with the listener, republished by every
+        # incremental configure.  (addr, token) equality across a quorum
+        # transition is the proof the SAME process incarnation still holds
+        # the far end of our lane sockets — an address alone could be a
+        # respawn that recycled the ephemeral port.
+        self._listener_token = os.urandom(8).hex()
         port = listener.getsockname()[1]
         host = socket.gethostname()
-        self._store.set(f"rank_{self._rank}", f"{host}:{port}".encode())
+        self._self_addr = f"{host}:{port}"
+        self._store.set(f"rank_{self._rank}", self._self_addr.encode())
+        # Mode token: "full" tells neighbors our previous sockets are GONE
+        # (abort() closed them) so they must not try to reuse the edge.
+        self._store.set(
+            f"cfg_{self._rank}", f"full:{self._listener_token}".encode()
+        )
 
         n = self._world_size
         rank = self._rank
         lanes = self._lanes
         next_rank = (rank + 1) % n
         prev_rank = (rank - 1) % n
-        gen = self._generation
         # One serialization budget per peer DIRECTION, shared by every lane
         # of that direction: shaped benches cannot widen the modeled link by
         # adding lanes, and the direction's byte counters stay whole.  Each
@@ -1533,13 +1823,18 @@ class TCPCollective(Collective):
                 (self._CH_ROW, self._row_tier, LinkShaper.from_env()),
                 (self._CH_COL, self._col_tier, LinkShaper.from_env()),
             ]
-        tier_prev_shapers = {ch: sh for ch, _t, sh in tier_specs}
+        self._ring_prev_shaper = prev_shaper
+        self._tier_prev_shapers = {ch: sh for ch, _t, sh in tier_specs}
 
         # Persistent accept loop: registers the per-lane ring links from
         # prev (flat and tier rings, keyed by channel) and any lazily-dialed
         # point-to-point links (used by checkpoint transports to move
         # weights between arbitrary replica pairs, the reference's
         # pg.send/recv path, torchft/checkpointing/pg_transport.py:197-301).
+        # Keyed by LISTENER identity, not generation: an incremental
+        # reconfigure bumps the generation but keeps this listener (and
+        # this loop) alive across quorum transitions; prev-direction
+        # shapers are read off the instance for the same reason.
         def accept_loop() -> None:
             while True:
                 try:
@@ -1559,16 +1854,16 @@ class TCPCollective(Collective):
                     if channel != self._CH_P2P and self._transport != "tcp":
                         self._shm_accept_handshake(peer, their_rank, channel, lane)
                     with self._accept_cond:
-                        if self._generation != gen:
+                        if self._listener is not listener:
                             conn.close()
                             return
                         if channel == self._CH_P2P:
                             self._peers[their_rank] = peer
                         else:
                             if channel == self._CH_RING:
-                                peer.shaper = prev_shaper
+                                peer.shaper = self._ring_prev_shaper
                             else:
-                                peer.shaper = tier_prev_shapers.get(channel)
+                                peer.shaper = self._tier_prev_shapers.get(channel)
                             self._accepted_ring[(their_rank, channel, lane)] = peer
                         self._accept_cond.notify_all()
                 except Exception:  # noqa: BLE001
@@ -1615,6 +1910,37 @@ class TCPCollective(Collective):
                     self._accepted_ring.pop((tier.prev_rank, channel, lane))
                     for lane in range(lanes)
                 ]
+        # Record each flat-ring neighbor's (addr, token) identity: the
+        # evidence the NEXT configure compares to decide whether this
+        # edge's sockets survived the membership delta.  Flat ring only —
+        # ring2d transitions always take the full path.  Best-effort: a
+        # missing identity just forces the full path next time.
+        self._neighbor_ids = {}
+        if self._active_topology == "ring":
+            try:
+                nxt = self._peer_identity(next_rank)
+                prv = self._peer_identity(prev_rank)
+                if nxt is not None and prv is not None:
+                    self._neighbor_ids = {"next": nxt[:2], "prev": prv[:2]}
+            except Exception:  # noqa: BLE001 — reuse hint only
+                pass
+
+    def _peer_identity(
+        self, peer_rank: int, timeout_ms: int = 10_000
+    ) -> Optional[tuple]:
+        """``(addr, token, mode)`` published by ``peer_rank`` in the
+        current store namespace — both keys are published before that
+        rank's lanes could have connected, so the default short wait
+        suffices for surviving neighbors; callers expecting a freshly
+        restarted peer pass a rendezvous-scale budget."""
+        addr = self._store.get(f"rank_{peer_rank}", wait=True, timeout_ms=timeout_ms)
+        cfg = self._store.get(f"cfg_{peer_rank}", wait=True, timeout_ms=timeout_ms)
+        if addr is None or cfg is None:
+            return None
+        mode, _, token = cfg.decode().partition(":")
+        if not token:
+            return None
+        return (addr.decode(), token, mode)
 
     def _dial_rank(
         self,
@@ -1763,6 +2089,14 @@ class TCPCollective(Collective):
             for lane, peer in enumerate(peers):
                 if peer._shm_pending is None:
                     continue
+                # Reused (incremental-reconfigure) peers on the Python
+                # engine are already armed — their _ShmRing halves map the
+                # kept segment and stay valid across generations.
+                if self._engine is None and (
+                    peer._shm_tx is not None or peer._shm_rx is not None
+                ):
+                    self._shm_links += 1
+                    continue
                 path, token, role = peer._shm_pending
                 try:
                     if self._engine is not None:
@@ -1865,6 +2199,10 @@ class TCPCollective(Collective):
             if self._listener is not None:
                 self._listener.close()
                 self._listener = None
+            # The listener (and its incarnation token) is dead: no edge of
+            # ours can be reused by the next transition.
+            self._neighbor_ids = {}
+            self._self_addr = None
             self._next_lanes = []
             self._prev_lanes = []
             # Unlink every negotiated shm segment (both ends track every

@@ -746,3 +746,58 @@ class ECPlane:
 
     def coverage(self) -> Tuple[int, int]:
         return self.store.coverage()
+
+    def reshard(self) -> int:
+        """Proactive re-placement after a membership change: re-derives the
+        newest held generation's placement under the NEW peer set and
+        pushes every held shard whose new holder is a peer.  Called by the
+        Manager on the quorum thread right after a participant-set change
+        (set_peers has already installed the new membership), so coverage
+        is restored BEFORE the next fault instead of waiting for the next
+        encode interval — the window the ``tpuft_ec_shard_coverage``
+        lighthouse alert fires on.  Keeps the local copies (extra
+        redundancy is free; retention evicts them); best-effort like every
+        push path — returns the number of shards actually pushed."""
+        cfg = self.config
+        ranks, addrs, self_rank = self._membership()
+        if not cfg.enabled or self_rank is None or len(ranks) < 2:
+            return 0
+        step = self.store.latest_step()
+        if step < 0:
+            return 0
+        pushed = errors = nbytes = 0
+        for idx in self.store.have(step):
+            holder = shard_holder(step, idx, ranks)
+            if holder == self_rank:
+                continue
+            shard = self.store.get(step, idx)
+            if shard is None:
+                continue  # evicted between have() and get()
+            base = self._http_base(addrs.get(holder, ""))
+            if not base:
+                errors += 1
+                continue
+            try:
+                push_shard(base, shard, self._push_timeout)
+                pushed += 1
+                nbytes += shard.nbytes
+            except Exception as e:  # noqa: BLE001 — reshard is best-effort
+                errors += 1
+                self._peer_http.pop(addrs.get(holder, ""), None)
+                logger.warning(
+                    "ec reshard shard %d step %d to rank %s failed: %s",
+                    idx, step, holder, e,
+                )
+        if self._metrics is not None and (pushed or errors):
+            self._metrics.emit(
+                "ec_push",
+                step=step,
+                k=cfg.k,
+                m=cfg.m,
+                reshard=True,
+                held=len(self.store.have(step)),
+                pushed=pushed,
+                push_errors=errors,
+                push_bytes=nbytes,
+            )
+        return pushed

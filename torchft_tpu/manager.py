@@ -332,6 +332,28 @@ class Manager:
         # accounting at start_quorum and flushed at the commit vote.
         self._summary_extra: Dict[str, object] = {}
 
+        # Elastic batch engine (docs/architecture.md "Elastic scale"): when
+        # TPUFT_ELASTIC_GLOBAL_BATCH is set, every quorum transition rescales
+        # this group's batch share so the GLOBAL batch stays constant across
+        # membership churn — join/leave changes throughput, never the
+        # training trajectory's effective batch.  The plan for the current
+        # participant count is exposed via elastic_plan() (train loops read
+        # group_batch/accum_steps from it) and stamped into every committed
+        # step record.  Membership callbacks fire on the quorum thread after
+        # the collective reconfigures, before the step proceeds — data
+        # loaders re-shard there.  Lazy import: ddp imports Manager at
+        # module level, so the reverse import must happen at runtime.
+        self._elastic = None
+        self._elastic_plan: Optional[Dict[str, object]] = None
+        try:
+            from torchft_tpu.ddp import ElasticBatchScaler
+
+            self._elastic = ElasticBatchScaler.from_env()
+        except Exception:  # noqa: BLE001 — elastic must not break startup
+            self._elastic = None
+        self._membership_callbacks: List[Callable[[Dict[str, object]], None]] = []
+        self._last_participants: Optional[List[int]] = None
+
         # Erasure-coded peer state (torchft_tpu/ec, docs/architecture.md
         # "Donor-free healing"): when TPUFT_EC_K > 0 and the checkpoint
         # transport can host a shard store, every committed step's state is
@@ -663,6 +685,10 @@ class Manager:
                     f"{store_address}/{prefix}", replica_rank, replica_world_size
                 )
             self._quorum_id = quorum_id
+            # The collective records how the configure went (full rendezvous
+            # vs incremental lane reuse).  The wrappers don't proxy unknown
+            # attributes, so read defensively.
+            lc = getattr(self._collective, "last_configure", None) or {}
             self._metrics.emit(
                 "reconfigure",
                 step=self._step,
@@ -670,6 +696,12 @@ class Manager:
                 replica_rank=replica_rank,
                 replica_world_size=replica_world_size,
                 configure_ms=sp_cfg.duration_ms,
+                mode=lc.get("mode", "unknown"),
+                reused_lanes=lc.get("reused_lanes", 0),
+                opened_lanes=lc.get("opened_lanes", 0),
+            )
+            self._on_membership_change(
+                quorum, quorum_id, replica_world_size, sp_cfg.duration_ms, lc
             )
 
         if allow_heal and self._checkpoint_transport is not None:
@@ -1157,6 +1189,106 @@ class Manager:
         with self._ar_lock:
             self._summary_extra.update(fields)
 
+    def register_membership_callback(
+        self, cb: Callable[[Dict[str, object]], None]
+    ) -> None:
+        """Registers ``cb`` to run on every quorum transition that changes
+        the participant set.  The callback receives the same payload the
+        ``membership_change`` event carries — old/new participant replica
+        ranks, joined/left deltas, transition wall time, configure mode,
+        and the refreshed elastic plan (None when the elastic batch engine
+        is off).  It runs on the quorum thread after the collective is
+        reconfigured and before the step proceeds, so a data loader can
+        re-shard before the next batch is drawn.  Exceptions are swallowed
+        and logged: a resize hook must never fail the step."""
+        self._membership_callbacks.append(cb)
+
+    def elastic_plan(self) -> Optional[Dict[str, object]]:
+        """The elastic batch plan for the current participant count, or
+        None when the elastic batch engine is off (TPUFT_ELASTIC_GLOBAL_BATCH
+        unset) or no quorum has formed yet.  Keys: participants,
+        global_batch, group_batch (this group's share), microbatch,
+        accum_steps, lr_scale.  Stable between quorum transitions."""
+        return self._elastic_plan
+
+    def _on_membership_change(
+        self,
+        quorum: object,
+        quorum_id: int,
+        replica_world_size: int,
+        configure_ms: float,
+        last_configure: Dict[str, object],
+    ) -> None:
+        """Post-reconfigure membership bookkeeping: refresh the elastic
+        batch plan, proactively re-shard the EC plane, emit the
+        ``membership_change`` event, and fire registered callbacks.  Runs
+        on the quorum thread for every quorum-id change; the event and
+        callbacks fire only when the participant SET actually changed
+        (a quorum id can change without membership churn, e.g. a
+        lighthouse failover re-issuing the same membership)."""
+        new_participants = sorted(
+            list(getattr(quorum, "participant_replica_ranks", []) or [])
+            or range(replica_world_size)
+        )
+        old_participants = self._last_participants
+        self._last_participants = new_participants
+
+        # Refresh the elastic plan from the PARTICIPATING world (healing
+        # groups contribute zeros and take no batch share) so the global
+        # batch stays constant across the transition.
+        if self._elastic is not None:
+            participants = self._participating_replica_world_size or len(
+                new_participants
+            )
+            try:
+                self._elastic_plan = self._elastic.plan(
+                    participants, rank=self._participating_replica_rank
+                )
+            except Exception as e:  # noqa: BLE001 — resize must not fail a step
+                self._logger.warn(f"elastic plan failed: {e}")
+
+        if old_participants == new_participants:
+            return
+
+        # Proactive EC re-shard: re-place the latest shard generation under
+        # the new membership so coverage is restored BEFORE the next fault,
+        # not after (the tpuft_ec_shard_coverage alert fires on the gap).
+        if self._ec is not None and hasattr(self._ec, "reshard"):
+            try:
+                self._ec.reshard()
+            except Exception as e:  # noqa: BLE001
+                self._logger.warn(f"ec reshard failed: {e}")
+
+        old_set = set(old_participants or [])
+        new_set = set(new_participants)
+        payload: Dict[str, object] = {
+            "quorum_id": quorum_id,
+            "old_participants": old_participants,
+            "new_participants": new_participants,
+            "joined": sorted(new_set - old_set),
+            "left": sorted(old_set - new_set),
+            "transition_s": configure_ms / 1e3,
+            "mode": last_configure.get("mode", "unknown"),
+            "elastic_plan": self._elastic_plan,
+        }
+        self._metrics.emit("membership_change", step=self._step, **payload)
+        # Also land the transition on this step's step_summary record so a
+        # slow step reads its cause inline (resize vs fault) without joining
+        # against the membership_change stream.
+        self.note_summary_fields(
+            membership_change={
+                "joined": payload["joined"],
+                "left": payload["left"],
+                "transition_s": payload["transition_s"],
+                "mode": payload["mode"],
+            }
+        )
+        for cb in self._membership_callbacks:
+            try:
+                cb(dict(payload))
+            except Exception as e:  # noqa: BLE001
+                self._logger.warn(f"membership callback failed: {e}")
+
     @property
     def metrics(self):
         """The Manager's :class:`~torchft_tpu.metrics.MetricsLogger`.
@@ -1633,6 +1765,22 @@ class Manager:
             self._h2d_bytes = 0
             self._summary_extra = {}
         ar_fields: Dict[str, object] = dict(summary_extra)
+        # Elastic invariant audit trail: every committed step record carries
+        # the plan it trained under, so the bench (and any postmortem) can
+        # assert the global batch never moved across membership churn.
+        if self._elastic_plan is not None:
+            ar_fields.setdefault(
+                "elastic_global_batch", self._elastic_plan["global_batch"]
+            )
+            ar_fields.setdefault(
+                "elastic_group_batch", self._elastic_plan["group_batch"]
+            )
+            ar_fields.setdefault(
+                "elastic_accum_steps", self._elastic_plan["accum_steps"]
+            )
+            ar_fields.setdefault(
+                "elastic_participants", self._elastic_plan["participants"]
+            )
         if d2h_bytes or h2d_bytes:
             ar_fields["d2h_bytes"] = d2h_bytes
             ar_fields["h2d_bytes"] = h2d_bytes

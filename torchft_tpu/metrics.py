@@ -47,7 +47,15 @@ SCHEMA_VERSION = 1
 EVENTS = {
     # -- Manager step lifecycle (torchft_tpu/manager.py) --------------------
     "quorum": "quorum result for a step (membership, participation, quorum_ms)",
-    "reconfigure": "cross-group collective rebuilt for a new quorum id",
+    "reconfigure": "cross-group collective rebuilt for a new quorum id "
+                   "(mode=full|incremental, reused/opened lane counts)",
+    "membership_change": "participant set changed across a quorum "
+                         "transition (old/new participant replica ranks, "
+                         "joined/left delta, transition_s wall time, "
+                         "configure mode, elastic plan when the elastic "
+                         "batch engine is on) — what the elastic bench and "
+                         "the incident verdict read to attribute resize "
+                         "cost",
     "heal_start": "this replica began fetching weights from its donors "
                   "(n_donors = striped multi-donor fan-in)",
     "heal_fetched": "healed state dict received (heal_ms = fetch duration, "

@@ -547,4 +547,30 @@ def verdict(bundle: str, events: Optional[Sequence[dict]] = None) -> dict:
         out["delta_by_replica"] = incident.get("delta_by_replica") or {}
         cs = out["charged_seconds"]
         out["lost_s"] = round(float(cs), 3) if cs else None
+
+    # Membership context: every verdict carries the churn timeline around
+    # the incident — a goodput dip or kill during an elastic resize reads
+    # differently from one in steady state (the resize cost is charged to
+    # the ledger's "resize" cause, not the fault).  Most recent last;
+    # bounded so a long churn soak does not bloat the manifest.
+    changes = [
+        {
+            "step": ev.get("step"),
+            "ts": ev.get("ts"),
+            "replica_id": ev.get("replica_id"),
+            "old_participants": ev.get("old_participants"),
+            "new_participants": ev.get("new_participants"),
+            "joined": ev.get("joined"),
+            "left": ev.get("left"),
+            "transition_s": ev.get("transition_s"),
+            "mode": ev.get("mode"),
+        }
+        for ev in events
+        if ev.get("event") == "membership_change"
+    ]
+    if changes:
+        out["membership_changes"] = changes[-8:]
+        out["resize_transition_s"] = round(
+            sum(float(c.get("transition_s") or 0.0) for c in changes), 3
+        )
     return out

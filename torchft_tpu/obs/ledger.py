@@ -94,6 +94,7 @@ CAUSES = (
     "heal",
     "drain",
     "other_ft",
+    "resize",
 )
 LOST_CAUSES = CAUSES[1:]
 
@@ -101,8 +102,11 @@ LOST_CAUSES = CAUSES[1:]
 # the wall time the hop-stall deltas distribute over.
 _AR_BLOCK_PHASES = ("allreduce_merge", "allreduce_d2h", "allreduce_h2d")
 # Phases with their own cause class (everything else non-overlapped falls
-# into other_ft / drain).
-_CLASSIFIED_PHASES = ("quorum", "heal", "ec_reconstruct") + _AR_BLOCK_PHASES
+# into other_ft / drain).  "configure" is the membership-transition
+# reconfigure (lane rendezvous + engine rebuild) — the ``resize`` cause,
+# so seconds lost to elastic membership churn are named, never smeared
+# into other_ft.
+_CLASSIFIED_PHASES = ("quorum", "heal", "ec_reconstruct", "configure") + _AR_BLOCK_PHASES
 
 
 def epoch_bank(slot: List[float], value: float) -> None:
@@ -245,6 +249,7 @@ class StepLedger:
             causes["quorum_server"] = q_server
             causes["quorum_transport"] = q_transport
             causes["heal"] = heal
+            causes["resize"] = float(phases_ms.get("configure", 0.0)) / 1e3
             # Distribute the train-thread's allreduce-blocking time over the
             # wire classes proportionally to this step's hop-stall deltas.
             hop_sum = sum(hop_d.values()) if hop_d else 0.0

@@ -55,9 +55,20 @@ from torchft_tpu.semisync.engine import SyncEngine
 from torchft_tpu.semisync.fragments import FragmentPlan
 from torchft_tpu.semisync.metrics import SemiSyncMetrics
 
-__all__ = ["StreamingDiLoCo", "TPUFT_SEMISYNC_STREAM_ENV"]
+__all__ = [
+    "StreamingDiLoCo",
+    "TPUFT_SEMISYNC_STREAM_ENV",
+    "TPUFT_SEMISYNC_FRAGMENT_COMMIT_ENV",
+]
 
 TPUFT_SEMISYNC_STREAM_ENV = "TPUFT_SEMISYNC_STREAM"
+# Fragment-granular commit (default off): every fragment's pseudogradient
+# round runs under its OWN quorum + commit vote, so a membership change
+# (elastic resize, peer death) mid-round fails only the in-flight
+# fragment's vote — the fragments whose votes already passed keep their
+# outer steps.  The round-level default keeps one vote for the whole round
+# (cheapest; all-or-nothing on churn).
+TPUFT_SEMISYNC_FRAGMENT_COMMIT_ENV = "TPUFT_SEMISYNC_FRAGMENT_COMMIT"
 
 
 def _codec_from_env(explicit: Optional[str]) -> str:
@@ -115,6 +126,7 @@ class StreamingDiLoCo:
         set_fragment_params: Optional[
             Callable[[List[int], List[np.ndarray]], None]
         ] = None,
+        fragment_commit: Optional[bool] = None,
     ) -> None:
         """``outer_scope``: "fragment" (default) keeps one optax state per
         fragment and applies the outer update fragment-locally — the
@@ -134,7 +146,25 @@ class StreamingDiLoCo:
         ``set_params`` reset is skipped entirely (it would re-land every
         byte a second time).  Aborted rounds still reset through the
         whole-tree ``set_params`` — inner steps moved ALL leaves, and the
-        backup they roll back to predates this round's fragments."""
+        backup they roll back to predates this round's fragments.
+
+        ``fragment_commit`` (env ``TPUFT_SEMISYNC_FRAGMENT_COMMIT``,
+        default off): fragment-granular fault containment for elastic
+        fleets.  Each fragment's pseudogradient round becomes its OWN
+        Manager step — quorum armed at the fragment's issue slot on the
+        train thread (heals and elastic reconfiguration stay off the
+        worker), the reduce overlaps inner steps as usual, and the vote +
+        outer apply land at the NEXT fragment's slot.  A resize or peer
+        death mid-round therefore fails exactly one fragment's vote: that
+        fragment's backup stands and its live leaves roll back through the
+        write-back hook, while every fragment whose vote already passed
+        keeps its outer step (the Streaming DiLoCo partial-updates shape)
+        — the round-level default would discard the whole round's wire
+        traffic.  Costs one quorum + vote per FRAGMENT instead of per
+        round; requires ``set_fragment_params`` (fragment scope).  Replica
+        consistency is preserved: votes are collective and write-backs
+        land at schedule-identical slots, so all groups' live params stay
+        bitwise identical."""
         if manager._use_async_quorum:
             raise ValueError(
                 "StreamingDiLoCo requires synchronous quorum: construct the "
@@ -198,6 +228,23 @@ class StreamingDiLoCo:
                 "whole-tree outer update has no per-fragment commit moment"
             )
         self._set_fragment_params = set_fragment_params
+        self._fragment_commit = (
+            bool(fragment_commit)
+            if fragment_commit is not None
+            else _env_flag(TPUFT_SEMISYNC_FRAGMENT_COMMIT_ENV, False)
+        )
+        if self._fragment_commit and set_fragment_params is None:
+            raise ValueError(
+                "fragment_commit requires set_fragment_params: a failed "
+                "fragment vote rolls back ONLY that fragment's leaves, "
+                "which needs the partial write-back hook"
+            )
+        # Fragment-commit round state: the fragment whose vote is still
+        # outstanding, and how many votes failed this round.
+        self._pending_fragment = None
+        self._round_failed = 0
+        self._round_open = False
+        self._post_vote = False
         if outer_scope == "fragment":
             self._outer_states: Any = [
                 outer_tx.init([self._leaves[i] for i in f.bucket.indices])
@@ -330,6 +377,9 @@ class StreamingDiLoCo:
         the round's quorum at the first inner step and issues fragments at
         their scheduled slots; the final step of the round runs
         :meth:`sync`."""
+        if self._fragment_commit:
+            self._step_fragment_commit()
+            return
         if (
             self._stream
             and not self._armed
@@ -381,6 +431,9 @@ class StreamingDiLoCo:
         the same cadence even when a sync dies mid-quorum."""
         from torchft_tpu.manager import ExceededMaxRetriesError
 
+        if self._fragment_commit:
+            self._sync_fragment_commit()
+            return
         self._round_closed = False
         self._voted = False
         self._vote_passed = False
@@ -470,6 +523,176 @@ class StreamingDiLoCo:
         # bytes; skip it.
         if not applied_inplace:
             self._set_params(self.backup_params)
+
+    # -- fragment-granular commit (see __init__ docstring) -------------------
+
+    def _step_fragment_commit(self) -> None:
+        """Inner-step tick in fragment-commit mode: at a fragment's slot,
+        settle the previous fragment's vote first (its reduce has been
+        overlapping inner steps since its own slot), then arm this
+        fragment's quorum and issue its reduce."""
+        self._local_step += 1
+        due = [
+            f
+            for f in self._schedule.get(self._local_step, ())
+            if f.index not in self._issued
+        ]
+        for frag in due:
+            self._finish_pending_fragment()
+            self._issue_fragment(frag)
+        if self._local_step >= self._sync_every:
+            self.sync()
+
+    def _issue_fragment(self, frag) -> None:
+        """Arms one fragment's quorum (train thread — heals and elastic
+        reconfiguration happen here, never on the worker) and submits its
+        reduce.  An arm failure latches; the fragment's vote then fails at
+        settle time and only ITS leaves roll back."""
+        self._issued.add(frag.index)
+        self._pending_fragment = frag
+        try:
+            self._manager.start_quorum()
+            self._armed = True
+        except Exception as e:  # noqa: BLE001 — latch, keep cadence
+            try:
+                self._manager.report_error(e)
+            except Exception:  # noqa: BLE001 — mocked managers
+                pass
+            return
+        if not self._round_open:
+            self._engine.begin_round()
+            self._round_open = True
+        leaves = self._jax.tree.flatten(self._get_params())[0]
+        self._engine.submit(frag, leaves)
+
+    def _finish_pending_fragment(self) -> None:
+        """Settles the outstanding fragment: drain its reduce, vote, and
+        apply-or-rollback just that fragment.  A post-vote apply failure
+        raises (peers were told the fragment committed — heal back rather
+        than diverge silently), same contract as the round-level path."""
+        frag = self._pending_fragment
+        if frag is None:
+            return
+        self._pending_fragment = None
+        results: Dict[int, np.ndarray] = {}
+        if self._armed:
+            try:
+                results = self._engine.drain()
+            except Exception as e:  # noqa: BLE001 — mocked managers
+                try:
+                    self._manager.report_error(e)
+                except Exception:  # noqa: BLE001
+                    pass
+            # Running round accounting lands on THIS fragment's step
+            # record before its vote flushes it.
+            self._note_summary(self._engine.round_stats())
+        committed = False
+        if self._armed:
+            self._armed = False
+            try:
+                committed = bool(self._manager.should_commit())
+            except Exception as e:  # noqa: BLE001 — vote itself failing
+                from torchft_tpu.manager import ExceededMaxRetriesError
+
+                if isinstance(e, ExceededMaxRetriesError):
+                    raise
+                try:
+                    self._manager.report_error(e)
+                except Exception:  # noqa: BLE001
+                    pass
+        if not committed:
+            self._round_failed += 1
+        flat = results.get(frag.index) if committed else None
+        if committed and flat is not None:
+            # Post-vote apply: peers were told this fragment committed, so
+            # a failure here must RAISE (heal back to the committed state)
+            # — _post_vote marks the window for the sync-level handler.
+            self._post_vote = True
+            self._apply_one_fragment(frag, flat)
+            self._post_vote = False
+        else:
+            try:
+                self._apply_one_fragment(frag, None)
+            except Exception:  # noqa: BLE001 — leave local params standing
+                pass
+        self._engine.promote_fragment(frag, committed)
+
+    def _apply_one_fragment(self, frag, flat: Optional[np.ndarray]) -> None:
+        """One fragment's outer step (vote passed, ``flat`` is its averaged
+        pseudogradient) or rollback (``flat`` is None): either way exactly
+        this fragment's leaves land on device through the write-back hook —
+        the surrounding fragments are untouched."""
+        import optax
+
+        write_back = self._set_fragment_params
+        assert write_back is not None  # enforced at construction
+        if flat is None:
+            # Failed vote: the backup stands; roll only this fragment's
+            # live leaves back to it (inner steps moved them).
+            write_back(
+                list(frag.bucket.indices),
+                [self._leaves[i] for i in frag.bucket.indices],
+            )
+            return
+        k = frag.index
+        pg_leaves = [np.ascontiguousarray(arr) for _i, arr in frag.unpack(flat)]
+        backup_leaves = [self._leaves[i] for i in frag.bucket.indices]
+        updates, self._outer_states[k] = self._outer_tx.update(
+            pg_leaves, self._outer_states[k], backup_leaves
+        )
+        new_leaves = optax.apply_updates(backup_leaves, updates)
+        for i, nl in zip(frag.bucket.indices, new_leaves):
+            self._leaves[i] = np.asarray(nl)
+        write_back(
+            list(frag.bucket.indices),
+            [self._leaves[i] for i in frag.bucket.indices],
+        )
+        self._codecs[k].set_backup(frag.pack(self._leaves))
+
+    def _sync_fragment_commit(self) -> None:
+        """Round boundary in fragment-commit mode: settle the last
+        outstanding fragment, run any never-issued stragglers (all of them
+        in blocking mode) as their own mini-rounds, then emit the round's
+        accounting.  There is no round-level vote and no whole-tree reset:
+        every fragment already landed (or rolled back) at its own commit
+        moment."""
+        from torchft_tpu.manager import ExceededMaxRetriesError
+
+        try:
+            self._finish_pending_fragment()
+            for frag in self._plan.fragments:
+                if frag.index not in self._issued:
+                    self._issue_fragment(frag)
+                    self._finish_pending_fragment()
+            stats = self._engine.round_stats()
+            committed = self._round_failed == 0
+            try:
+                round_step = int(self._manager.current_step())
+            except (TypeError, ValueError):  # mocked managers
+                round_step = -1
+            if self._round_open:
+                self._engine.end_round(committed=committed, promote=False)
+            self._emit_round(stats, committed, round_step)
+        except ExceededMaxRetriesError:
+            raise
+        except Exception as e:  # noqa: BLE001 — latch, never desync cadence
+            if self._post_vote:
+                # A committed fragment's apply failed — peers already
+                # advanced; crash and heal rather than silently diverge.
+                raise
+            try:
+                self._manager.report_error(e)
+            except Exception:  # noqa: BLE001 — mocked managers
+                pass
+        finally:
+            self._local_step = 0
+            self._armed = False
+            self._arm_attempted = False
+            self._issued = set()
+            self._pending_fragment = None
+            self._round_failed = 0
+            self._round_open = False
+            self._post_vote = False
 
     def _apply(self, results: Dict[int, np.ndarray]) -> bool:
         """Outer optimizer step on the averaged pseudogradients —

@@ -243,14 +243,27 @@ class SyncEngine:
                 "d2h_bytes": self._round_d2h_bytes,
             }
 
-    def end_round(self, committed: bool) -> Dict[str, int]:
+    def promote_fragment(self, fragment: Fragment, committed: bool) -> None:
+        """Per-fragment codec promotion for fragment-commit mode: promotes
+        or discards ONE fragment's pending codec state (EF residuals) at
+        its own vote, instead of the round-level sweep in end_round."""
+        codec = self._codecs[fragment.index]
+        if committed:
+            codec.on_commit()
+        else:
+            codec.on_abort()
+
+    def end_round(self, committed: bool, promote: bool = True) -> Dict[str, int]:
         """Round bookkeeping: promotes or discards every codec's pending
-        state and reports the round's accounting."""
-        for codec in self._codecs:
-            if committed:
-                codec.on_commit()
-            else:
-                codec.on_abort()
+        state and reports the round's accounting.  ``promote=False``
+        (fragment-commit mode) skips the codec sweep — each fragment's
+        state was already settled at its own vote by promote_fragment."""
+        if promote:
+            for codec in self._codecs:
+                if committed:
+                    codec.on_commit()
+                else:
+                    codec.on_abort()
         self.metrics.observe_round(committed=committed)
         with self._lock:
             self.metrics.observe_overlap_ms(self._round_overlap_ms)
